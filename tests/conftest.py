@@ -1,0 +1,27 @@
+"""Test config: force an 8-device virtual CPU platform before jax initializes.
+
+Mirrors how the reference tests distributed behavior fully in-process
+(ref: pkg/testkit/mockstore.go CreateMockStore + unistore region splitting):
+we get an 8-device mesh on CPU so shard_map/psum/all_to_all paths run
+without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

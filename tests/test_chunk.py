@@ -1,0 +1,61 @@
+import numpy as np
+import pytest
+
+from tidb_tpu.types import Datum, MyDecimal, new_decimal, new_double, new_longlong, new_varchar
+from tidb_tpu.chunk import Chunk, Column, to_device_batch
+from tidb_tpu.chunk.device import pack_string_words
+
+import jax.numpy as jnp
+
+
+def make_chunk():
+    fts = [new_longlong(), new_double(), new_decimal(15, 2), new_varchar(16)]
+    rows = [
+        [Datum.i64(1), Datum.f64(1.5), Datum.dec("10.25"), Datum.string("apple")],
+        [Datum.i64(-7), Datum.NULL, Datum.dec("-3.10"), Datum.string("banana")],
+        [Datum.NULL, Datum.f64(2.25), Datum.NULL, Datum.NULL],
+    ]
+    return Chunk.from_rows(fts, rows), rows
+
+
+def test_chunk_roundtrip():
+    ch, rows = make_chunk()
+    assert ch.num_rows() == 3
+    got = ch.rows()
+    assert got[0][0].val == 1
+    assert got[1][2].val == MyDecimal("-3.10")
+    assert got[2][3].is_null()
+    assert got[0][3].val == "apple"
+
+
+def test_chunk_take_concat():
+    ch, _ = make_chunk()
+    sub = ch.take(np.array([2, 0]))
+    assert sub.num_rows() == 2
+    assert sub.row(1)[3].val == "apple"
+    cc = Chunk.concat([ch, sub])
+    assert cc.num_rows() == 5
+    assert cc.row(4)[3].val == "apple"
+
+
+def test_device_batch_padding():
+    ch, _ = make_chunk()
+    db = to_device_batch(ch, capacity=8)
+    assert db.capacity == 8
+    assert int(db.n_rows) == 3
+    assert bool(db.row_valid[2]) and not bool(db.row_valid[3])
+    # decimal stored as scaled int64
+    assert int(db.cols[2].data[0]) == 1025
+    # null mask set for padding too
+    assert bool(db.cols[0].null[5])
+
+
+def test_pack_string_words_order():
+    ch, _ = make_chunk()
+    db = to_device_batch(ch, capacity=4)
+    col = db.cols[3]
+    words = pack_string_words(col.data, col.length)
+    # "apple" < "banana" lexicographically
+    a, b = words[0], words[1]
+    lt = (a[0] < b[0]) | ((a[0] == b[0]) & (a[1] < b[1]))
+    assert bool(lt)

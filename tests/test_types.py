@@ -1,0 +1,70 @@
+import numpy as np
+import pytest
+
+from tidb_tpu.types import (
+    Datum,
+    FieldType,
+    MyDecimal,
+    MyTime,
+    TypeCode,
+    new_decimal,
+    new_longlong,
+    new_varchar,
+    pack_datetime,
+    unpack_datetime,
+)
+
+
+class TestMyDecimal:
+    def test_scale_propagation_add(self):
+        a = MyDecimal("1.25")
+        b = MyDecimal("2.5")
+        c = a + b
+        assert c.scale == 2
+        assert str(c) == "3.75"
+
+    def test_mul_scale(self):
+        c = MyDecimal("1.50") * MyDecimal("0.06")
+        assert c.scale == 4
+        assert str(c) == "0.0900"
+
+    def test_div_frac_incr(self):
+        # MySQL: scale(a/b) = scale(a) + 4 (ref div_frac_incr)
+        c = MyDecimal("1.00").div(MyDecimal("3"))
+        assert c.scale == 6
+        assert str(c) == "0.333333"
+
+    def test_div_by_zero_is_null(self):
+        assert MyDecimal("1").div(MyDecimal("0")) is None
+
+    def test_round_half_away_from_zero(self):
+        assert str(MyDecimal("2.5", 2).round(0)) == "3"
+        assert str(MyDecimal("-2.5", 2).round(0)) == "-3"
+
+    def test_scaled_int_roundtrip(self):
+        d = MyDecimal("12345.67")
+        assert d.to_scaled_int() == 1234567
+        assert MyDecimal.from_scaled_int(1234567, 2) == d
+
+
+class TestMyTime:
+    def test_pack_order_preserving(self):
+        a = MyTime.parse("1997-12-31 23:59:59")
+        b = MyTime.parse("1998-01-01")
+        assert a.packed < b.packed
+
+    def test_roundtrip(self):
+        p = pack_datetime(1995, 3, 15, 10, 30, 45, 123456)
+        assert unpack_datetime(p) == (1995, 3, 15, 10, 30, 45, 123456)
+
+    def test_str(self):
+        assert str(MyTime.parse("1995-03-15")) == "1995-03-15"
+        assert str(MyTime.parse("1995-03-15 01:02:03")) == "1995-03-15 01:02:03"
+
+
+class TestFieldType:
+    def test_eval_types(self):
+        assert new_longlong().eval_type() == "int"
+        assert new_decimal(15, 2).eval_type() == "decimal"
+        assert new_varchar(10).eval_type() == "string"
+        assert FieldType(TypeCode.Double).eval_type() == "real"

@@ -1,0 +1,39 @@
+"""tidb_tpu — a TPU-native analytical execution framework.
+
+A from-scratch reimplementation of TiDB's query-processing capabilities
+(reference: YangKeao/tidb) designed TPU-first: columnar region batches live as
+HBM-resident device arrays, `tipb.Expr`-shaped expression trees compile to fused
+XLA programs, and the coprocessor operator set (Selection, HashAgg, StreamAgg,
+TopN, HashJoin, Limit, Projection) runs as vmapped/shard_mapped kernels over a
+`jax.sharding.Mesh`, with per-region partial aggregates psum-reduced over ICI.
+
+Package map (mirrors reference layers, SURVEY.md §1):
+  types/     MySQL type system: FieldType, Datum, MyDecimal, Time
+             (ref: pkg/types, pkg/parser/types)
+  chunk/     Columnar batches, host (numpy) + device (jax) forms
+             (ref: pkg/util/chunk)
+  codec/     Memcomparable datum codec, row format v2, table key layout
+             (ref: pkg/util/codec, pkg/util/rowcodec, pkg/tablecodec)
+  expr/      Expression IR, JAX compiler, aggregation descriptors
+             (ref: pkg/expression)
+  ops/       Device kernels for the coprocessor operator set
+             (ref: pkg/store/mockstore/unistore/cophandler/mpp_exec.go)
+  exec/      DAG executor: DAGRequest -> fused compiled program
+             (ref: unistore/cophandler/cop_handler.go)
+  store/     In-process region-sharded MVCC store (unistore analog)
+             (ref: pkg/store/mockstore/unistore)
+  distsql/   Request building, per-region task split, result merge
+             (ref: pkg/distsql, pkg/store/copr)
+  parallel/  Mesh sharding, psum partial-agg merge, all_to_all exchange
+             (ref: MPP — pkg/planner/core/fragment.go, cophandler/mpp_exec.go)
+  sql/       SQL front end: parser, planner, session, catalog
+             (ref: pkg/parser, pkg/planner, pkg/session)
+"""
+
+import jax as _jax
+
+# MySQL semantics need 64-bit ints (BIGINT, packed datetimes, scaled
+# decimals) and float64 DOUBLE; the engine is written for x64 throughout.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,91 @@
+"""Host-side dynamic value (ref: pkg/types/datum.go `Datum`).
+
+Used at the edges only — codec round-trips, constant folding, final result
+rendering, the row-at-a-time parity evaluator. The hot path never touches
+Datums; it runs on columnar device arrays.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Any, ClassVar
+
+from .mydecimal import MyDecimal
+from .mytime import MyTime
+
+
+class DatumKind(enum.IntEnum):
+    """(ref: pkg/types/datum.go:48-70 Kind* constants)."""
+
+    Null = 0
+    Int64 = 1
+    Uint64 = 2
+    Float32 = 3
+    Float64 = 4
+    String = 5
+    Bytes = 6
+    BinaryLiteral = 7
+    MysqlDecimal = 8
+    MysqlDuration = 9
+    MysqlEnum = 10
+    MysqlBit = 11
+    MysqlSet = 12
+    MysqlTime = 13
+    Interface = 14
+    MinNotNull = 15
+    MaxValue = 16
+    Raw = 17
+    MysqlJSON = 18
+
+
+@dataclass(frozen=True)
+class Datum:
+    kind: DatumKind
+    val: Any = None
+
+    NULL: ClassVar["Datum"]  # set below
+
+    @classmethod
+    def i64(cls, v: int) -> "Datum":
+        return cls(DatumKind.Int64, int(v))
+
+    @classmethod
+    def u64(cls, v: int) -> "Datum":
+        return cls(DatumKind.Uint64, int(v))
+
+    @classmethod
+    def f64(cls, v: float) -> "Datum":
+        return cls(DatumKind.Float64, float(v))
+
+    @classmethod
+    def string(cls, v: str) -> "Datum":
+        return cls(DatumKind.String, v)
+
+    @classmethod
+    def bytes_(cls, v: bytes) -> "Datum":
+        return cls(DatumKind.Bytes, v)
+
+    @classmethod
+    def dec(cls, v, scale: int | None = None) -> "Datum":
+        return cls(DatumKind.MysqlDecimal, v if isinstance(v, MyDecimal) else MyDecimal(v, scale))
+
+    @classmethod
+    def time(cls, v: MyTime) -> "Datum":
+        return cls(DatumKind.MysqlTime, v)
+
+    @classmethod
+    def duration(cls, nanos: int) -> "Datum":
+        # fsp (fractional rendering width) lives on the FieldType, not the value
+        return cls(DatumKind.MysqlDuration, int(nanos))
+
+    def is_null(self) -> bool:
+        return self.kind == DatumKind.Null
+
+    def __repr__(self):
+        if self.kind == DatumKind.Null:
+            return "NULL"
+        return f"{self.kind.name}({self.val!r})"
+
+
+Datum.NULL = Datum(DatumKind.Null)

@@ -1,0 +1,93 @@
+"""DATETIME/DATE/DURATION representations.
+
+The reference stores datetimes as a bit-packed uint64 (ref: pkg/types/time.go
+`Time.ToPackedUint` / `FromPackedUint`, the MySQL packed layout):
+
+    ymd    = (year*13 + month) << 5 | day
+    hms    = hour << 12 | minute << 6 | second
+    packed = ((ymd << 17) | hms) << 24 | microsecond
+
+The packing is order-preserving, so the packed uint64 *is* the device
+representation: comparisons, group-by keys and min/max work directly on it;
+EXTRACT-style functions unpack with shifts/masks inside kernels.
+
+DURATION is int64 nanoseconds (ref: pkg/types/time.go Duration).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+def pack_datetime(year: int, month: int, day: int, hour: int = 0, minute: int = 0,
+                  second: int = 0, microsecond: int = 0) -> int:
+    ymd = (year * 13 + month) << 5 | day
+    hms = hour << 12 | minute << 6 | second
+    return ((ymd << 17) | hms) << 24 | microsecond
+
+
+def unpack_datetime(packed: int) -> tuple[int, int, int, int, int, int, int]:
+    microsecond = packed & ((1 << 24) - 1)
+    rest = packed >> 24
+    hms = rest & ((1 << 17) - 1)
+    ymd = rest >> 17
+    day = ymd & 31
+    ym = ymd >> 5
+    year, month = divmod(ym, 13)
+    second = hms & 63
+    minute = (hms >> 6) & 63
+    hour = hms >> 12
+    return year, month, day, hour, minute, second, microsecond
+
+
+@dataclass(frozen=True)
+class MyTime:
+    """A host-side datetime value; `tp` distinguishes DATE/DATETIME/TIMESTAMP."""
+
+    packed: int
+    fsp: int = 0
+
+    @classmethod
+    def from_ymd(cls, year: int, month: int, day: int, hour: int = 0, minute: int = 0,
+                 second: int = 0, microsecond: int = 0, fsp: int = 0) -> "MyTime":
+        return cls(pack_datetime(year, month, day, hour, minute, second, microsecond), fsp)
+
+    @classmethod
+    def parse(cls, s: str, fsp: int = 0) -> "MyTime":
+        s = s.strip()
+        date_part, _, time_part = s.partition(" ")
+        y, m, d = (int(x) for x in date_part.split("-"))
+        hh = mm = ss = us = 0
+        if time_part:
+            hms, _, frac = time_part.partition(".")
+            hh, mm, ss = (int(x) for x in hms.split(":"))
+            if frac:
+                us = int(frac[:6].ljust(6, "0"))
+        return cls.from_ymd(y, m, d, hh, mm, ss, us, fsp)
+
+    def parts(self):
+        return unpack_datetime(self.packed)
+
+    def is_date_only(self) -> bool:
+        _, _, _, h, mi, s, us = self.parts()
+        return h == 0 and mi == 0 and s == 0 and us == 0
+
+    def __str__(self) -> str:
+        y, m, d, h, mi, s, us = self.parts()
+        base = f"{y:04d}-{m:02d}-{d:02d}"
+        if self.fsp > 0:
+            frac = f"{us:06d}"[: self.fsp]
+            return f"{base} {h:02d}:{mi:02d}:{s:02d}.{frac}"
+        if h or mi or s or us:
+            return f"{base} {h:02d}:{mi:02d}:{s:02d}"
+        return base
+
+    def str_full(self) -> str:
+        y, m, d, h, mi, s, us = self.parts()
+        base = f"{y:04d}-{m:02d}-{d:02d} {h:02d}:{mi:02d}:{s:02d}"
+        if self.fsp > 0:
+            return base + "." + f"{us:06d}"[: self.fsp]
+        return base
+
+    def __lt__(self, other: "MyTime") -> bool:
+        return self.packed < other.packed
