@@ -1,0 +1,136 @@
+import struct
+
+import pytest
+
+from tidb_tpu.types import Datum, DatumKind, MyDecimal, MyTime, new_decimal, new_double, new_longlong, new_varchar, new_datetime
+from tidb_tpu.codec import number, datum_codec, tablecodec
+from tidb_tpu.codec.decimal_bin import decode_decimal, encode_decimal
+from tidb_tpu.codec.rowcodec import RowEncoder, decode_row_to_datum_map
+
+
+class TestNumber:
+    def test_int_cmp_order(self):
+        vals = [-(2**63), -5, -1, 0, 1, 7, 2**63 - 1]
+        encs = [number.encode_int_cmp(v) for v in vals]
+        assert encs == sorted(encs)
+        for v, e in zip(vals, encs):
+            assert number.decode_int_cmp(e)[0] == v
+
+    def test_float_cmp_order(self):
+        vals = [float("-inf"), -1e300, -1.5, -0.0, 0.0, 2.25, 1e300, float("inf")]
+        encs = [number.encode_float_cmp(v) for v in vals]
+        assert encs == sorted(encs)
+        assert number.decode_float_cmp(number.encode_float_cmp(-1.5))[0] == -1.5
+
+    def test_bytes_cmp(self):
+        vals = [b"", b"a", b"a\x00", b"ab", b"abcdefgh", b"abcdefghi", b"b"]
+        encs = [number.encode_bytes_cmp(v) for v in vals]
+        assert encs == sorted(encs)
+        for v, e in zip(vals, encs):
+            assert number.decode_bytes_cmp(e)[0] == v
+
+    def test_varint_roundtrip(self):
+        for v in [0, 1, -1, 127, -128, 300, -300, 2**62, -(2**62)]:
+            got, _ = number.decode_varint(number.encode_varint(v))
+            assert got == v, v
+
+    def test_int_value_widths(self):
+        assert len(number.encode_int_value(1)) == 1
+        assert len(number.encode_int_value(300)) == 2
+        assert len(number.encode_int_value(70000)) == 4
+        assert len(number.encode_int_value(2**40)) == 8
+        for v in [0, -1, 127, -129, 2**20, -(2**35)]:
+            assert number.decode_int_value(number.encode_int_value(v)) == v
+
+
+class TestDecimalBin:
+    @pytest.mark.parametrize("s,prec,frac", [
+        ("0", 1, 0),
+        ("1234567890.1234", 14, 4),
+        ("-1234567890.1234", 14, 4),
+        ("0.00012345000098765", 22, 20),
+        ("12345", 5, 0),
+        ("-99.99", 4, 2),
+        ("1234567891234567890.12", 21, 2),
+    ])
+    def test_roundtrip(self, s, prec, frac):
+        d = MyDecimal(s)
+        enc = encode_decimal(d, prec, frac)
+        got, pos = decode_decimal(enc)
+        assert pos == len(enc)
+        assert got == MyDecimal(s), f"{got} != {s}"
+
+    def test_order_same_precision(self):
+        vals = ["-100.5", "-2.25", "0", "0.01", "3.5", "99.99"]
+        encs = [encode_decimal(MyDecimal(v), 6, 2)[2:] for v in vals]
+        assert encs == sorted(encs)
+
+
+class TestDatumCodec:
+    def test_roundtrip_kinds(self):
+        ds = [
+            Datum.i64(-42),
+            Datum.u64(2**63 + 5),
+            Datum.f64(2.5),
+            Datum.string("hello"),
+            Datum.NULL,
+            Datum.dec("12.345"),
+            Datum.time(MyTime.parse("1996-04-01 12:00:01")),
+        ]
+        fts = [new_longlong(), new_longlong(True), new_double(), new_varchar(8), new_longlong(), new_decimal(7, 3), new_datetime()]
+        enc = datum_codec.encode_datums(ds)
+        got = datum_codec.decode_datums(enc, fts)
+        assert got[0].val == -42
+        assert got[1].val == 2**63 + 5
+        assert got[2].val == 2.5
+        assert got[3].val == "hello"
+        assert got[4].is_null()
+        assert got[5].val == MyDecimal("12.345")
+        assert isinstance(got[6].val, MyTime) and str(got[6].val) == "1996-04-01 12:00:01"
+
+    def test_key_order_mixed(self):
+        rows = [[Datum.i64(1), Datum.string("a")], [Datum.i64(1), Datum.string("b")], [Datum.i64(2), Datum.string("a")]]
+        encs = [datum_codec.encode_datums(r) for r in rows]
+        assert encs == sorted(encs)
+
+
+class TestRowCodec:
+    def test_roundtrip_small(self):
+        fts = {1: new_longlong(), 2: new_double(), 3: new_varchar(10), 4: new_decimal(10, 2), 5: new_longlong(True)}
+        enc = RowEncoder().encode(
+            [1, 2, 3, 4, 5],
+            [Datum.i64(-7), Datum.NULL, Datum.string("xyz"), Datum.dec("55.66"), Datum.u64(2**40)],
+        )
+        got = decode_row_to_datum_map(enc, fts)
+        assert got[1].val == -7
+        assert got[2].is_null()
+        assert got[3].val == "xyz"
+        assert got[4].val == MyDecimal("55.66")
+        assert got[5].val == 2**40
+
+    def test_large_row(self):
+        fts = {1000: new_longlong(), 2: new_varchar(5)}
+        enc = RowEncoder().encode([1000, 2], [Datum.i64(9), Datum.string("ab")])
+        assert enc[1] & 1  # large flag
+        got = decode_row_to_datum_map(enc, fts)
+        assert got[1000].val == 9 and got[2].val == "ab"
+
+    def test_absent_column_is_null(self):
+        fts = {1: new_longlong(), 9: new_longlong()}
+        enc = RowEncoder().encode([1], [Datum.i64(5)])
+        got = decode_row_to_datum_map(enc, fts)
+        assert got[9].is_null()
+
+
+class TestTableCodec:
+    def test_row_key_roundtrip_and_order(self):
+        k1 = tablecodec.encode_row_key(45, -10)
+        k2 = tablecodec.encode_row_key(45, 3)
+        k3 = tablecodec.encode_row_key(46, -99)
+        assert k1 < k2 < k3
+        assert tablecodec.decode_row_key(k2) == (45, 3)
+
+    def test_index_key(self):
+        k = tablecodec.encode_index_key(7, 1, [Datum.i64(5), Datum.string("x")])
+        assert k.startswith(b"t")
+        assert b"_i" in k
