@@ -1,0 +1,798 @@
+"""Compile expression trees to fused JAX computations.
+
+The reference evaluates expressions either row-at-a-time or vectorized over
+chunk columns (ref: pkg/expression/evaluator.go, builtin_*_vec.go). Here the
+whole tree compiles into jnp operations over device columns, so XLA fuses the
+entire predicate/projection into the surrounding kernel — the TPU-native
+version of the "closure executor" fused fast path
+(ref: unistore/cophandler/closure_exec.go:165).
+
+Value model: every node yields a CompVal — (value, null) arrays plus the
+FieldType. SQL three-valued logic is explicit: `null` is a bool array; the
+`value` lane of a NULL slot is unspecified but harmless (kernels mask it).
+
+Class-specific semantics (the tipb ScalarFuncSig split, e.g. GTInt vs GTReal)
+are chosen from argument FieldTypes at trace time:
+
+  int       int64 lanes; mixed signed/unsigned compares handled explicitly
+  real      float64 lanes (MySQL DOUBLE)
+  decimal   int64 lanes scaled by 10^ft.decimal — exact fixed-point
+  time      int64 lanes holding the order-preserving packed layout
+  string    int64 [N, W+1] packed big-endian words + length (device compare);
+            raw bytes ride along for pass-through projection
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..chunk.device import DeviceColumn, pack_string_words
+from ..types import Datum, DatumKind, FieldType, MyDecimal, MyTime, TypeCode
+from .ir import ColumnRef, Const, Expr, ScalarFunc
+
+I64_MIN = jnp.int64(-0x8000000000000000)
+
+
+@dataclass
+class CompVal:
+    value: jax.Array  # [N] lanes, or [N, W+1] packed words for strings
+    null: jax.Array  # bool [N]
+    ft: FieldType
+    raw: tuple | None = None  # (data[N,W] uint8, length[N] int32) for strings
+
+    @property
+    def eval_type(self) -> str:
+        return self.ft.eval_type()
+
+
+def _scale(ft: FieldType) -> int:
+    return max(ft.decimal, 0)
+
+
+def _pow10(k: int):
+    return jnp.int64(10 ** k)
+
+
+def _flip(v):
+    """Map uint64-bitcast lanes to sign-flipped int64 so signed compare
+    gives unsigned order."""
+    return v ^ I64_MIN
+
+
+def _round_div(num, den):
+    """Integer divide rounding half away from zero (MySQL decimal/int rules)."""
+    sign = jnp.where((num < 0) ^ (den < 0), jnp.int64(-1), jnp.int64(1))
+    n, d = jnp.abs(num), jnp.abs(den)
+    q = (2 * n + d) // (2 * d)
+    return sign * q
+
+
+def _words_cmp(a, b):
+    """Lexicographic compare of [N, W] int64 word arrays -> (-1/0/1)[N]."""
+    neq = a != b
+    any_neq = neq.any(axis=-1)
+    idx = jnp.argmax(neq, axis=-1)
+    av = jnp.take_along_axis(a, idx[:, None], axis=-1)[:, 0]
+    bv = jnp.take_along_axis(b, idx[:, None], axis=-1)[:, 0]
+    lt = any_neq & (av < bv)
+    gt = any_neq & (av > bv)
+    return jnp.where(lt, -1, jnp.where(gt, 1, 0)).astype(jnp.int32)
+
+
+class ExprCompiler:
+    """Compiles Expr trees against a fixed input schema."""
+
+    def __init__(self, input_fts: list[FieldType]):
+        self.input_fts = input_fts
+
+    # -- entry ---------------------------------------------------------------
+    def run(self, exprs: list[Expr], cols: list[DeviceColumn]) -> list[CompVal]:
+        """Trace `exprs` over device columns (called inside jit)."""
+        self._cols = cols
+        self._n = cols[0].null.shape[0] if cols else 1
+        self._col_cache: dict[int, CompVal] = {}
+        return [self._eval(e) for e in exprs]
+
+    # -- dispatch ------------------------------------------------------------
+    def _eval(self, e: Expr) -> CompVal:
+        if isinstance(e, ColumnRef):
+            return self._column(e)
+        if isinstance(e, Const):
+            return self._const(e)
+        if isinstance(e, ScalarFunc):
+            fn = getattr(self, f"_op_{e.op}", None)
+            if fn is None:
+                raise NotImplementedError(f"scalar op {e.op} not implemented on device")
+            return fn(e)
+        raise TypeError(f"unknown expr node {e!r}")
+
+    def _column(self, e: ColumnRef) -> CompVal:
+        if e.index in self._col_cache:
+            return self._col_cache[e.index]
+        c = self._cols[e.index]
+        if c.is_varlen():
+            words = pack_string_words(c.data, c.length)
+            v = CompVal(words, c.null, e.ft, raw=(c.data, c.length))
+        else:
+            data = c.data
+            if data.dtype != jnp.int64 and e.ft.eval_type() not in ("real",):
+                data = data.astype(jnp.int64)
+            v = CompVal(data, c.null, e.ft)
+        self._col_cache[e.index] = v
+        return v
+
+    def _const(self, e: Const) -> CompVal:
+        n = self._n
+        d = e.datum
+        if d.is_null():
+            et = e.ft.eval_type()
+            dt = jnp.float64 if et == "real" else jnp.int64
+            return CompVal(jnp.zeros(n, dt), jnp.ones(n, bool), e.ft)
+        et = e.ft.eval_type()
+        if et == "real":
+            v = jnp.full(n, float(d.val), jnp.float64)
+        elif et == "decimal":
+            dec = d.val if isinstance(d.val, MyDecimal) else MyDecimal(d.val)
+            v = jnp.full(n, dec.to_scaled_int(_scale(e.ft)), jnp.int64)
+        elif et == "time":
+            packed = d.val.packed if isinstance(d.val, MyTime) else int(d.val)
+            v = jnp.full(n, packed, jnp.int64)
+        elif et == "string":
+            b = d.val.encode() if isinstance(d.val, str) else bytes(d.val)
+            import numpy as np
+
+            w = max(1, len(b))
+            data = np.zeros((1, w), np.uint8)
+            data[0, : len(b)] = np.frombuffer(b, np.uint8)
+            words = pack_string_words(jnp.asarray(data), jnp.asarray(np.array([len(b)], np.int32)))
+            v = jnp.broadcast_to(words, (n, words.shape[1]))
+            return CompVal(v, jnp.zeros(n, bool), e.ft, raw=(jnp.broadcast_to(jnp.asarray(data), (n, w)), jnp.full(n, len(b), jnp.int32)))
+        else:
+            v = jnp.full(n, int(d.val), jnp.int64)
+        return CompVal(v, jnp.zeros(n, bool), e.ft)
+
+    # -- coercion ------------------------------------------------------------
+    @staticmethod
+    def _common_class(a: CompVal, b: CompVal) -> str:
+        ea, eb = a.eval_type, b.eval_type
+        if "string" in (ea, eb) and ea == eb:
+            return "string"
+        if "real" in (ea, eb):
+            return "real"
+        if "decimal" in (ea, eb):
+            return "decimal"
+        if "time" in (ea, eb):
+            return "time"
+        return "int"
+
+    def _to_class(self, v: CompVal, cls: str, scale: int | None = None) -> CompVal:
+        et = v.eval_type
+        if cls == "real":
+            if et == "real":
+                return v
+            if et == "decimal":
+                return CompVal(v.value.astype(jnp.float64) / float(10 ** _scale(v.ft)), v.null, FieldType(TypeCode.Double))
+            if v.ft.is_unsigned():
+                # uint64 bit-pattern -> f64 without sign error
+                val = v.value
+                as_f = jnp.where(val >= 0, val.astype(jnp.float64), val.astype(jnp.float64) + 2.0**64)
+                return CompVal(as_f, v.null, FieldType(TypeCode.Double))
+            return CompVal(v.value.astype(jnp.float64), v.null, FieldType(TypeCode.Double))
+        if cls == "decimal":
+            s = _scale(v.ft) if scale is None else scale
+            if et == "decimal":
+                return self._rescale_dec(v, s)
+            if et == "int":
+                from ..types import new_decimal
+
+                ft = new_decimal(20, 0)
+                vv = CompVal(v.value, v.null, ft)
+                return self._rescale_dec(vv, s)
+            if et == "real":
+                ft = FieldType(TypeCode.NewDecimal, decimal=s)
+                x = v.value * float(10 ** s)
+                # MySQL rounds half away from zero, not half-to-even
+                scaled = jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5)).astype(jnp.int64)
+                return CompVal(scaled, v.null, ft)
+        if cls in ("int", "time"):
+            return v
+        raise NotImplementedError(f"coerce {et} -> {cls}")
+
+    @staticmethod
+    def _rescale_dec(v: CompVal, s: int) -> CompVal:
+        cur = _scale(v.ft)
+        ft = v.ft.clone()
+        ft.tp = TypeCode.NewDecimal
+        ft.decimal = s
+        if s == cur:
+            return CompVal(v.value, v.null, ft)
+        if s > cur:
+            return CompVal(v.value * _pow10(s - cur), v.null, ft)
+        return CompVal(_round_div(v.value, _pow10(cur - s)), v.null, ft)
+
+    # -- arithmetic ----------------------------------------------------------
+    def _arith(self, e: ScalarFunc, int_fn, real_fn, dec_fn):
+        a, b = self._eval(e.args[0]), self._eval(e.args[1])
+        cls = self._common_class(a, b)
+        null = a.null | b.null
+        if cls == "real":
+            a, b = self._to_class(a, "real"), self._to_class(b, "real")
+            out = real_fn(a.value, b.value)
+            return CompVal(out, null, e.ft)
+        if cls == "decimal":
+            return dec_fn(a, b, null, e.ft)
+        out = int_fn(a.value, b.value)
+        return CompVal(out, null, e.ft)
+
+    def _dec_addsub(self, sign: int):
+        def fn(a: CompVal, b: CompVal, null, ft):
+            s = max(_scale(a.ft), _scale(b.ft))
+            av = self._to_class(a, "decimal", s).value
+            bv = self._to_class(b, "decimal", s).value
+            out = av + sign * bv
+            return self._rescale_dec(CompVal(out, null, FieldType(TypeCode.NewDecimal, decimal=s)), _scale(ft))
+
+        return fn
+
+    def _op_plus(self, e):
+        return self._arith(e, lambda a, b: a + b, lambda a, b: a + b, self._dec_addsub(1))
+
+    def _op_minus(self, e):
+        return self._arith(e, lambda a, b: a - b, lambda a, b: a - b, self._dec_addsub(-1))
+
+    def _op_mul(self, e):
+        def dec(a: CompVal, b: CompVal, null, ft):
+            av, bv = self._to_class(a, "decimal"), self._to_class(b, "decimal")
+            s = _scale(av.ft) + _scale(bv.ft)
+            out = av.value * bv.value
+            return self._rescale_dec(CompVal(out, null, FieldType(TypeCode.NewDecimal, decimal=s)), _scale(ft))
+
+        return self._arith(e, lambda a, b: a * b, lambda a, b: a * b, dec)
+
+    def _op_div(self, e):
+        """`/`: reals divide; ints & decimals use decimal division with the
+        +4 scale increment (ref: cop_handler.go:350-354, mydecimal DivFracIncr).
+        Division by zero yields NULL."""
+        a, b = self._eval(e.args[0]), self._eval(e.args[1])
+        if self._common_class(a, b) == "real":
+            a, b = self._to_class(a, "real"), self._to_class(b, "real")
+            zero = b.value == 0.0
+            null = a.null | b.null | zero
+            out = a.value / jnp.where(zero, 1.0, b.value)
+            return CompVal(out, null, e.ft)
+        av, bv = self._to_class(a, "decimal"), self._to_class(b, "decimal")
+        sr = _scale(e.ft)
+        k = sr - _scale(av.ft) + _scale(bv.ft)
+        zero = bv.value == 0
+        null = a.null | b.null | zero
+        num = av.value * _pow10(max(k, 0))
+        den = jnp.where(zero, jnp.int64(1), bv.value)
+        out = _round_div(num, den)
+        if k < 0:
+            out = _round_div(out, _pow10(-k))
+        return CompVal(out, null, e.ft)
+
+    def _op_intdiv(self, e):
+        a, b = self._eval(e.args[0]), self._eval(e.args[1])
+        if self._common_class(a, b) == "real":
+            av, bv = self._to_class(a, "real"), self._to_class(b, "real")
+            zero = bv.value == 0.0
+            null = a.null | b.null | zero
+            q = av.value / jnp.where(zero, 1.0, bv.value)
+            out = jnp.trunc(q).astype(jnp.int64)
+            return CompVal(out, null, e.ft)
+        if self._common_class(a, b) == "decimal":
+            av, bv = self._to_class(a, "decimal"), self._to_class(b, "decimal")
+            zero = bv.value == 0
+            null = a.null | b.null | zero
+            sa, sb = _scale(av.ft), _scale(bv.ft)
+            num, den = av.value * _pow10(sb), bv.value * _pow10(sa)
+            den = jnp.where(zero, jnp.int64(1), den)
+            q = jnp.abs(num) // jnp.abs(den)  # truncate toward zero
+            out = jnp.where((num < 0) ^ (den < 0), -q, q)
+            return CompVal(out, null, e.ft)
+        zero = b.value == 0
+        null = a.null | b.null | zero
+        den = jnp.where(zero, jnp.int64(1), b.value)
+        q = jnp.abs(a.value) // jnp.abs(den)
+        out = jnp.where((a.value < 0) ^ (den < 0), -q, q)
+        return CompVal(out, null, e.ft)
+
+    def _op_mod(self, e):
+        a, b = self._eval(e.args[0]), self._eval(e.args[1])
+        if self._common_class(a, b) == "real":
+            a, b = self._to_class(a, "real"), self._to_class(b, "real")
+            zero = b.value == 0.0
+            null = a.null | b.null | zero
+            out = jnp.fmod(a.value, jnp.where(zero, 1.0, b.value))
+            return CompVal(out, null, e.ft)
+        if self._common_class(a, b) == "decimal":
+            s = max(_scale(a.ft), _scale(b.ft))
+            av = self._to_class(a, "decimal", s).value
+            bv = self._to_class(b, "decimal", s).value
+            zero = bv == 0
+            null = a.null | b.null | zero
+            den = jnp.where(zero, jnp.int64(1), bv)
+            r = jnp.abs(av) % jnp.abs(den)
+            out = jnp.where(av < 0, -r, r)  # MySQL mod takes dividend sign
+            return CompVal(out, null, e.ft)
+        zero = b.value == 0
+        null = a.null | b.null | zero
+        den = jnp.where(zero, jnp.int64(1), b.value)
+        r = jnp.abs(a.value) % jnp.abs(den)
+        out = jnp.where(a.value < 0, -r, r)
+        return CompVal(out, null, e.ft)
+
+    def _op_unaryminus(self, e):
+        a = self._eval(e.args[0])
+        return CompVal(-a.value, a.null, e.ft)
+
+    def _op_abs(self, e):
+        a = self._eval(e.args[0])
+        return CompVal(jnp.abs(a.value), a.null, e.ft)
+
+    # -- comparison ----------------------------------------------------------
+    def _cmp(self, a: CompVal, b: CompVal):
+        """Return (-1/0/1)[N] semantic comparison of a vs b."""
+        cls = self._common_class(a, b)
+        if cls == "string":
+            return _words_cmp(a.value, b.value)
+        if cls == "real":
+            av, bv = self._to_class(a, "real").value, self._to_class(b, "real").value
+            return (jnp.sign(av - bv)).astype(jnp.int32)
+        if cls == "decimal":
+            s = max(_scale(a.ft), _scale(b.ft))
+            av = self._to_class(a, "decimal", s).value
+            bv = self._to_class(b, "decimal", s).value
+            return jnp.sign(av - bv).astype(jnp.int32)
+        # int class: handle signedness (ref: builtin_compare.go CompareInt)
+        au, bu = a.ft.is_unsigned(), b.ft.is_unsigned()
+        av, bv = a.value, b.value
+        if au and bu:
+            av, bv = _flip(av), _flip(bv)
+            return jnp.where(av < bv, -1, jnp.where(av > bv, 1, 0)).astype(jnp.int32)
+        if not au and not bu:
+            return jnp.where(av < bv, -1, jnp.where(av > bv, 1, 0)).astype(jnp.int32)
+        if au and not bu:
+            # a unsigned vs b signed: b<0 => a>b; else unsigned compare
+            c = jnp.where(_flip(av) < _flip(bv), -1, jnp.where(_flip(av) > _flip(bv), 1, 0))
+            return jnp.where(bv < 0, 1, c).astype(jnp.int32)
+        c = jnp.where(_flip(av) < _flip(bv), -1, jnp.where(_flip(av) > _flip(bv), 1, 0))
+        return jnp.where(av < 0, -1, c).astype(jnp.int32)
+
+    def _cmp_op(self, e: ScalarFunc, pred):
+        a, b = self._eval(e.args[0]), self._eval(e.args[1])
+        c = self._cmp(a, b)
+        out = pred(c).astype(jnp.int64)
+        return CompVal(out, a.null | b.null, e.ft)
+
+    def _op_eq(self, e):
+        return self._cmp_op(e, lambda c: c == 0)
+
+    def _op_ne(self, e):
+        return self._cmp_op(e, lambda c: c != 0)
+
+    def _op_lt(self, e):
+        return self._cmp_op(e, lambda c: c < 0)
+
+    def _op_le(self, e):
+        return self._cmp_op(e, lambda c: c <= 0)
+
+    def _op_gt(self, e):
+        return self._cmp_op(e, lambda c: c > 0)
+
+    def _op_ge(self, e):
+        return self._cmp_op(e, lambda c: c >= 0)
+
+    def _op_nulleq(self, e):
+        a, b = self._eval(e.args[0]), self._eval(e.args[1])
+        c = self._cmp(a, b)
+        both_null = a.null & b.null
+        eq = (c == 0) & ~a.null & ~b.null
+        return CompVal((both_null | eq).astype(jnp.int64), jnp.zeros_like(a.null), e.ft)
+
+    def _op_in(self, e):
+        a = self._eval(e.args[0])
+        hit = jnp.zeros(self._n, bool)
+        any_null = jnp.zeros(self._n, bool)
+        for arg in e.args[1:]:
+            b = self._eval(arg)
+            c = self._cmp(a, b)
+            hit = hit | ((c == 0) & ~b.null)
+            any_null = any_null | b.null
+        # a NULL lane's value is garbage — never let it match
+        hit = hit & ~a.null
+        # NULL if lhs null, or no hit with some NULL operand (MySQL IN)
+        null = a.null | (~hit & any_null)
+        return CompVal(hit.astype(jnp.int64), null, e.ft)
+
+    def _op_between(self, e):
+        a, lo, hi = (self._eval(x) for x in e.args)
+        c1, c2 = self._cmp(a, lo), self._cmp(a, hi)
+        out = ((c1 >= 0) & (c2 <= 0)).astype(jnp.int64)
+        return CompVal(out, a.null | lo.null | hi.null, e.ft)
+
+    # -- logical -------------------------------------------------------------
+    @staticmethod
+    def _truth(v: CompVal):
+        """MySQL truthiness of a value lane (nonzero = true)."""
+        if v.eval_type == "real":
+            return v.value != 0.0
+        if v.value.ndim == 2:
+            # MySQL string truthiness parses a leading number ('0'→false,
+            # 'abc'→false); no device parse yet, so refuse pushdown — the
+            # whitelist gate routes these to the host path.
+            raise NotImplementedError("logical op over string operand not on device")
+        return v.value != 0
+
+    @staticmethod
+    def _sel(cond, a: CompVal, b: CompVal, av, bv):
+        """jnp.where that handles 2-D string word lanes and carries raw."""
+        if av.ndim == 2:
+            out = jnp.where(cond[:, None], av, bv)
+            raw = None
+            if a.raw is not None and b.raw is not None:
+                ad, al = a.raw
+                bd, bl = b.raw
+                w = max(ad.shape[1], bd.shape[1])
+                if ad.shape[1] < w:
+                    ad = jnp.pad(ad, ((0, 0), (0, w - ad.shape[1])))
+                if bd.shape[1] < w:
+                    bd = jnp.pad(bd, ((0, 0), (0, w - bd.shape[1])))
+                raw = (jnp.where(cond[:, None], ad, bd), jnp.where(cond, al, bl))
+            return out, raw
+        return jnp.where(cond, av, bv), None
+
+    def _op_and(self, e):
+        a, b = self._eval(e.args[0]), self._eval(e.args[1])
+        ta, tb = self._truth(a), self._truth(b)
+        f = (~ta & ~a.null) | (~tb & ~b.null)
+        null = ~f & (a.null | b.null)
+        return CompVal((~f & ~null).astype(jnp.int64), null, e.ft)
+
+    def _op_or(self, e):
+        a, b = self._eval(e.args[0]), self._eval(e.args[1])
+        ta, tb = self._truth(a), self._truth(b)
+        t = (ta & ~a.null) | (tb & ~b.null)
+        null = ~t & (a.null | b.null)
+        return CompVal(t.astype(jnp.int64), null, e.ft)
+
+    def _op_not(self, e):
+        a = self._eval(e.args[0])
+        return CompVal((~self._truth(a)).astype(jnp.int64), a.null, e.ft)
+
+    def _op_xor(self, e):
+        a, b = self._eval(e.args[0]), self._eval(e.args[1])
+        out = (self._truth(a) ^ self._truth(b)).astype(jnp.int64)
+        return CompVal(out, a.null | b.null, e.ft)
+
+    # -- null handling / control ---------------------------------------------
+    def _op_isnull(self, e):
+        a = self._eval(e.args[0])
+        return CompVal(a.null.astype(jnp.int64), jnp.zeros_like(a.null), e.ft)
+
+    def _op_ifnull(self, e):
+        a, b = self._eval(e.args[0]), self._eval(e.args[1])
+        av = self._coerce_result(a, e.ft).value
+        bv = self._coerce_result(b, e.ft).value
+        out, raw = self._sel(~a.null, a, b, av, bv)
+        return CompVal(out, a.null & b.null, e.ft, raw=raw)
+
+    def _op_if(self, e):
+        c, a, b = (self._eval(x) for x in e.args)
+        cond = self._truth(c) & ~c.null
+        av = self._coerce_result(a, e.ft).value
+        bv = self._coerce_result(b, e.ft).value
+        out, raw = self._sel(cond, a, b, av, bv)
+        null = jnp.where(cond, a.null, b.null)
+        return CompVal(out, null, e.ft, raw=raw)
+
+    def _op_case(self, e):
+        """case [when1, then1, when2, then2, ..., else?]."""
+        args = e.args
+        pairs = []
+        i = 0
+        while i + 1 < len(args):
+            pairs.append((args[i], args[i + 1]))
+            i += 2
+        els = self._eval(args[i]) if i < len(args) else None
+        if els is not None:
+            out = self._coerce_result(els, e.ft).value
+            null = els.null
+        else:
+            dt = jnp.float64 if e.ft.eval_type() == "real" else jnp.int64
+            out = jnp.zeros(self._n, dt)
+            null = jnp.ones(self._n, bool)
+        for cond_e, then_e in reversed(pairs):
+            c = self._eval(cond_e)
+            t = self._eval(then_e)
+            hit = self._truth(c) & ~c.null
+            tv = self._coerce_result(t, e.ft).value
+            cond2 = hit[:, None] if tv.ndim == 2 else hit
+            out = jnp.where(cond2, tv, out)
+            null = jnp.where(hit, t.null, null)
+        return CompVal(out, null, e.ft)
+
+    def _op_coalesce(self, e):
+        vals = [self._eval(a) for a in e.args]
+        out = self._coerce_result(vals[-1], e.ft).value
+        null = vals[-1].null
+        for v in reversed(vals[:-1]):
+            vv = self._coerce_result(v, e.ft).value
+            cond = v.null[:, None] if vv.ndim == 2 else v.null
+            out = jnp.where(cond, out, vv)
+            null = jnp.where(v.null, null, jnp.zeros_like(null))
+        return CompVal(out, null, e.ft)
+
+    def _coerce_result(self, v: CompVal, ft: FieldType) -> CompVal:
+        cls = ft.eval_type()
+        if cls == "decimal":
+            return self._to_class(v, "decimal", _scale(ft))
+        if cls == "real":
+            return self._to_class(v, "real")
+        return v
+
+    # -- cast ----------------------------------------------------------------
+    def _op_cast(self, e):
+        a = self._eval(e.args[0])
+        src, dst = a.eval_type, e.ft.eval_type()
+        if dst == "real":
+            return CompVal(self._to_class(a, "real").value, a.null, e.ft)
+        if dst == "decimal":
+            return CompVal(self._to_class(a, "decimal", _scale(e.ft)).value, a.null, e.ft)
+        if dst == "int":
+            if src == "real":
+                out = jnp.round(a.value).astype(jnp.int64)  # MySQL rounds
+                return CompVal(out, a.null, e.ft)
+            if src == "decimal":
+                out = _round_div(a.value, _pow10(_scale(a.ft)))
+                return CompVal(out, a.null, e.ft)
+            return CompVal(a.value, a.null, e.ft)
+        if dst == "time" and src == "time":
+            return CompVal(a.value, a.null, e.ft)
+        if dst == "string" and src == "string":
+            return CompVal(a.value, a.null, e.ft, raw=a.raw)
+        raise NotImplementedError(f"cast {src} -> {dst} not on device")
+
+    # -- math ----------------------------------------------------------------
+    def _op_ceil(self, e):
+        a = self._eval(e.args[0])
+        if a.eval_type == "real":
+            return CompVal(jnp.ceil(a.value), a.null, e.ft)
+        if a.eval_type == "decimal":
+            p = _pow10(_scale(a.ft))
+            q = jnp.where(a.value >= 0, (a.value + p - 1) // p, -((-a.value) // p))
+            return CompVal(q, a.null, e.ft)
+        return CompVal(a.value, a.null, e.ft)
+
+    def _op_floor(self, e):
+        a = self._eval(e.args[0])
+        if a.eval_type == "real":
+            return CompVal(jnp.floor(a.value), a.null, e.ft)
+        if a.eval_type == "decimal":
+            p = _pow10(_scale(a.ft))
+            q = jnp.where(a.value >= 0, a.value // p, -((-a.value + p - 1) // p))
+            return CompVal(q, a.null, e.ft)
+        return CompVal(a.value, a.null, e.ft)
+
+    def _op_round(self, e):
+        a = self._eval(e.args[0])
+        nd = 0
+        if len(e.args) > 1:
+            c = e.args[1]
+            if isinstance(c, Const) and not c.datum.is_null():
+                nd = int(c.datum.val)
+            else:
+                raise NotImplementedError("round with non-constant digits")
+        if a.eval_type == "real":
+            p = float(10 ** nd)
+            v = a.value * p
+            out = jnp.where(v >= 0, jnp.floor(v + 0.5), jnp.ceil(v - 0.5)) / p
+            return CompVal(out, a.null, e.ft)
+        if a.eval_type == "decimal":
+            tgt = min(max(nd, 0), _scale(a.ft))
+            r = self._rescale_dec(a, tgt)
+            return CompVal(self._rescale_dec(r, _scale(e.ft)).value, a.null, e.ft)
+        if nd >= 0:
+            return CompVal(a.value, a.null, e.ft)
+        p = _pow10(-nd)
+        return CompVal(_round_div(a.value, p) * p, a.null, e.ft)
+
+    def _op_sqrt(self, e):
+        a = self._to_class(self._eval(e.args[0]), "real")
+        neg = a.value < 0
+        out = jnp.sqrt(jnp.where(neg, 0.0, a.value))
+        return CompVal(out, a.null | neg, e.ft)
+
+    def _op_exp(self, e):
+        a = self._to_class(self._eval(e.args[0]), "real")
+        return CompVal(jnp.exp(a.value), a.null, e.ft)
+
+    def _op_ln(self, e):
+        a = self._to_class(self._eval(e.args[0]), "real")
+        bad = a.value <= 0
+        return CompVal(jnp.log(jnp.where(bad, 1.0, a.value)), a.null | bad, e.ft)
+
+    _op_log = _op_ln
+
+    def _op_pow(self, e):
+        a = self._to_class(self._eval(e.args[0]), "real")
+        b = self._to_class(self._eval(e.args[1]), "real")
+        return CompVal(jnp.power(a.value, b.value), a.null | b.null, e.ft)
+
+    def _op_sign(self, e):
+        a = self._eval(e.args[0])
+        out = jnp.sign(a.value).astype(jnp.int64)
+        return CompVal(out, a.null, e.ft)
+
+    # -- bit ops (int64 lanes) -----------------------------------------------
+    def _bitop(self, e, fn):
+        a, b = self._eval(e.args[0]), self._eval(e.args[1])
+        return CompVal(fn(a.value, b.value), a.null | b.null, e.ft)
+
+    def _op_bitand(self, e):
+        return self._bitop(e, lambda a, b: a & b)
+
+    def _op_bitor(self, e):
+        return self._bitop(e, lambda a, b: a | b)
+
+    def _op_bitxor(self, e):
+        return self._bitop(e, lambda a, b: a ^ b)
+
+    def _op_bitneg(self, e):
+        a = self._eval(e.args[0])
+        return CompVal(~a.value, a.null, e.ft)
+
+    def _op_shiftleft(self, e):
+        return self._bitop(e, lambda a, b: jnp.where((b >= 64) | (b < 0), jnp.int64(0), a << jnp.clip(b, 0, 63)))
+
+    def _op_shiftright(self, e):
+        # logical (unsigned) shift, as MySQL >> on BIGINT UNSIGNED
+        return self._bitop(
+            e,
+            lambda a, b: jnp.where(
+                (b >= 64) | (b < 0),
+                jnp.int64(0),
+                (a.astype(jnp.uint64) >> jnp.clip(b, 0, 63).astype(jnp.uint64)).astype(jnp.int64),
+            ),
+        )
+
+    # -- string --------------------------------------------------------------
+    def _op_length(self, e):
+        a = self._eval(e.args[0])
+        if a.raw is None:
+            raise NotImplementedError("length() needs raw string column")
+        return CompVal(a.raw[1].astype(jnp.int64), a.null, e.ft)
+
+    def _op_strcmp(self, e):
+        a, b = self._eval(e.args[0]), self._eval(e.args[1])
+        return CompVal(_words_cmp(a.value, b.value).astype(jnp.int64), a.null | b.null, e.ft)
+
+    def _op_like(self, e):
+        """LIKE with constant pattern; device support for exact / 'prefix%' /
+        '%suffix' is TODO — currently exact and prefix% patterns."""
+        a = self._eval(e.args[0])
+        pat = e.args[1]
+        if not isinstance(pat, Const):
+            raise NotImplementedError("LIKE with non-constant pattern")
+        p = pat.datum.val
+        p = p if isinstance(p, str) else p.decode()
+        if a.raw is None:
+            raise NotImplementedError("LIKE needs raw string column")
+        data, length = a.raw
+        import numpy as np
+
+        if p.endswith("%") and "%" not in p[:-1] and "_" not in p:
+            prefix = p[:-1].encode()
+            out = self._prefix_match(data, length, prefix)
+        elif "%" not in p and "_" not in p:
+            exact = p.encode()
+            out = self._prefix_match(data, length, exact) & (length == len(exact))
+        else:
+            raise NotImplementedError(f"LIKE pattern {p!r} not on device yet")
+        return CompVal(out.astype(jnp.int64), a.null, e.ft)
+
+    @staticmethod
+    def _prefix_match(data, length, prefix: bytes):
+        import numpy as np
+
+        k = len(prefix)
+        if k == 0:
+            return jnp.ones(data.shape[0], bool)
+        w = data.shape[1]
+        if k > w:
+            return jnp.zeros(data.shape[0], bool)
+        pref = jnp.asarray(np.frombuffer(prefix, np.uint8))
+        eq = (data[:, :k] == pref[None, :]).all(axis=1)
+        return eq & (length >= k)
+
+    def _op_substr(self, e):
+        raise NotImplementedError("substr on device TODO; host fallback")
+
+    # -- time extraction (packed layout, types/mytime.py) ---------------------
+    def _time_parts(self, a: CompVal):
+        packed = a.value
+        ymd = packed >> 41
+        ym = ymd >> 5
+        return packed, ymd, ym
+
+    def _op_year(self, e):
+        a = self._eval(e.args[0])
+        _, _, ym = self._time_parts(a)
+        return CompVal((ym // 13).astype(jnp.int64), a.null, e.ft)
+
+    def _op_month(self, e):
+        a = self._eval(e.args[0])
+        _, _, ym = self._time_parts(a)
+        return CompVal((ym % 13).astype(jnp.int64), a.null, e.ft)
+
+    def _op_day(self, e):
+        a = self._eval(e.args[0])
+        _, ymd, _ = self._time_parts(a)
+        return CompVal((ymd & 31).astype(jnp.int64), a.null, e.ft)
+
+    def _op_hour(self, e):
+        a = self._eval(e.args[0])
+        hms = (a.value >> 24) & ((1 << 17) - 1)
+        return CompVal((hms >> 12).astype(jnp.int64), a.null, e.ft)
+
+    def _op_minute(self, e):
+        a = self._eval(e.args[0])
+        hms = (a.value >> 24) & ((1 << 17) - 1)
+        return CompVal(((hms >> 6) & 63).astype(jnp.int64), a.null, e.ft)
+
+    def _op_second(self, e):
+        a = self._eval(e.args[0])
+        hms = (a.value >> 24) & ((1 << 17) - 1)
+        return CompVal((hms & 63).astype(jnp.int64), a.null, e.ft)
+
+    def _op_to_days(self, e):
+        """Days since year 0 (MySQL TO_DAYS) via civil-day arithmetic."""
+        a = self._eval(e.args[0])
+        _, ymd, ym = self._time_parts(a)
+        y = ym // 13
+        m = ym % 13
+        d = ymd & 31
+        # days from year 0: MySQL calcDaynr (ref: pkg/types/mytime.go calcDaynr)
+        delsum = 365 * y + 31 * (m - 1) + d
+        adj = jnp.where(m <= 2, 0, (0.4 * m.astype(jnp.float64) + 2.3).astype(jnp.int64))
+        delsum = jnp.where(m <= 2, delsum, delsum - adj)
+        yy = jnp.where(m <= 2, y - 1, y)
+        out = delsum + yy // 4 - yy // 100 + yy // 400
+        return CompVal(out.astype(jnp.int64), a.null, e.ft)
+
+    def _op_weekday(self, e):
+        a = self._eval(e.args[0])
+        days = self._op_to_days(ScalarFunc("to_days", (e.args[0],), e.ft))
+        return CompVal((days.value + 5) % 7, a.null, e.ft)
+
+    def _op_extract(self, e):
+        unit = e.args[0]
+        if not isinstance(unit, Const):
+            raise NotImplementedError
+        u = str(unit.datum.val).lower()
+        sub = ScalarFunc(u, (e.args[1],), e.ft)
+        return self._eval(sub)
+
+
+@dataclass
+class CompiledExpr:
+    """A jit-compiled projection over an input schema."""
+
+    fn: Callable
+    out_fts: list[FieldType]
+
+
+def compile_exprs(input_fts: list[FieldType], exprs: list[Expr]) -> CompiledExpr:
+    comp = ExprCompiler(input_fts)
+
+    @jax.jit
+    def run(cols):
+        vals = comp.run(exprs, cols)
+        return [(v.value, v.null) for v in vals]
+
+    return CompiledExpr(run, [e.ft for e in exprs])

@@ -1,0 +1,560 @@
+"""Row-at-a-time reference evaluator — the parity oracle.
+
+Re-expresses the semantics of the reference's naive coprocessor executors
+(ref: unistore/cophandler/mpp_exec.go, pkg/expression builtin row Eval*) in
+host Python over Datums. Every device kernel is cross-checked against this
+(SURVEY.md §4: "bit-parity harness = run the same DAG through the Go-semantics
+reference executor and the TPU kernels and diff chunks").
+
+Slow by design; never on the hot path.
+"""
+
+from __future__ import annotations
+
+from ..types import Datum, DatumKind, FieldType, MyDecimal, MyTime, DIV_FRAC_INCR
+from .ir import ColumnRef, Const, Expr, ScalarFunc
+
+
+def _num(d: Datum):
+    return d.val
+
+
+def _as_decimal(d: Datum) -> MyDecimal:
+    if d.kind == DatumKind.MysqlDecimal:
+        return d.val
+    if d.kind in (DatumKind.Int64, DatumKind.Uint64):
+        return MyDecimal(d.val, 0)
+    if d.kind in (DatumKind.Float64, DatumKind.Float32):
+        return MyDecimal(d.val)
+    raise TypeError(f"cannot coerce {d} to decimal")
+
+
+def _as_float(d: Datum) -> float:
+    if d.kind == DatumKind.MysqlDecimal:
+        return d.val.to_float()
+    return float(d.val)
+
+
+def _class2(a: Datum, b: Datum) -> str:
+    ks = {a.kind, b.kind}
+    if DatumKind.Float64 in ks or DatumKind.Float32 in ks:
+        return "real"
+    if DatumKind.MysqlDecimal in ks:
+        return "decimal"
+    if ks <= {DatumKind.String, DatumKind.Bytes}:
+        return "string"
+    return "int"
+
+
+def _truth(d: Datum) -> bool | None:
+    if d.is_null():
+        return None
+    if d.kind in (DatumKind.String, DatumKind.Bytes):
+        try:
+            return float(d.val) != 0
+        except (TypeError, ValueError):
+            return False
+    if d.kind == DatumKind.MysqlDecimal:
+        return d.val.d != 0
+    if d.kind == DatumKind.MysqlTime:
+        return d.val.packed != 0
+    return d.val != 0
+
+
+def compare(a: Datum, b: Datum) -> int | None:
+    """3-way semantic compare; None if either side NULL."""
+    if a.is_null() or b.is_null():
+        return None
+    cls = _class2(a, b)
+    if cls == "string":
+        av = a.val.encode() if isinstance(a.val, str) else bytes(a.val)
+        bv = b.val.encode() if isinstance(b.val, str) else bytes(b.val)
+        return (av > bv) - (av < bv)
+    if cls == "real":
+        av, bv = _as_float(a), _as_float(b)
+        return (av > bv) - (av < bv)
+    if cls == "decimal":
+        av, bv = _as_decimal(a), _as_decimal(b)
+        return (av.d > bv.d) - (av.d < bv.d)
+    if a.kind == DatumKind.MysqlTime or b.kind == DatumKind.MysqlTime:
+        av = a.val.packed if isinstance(a.val, MyTime) else a.val
+        bv = b.val.packed if isinstance(b.val, MyTime) else b.val
+        return (av > bv) - (av < bv)
+    av, bv = a.val, b.val  # python ints compare exactly regardless of sign
+    return (av > bv) - (av < bv)
+
+
+class RefEvaluator:
+    """Evaluate an Expr over one row of Datums."""
+
+    def eval(self, e: Expr, row: list[Datum]) -> Datum:
+        if isinstance(e, ColumnRef):
+            return row[e.index]
+        if isinstance(e, Const):
+            return e.datum
+        assert isinstance(e, ScalarFunc)
+        return getattr(self, f"_op_{e.op}")(e, row)
+
+    # -- helpers -------------------------------------------------------------
+    def _args(self, e, row):
+        return [self.eval(a, row) for a in e.args]
+
+    def _result_num(self, v, ft: FieldType) -> Datum:
+        if v is None:
+            return Datum.NULL
+        if ft.eval_type() == "decimal":
+            return Datum.dec(v if isinstance(v, MyDecimal) else MyDecimal(v, max(ft.decimal, 0)))
+        if ft.eval_type() == "real":
+            return Datum.f64(float(v))
+        if ft.is_unsigned():
+            return Datum.u64(int(v))
+        return Datum.i64(int(v))
+
+    def _arith(self, e, row, int_fn, real_fn, dec_fn):
+        a, b = self._args(e, row)
+        if a.is_null() or b.is_null():
+            return Datum.NULL
+        cls = _class2(a, b)
+        if cls == "real":
+            return self._result_num(real_fn(_as_float(a), _as_float(b)), e.ft)
+        if cls == "decimal":
+            return self._result_num(dec_fn(_as_decimal(a), _as_decimal(b)), e.ft)
+        return self._result_num(int_fn(a.val, b.val), e.ft)
+
+    # -- arithmetic ----------------------------------------------------------
+    def _op_plus(self, e, row):
+        return self._arith(e, row, lambda a, b: a + b, lambda a, b: a + b, lambda a, b: a + b)
+
+    def _op_minus(self, e, row):
+        return self._arith(e, row, lambda a, b: a - b, lambda a, b: a - b, lambda a, b: a - b)
+
+    def _op_mul(self, e, row):
+        return self._arith(e, row, lambda a, b: a * b, lambda a, b: a * b, lambda a, b: a * b)
+
+    def _op_div(self, e, row):
+        a, b = self._args(e, row)
+        if a.is_null() or b.is_null():
+            return Datum.NULL
+        if _class2(a, b) == "real":
+            bf = _as_float(b)
+            if bf == 0.0:
+                return Datum.NULL
+            return Datum.f64(_as_float(a) / bf)
+        q = _as_decimal(a).div(_as_decimal(b))
+        if q is None:
+            return Datum.NULL
+        return Datum.dec(q.round(max(e.ft.decimal, 0)))
+
+    def _op_intdiv(self, e, row):
+        a, b = self._args(e, row)
+        if a.is_null() or b.is_null():
+            return Datum.NULL
+        if _class2(a, b) in ("decimal", "real"):
+            ad, bd = _as_decimal(a), _as_decimal(b)
+            if bd.d == 0:
+                return Datum.NULL
+            q = ad.d / bd.d
+            return self._result_num(int(q), e.ft)
+        if b.val == 0:
+            return Datum.NULL
+        q = abs(a.val) // abs(b.val)
+        return self._result_num(-q if (a.val < 0) != (b.val < 0) else q, e.ft)
+
+    def _op_mod(self, e, row):
+        a, b = self._args(e, row)
+        if a.is_null() or b.is_null():
+            return Datum.NULL
+        if _class2(a, b) == "real":
+            bf = _as_float(b)
+            if bf == 0.0:
+                return Datum.NULL
+            import math
+
+            return Datum.f64(math.fmod(_as_float(a), bf))
+        if _class2(a, b) == "decimal":
+            ad, bd = _as_decimal(a), _as_decimal(b)
+            if bd.d == 0:
+                return Datum.NULL
+            s = max(ad.scale, bd.scale)
+            r = abs(ad.d) % abs(bd.d)
+            return Datum.dec(MyDecimal(-r if ad.d < 0 else r, s))
+        if b.val == 0:
+            return Datum.NULL
+        r = abs(a.val) % abs(b.val)
+        return self._result_num(-r if a.val < 0 else r, e.ft)
+
+    def _op_unaryminus(self, e, row):
+        (a,) = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        if a.kind == DatumKind.MysqlDecimal:
+            return Datum.dec(-a.val)
+        return self._result_num(-a.val, e.ft)
+
+    def _op_abs(self, e, row):
+        (a,) = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        if a.kind == DatumKind.MysqlDecimal:
+            return Datum.dec(MyDecimal(abs(a.val.d), a.val.scale))
+        return self._result_num(abs(a.val), e.ft)
+
+    # -- comparison ----------------------------------------------------------
+    def _cmp_op(self, e, row, pred):
+        a, b = self._args(e, row)
+        c = compare(a, b)
+        if c is None:
+            return Datum.NULL
+        return Datum.i64(1 if pred(c) else 0)
+
+    def _op_eq(self, e, row):
+        return self._cmp_op(e, row, lambda c: c == 0)
+
+    def _op_ne(self, e, row):
+        return self._cmp_op(e, row, lambda c: c != 0)
+
+    def _op_lt(self, e, row):
+        return self._cmp_op(e, row, lambda c: c < 0)
+
+    def _op_le(self, e, row):
+        return self._cmp_op(e, row, lambda c: c <= 0)
+
+    def _op_gt(self, e, row):
+        return self._cmp_op(e, row, lambda c: c > 0)
+
+    def _op_ge(self, e, row):
+        return self._cmp_op(e, row, lambda c: c >= 0)
+
+    def _op_nulleq(self, e, row):
+        a, b = self._args(e, row)
+        if a.is_null() and b.is_null():
+            return Datum.i64(1)
+        c = compare(a, b)
+        return Datum.i64(1 if c == 0 else 0)
+
+    def _op_in(self, e, row):
+        a = self.eval(e.args[0], row)
+        if a.is_null():
+            return Datum.NULL
+        saw_null = False
+        for arg in e.args[1:]:
+            b = self.eval(arg, row)
+            c = compare(a, b)
+            if c is None:
+                saw_null = True
+            elif c == 0:
+                return Datum.i64(1)
+        return Datum.NULL if saw_null else Datum.i64(0)
+
+    def _op_between(self, e, row):
+        a, lo, hi = self._args(e, row)
+        c1, c2 = compare(a, lo), compare(a, hi)
+        if c1 is None or c2 is None:
+            return Datum.NULL
+        return Datum.i64(1 if c1 >= 0 and c2 <= 0 else 0)
+
+    # -- logical -------------------------------------------------------------
+    def _op_and(self, e, row):
+        a, b = self._args(e, row)
+        ta, tb = _truth(a), _truth(b)
+        if ta is False or tb is False:
+            return Datum.i64(0)
+        if ta is None or tb is None:
+            return Datum.NULL
+        return Datum.i64(1)
+
+    def _op_or(self, e, row):
+        a, b = self._args(e, row)
+        ta, tb = _truth(a), _truth(b)
+        if ta is True or tb is True:
+            return Datum.i64(1)
+        if ta is None or tb is None:
+            return Datum.NULL
+        return Datum.i64(0)
+
+    def _op_not(self, e, row):
+        (a,) = self._args(e, row)
+        t = _truth(a)
+        if t is None:
+            return Datum.NULL
+        return Datum.i64(0 if t else 1)
+
+    def _op_xor(self, e, row):
+        a, b = self._args(e, row)
+        ta, tb = _truth(a), _truth(b)
+        if ta is None or tb is None:
+            return Datum.NULL
+        return Datum.i64(1 if ta != tb else 0)
+
+    # -- null / control ------------------------------------------------------
+    def _op_isnull(self, e, row):
+        (a,) = self._args(e, row)
+        return Datum.i64(1 if a.is_null() else 0)
+
+    def _op_ifnull(self, e, row):
+        a, b = self._args(e, row)
+        return b if a.is_null() else a
+
+    def _op_if(self, e, row):
+        c, a, b = self._args(e, row)
+        return a if _truth(c) else b
+
+    def _op_case(self, e, row):
+        args = e.args
+        i = 0
+        while i + 1 < len(args):
+            if _truth(self.eval(args[i], row)):
+                return self.eval(args[i + 1], row)
+            i += 2
+        if i < len(args):
+            return self.eval(args[i], row)
+        return Datum.NULL
+
+    def _op_coalesce(self, e, row):
+        for a in e.args:
+            v = self.eval(a, row)
+            if not v.is_null():
+                return v
+        return Datum.NULL
+
+    # -- cast ----------------------------------------------------------------
+    def _op_cast(self, e, row):
+        (a,) = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        dst = e.ft.eval_type()
+        if dst == "real":
+            return Datum.f64(_as_float(a))
+        if dst == "decimal":
+            return Datum.dec(_as_decimal(a).round(max(e.ft.decimal, 0)))
+        if dst == "int":
+            if a.kind in (DatumKind.Float64, DatumKind.Float32):
+                import math
+
+                v = a.val
+                return self._result_num(int(math.floor(v + 0.5)) if v >= 0 else int(math.ceil(v - 0.5)), e.ft)
+            if a.kind == DatumKind.MysqlDecimal:
+                return self._result_num(a.val.to_int(), e.ft)
+            return self._result_num(a.val, e.ft)
+        if dst == "string":
+            if a.kind in (DatumKind.String, DatumKind.Bytes):
+                return a
+            return Datum.string(str(a.val))
+        if dst == "time":
+            return a
+        raise NotImplementedError(f"ref cast to {dst}")
+
+    # -- math ----------------------------------------------------------------
+    def _op_ceil(self, e, row):
+        import math
+
+        (a,) = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        if a.kind == DatumKind.MysqlDecimal:
+            return self._result_num(int(math.ceil(a.val.d)), e.ft)
+        if a.kind == DatumKind.Float64:
+            return Datum.f64(math.ceil(a.val))
+        return a
+
+    def _op_floor(self, e, row):
+        import math
+
+        (a,) = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        if a.kind == DatumKind.MysqlDecimal:
+            return self._result_num(int(math.floor(a.val.d)), e.ft)
+        if a.kind == DatumKind.Float64:
+            return Datum.f64(math.floor(a.val))
+        return a
+
+    def _op_round(self, e, row):
+        a = self.eval(e.args[0], row)
+        nd = 0
+        if len(e.args) > 1:
+            d = self.eval(e.args[1], row)
+            if d.is_null():
+                return Datum.NULL
+            nd = int(d.val)
+        if a.is_null():
+            return Datum.NULL
+        if a.kind == DatumKind.MysqlDecimal:
+            tgt = min(max(nd, 0), a.val.scale)
+            return Datum.dec(a.val.round(tgt).round(max(e.ft.decimal, 0)))
+        if a.kind == DatumKind.Float64:
+            import math
+
+            p = 10.0 ** nd
+            v = a.val * p
+            out = math.floor(v + 0.5) if v >= 0 else math.ceil(v - 0.5)
+            return Datum.f64(out / p)
+        if nd >= 0:
+            return a
+        p = 10 ** (-nd)
+        v = a.val
+        q = (abs(v) * 2 + p) // (2 * p) * p
+        return self._result_num(-q if v < 0 else q, e.ft)
+
+    def _op_sqrt(self, e, row):
+        import math
+
+        (a,) = self._args(e, row)
+        if a.is_null() or _as_float(a) < 0:
+            return Datum.NULL
+        return Datum.f64(math.sqrt(_as_float(a)))
+
+    def _op_exp(self, e, row):
+        import math
+
+        (a,) = self._args(e, row)
+        return Datum.NULL if a.is_null() else Datum.f64(math.exp(_as_float(a)))
+
+    def _op_ln(self, e, row):
+        import math
+
+        (a,) = self._args(e, row)
+        if a.is_null() or _as_float(a) <= 0:
+            return Datum.NULL
+        return Datum.f64(math.log(_as_float(a)))
+
+    _op_log = _op_ln
+
+    def _op_pow(self, e, row):
+        a, b = self._args(e, row)
+        if a.is_null() or b.is_null():
+            return Datum.NULL
+        return Datum.f64(_as_float(a) ** _as_float(b))
+
+    def _op_sign(self, e, row):
+        (a,) = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        v = _as_float(a)
+        return Datum.i64((v > 0) - (v < 0))
+
+    # -- bit -----------------------------------------------------------------
+    def _bits(self, e, row, fn):
+        a, b = self._args(e, row)
+        if a.is_null() or b.is_null():
+            return Datum.NULL
+        return Datum.u64(fn(a.val & 0xFFFFFFFFFFFFFFFF, b.val & 0xFFFFFFFFFFFFFFFF) & 0xFFFFFFFFFFFFFFFF)
+
+    def _op_bitand(self, e, row):
+        return self._bits(e, row, lambda a, b: a & b)
+
+    def _op_bitor(self, e, row):
+        return self._bits(e, row, lambda a, b: a | b)
+
+    def _op_bitxor(self, e, row):
+        return self._bits(e, row, lambda a, b: a ^ b)
+
+    def _op_bitneg(self, e, row):
+        (a,) = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        return Datum.u64(~a.val & 0xFFFFFFFFFFFFFFFF)
+
+    def _op_shiftleft(self, e, row):
+        return self._bits(e, row, lambda a, b: 0 if b >= 64 else a << b)
+
+    def _op_shiftright(self, e, row):
+        return self._bits(e, row, lambda a, b: 0 if b >= 64 else a >> b)
+
+    # -- string --------------------------------------------------------------
+    def _op_length(self, e, row):
+        (a,) = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        b = a.val.encode() if isinstance(a.val, str) else bytes(a.val)
+        return Datum.i64(len(b))
+
+    def _op_strcmp(self, e, row):
+        a, b = self._args(e, row)
+        c = compare(a, b)
+        return Datum.NULL if c is None else Datum.i64(c)
+
+    def _op_like(self, e, row):
+        import re
+
+        a, p = self._args(e, row)
+        if a.is_null() or p.is_null():
+            return Datum.NULL
+        s = a.val if isinstance(a.val, str) else a.val.decode("utf-8", "surrogateescape")
+        pat = p.val if isinstance(p.val, str) else p.val.decode()
+        rx = re.escape(pat).replace(re.escape("%"), ".*").replace(re.escape("_"), ".")
+        return Datum.i64(1 if re.fullmatch(rx, s, re.S) else 0)
+
+    def _op_substr(self, e, row):
+        args = self._args(e, row)
+        a = args[0]
+        if a.is_null():
+            return Datum.NULL
+        s = a.val if isinstance(a.val, str) else a.val.decode("utf-8", "surrogateescape")
+        pos = int(args[1].val)
+        if pos > 0:
+            start = pos - 1
+        elif pos < 0:
+            start = len(s) + pos
+            if start < 0:  # MySQL: position before string start -> ''
+                return Datum.string("")
+        else:
+            return Datum.string("")
+        ln = int(args[2].val) if len(args) > 2 else None
+        out = s[start : start + ln] if ln is not None else s[start:]
+        return Datum.string(out)
+
+    # -- time ----------------------------------------------------------------
+    def _time_parts(self, a: Datum):
+        t = a.val if isinstance(a.val, MyTime) else MyTime(int(a.val))
+        return t.parts()
+
+    def _tfield(self, e, row, idx):
+        (a,) = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        return Datum.i64(self._time_parts(a)[idx])
+
+    def _op_year(self, e, row):
+        return self._tfield(e, row, 0)
+
+    def _op_month(self, e, row):
+        return self._tfield(e, row, 1)
+
+    def _op_day(self, e, row):
+        return self._tfield(e, row, 2)
+
+    def _op_hour(self, e, row):
+        return self._tfield(e, row, 3)
+
+    def _op_minute(self, e, row):
+        return self._tfield(e, row, 4)
+
+    def _op_second(self, e, row):
+        return self._tfield(e, row, 5)
+
+    def _op_to_days(self, e, row):
+        (a,) = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        y, m, d = self._time_parts(a)[:3]
+        delsum = 365 * y + 31 * (m - 1) + d
+        if m > 2:
+            delsum -= int(0.4 * m + 2.3)
+            yy = y
+        else:
+            yy = y - 1
+        return Datum.i64(delsum + yy // 4 - yy // 100 + yy // 400)
+
+    def _op_weekday(self, e, row):
+        d = self._op_to_days(e, row)
+        if d.is_null():
+            return Datum.NULL
+        return Datum.i64((d.val + 5) % 7)
+
+    def _op_extract(self, e, row):
+        unit = e.args[0]
+        u = str(unit.datum.val).lower()
+        from .ir import ScalarFunc as SF
+
+        return self.eval(SF(u, (e.args[1],), e.ft), row)
