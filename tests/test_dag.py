@@ -1,0 +1,180 @@
+"""Full-DAG parity: fused device program vs reference interpreter.
+
+The bit-parity harness of SURVEY.md §4/§7: same DAG, two engines, diff rows.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.types import (
+    Datum,
+    MyDecimal,
+    MyTime,
+    new_datetime,
+    new_decimal,
+    new_double,
+    new_longlong,
+    new_varchar,
+)
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.expr import AggDesc, col, func, lit
+from tidb_tpu.exec import (
+    Aggregation,
+    ColumnInfo,
+    DAGRequest,
+    Limit,
+    ProgramCache,
+    Selection,
+    TableScan,
+    TopN,
+    run_dag_on_chunk,
+    run_dag_reference,
+)
+from tidb_tpu.exec.executor import datum_group_key
+
+BOOL = new_longlong(notnull=True)
+
+# lineitem-ish schema: shipdate, qty dec(15,2), price dec(15,2), disc dec(15,2),
+# returnflag varchar(1), linestatus varchar(1), tax double
+FTS = [new_datetime(), new_decimal(15, 2), new_decimal(15, 2), new_decimal(15, 2), new_varchar(1), new_varchar(1), new_double()]
+
+
+def lineitem_chunk(n=400, seed=9, null_p=0.03):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        def maybe(d):
+            return Datum.NULL if rng.random() < null_p else d
+
+        y = 1992 + int(rng.integers(7))
+        rows.append([
+            maybe(Datum.time(MyTime.from_ymd(y, 1 + int(rng.integers(12)), 1 + int(rng.integers(28))))),
+            maybe(Datum.dec(MyDecimal(f"{int(rng.integers(1, 51))}.00"))),
+            maybe(Datum.dec(MyDecimal(f"{int(rng.integers(90000, 9000000))/100:.2f}"))),
+            maybe(Datum.dec(MyDecimal(f"0.0{int(rng.integers(10))}"))),
+            maybe(Datum.string("ANR"[int(rng.integers(3))])),
+            maybe(Datum.string("OF"[int(rng.integers(2))])),
+            maybe(Datum.f64(float(np.round(rng.random() * 0.08, 2)))),
+        ])
+    return Chunk.from_rows(FTS, rows)
+
+
+def scan():
+    return TableScan(1, tuple(ColumnInfo(i + 1, ft) for i, ft in enumerate(FTS)))
+
+
+def canon(d):
+    k = datum_group_key(d)
+    # float aggregates sum in different orders on device vs oracle; IEEE
+    # non-associativity makes last-bit drift expected — 12 sig digits is the
+    # parity contract for DOUBLE (decimals stay bit-exact)
+    if isinstance(k[1], float):
+        return (k[0], float(f"{k[1]:.12g}"))
+    return k
+
+
+def rows_canon(rows):
+    return [tuple(canon(d) for d in r) for r in rows]
+
+
+def assert_same(dev_chunk, ref_rows, sort=True):
+    got = rows_canon(dev_chunk.rows())
+    want = rows_canon(ref_rows)
+    if sort:
+        got, want = sorted(got), sorted(want)
+    assert got == want, f"\ndevice={got[:5]}\nref   ={want[:5]} (len {len(got)} vs {len(want)})"
+
+
+C = lambda i: col(i, FTS[i])
+
+
+class TestDAGParity:
+    def test_q6_shape(self):
+        """Selection + scalar agg: sum(price*disc), count(*)."""
+        ch = lineitem_chunk()
+        pred = func(
+            "and",
+            BOOL,
+            func("ge", BOOL, C(0), lit("1994-01-01", new_datetime())),
+            func(
+                "and",
+                BOOL,
+                func("lt", BOOL, C(0), lit("1995-01-01", new_datetime())),
+                func(
+                    "and",
+                    BOOL,
+                    func("between", BOOL, C(3), lit("0.05", new_decimal(3, 2)), lit("0.07", new_decimal(3, 2))),
+                    func("lt", BOOL, C(1), lit(24, new_longlong())),
+                ),
+            ),
+        )
+        revenue = func("mul", new_decimal(31, 4), C(2), C(3))
+        agg = Aggregation(
+            group_by=(),
+            aggs=(AggDesc("sum", (revenue,)), AggDesc("count", ())),
+        )
+        dag = DAGRequest((scan(), Selection((pred,)), agg), output_offsets=(0, 1))
+        dev = run_dag_on_chunk(dag, ch)
+        ref = run_dag_reference(dag, ch)
+        assert_same(dev, ref)
+
+    def test_q1_shape(self):
+        """GROUP BY returnflag, linestatus with 8 aggregates."""
+        ch = lineitem_chunk(600)
+        disc_price = func("mul", new_decimal(31, 4), C(2), func("minus", new_decimal(16, 2), lit(1, new_longlong()), C(3)))
+        charge = func("mul", new_double(), func("cast", new_double(), disc_price), func("plus", new_double(), lit(1.0, new_double()), C(6)))
+        agg = Aggregation(
+            group_by=(C(4), C(5)),
+            aggs=(
+                AggDesc("sum", (C(1),)),
+                AggDesc("sum", (C(2),)),
+                AggDesc("sum", (disc_price,)),
+                AggDesc("avg", (C(1),)),
+                AggDesc("avg", (C(2),)),
+                AggDesc("avg", (C(3),)),
+                AggDesc("count", ()),
+                AggDesc("sum", (charge,)),
+            ),
+        )
+        sel = Selection((func("le", BOOL, C(0), lit("1998-09-02", new_datetime())),))
+        dag = DAGRequest((scan(), sel, agg), output_offsets=tuple(range(10)))
+        dev = run_dag_on_chunk(dag, ch)
+        ref = run_dag_reference(dag, ch)
+        assert dev.num_rows() == len(ref)
+        assert_same(dev, ref)
+
+    def test_topn_limit(self):
+        ch = lineitem_chunk(300)
+        t = TopN(order_by=((C(2), True), (C(0), False)), limit=17)
+        dag = DAGRequest((scan(), t), output_offsets=(2, 0, 4))
+        dev = run_dag_on_chunk(dag, ch)
+        ref = run_dag_reference(dag, ch)
+        assert_same(dev, ref, sort=False)  # TopN is ordered
+
+    def test_limit(self):
+        ch = lineitem_chunk(100)
+        dag = DAGRequest((scan(), Limit(9)), output_offsets=(0, 1, 2, 3, 4, 5, 6))
+        dev = run_dag_on_chunk(dag, ch)
+        assert dev.num_rows() == 9
+        # device keeps first 9 valid rows in input order
+        ref = run_dag_reference(dag, ch)
+        assert_same(dev, ref, sort=False)
+
+    def test_group_overflow_retry(self):
+        """More groups than initial capacity: driver retries with bigger."""
+        ch = lineitem_chunk(500)
+        agg = Aggregation(group_by=(C(2),), aggs=(AggDesc("count", ()),))
+        dag = DAGRequest((scan(), agg), output_offsets=(0, 1))
+        dev = run_dag_on_chunk(dag, ch, group_capacity=32)  # ~500 distinct prices
+        ref = run_dag_reference(dag, ch)
+        assert_same(dev, ref)
+
+    def test_empty_result(self):
+        ch = lineitem_chunk(50)
+        sel = Selection((func("gt", BOOL, C(1), lit(1000, new_longlong())),))
+        agg = Aggregation(group_by=(), aggs=(AggDesc("count", ()), AggDesc("sum", (C(2),))))
+        dag = DAGRequest((scan(), sel, agg), output_offsets=(0, 1))
+        dev = run_dag_on_chunk(dag, ch)
+        assert dev.num_rows() == 1
+        r = dev.row(0)
+        assert r[0].val == 0 and r[1].is_null()

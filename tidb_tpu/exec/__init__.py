@@ -1,0 +1,28 @@
+from .dag import (
+    Aggregation,
+    DAGRequest,
+    Limit,
+    Projection,
+    Selection,
+    TableScan,
+    TopN,
+    ColumnInfo,
+)
+from .builder import build_program, ProgramCache, CompiledDAG
+from .executor import run_dag_on_chunk, run_dag_reference
+
+__all__ = [
+    "Aggregation",
+    "DAGRequest",
+    "Limit",
+    "Projection",
+    "Selection",
+    "TableScan",
+    "TopN",
+    "ColumnInfo",
+    "build_program",
+    "ProgramCache",
+    "CompiledDAG",
+    "run_dag_on_chunk",
+    "run_dag_reference",
+]

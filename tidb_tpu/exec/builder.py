@@ -1,0 +1,148 @@
+"""DAG -> one fused XLA program.
+
+The reference interprets a DAG as a pull-based iterator chain per batch
+(ref: cophandler mppExecute pull loop, cop_handler.go:228). Here the whole
+executor list traces into a *single* jitted function: scan columns in HBM ->
+masked selection -> sort-based aggregation / topn / projection — XLA fuses
+the lot, which is the TPU analog of the legacy fused closure executor
+(ref: unistore/cophandler/closure_exec.go:165 buildClosureExecutor).
+
+Programs cache by (DAG fingerprint, capacity, group capacity) — the XLA
+compile is the expensive part, amortized exactly like the reference's
+coprocessor cache (ref: pkg/store/copr/coprocessor_cache.go).
+
+A program returns per-output-column (value, null[, raw bytes + lengths]),
+plus row validity, row count and an overflow flag; on overflow (group/join
+capacity exceeded) the host driver re-plans with a larger capacity or falls
+back to the reference evaluator (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..chunk.device import DeviceBatch
+from ..expr.compile import CompVal, ExprCompiler, normalize_device_column
+from ..ops import apply_selection, group_aggregate, scalar_aggregate, topn
+from ..ops.aggregate import finalize_agg
+from ..types import FieldType
+from .dag import Aggregation, DAGRequest, Limit, Projection, Selection, TableScan, TopN, current_schema_fts
+
+DEFAULT_GROUP_CAPACITY = 4096
+
+
+def _gather(cols: list[CompVal], idx) -> list[CompVal]:
+    out = []
+    for c in cols:
+        raw = None
+        if c.raw is not None:
+            raw = (c.raw[0][idx], c.raw[1][idx])
+        out.append(CompVal(c.value[idx], c.null[idx], c.ft, raw=raw))
+    return out
+
+
+@dataclass
+class CompiledDAG:
+    fn: object  # jitted DeviceBatch -> (outputs, valid, n_rows, overflow)
+    out_fts: list[FieldType]
+    capacity: int
+    group_capacity: int
+
+
+def build_program(dag: DAGRequest, capacity: int, group_capacity: int = DEFAULT_GROUP_CAPACITY) -> CompiledDAG:
+    executors = dag.executors
+    scan = dag.scan()
+    input_fts = [c.ft for c in scan.columns]
+
+    def program(batch: DeviceBatch):
+        fts = input_fts
+        cols = [normalize_device_column(c) for c in batch.cols]
+        valid = batch.row_valid
+        overflow = jnp.bool_(False)
+
+        for ex in executors[1:]:
+            comp = ExprCompiler(fts)
+            if isinstance(ex, Selection):
+                conds = comp.run(list(ex.conditions), cols)
+                valid = apply_selection(valid, conds)
+            elif isinstance(ex, Projection):
+                cols = comp.run(list(ex.exprs), cols)
+                fts = [e.ft for e in ex.exprs]
+            elif isinstance(ex, Limit):
+                keep = jnp.cumsum(valid.astype(jnp.int32)) <= ex.limit
+                valid = valid & keep
+            elif isinstance(ex, TopN):
+                order_vals = comp.run([e for e, _ in ex.order_by], cols)
+                by = list(zip(order_vals, [d for _, d in ex.order_by]))
+                idx, out_valid = topn(by, valid, ex.limit)
+                cols = _gather(cols, idx)
+                valid = out_valid
+            elif isinstance(ex, Aggregation):
+                garg_exprs = []
+                for a in ex.aggs:
+                    garg_exprs.extend(a.args)
+                gvals = comp.run(list(ex.group_by), cols) if ex.group_by else []
+                avals = comp.run(list(garg_exprs), cols) if garg_exprs else []
+                aggs = []
+                k = 0
+                for a in ex.aggs:
+                    aggs.append((a, avals[k : k + len(a.args)]))
+                    k += len(a.args)
+                new_cols: list[CompVal] = []
+                if ex.group_by:
+                    res = group_aggregate(gvals, aggs, valid, group_capacity, merge=ex.merge)
+                    overflow = overflow | res.overflow
+                    for a, st in zip(ex.aggs, res.states):
+                        new_cols.extend(_agg_out_cols(a, st, res.group_valid, ex.partial))
+                    new_cols.extend(_gather(gvals, res.group_rep))
+                    valid = res.group_valid
+                else:
+                    states = scalar_aggregate(aggs, valid, merge=ex.merge)
+                    for a, st in zip(ex.aggs, states):
+                        new_cols.extend(_agg_out_cols(a, st, jnp.ones(1, bool), ex.partial))
+                    valid = jnp.ones(1, bool)
+                cols = new_cols
+                fts = ex.output_fts()
+            else:
+                raise TypeError(f"unsupported executor {ex}")
+
+        outs = [cols[i] for i in dag.output_offsets]
+        packed = []
+        for c in outs:
+            if c.raw is not None:
+                packed.append((c.value, c.null, c.raw[0], c.raw[1]))
+            else:
+                packed.append((c.value, c.null))
+        return packed, valid, valid.sum(), overflow
+
+    jit_fn = jax.jit(program)
+    return CompiledDAG(jit_fn, dag.output_fts(), capacity, group_capacity)
+
+
+def _agg_out_cols(a, states, group_valid, partial: bool) -> list[CompVal]:
+    fts = a.partial_fts()
+    if partial:
+        return [CompVal(v, nl, ft) for (v, nl), ft in zip(states, fts)]
+    v, nl = finalize_agg(a, states, group_valid)
+    return [CompVal(v, nl, a.ft)]
+
+
+class ProgramCache:
+    """Fingerprint -> CompiledDAG (ref: coprocessor cache keying)."""
+
+    def __init__(self):
+        self._cache: dict = {}
+
+    def get(self, dag: DAGRequest, capacity: int, group_capacity: int = DEFAULT_GROUP_CAPACITY) -> CompiledDAG:
+        key = (dag.fingerprint(), capacity, group_capacity)
+        prog = self._cache.get(key)
+        if prog is None:
+            prog = build_program(dag, capacity, group_capacity)
+            self._cache[key] = prog
+        return prog
+
+    def stats(self):
+        return {"entries": len(self._cache)}

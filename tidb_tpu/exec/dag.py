@@ -1,0 +1,157 @@
+"""The DAG request IR — this framework's `tipb.DAGRequest`.
+
+Mirrors the executor-list shape of the reference wire format
+(ref: pingcap/tipb DAGRequest; built by pkg/planner/core/plan_to_pb.go and
+consumed by unistore/cophandler/cop_handler.go:319 buildDAG): a scan-first
+pipeline of executors plus output offsets and encode options. Everything is
+immutable and fingerprintable so compiled XLA programs cache per plan shape
+(ref: the coprocessor-cache keying idea, pkg/store/copr/coprocessor_cache.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..expr.agg import AggDesc
+from ..expr.ir import Expr
+from ..types import FieldType
+
+
+@dataclass(frozen=True)
+class ColumnInfo:
+    """(ref: tipb.ColumnInfo — column id + type as the scan emits it)."""
+
+    col_id: int
+    ft: FieldType
+
+    def fingerprint(self):
+        return (self.col_id, self.ft.tp, int(self.ft.flag), self.ft.flen, self.ft.decimal)
+
+
+@dataclass(frozen=True)
+class TableScan:
+    """(ref: tipb.TableScan; executor mpp_exec.go:110 tableScanExec)."""
+
+    table_id: int
+    columns: tuple  # tuple[ColumnInfo, ...]
+    desc: bool = False
+
+    def fingerprint(self):
+        return ("scan", self.table_id, self.desc) + tuple(c.fingerprint() for c in self.columns)
+
+
+@dataclass(frozen=True)
+class Selection:
+    """(ref: tipb.Selection; mpp_exec.go:1121 selExec)."""
+
+    conditions: tuple  # tuple[Expr, ...]
+
+    def fingerprint(self):
+        return ("sel",) + tuple(c.fingerprint() for c in self.conditions)
+
+
+@dataclass(frozen=True)
+class Projection:
+    """(ref: tipb.Projection; mpp_exec.go:1157 projExec)."""
+
+    exprs: tuple
+
+    def fingerprint(self):
+        return ("proj",) + tuple(e.fingerprint() for e in self.exprs)
+
+
+@dataclass(frozen=True)
+class Aggregation:
+    """(ref: tipb.Aggregation; mpp_exec.go:999 aggExec). Output schema is
+    [agg results..., group-by keys...] matching the reference's layout.
+
+    `stream` marks input already sorted by group keys (StreamAgg) — same
+    kernel here, the sort inside is nearly free on sorted input.
+    `partial` True emits partial states instead of finalized values.
+    """
+
+    group_by: tuple  # tuple[Expr, ...]
+    aggs: tuple  # tuple[AggDesc, ...]
+    stream: bool = False
+    partial: bool = False
+    merge: bool = False  # input rows are partial states (Final/Partial2)
+
+    def fingerprint(self):
+        return (
+            ("agg", self.stream, self.partial, self.merge)
+            + tuple(g.fingerprint() for g in self.group_by)
+            + tuple(a.fingerprint() for a in self.aggs)
+        )
+
+    def output_fts(self) -> list[FieldType]:
+        out = []
+        for a in self.aggs:
+            if self.partial:
+                out.extend(a.partial_fts())
+            else:
+                out.append(a.ft)
+        out.extend(g.ft for g in self.group_by)
+        return out
+
+
+@dataclass(frozen=True)
+class TopN:
+    """(ref: tipb.TopN; mpp_exec.go:526 topNExec)."""
+
+    order_by: tuple  # tuple[(Expr, desc: bool), ...]
+    limit: int
+
+    def fingerprint(self):
+        return ("topn", self.limit) + tuple((e.fingerprint(), d) for e, d in self.order_by)
+
+
+@dataclass(frozen=True)
+class Limit:
+    """(ref: tipb.Limit; mpp_exec.go:397 limitExec)."""
+
+    limit: int
+
+    def fingerprint(self):
+        return ("limit", self.limit)
+
+
+@dataclass(frozen=True)
+class DAGRequest:
+    """Executor pipeline, scan first (ref: tipb.DAGRequest.Executors).
+
+    output_offsets selects/permutes the final executor's columns
+    (ref: cop_handler.go output offsets handling :249-267).
+    """
+
+    executors: tuple
+    output_offsets: tuple
+    time_zone: str = "UTC"
+    flags: int = 0
+
+    def fingerprint(self):
+        return tuple(e.fingerprint() for e in self.executors) + ("out",) + tuple(self.output_offsets)
+
+    def scan(self) -> TableScan:
+        assert isinstance(self.executors[0], TableScan)
+        return self.executors[0]
+
+    def output_fts(self) -> list[FieldType]:
+        fts = current_schema_fts(self.executors)
+        return [fts[i] for i in self.output_offsets]
+
+
+def current_schema_fts(executors) -> list[FieldType]:
+    """Schema of the last executor's output."""
+    fts: list[FieldType] = []
+    for ex in executors:
+        if isinstance(ex, TableScan):
+            fts = [c.ft for c in ex.columns]
+        elif isinstance(ex, (Selection, Limit, TopN)):
+            pass  # schema unchanged
+        elif isinstance(ex, Projection):
+            fts = [e.ft for e in ex.exprs]
+        elif isinstance(ex, Aggregation):
+            fts = ex.output_fts()
+        else:
+            raise TypeError(f"unknown executor {ex}")
+    return fts

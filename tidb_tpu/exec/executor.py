@@ -1,0 +1,222 @@
+"""Host-side DAG drivers.
+
+run_dag_on_chunk: the device path — pad a host Chunk into a DeviceBatch, run
+the fused program, decode outputs back to a host Chunk. Handles the overflow
+contract by retrying with doubled group capacity (recompile, cached).
+
+run_dag_reference: the Go-semantics oracle — interprets the same DAG row by
+row with RefEvaluator (ref: unistore/cophandler/mpp_exec.go executors),
+used by the parity harness and as the small-data root executor.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..chunk import Chunk, Column, to_device_batch
+from ..expr.agg import AggDesc
+from ..expr.eval_ref import RefEvaluator, compare, _truth
+from ..types import Datum, DatumKind, FieldType, MyDecimal, MyTime
+from .builder import DEFAULT_GROUP_CAPACITY, CompiledDAG, ProgramCache, build_program
+from .dag import Aggregation, DAGRequest, Limit, Projection, Selection, TableScan, TopN
+
+
+def _pow2(n: int) -> int:
+    c = 1
+    while c < n:
+        c *= 2
+    return c
+
+
+def decode_outputs(packed, valid, out_fts) -> Chunk:
+    valid = np.asarray(valid)
+    idx = np.nonzero(valid)[0]
+    cols = []
+    for ft, out in zip(out_fts, packed):
+        if len(out) == 4:  # string: words, null, raw bytes, lengths
+            _, null, data, length = out
+            null = np.asarray(null)[idx]
+            data = np.asarray(data)[idx]
+            length = np.asarray(length)[idx]
+            offs = np.zeros(len(idx) + 1, np.int64)
+            np.cumsum(np.where(null, 0, length), out=offs[1:])
+            blob = np.zeros(int(offs[-1]), np.uint8)
+            for j in range(len(idx)):
+                if not null[j]:
+                    blob[offs[j] : offs[j + 1]] = data[j, : length[j]]
+            cols.append(Column(ft, None, null, offs, blob))
+        else:
+            v, null = out
+            v = np.asarray(v)[idx]
+            null = np.asarray(null)[idx]
+            if ft.is_unsigned() or ft.is_time():
+                v = v.view(np.uint64) if v.dtype == np.int64 else v.astype(np.uint64)
+            cols.append(Column(ft, v.copy(), null.copy()))
+    return Chunk(cols)
+
+
+def run_dag_on_chunk(
+    dag: DAGRequest,
+    chunk: Chunk,
+    cache: ProgramCache | None = None,
+    capacity: int | None = None,
+    group_capacity: int = DEFAULT_GROUP_CAPACITY,
+    max_retries: int = 3,
+) -> Chunk:
+    cache = cache or ProgramCache()
+    cap = capacity or _pow2(max(chunk.num_rows(), 1))
+    batch = to_device_batch(chunk, capacity=cap)
+    gc = group_capacity
+    for _ in range(max_retries + 1):
+        prog = cache.get(dag, cap, gc)
+        packed, valid, n, overflow = prog.fn(batch)
+        if not bool(overflow):
+            return decode_outputs(packed, valid, prog.out_fts)
+        gc *= 4  # group/ join capacity exceeded: recompile bigger
+    raise RuntimeError("DAG overflow not resolved after retries")
+
+
+# ---------------------------------------------------------------------------
+# Reference interpreter (oracle)
+# ---------------------------------------------------------------------------
+
+def datum_group_key(d: Datum):
+    if d.is_null():
+        return (0, None)
+    if d.kind == DatumKind.MysqlDecimal:
+        return (1, str(d.val.d.normalize()))
+    if d.kind in (DatumKind.String, DatumKind.Bytes):
+        v = d.val.encode() if isinstance(d.val, str) else bytes(d.val)
+        return (1, v)
+    if d.kind == DatumKind.MysqlTime:
+        return (1, d.val.packed)
+    if d.kind in (DatumKind.Float32, DatumKind.Float64):
+        return (1, float(d.val) + 0.0)  # -0.0 -> 0.0
+    return (1, d.val)
+
+
+class _RefAgg:
+    """One aggregate's accumulator (Complete mode)."""
+
+    def __init__(self, desc: AggDesc):
+        self.d = desc
+        self.count = 0
+        self.sum = None
+        self.extreme = None
+        self.first = None
+        self.has_first = False
+
+    def update(self, args: list[Datum]):
+        name = self.d.name
+        if name == "count":
+            if all(not a.is_null() for a in args):
+                self.count += 1
+            return
+        a = args[0]
+        if name == "first_row":
+            if not self.has_first:
+                self.first, self.has_first = a, True
+            return
+        if a.is_null():
+            return
+        self.count += 1
+        if name in ("sum", "avg"):
+            if self.sum is None:
+                if a.kind in (DatumKind.Float64, DatumKind.Float32):
+                    self.sum = float(a.val)
+                elif a.kind == DatumKind.MysqlDecimal:
+                    self.sum = a.val
+                else:
+                    self.sum = MyDecimal(a.val, 0)
+            else:
+                if isinstance(self.sum, float):
+                    self.sum += float(a.val)
+                else:
+                    self.sum = self.sum + (a.val if a.kind == DatumKind.MysqlDecimal else MyDecimal(a.val, 0))
+        elif name in ("min", "max"):
+            if self.extreme is None:
+                self.extreme = a
+            else:
+                c = compare(a, self.extreme)
+                if (name == "min" and c < 0) or (name == "max" and c > 0):
+                    self.extreme = a
+        else:
+            raise NotImplementedError(name)
+
+    def result(self) -> Datum:
+        name = self.d.name
+        ft = self.d.ft
+        if name == "count":
+            return Datum.i64(self.count)
+        if name == "first_row":
+            return self.first if self.has_first else Datum.NULL
+        if name == "sum":
+            if self.sum is None:
+                return Datum.NULL
+            if isinstance(self.sum, float):
+                return Datum.f64(self.sum)
+            return Datum.dec(self.sum.round(max(ft.decimal, 0)))
+        if name == "avg":
+            if self.count == 0:
+                return Datum.NULL
+            if isinstance(self.sum, float):
+                return Datum.f64(self.sum / self.count)
+            q = self.sum.div(MyDecimal(self.count, 0))
+            return Datum.dec(q.round(max(ft.decimal, 0)))
+        if name in ("min", "max"):
+            return self.extreme if self.extreme is not None else Datum.NULL
+        raise NotImplementedError(name)
+
+
+def run_dag_reference(dag: DAGRequest, chunk: Chunk) -> list[list[Datum]]:
+    ev = RefEvaluator()
+    rows = chunk.rows()
+    for ex in dag.executors[1:]:
+        if isinstance(ex, Selection):
+            rows = [r for r in rows if all(_truth(ev.eval(c, r)) for c in ex.conditions)]
+        elif isinstance(ex, Projection):
+            rows = [[ev.eval(e, r) for e in ex.exprs] for r in rows]
+        elif isinstance(ex, Limit):
+            rows = rows[: ex.limit]
+        elif isinstance(ex, TopN):
+            import functools
+
+            def cmp_rows(r1, r2):
+                for e, desc in ex.order_by:
+                    a, b = ev.eval(e, r1), ev.eval(e, r2)
+                    if a.is_null() and b.is_null():
+                        continue
+                    if a.is_null():
+                        c = -1
+                    elif b.is_null():
+                        c = 1
+                    else:
+                        c = compare(a, b)
+                    if c:
+                        return -c if desc else c
+                return 0
+
+            rows = sorted(rows, key=functools.cmp_to_key(cmp_rows))[: ex.limit]
+        elif isinstance(ex, Aggregation):
+            assert not ex.partial and not ex.merge, "oracle runs Complete mode"
+            groups: dict = {}
+            order: list = []
+            for r in rows:
+                key = tuple(datum_group_key(ev.eval(g, r)) for g in ex.group_by)
+                if key not in groups:
+                    groups[key] = ([_RefAgg(a) for a in ex.aggs], [ev.eval(g, r) for g in ex.group_by])
+                    order.append(key)
+                accs, _ = groups[key]
+                for acc, a in zip(accs, ex.aggs):
+                    acc.update([ev.eval(x, r) for x in a.args])
+            if not ex.group_by:
+                if not rows:
+                    groups[()] = ([_RefAgg(a) for a in ex.aggs], [])
+                    order.append(())
+            rows = []
+            for key in order:
+                accs, gvals = groups[key]
+                rows.append([acc.result() for acc in accs] + gvals)
+        else:
+            raise TypeError(f"unsupported executor {ex}")
+    return [[r[i] for i in dag.output_offsets] for r in rows]

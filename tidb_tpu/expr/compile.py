@@ -83,6 +83,17 @@ def _words_cmp(a, b):
     return jnp.where(lt, -1, jnp.where(gt, 1, 0)).astype(jnp.int32)
 
 
+def normalize_device_column(c: DeviceColumn) -> CompVal:
+    """DeviceColumn -> CompVal (strings get packed compare words)."""
+    if c.is_varlen():
+        words = pack_string_words(c.data, c.length)
+        return CompVal(words, c.null, c.ft, raw=(c.data, c.length))
+    data = c.data
+    if data.dtype != jnp.int64 and c.ft.eval_type() != "real":
+        data = data.astype(jnp.int64)
+    return CompVal(data, c.null, c.ft)
+
+
 class ExprCompiler:
     """Compiles Expr trees against a fixed input schema."""
 
@@ -114,14 +125,11 @@ class ExprCompiler:
         if e.index in self._col_cache:
             return self._col_cache[e.index]
         c = self._cols[e.index]
-        if c.is_varlen():
-            words = pack_string_words(c.data, c.length)
-            v = CompVal(words, c.null, e.ft, raw=(c.data, c.length))
-        else:
-            data = c.data
-            if data.dtype != jnp.int64 and e.ft.eval_type() not in ("real",):
-                data = data.astype(jnp.int64)
-            v = CompVal(data, c.null, e.ft)
+        if isinstance(c, CompVal):
+            # pipeline stages (exec/builder.py) bind already-normalized values
+            self._col_cache[e.index] = c
+            return c
+        v = normalize_device_column(c)
         self._col_cache[e.index] = v
         return v
 

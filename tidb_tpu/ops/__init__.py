@@ -1,0 +1,17 @@
+from .keys import sort_key_arrays, lexsort, segments_from_sorted
+from .selection import apply_selection
+from .aggregate import GroupAggResult, group_aggregate, scalar_aggregate
+from .topn import topn
+from .join import hash_join
+
+__all__ = [
+    "sort_key_arrays",
+    "lexsort",
+    "segments_from_sorted",
+    "apply_selection",
+    "GroupAggResult",
+    "group_aggregate",
+    "scalar_aggregate",
+    "topn",
+    "hash_join",
+]

@@ -1,0 +1,213 @@
+"""Aggregation kernels (ref: unistore/cophandler/mpp_exec.go:999 aggExec,
+pkg/executor/aggregate/agg_hash_executor.go, pkg/executor/aggfuncs).
+
+TPU-native shape: instead of a hash table (pointer chasing — hostile to the
+VPU), group-by is sort-based: normalize keys to int64 arrays, lexsort, detect
+segment boundaries, then scatter-reduce into a fixed `group_capacity` table
+with `jax.ops.segment_*`. Dynamic group counts live behind a static capacity
+plus an overflow flag (SURVEY.md §7 "hard parts": dynamic cardinality).
+
+Two phases mirror the reference's partial/final split
+(ref: pkg/expression/aggregation modes):
+  raw phase    (Complete/Partial1)  raw rows in
+  merge phase  (Partial2/Final)     partial-state columns in, reduced by
+                                    state-specific merge (+, +, min, max...)
+
+Partial states (expr/agg.py): count=[n], sum=[s], avg=[n,s], min/max=[v].
+The psum across regions of these states is exactly the ICI-mesh merge of the
+north star (BASELINE.json): count/sum/avg states add elementwise.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..expr.agg import AggDesc
+from ..expr.compile import CompVal, _round_div, _scale
+from ..types import FieldType, TypeCode
+from .keys import lexsort, segments_from_sorted, sort_key_arrays
+
+I64_MAX = jnp.int64(0x7FFFFFFFFFFFFFFF)
+I64_MIN_ = jnp.int64(-0x8000000000000000)
+
+
+@dataclass
+class GroupAggResult:
+    """Fixed-capacity aggregation output.
+
+    group_rep: int32 [G] representative input-row index per group (gather
+    group-by output columns from the original batch with it).
+    states: per agg, list of (value[G], null[G]) state/result columns.
+    """
+
+    group_rep: jax.Array
+    group_valid: jax.Array
+    n_groups: jax.Array
+    overflow: jax.Array
+    states: list
+
+
+def _seg_sum(vals, seg, n, dtype=None):
+    return jax.ops.segment_sum(vals if dtype is None else vals.astype(dtype), seg, num_segments=n)
+
+
+def _masked(vals, mask, fill):
+    return jnp.where(mask, vals, fill)
+
+
+def _agg_states_raw(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
+    """Per-group partial states from raw rows."""
+    name = desc.name
+    if name == "count":
+        mask = valid
+        for a in args:
+            mask = mask & ~a.null
+        return [(_seg_sum(mask.astype(jnp.int64), seg, nseg), jnp.zeros(nseg, bool))]
+    a = args[0]
+    mask = valid & ~a.null
+    cnt = _seg_sum(mask.astype(jnp.int64), seg, nseg)
+    empty = cnt == 0
+    if name in ("sum", "avg"):
+        if a.eval_type == "real":
+            s = _seg_sum(_masked(a.value, mask, 0.0), seg, nseg)
+        else:
+            s = _seg_sum(_masked(a.value.astype(jnp.int64), mask, jnp.int64(0)), seg, nseg)
+        if name == "sum":
+            return [(s, empty)]
+        return [(cnt, jnp.zeros(nseg, bool)), (s, empty)]
+    if name in ("min", "max"):
+        op = jax.ops.segment_min if name == "min" else jax.ops.segment_max
+        if a.eval_type == "real":
+            fill = jnp.inf if name == "min" else -jnp.inf
+            v = op(_masked(a.value, mask, fill), seg, num_segments=nseg)
+        elif a.value.ndim == 2:
+            # strings: packed words are sign-adjusted but per-word reduction
+            # is not lexicographic; handled via a per-segment arg-extreme on
+            # the first word only when strings fit one word (W+1 == 2).
+            raise NotImplementedError("min/max over strings on device TODO")
+        elif a.ft.is_unsigned() and a.eval_type == "int":
+            flip = jnp.int64(-0x8000000000000000)
+            av = a.value.astype(jnp.int64) ^ flip
+            fill = I64_MAX if name == "min" else I64_MIN_
+            v = op(_masked(av, mask, fill), seg, num_segments=nseg) ^ flip
+        else:
+            av = a.value.astype(jnp.int64)
+            fill = I64_MAX if name == "min" else I64_MIN_
+            v = op(_masked(av, mask, fill), seg, num_segments=nseg)
+        return [(v, empty)]
+    if name == "first_row":
+        # first row in sorted order per segment (arbitrary row, like the
+        # reference's map-ordered first_row)
+        pos = jnp.arange(seg.shape[0], dtype=jnp.int32)
+        inseg = valid  # first_row keeps NULL argument values too
+        first = jax.ops.segment_min(jnp.where(inseg, pos, jnp.int32(2**31 - 1)), seg, num_segments=nseg)
+        first = jnp.clip(first, 0, seg.shape[0] - 1)
+        return [(a.value[first], a.null[first])]
+    raise NotImplementedError(f"aggregate {name} on device")
+
+
+def _agg_states_merge(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
+    """Merge partial-state columns (Partial2/Final): args are state cols."""
+    name = desc.name
+    if name == "count":
+        a = args[0]
+        return [(_seg_sum(_masked(a.value, valid, 0), seg, nseg), jnp.zeros(nseg, bool))]
+    if name in ("sum", "avg"):
+        out = []
+        for a in args:  # count then sum for avg; sum only for sum
+            mask = valid & ~a.null
+            present = _seg_sum(mask.astype(jnp.int64), seg, nseg) > 0
+            if a.eval_type == "real":
+                s = _seg_sum(_masked(a.value, mask, 0.0), seg, nseg)
+            else:
+                s = _seg_sum(_masked(a.value.astype(jnp.int64), mask, jnp.int64(0)), seg, nseg)
+            out.append((s, ~present))
+        if name == "avg":
+            # count state never null
+            out[0] = (out[0][0], jnp.zeros(nseg, bool))
+        return out
+    if name in ("min", "max"):
+        return _agg_states_raw(desc, args, valid, seg, nseg)
+    if name == "first_row":
+        return _agg_states_raw(desc, args, valid, seg, nseg)
+    raise NotImplementedError(f"merge of {name} on device")
+
+
+def finalize_agg(desc: AggDesc, states: list, group_valid) -> tuple:
+    """State columns -> final (value, null) result column."""
+    name = desc.name
+    if name == "avg":
+        cnt, (s, snull) = states[0][0], states[1]
+        if desc.ft.eval_type() == "real":
+            out = s / jnp.where(cnt == 0, 1.0, cnt).astype(jnp.float64)
+            return out, snull | (cnt == 0)
+        # decimal: scale(avg) = scale(sum) + 4 (div frac incr)
+        sum_scale = _scale(desc.partial_fts()[1])
+        tgt = _scale(desc.ft)
+        num = s * jnp.int64(10 ** (tgt - sum_scale))
+        out = _round_div(num, jnp.where(cnt == 0, jnp.int64(1), cnt))
+        return out, snull | (cnt == 0)
+    # identity finalize
+    v, nl = states[0][0], states[0][1]
+    return v, nl
+
+
+def group_aggregate(
+    group_bys: list[CompVal],
+    aggs: list,
+    row_valid: jax.Array,
+    group_capacity: int,
+    merge: bool = False,
+):
+    """Sort-based group aggregation.
+
+    aggs: list of (AggDesc, [arg CompVals]). Returns GroupAggResult with one
+    extra hidden overflow segment dropped.
+    """
+    n = row_valid.shape[0]
+    keys: list[jax.Array] = []
+    for g in group_bys:
+        keys.extend(sort_key_arrays(g))
+    invalid_first_key = jnp.where(row_valid, jnp.int64(0), jnp.int64(1))
+    perm = lexsort([invalid_first_key] + keys)
+    valid_s = row_valid[perm]
+    keys_s = [k[perm] for k in keys]
+    seg, n_groups = segments_from_sorted(keys_s, valid_s)
+    overflow = n_groups > group_capacity
+    nseg = group_capacity + 1
+    seg = jnp.minimum(seg, nseg - 1)
+
+    # representative original row per group
+    pos = jnp.arange(n, dtype=jnp.int32)
+    first_pos = jax.ops.segment_min(jnp.where(valid_s, pos, jnp.int32(n)), seg, num_segments=nseg)
+    first_pos = jnp.clip(first_pos, 0, n - 1)
+    group_rep = perm[first_pos][:group_capacity].astype(jnp.int32)
+    gids = jnp.arange(group_capacity, dtype=jnp.int32)
+    group_valid = gids < n_groups
+
+    states = []
+    for desc, arg_vals in aggs:
+        av_s = [CompVal(a.value[perm] if a.value.ndim == 1 else a.value[perm, :], a.null[perm], a.ft, raw=None) for a in arg_vals]
+        fn = _agg_states_merge if merge else _agg_states_raw
+        st = fn(desc, av_s, valid_s, seg, nseg)
+        st = [(v[:group_capacity], nl[:group_capacity]) for v, nl in st]
+        st = [(v, nl | ~group_valid) for v, nl in st]
+        states.append(st)
+
+    return GroupAggResult(group_rep, group_valid, jnp.minimum(n_groups, group_capacity), overflow, states)
+
+
+def scalar_aggregate(aggs: list, row_valid: jax.Array, merge: bool = False):
+    """Aggregation without GROUP BY: always exactly one output row
+    (ref: SELECT count(*) over empty set returns 0)."""
+    n = row_valid.shape[0]
+    seg = jnp.zeros(n, jnp.int32)
+    fn = _agg_states_merge if merge else _agg_states_raw
+    states = []
+    for desc, arg_vals in aggs:
+        st = fn(desc, arg_vals, row_valid, seg, 1)
+        states.append(st)
+    return states

@@ -1,0 +1,158 @@
+"""Equi hash-join kernel (ref: unistore/cophandler/mpp_exec.go:844 joinExec,
+pkg/executor/join/hash_join_v2.go).
+
+The reference builds a string-keyed hash map then probes row by row. On TPU
+that becomes sort + binary search: sort the build side by normalized join
+keys; for each probe row, lower/upper-bound searchsorted gives the matching
+run [lo, hi). Output expansion (dynamic fan-out) lands in a static
+`out_capacity` table: a prefix sum over match counts assigns each output
+slot to a (probe, nth-match) pair, recovered with one more searchsorted —
+fully vectorized, no data-dependent shapes, overflow flagged for host
+fallback (SURVEY.md §7 hard parts: join fan-out).
+
+NULL join keys never match (SQL equi-join), mirroring the reference's
+skip-on-null (mpp_exec.go joinExec null key handling).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..expr.compile import CompVal
+from .keys import lexsort, sort_key_arrays
+
+
+@dataclass
+class JoinResult:
+    """Index-pair form: gather output columns from both sides.
+
+    build_idx/probe_idx: int32 [out_capacity] row indices into the original
+    batches; for outer-join null-extended rows, build_idx slot is -1 and
+    build_null True.
+    """
+
+    probe_idx: jax.Array
+    build_idx: jax.Array
+    build_null: jax.Array  # True where no build match (outer fill)
+    out_valid: jax.Array
+    n_out: jax.Array
+    overflow: jax.Array
+
+
+def _key_matrix(vals: list[CompVal], valid):
+    """Normalized key arrays; rows with any NULL key are excluded via the
+    returned `usable` mask (NULL never equi-matches)."""
+    keys = []
+    usable = valid
+    for v in vals:
+        usable = usable & ~v.null
+        keys.extend(sort_key_arrays(v)[1:])  # drop null-flag word; nulls excluded
+    return keys, usable
+
+
+def hash_join(
+    build_keys: list[CompVal],
+    probe_keys: list[CompVal],
+    build_valid,
+    probe_valid,
+    out_capacity: int,
+    join_type: str = "inner",
+):
+    """join_type: inner | left_outer (probe side preserved) | semi | anti."""
+    bkeys, b_usable = _key_matrix(build_keys, build_valid)
+    pkeys, p_usable = _key_matrix(probe_keys, probe_valid)
+    nb = build_valid.shape[0]
+    I64_MAX = jnp.int64(0x7FFFFFFFFFFFFFFF)
+
+    # Mask unusable (invalid / NULL-key) build rows to +max so the sorted
+    # array is globally ordered by key words alone — searchsorted needs that.
+    # Phantom matches against the masked tail are removed by clipping hi to
+    # nb_usable below.
+    def _maskmax(k):
+        top = jnp.inf if jnp.issubdtype(k.dtype, jnp.floating) else I64_MAX
+        return jnp.where(b_usable, k, top)
+
+    bkeys = [_maskmax(k) for k in bkeys]
+    bperm = lexsort(bkeys)
+    bkeys_s = [k[bperm] for k in bkeys]
+    nb_usable = b_usable.sum()
+
+    # Single-word keys (ints, dates, decimals, short strings): direct
+    # searchsorted. Multi-word keys: densify (build ∪ probe) tuples to ranks
+    # with one shared lexsort, then searchsorted on ranks.
+    if len(bkeys_s) == 1:
+        bk, pk = bkeys_s[0], pkeys[0]
+        lo = jnp.searchsorted(bk, pk, side="left").astype(jnp.int32)
+        hi = jnp.searchsorted(bk, pk, side="right").astype(jnp.int32)
+    else:
+        # multi-word: map each word tuple to a dense rank via sorting the
+        # union (build + probe) once, then single searchsorted on ranks.
+        nb_, np_ = bkeys_s[0].shape[0], pkeys[0].shape[0]
+        allk = [jnp.concatenate([b, p]) for b, p in zip(bkeys_s, pkeys)]
+        operm = lexsort(allk)
+        ok = [k[operm] for k in allk]
+        diff = jnp.zeros(nb_ + np_, bool)
+        for k in ok:
+            diff = diff | jnp.concatenate([jnp.ones(1, bool), k[1:] != k[:-1]])
+        rank_sorted = jnp.cumsum(diff.astype(jnp.int64)) - 1
+        rank = jnp.zeros(nb_ + np_, jnp.int64).at[operm].set(rank_sorted)
+        brank, prank = rank[:nb_], rank[nb_:]
+        lo = jnp.searchsorted(brank, prank, side="left").astype(jnp.int32)
+        hi = jnp.searchsorted(brank, prank, side="right").astype(jnp.int32)
+
+    hi = jnp.minimum(hi, nb_usable.astype(jnp.int32))
+    lo = jnp.minimum(lo, hi)
+    counts = jnp.where(p_usable, hi - lo, 0)
+    matched = counts > 0
+
+    if join_type == "semi":
+        return JoinResult(
+            probe_idx=jnp.arange(probe_valid.shape[0], dtype=jnp.int32),
+            build_idx=jnp.full(probe_valid.shape[0], -1, jnp.int32),
+            build_null=jnp.ones(probe_valid.shape[0], bool),
+            out_valid=probe_valid & matched,
+            n_out=(probe_valid & matched).sum(),
+            overflow=jnp.bool_(False),
+        )
+    if join_type == "anti":
+        keep = probe_valid & ~matched
+        return JoinResult(
+            probe_idx=jnp.arange(probe_valid.shape[0], dtype=jnp.int32),
+            build_idx=jnp.full(probe_valid.shape[0], -1, jnp.int32),
+            build_null=jnp.ones(probe_valid.shape[0], bool),
+            out_valid=keep,
+            n_out=keep.sum(),
+            overflow=jnp.bool_(False),
+        )
+
+    if join_type == "left_outer":
+        counts = jnp.where(probe_valid, jnp.maximum(counts, 1), 0)
+
+    offsets = jnp.cumsum(counts) - counts  # start slot per probe row
+    total = counts.sum()
+    overflow = total > out_capacity
+
+    slot = jnp.arange(out_capacity)
+    # which probe row does each output slot belong to
+    probe_of = jnp.searchsorted(offsets + counts, slot, side="right").astype(jnp.int32)
+    probe_of = jnp.minimum(probe_of, probe_valid.shape[0] - 1)
+    nth = slot - offsets[probe_of]
+    b_sorted_pos = lo[probe_of] + nth.astype(jnp.int32)
+    b_sorted_pos = jnp.clip(b_sorted_pos, 0, nb - 1)
+    build_idx = bperm[b_sorted_pos].astype(jnp.int32)
+    out_valid = slot < total
+    real_match = p_usable[probe_of] & ((hi[probe_of] - lo[probe_of]) > 0)
+    build_null = ~real_match  # only possible under left_outer fill
+    build_idx = jnp.where(build_null, -1, build_idx)
+
+    return JoinResult(
+        probe_idx=probe_of,
+        build_idx=build_idx,
+        build_null=build_null & out_valid,
+        out_valid=out_valid,
+        n_out=total,
+        overflow=overflow,
+    )

@@ -1,0 +1,83 @@
+"""Key normalization: every sortable/groupable value becomes int64 arrays.
+
+All kernel machinery (sort, segment detection, join probe) then works on a
+uniform list of int64 key arrays with lexicographic semantics:
+
+  numeric int/decimal/time  [null_flag, value]
+  real                      [null_flag, order-preserving bit trick]
+  string                    [null_flag, word0..wordW, length]
+
+NULL ordering follows MySQL: NULLs sort first ascending / last descending;
+for GROUP BY, NULLs form one group (ref: aggExec treats NULL keys as equal,
+unistore/cophandler/mpp_exec.go:999). The float trick mirrors
+codec.EncodeFloat (ref: pkg/util/codec/float.go:23): flip all bits for
+negatives, flip the sign bit for positives.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..expr.compile import CompVal, I64_MIN
+
+
+def _float_sortable(v: jax.Array) -> jax.Array:
+    """Floats stay float keys — XLA sort/compare gives the right total order
+    once -0.0 is canonicalized, and a 64-bit bitcast would break the TPU
+    x64-emulation rewrite (s64 is a pair of u32 under the hood there)."""
+    return jnp.where(v == 0.0, 0.0, v.astype(jnp.float64))
+
+
+def sort_key_arrays(v: CompVal, desc: bool = False) -> list[jax.Array]:
+    """CompVal -> int64 arrays, most significant first.
+
+    Ascending lexicographic order on the result == SQL ORDER BY order of the
+    value with NULLs first; `desc` bit-inverts every word (an order-reversing
+    bijection on int64), which also puts NULLs last, matching MySQL DESC.
+    NULL rows' value lanes are zeroed so all NULLs compare equal (one group).
+    """
+    nf = 1 - v.null.astype(jnp.int64)  # null -> 0 (sorts first ascending)
+    if v.value.ndim == 2:
+        arrs = [nf] + [v.value[:, i] for i in range(v.value.shape[1])]
+    elif v.eval_type == "real":
+        arrs = [nf, _float_sortable(v.value)]
+    elif v.ft.is_unsigned() and v.eval_type == "int":
+        arrs = [nf, v.value ^ I64_MIN]
+    else:
+        arrs = [nf, v.value.astype(jnp.int64)]
+    arrs = [arrs[0]] + [jnp.where(v.null, jnp.zeros((), a.dtype), a) for a in arrs[1:]]
+    if desc:
+        # order-reversing bijection: bit-inverse for ints, negation for floats
+        arrs = [-a if jnp.issubdtype(a.dtype, jnp.floating) else ~a for a in arrs]
+    return arrs
+
+
+def lexsort(keys: list[jax.Array], extra_key: jax.Array | None = None):
+    """Stable lexicographic argsort, most-significant key first.
+
+    jnp.lexsort treats its *last* key as primary, so reverse. `extra_key`
+    (least significant, e.g. original row index) goes first after reversal.
+    """
+    order = list(reversed(keys))
+    if extra_key is not None:
+        order = [extra_key] + order
+    return jnp.lexsort(tuple(order))
+
+
+def segments_from_sorted(sorted_keys: list[jax.Array], valid: jax.Array):
+    """Given key arrays already in sorted row order plus a validity mask
+    (invalid rows sorted to the end), return (segment_ids, n_groups).
+
+    segment_ids: int32 [N], 0-based group index per row; invalid rows get
+    segment id == n_groups (one past the last real group) so scatter-based
+    reductions can drop them into a spare slot.
+    """
+    diff = jnp.zeros(valid.shape[0], bool)
+    for k in sorted_keys:
+        diff = diff | jnp.concatenate([jnp.ones(1, bool), k[1:] != k[:-1]])
+    new_seg = diff & valid
+    seg = jnp.cumsum(new_seg.astype(jnp.int32)) - 1
+    n_groups = jnp.max(jnp.where(valid, seg, -1)) + 1
+    seg = jnp.where(valid, seg, n_groups)
+    return seg.astype(jnp.int32), n_groups.astype(jnp.int32)

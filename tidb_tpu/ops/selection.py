@@ -1,0 +1,27 @@
+"""Selection kernel (ref: unistore/cophandler/mpp_exec.go:1121 selExec,
+pkg/expression/chunk_executor.go:423 VectorizedFilter).
+
+On TPU a filter is just a mask intersection — no row movement. Downstream
+kernels consume `row_valid`; compaction happens only at output encode or
+before capacity-sensitive ops (join build sides)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..expr.compile import CompVal
+
+
+def apply_selection(row_valid, conds: list[CompVal]):
+    """AND of condition truthiness; NULL and false both drop the row
+    (SQL WHERE keeps rows where every condition is true and non-NULL)."""
+    out = row_valid
+    for c in conds:
+        if c.value.ndim == 2:
+            raise NotImplementedError("string-typed filter condition")
+        if c.eval_type == "real":
+            t = c.value != 0.0
+        else:
+            t = c.value != 0
+        out = out & t & ~c.null
+    return out

@@ -1,0 +1,34 @@
+"""TopN kernel (ref: unistore/cophandler/mpp_exec.go:526 topNExec,
+pkg/executor/sortexec/topn.go:38).
+
+The reference keeps a heap over evaluated sort keys; on TPU the batch is
+resident, so TopN = normalize keys -> lexsort (stable, so ties keep input
+order like the reference's stable heap-pop order) -> take first k row
+indices. Single-key numeric cases could use lax.top_k, but full sort keeps
+multi-key and NULL ordering uniform and XLA's sort is fast on VPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..expr.compile import CompVal
+from .keys import lexsort, sort_key_arrays
+
+
+def topn(by: list, row_valid, k: int):
+    """by: list of (CompVal, desc: bool). Returns (row_indices[k], out_valid[k]).
+
+    Invalid rows sort last; out_valid marks slots < min(k, n_valid_rows).
+    """
+    keys = []
+    for v, desc in by:
+        keys.extend(sort_key_arrays(v, desc=desc))
+    n = row_valid.shape[0]
+    invalid_last = jnp.where(row_valid, jnp.int64(0), jnp.int64(1))
+    perm = lexsort([invalid_last] + keys)
+    k = min(k, n)
+    idx = perm[:k]
+    n_valid = row_valid.sum()
+    out_valid = jnp.arange(k) < n_valid
+    return idx.astype(jnp.int32), out_valid
