@@ -178,3 +178,26 @@ class TestDAGParity:
         assert dev.num_rows() == 1
         r = dev.row(0)
         assert r[0].val == 0 and r[1].is_null()
+
+
+def test_first_row_string_group():
+    """first_row over a varchar column via rep-row gather."""
+    from tidb_tpu.expr import AggDesc
+
+    ch = lineitem_chunk(120)
+    agg = Aggregation(group_by=(C(4),), aggs=(AggDesc("first_row", (C(5),)), AggDesc("count", ())))
+    dag = DAGRequest((scan(), agg), output_offsets=(0, 1, 2))
+    dev = run_dag_on_chunk(dag, ch)
+    # first_row is 'any row of the group' — verify each value is drawn from
+    # the group's actual rows and counts match the oracle
+    groups = {}
+    for r in ch.rows():
+        k = canon(r[4])
+        groups.setdefault(k, []).append(r)
+    assert dev.num_rows() == len(groups)
+    for r in dev.rows():
+        k = canon(r[2])
+        members = groups[k]
+        assert r[1].val == len(members)
+        vals = {canon(m[5]) for m in members}
+        assert canon(r[0]) in vals

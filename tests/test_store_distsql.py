@@ -1,0 +1,138 @@
+"""Store + distsql: multi-region cop dispatch, partial agg across regions,
+region-split retry — the reference's testkit-style in-process cluster
+(ref: pkg/testkit/mockstore.go CreateMockStore + unistore cluster)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.types import Datum, MyDecimal, new_decimal, new_longlong, new_varchar
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.codec import tablecodec
+from tidb_tpu.distsql import KVRequest, full_table_ranges, select
+from tidb_tpu.exec import Aggregation, ColumnInfo, DAGRequest, Selection, TableScan, run_dag_reference
+from tidb_tpu.expr import AggDesc, AggMode, col, func, lit
+from tidb_tpu.store import TPUStore
+
+BOOL = new_longlong(notnull=True)
+TID = 44
+FTS = [new_longlong(), new_decimal(10, 2), new_varchar(6)]
+COL_IDS = [1, 2, 3]
+
+
+def fill_store(n=300, regions=4, seed=2):
+    store = TPUStore()
+    rng = np.random.default_rng(seed)
+    rows = []
+    for h in range(n):
+        row = [
+            Datum.i64(int(rng.integers(0, 9))),
+            Datum.dec(MyDecimal(f"{int(rng.integers(-10000, 10000))/100:.2f}")),
+            Datum.string(["red", "green", "blue"][int(rng.integers(3))]),
+        ]
+        rows.append(row)
+        store.put_row(TID, h, COL_IDS, row, ts=10)
+    # split into regions on handle boundaries (ref: cluster.SplitKeys)
+    for i in range(1, regions):
+        store.cluster.split(tablecodec.encode_row_key(TID, i * n // regions))
+    return store, rows
+
+
+def scan():
+    return TableScan(TID, tuple(ColumnInfo(cid, ft) for cid, ft in zip(COL_IDS, FTS)))
+
+
+def test_multi_region_scan_concat():
+    store, rows = fill_store()
+    dag = DAGRequest((scan(),), output_offsets=(0, 1, 2))
+    res = select(store, KVRequest(dag, full_table_ranges(TID), start_ts=100))
+    assert len(res.chunks) == 4  # one per region
+    merged = res.merged()
+    assert merged.num_rows() == len(rows)
+    got = sorted((r[0].val, str(r[1].val), r[2].val) for r in merged.rows())
+    want = sorted((r[0].val, str(r[1].val), r[2].val) for r in rows)
+    assert got == want
+
+
+def test_partial_agg_per_region_then_merge():
+    """Partial1 on each region; Final merge at root — the north-star shape."""
+    store, rows = fill_store(n=200, regions=4)
+    g = col(0, FTS[0])
+    d = col(1, FTS[1])
+    partial = Aggregation(group_by=(g,), aggs=(AggDesc("avg", (d,)), AggDesc("count", ())), partial=True)
+    dag = DAGRequest((scan(), partial), output_offsets=tuple(range(4)))
+    res = select(store, KVRequest(dag, full_table_ranges(TID), start_ts=100))
+    # root merge: stack partial chunks, Final aggregate keyed on group col
+    stacked = res.merged()
+    # partial schema: [avg.count, avg.sum, count.count, g]
+    pfts = stacked.field_types()
+    from tidb_tpu.exec import run_dag_on_chunk
+
+    avg_desc = AggDesc("avg", (col(1, FTS[1]),))
+    cnt_desc = AggDesc("count", ())
+    merge_agg = Aggregation(
+        group_by=(col(3, pfts[3]),),
+        aggs=(
+            AggDesc("avg", (col(0, pfts[0]), col(1, pfts[1])), mode=AggMode.Final),
+            AggDesc("count", (col(2, pfts[2]),), mode=AggMode.Final),
+        ),
+        merge=True,
+    )
+    root = DAGRequest((TableScan(0, tuple(ColumnInfo(i, ft) for i, ft in enumerate(pfts))), merge_agg), output_offsets=(0, 1, 2))
+    final = run_dag_on_chunk(root, stacked)
+    # oracle: single-shot over all rows
+    oracle_agg = Aggregation(group_by=(g,), aggs=(avg_desc, cnt_desc))
+    oracle = run_dag_reference(DAGRequest((scan(), oracle_agg), output_offsets=(0, 1, 2)), Chunk.from_rows(FTS, rows))
+    got = sorted((str(r[0].val) if not r[0].is_null() else None, r[1].val, r[2].val if not r[2].is_null() else None) for r in final.rows())
+    want = sorted((str(r[0].val) if not r[0].is_null() else None, r[1].val, r[2].val if not r[2].is_null() else None) for r in oracle)
+    assert got == want
+
+
+def test_selection_pushdown_multi_region():
+    store, rows = fill_store(n=150, regions=3)
+    pred = func("gt", BOOL, col(1, FTS[1]), lit("0.00", new_decimal(3, 2)))
+    dag = DAGRequest((scan(), Selection((pred,))), output_offsets=(0, 1))
+    res = select(store, KVRequest(dag, full_table_ranges(TID), start_ts=100))
+    merged = res.merged()
+    want = [r for r in rows if not r[1].is_null() and r[1].val > MyDecimal("0")]
+    assert merged.num_rows() == len(want)
+
+
+def test_region_split_retry():
+    """Split after tasks are built -> epoch mismatch -> transparent retry."""
+    store, rows = fill_store(n=100, regions=2)
+    dag = DAGRequest((scan(),), output_offsets=(0,))
+
+    # build tasks against the current view, then split to invalidate epochs
+    from tidb_tpu.distsql.dispatch import _build_tasks
+
+    ranges = full_table_ranges(TID)
+    tasks = _build_tasks(store, ranges)
+    store.cluster.split(tablecodec.encode_row_key(TID, 25))
+
+    # run through select: it rebuilds from fresh view internally, so emulate
+    # staleness by issuing the stale task directly first
+    from tidb_tpu.store import CopRequest
+
+    stale = tasks[0]
+    resp = store.coprocessor(CopRequest(dag, stale.ranges, 100, stale.region_id, stale.epoch))
+    assert resp.region_error is not None and "epoch_not_match" in resp.region_error
+
+    res = select(store, KVRequest(dag, ranges, start_ts=100))
+    assert res.merged().num_rows() == 100
+
+
+def test_mvcc_snapshot_read():
+    store, _ = fill_store(n=20, regions=1)
+    # overwrite handle 0 at ts=50
+    store.put_row(TID, 0, COL_IDS, [Datum.i64(777), Datum.dec("1.00"), Datum.string("red")], ts=50)
+    dag = DAGRequest((scan(),), output_offsets=(0,))
+    old = select(store, KVRequest(dag, full_table_ranges(TID), start_ts=20)).merged()
+    new = select(store, KVRequest(dag, full_table_ranges(TID), start_ts=60)).merged()
+    olds = sorted(r[0].val for r in old.rows())
+    news = sorted(r[0].val for r in new.rows())
+    assert 777 not in olds
+    assert 777 in news
+    # delete visible only after its ts
+    store.delete_row(TID, 1, ts=70)
+    assert select(store, KVRequest(dag, full_table_ranges(TID), start_ts=60)).merged().num_rows() == 20
+    assert select(store, KVRequest(dag, full_table_ranges(TID), start_ts=80)).merged().num_rows() == 19

@@ -1,0 +1,3 @@
+from .dispatch import KVRequest, SelectResult, select, full_table_ranges
+
+__all__ = ["KVRequest", "SelectResult", "select", "full_table_ranges"]

@@ -1,0 +1,123 @@
+"""DistSQL dispatch: split a request into per-region cop tasks, send, merge
+(ref: pkg/distsql/distsql.go:56 Select + RequestBuilder request_builder.go:56;
+task split copr/coprocessor.go:331 buildCopTasks; retry-on-region-error
+coprocessor.go:1424).
+
+Concurrency mirrors `tidb_distsql_scan_concurrency` (sysvar.go:1956) with a
+thread pool; device execution itself serializes on the single JAX stream,
+but scan-decode and host encode overlap.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from ..chunk import Chunk
+from ..codec import tablecodec
+from ..codec.number import encode_int_cmp
+from ..exec.dag import DAGRequest
+from ..store import CopRequest, KeyRange, TPUStore
+
+I64_MIN = -(1 << 63)
+I64_MAX = (1 << 63) - 1
+MAX_RETRY = 8
+
+
+def full_table_ranges(table_id: int) -> list[KeyRange]:
+    start = tablecodec.encode_row_key(table_id, I64_MIN)
+    end = tablecodec.encode_row_key(table_id, I64_MAX) + b"\x00"
+    return [KeyRange(start, end)]
+
+
+def handle_ranges(table_id: int, pairs: list[tuple[int, int]]) -> list[KeyRange]:
+    """[lo, hi] handle intervals -> key ranges (ref: ranger -> kv ranges)."""
+    out = []
+    for lo, hi in pairs:
+        out.append(KeyRange(tablecodec.encode_row_key(table_id, lo), tablecodec.encode_row_key(table_id, hi) + b"\x00"))
+    return out
+
+
+@dataclass
+class KVRequest:
+    """(ref: kv.Request kv.go:528 — the slice the executor hands to distsql)."""
+
+    dag: DAGRequest
+    ranges: list
+    start_ts: int
+    concurrency: int = 4
+    keep_order: bool = False
+
+
+@dataclass
+class CopTask:
+    region_id: int
+    epoch: int
+    ranges: list
+
+
+@dataclass
+class SelectResult:
+    """(ref: distsql.SelectResult select_result.go:63)."""
+
+    chunks: list
+    exec_summaries: list = field(default_factory=list)
+
+    def merged(self) -> Chunk:
+        return Chunk.concat(self.chunks) if self.chunks else None
+
+
+def _build_tasks(store: TPUStore, ranges: list) -> list[CopTask]:
+    tasks = []
+    for rng in ranges:
+        for region in store.cluster.regions_in_range(rng.start, rng.end):
+            start = max(rng.start, region.start_key)
+            end = min(rng.end, region.end_key)
+            if start < end:
+                tasks.append(CopTask(region.region_id, region.epoch, [KeyRange(start, end)]))
+    # merge tasks per region (ref: buildCopTasks per-region aggregation)
+    by_region: dict[int, CopTask] = {}
+    ordered = []
+    for t in tasks:
+        ex = by_region.get(t.region_id)
+        if ex is None:
+            by_region[t.region_id] = t
+            ordered.append(t)
+        else:
+            ex.ranges.extend(t.ranges)
+    return ordered
+
+
+def select(store: TPUStore, req: KVRequest) -> SelectResult:
+    tasks = _build_tasks(store, req.ranges)
+    results: list = [None] * len(tasks)
+    summaries: list = []
+
+    def run_task(i: int, task: CopTask, retries: int = MAX_RETRY):
+        creq = CopRequest(req.dag, task.ranges, req.start_ts, task.region_id, task.epoch)
+        resp = store.coprocessor(creq)
+        if resp.region_error is not None:
+            if retries <= 0:
+                raise RuntimeError(f"region retries exhausted: {resp.region_error}")
+            # re-split this task's ranges against the fresh region view
+            sub = _build_tasks(store, task.ranges)
+            outs = []
+            for s in sub:
+                outs.extend(run_task(i, s, retries - 1))
+            return outs
+        if resp.other_error is not None:
+            raise RuntimeError(resp.other_error)
+        summaries.append(resp.exec_summaries)
+        return [resp.chunk]
+
+    if req.concurrency > 1 and len(tasks) > 1:
+        with ThreadPoolExecutor(max_workers=req.concurrency) as pool:
+            futs = [pool.submit(run_task, i, t) for i, t in enumerate(tasks)]
+            for i, f in enumerate(futs):
+                results[i] = f.result()
+    else:
+        for i, t in enumerate(tasks):
+            results[i] = run_task(i, t)
+
+    chunks = [c for sub in results for c in sub if c is not None]
+    return SelectResult(chunks=chunks, exec_summaries=summaries)

@@ -93,10 +93,20 @@ def build_program(dag: DAGRequest, capacity: int, group_capacity: int = DEFAULT_
                     k += len(a.args)
                 new_cols: list[CompVal] = []
                 if ex.group_by:
-                    res = group_aggregate(gvals, aggs, valid, group_capacity, merge=ex.merge)
+                    # first_row is served by the representative-row gather
+                    # (any group row is a valid answer), which also covers
+                    # string columns with their raw bytes
+                    state_aggs = [(a, av) for a, av in aggs if a.name != "first_row"]
+                    res = group_aggregate(gvals, state_aggs, valid, group_capacity, merge=ex.merge)
                     overflow = overflow | res.overflow
-                    for a, st in zip(ex.aggs, res.states):
-                        new_cols.extend(_agg_out_cols(a, st, res.group_valid, ex.partial))
+                    st_iter = iter(res.states)
+                    for a, av in aggs:
+                        if a.name == "first_row":
+                            gath = _gather(av, res.group_rep)[0]
+                            gath = CompVal(gath.value, gath.null | ~res.group_valid, a.ft, raw=gath.raw)
+                            new_cols.append(gath)
+                        else:
+                            new_cols.extend(_agg_out_cols(a, next(st_iter), res.group_valid, ex.partial))
                     new_cols.extend(_gather(gvals, res.group_rep))
                     valid = res.group_valid
                 else:

@@ -55,6 +55,24 @@ def decode_outputs(packed, valid, out_fts) -> Chunk:
     return Chunk(cols)
 
 
+# Shared default so repeated executions of the same plan shape reuse the
+# compiled XLA program (ref: coprocessor cache amortization).
+DEFAULT_PROGRAM_CACHE = ProgramCache()
+
+
+def drive_program(cache: ProgramCache, dag: DAGRequest, batch, group_capacity: int, max_retries: int = 3):
+    """Run the fused program, growing group capacity on overflow
+    (the single overflow-retry contract — store and host driver share it)."""
+    gc = group_capacity
+    for _ in range(max_retries + 1):
+        prog = cache.get(dag, batch.capacity, gc)
+        packed, valid, n, overflow = prog.fn(batch)
+        if not bool(overflow):
+            return decode_outputs(packed, valid, prog.out_fts)
+        gc *= 4  # group/join capacity exceeded: recompile bigger
+    raise RuntimeError("DAG overflow not resolved after retries")
+
+
 def run_dag_on_chunk(
     dag: DAGRequest,
     chunk: Chunk,
@@ -63,17 +81,10 @@ def run_dag_on_chunk(
     group_capacity: int = DEFAULT_GROUP_CAPACITY,
     max_retries: int = 3,
 ) -> Chunk:
-    cache = cache or ProgramCache()
+    cache = cache or DEFAULT_PROGRAM_CACHE
     cap = capacity or _pow2(max(chunk.num_rows(), 1))
     batch = to_device_batch(chunk, capacity=cap)
-    gc = group_capacity
-    for _ in range(max_retries + 1):
-        prog = cache.get(dag, cap, gc)
-        packed, valid, n, overflow = prog.fn(batch)
-        if not bool(overflow):
-            return decode_outputs(packed, valid, prog.out_fts)
-        gc *= 4  # group/ join capacity exceeded: recompile bigger
-    raise RuntimeError("DAG overflow not resolved after retries")
+    return drive_program(cache, dag, batch, group_capacity, max_retries)
 
 
 # ---------------------------------------------------------------------------

@@ -53,6 +53,20 @@ class AggDesc:
         """Result FieldType (ref: aggregation type inference in planner)."""
         if self.name == "count":
             return new_longlong(notnull=True)
+        # In merge modes (Final/Partial2) args are partial-state columns:
+        # [count, sum] for avg, [sum] for sum — the value column is last.
+        arg_ft = self.args[-1].ft if self.args else new_longlong()
+        if self.mode in (AggMode.Final, AggMode.Partial2):
+            if self.name == "sum":
+                return arg_ft.clone()
+            if self.name == "avg":
+                if arg_ft.eval_type() == "real":
+                    return FieldType(TypeCode.Double)
+                return FieldType(
+                    TypeCode.NewDecimal,
+                    flen=(arg_ft.flen or 20) + 4,
+                    decimal=min(max(arg_ft.decimal, 0) + 4, 30),
+                )
         arg_ft = self.args[0].ft if self.args else new_longlong()
         if self.name in ("min", "max", "first_row"):
             return arg_ft.clone()
@@ -73,6 +87,9 @@ class AggDesc:
 
     def partial_fts(self) -> list[FieldType]:
         """Schema of this aggregate's partial state columns."""
+        if self.mode in (AggMode.Final, AggMode.Partial2) and self.args:
+            # args already ARE the state columns
+            return [a.ft.clone() for a in self.args]
         if self.name == "count":
             return [new_longlong(notnull=True)]
         arg_ft = self.args[0].ft

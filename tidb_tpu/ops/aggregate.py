@@ -99,6 +99,10 @@ def _agg_states_raw(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
             v = op(_masked(av, mask, fill), seg, num_segments=nseg)
         return [(v, empty)]
     if name == "first_row":
+        if a.value.ndim == 2:
+            # grouped first_row over strings is served by the rep-row gather
+            # in exec/builder.py; this state path has no raw bytes to carry
+            raise NotImplementedError("first_row over string needs rep-row gather")
         # first row in sorted order per segment (arbitrary row, like the
         # reference's map-ordered first_row)
         pos = jnp.arange(seg.shape[0], dtype=jnp.int32)

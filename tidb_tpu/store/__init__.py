@@ -1,0 +1,5 @@
+from .kv import MemKV
+from .region import Region, Cluster
+from .store import TPUStore, CopRequest, CopResponse, KeyRange
+
+__all__ = ["MemKV", "Region", "Cluster", "TPUStore", "CopRequest", "CopResponse", "KeyRange"]

@@ -1,0 +1,75 @@
+"""In-memory MVCC key-value engine (ref: unistore/tikv/mvcc.go MVCCStore on
+badger + lockstore).
+
+A sorted-array store with timestamped versions: enough Percolator surface
+for snapshot reads and the write path (put at commit_ts, delete as
+tombstone), without the lock column family — single-process writes are
+serialized by the session layer for now (2PC lands with the txn layer).
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+
+
+class MemKV:
+    __slots__ = ("_data", "_keys", "_dirty")
+
+    def __init__(self):
+        self._data: dict[bytes, list[tuple[int, bytes | None]]] = {}
+        self._keys: list[bytes] = []
+        self._dirty = False
+
+    def put(self, key: bytes, value: bytes | None, ts: int):
+        """value None = tombstone."""
+        versions = self._data.get(key)
+        if versions is None:
+            self._data[key] = [(ts, value)]
+            self._dirty = True
+        else:
+            versions.append((ts, value))
+            if len(versions) > 1 and versions[-2][0] > ts:
+                versions.sort(key=lambda v: v[0])
+
+    def _ensure_sorted(self):
+        if self._dirty:
+            self._keys = sorted(self._data.keys())
+            self._dirty = False
+
+    def get(self, key: bytes, ts: int) -> bytes | None:
+        versions = self._data.get(key)
+        if not versions:
+            return None
+        # newest version with commit_ts <= ts
+        for vts, val in reversed(versions):
+            if vts <= ts:
+                return val
+        return None
+
+    def scan(self, start: bytes, end: bytes, ts: int, limit: int | None = None):
+        """Yield (key, value) with start <= key < end visible at ts."""
+        self._ensure_sorted()
+        i = bisect.bisect_left(self._keys, start)
+        n = 0
+        while i < len(self._keys):
+            k = self._keys[i]
+            if k >= end:
+                break
+            v = self.get(k, ts)
+            if v is not None:
+                yield k, v
+                n += 1
+                if limit is not None and n >= limit:
+                    break
+            i += 1
+
+    def max_ts(self) -> int:
+        ts = 0
+        for versions in self._data.values():
+            if versions:
+                ts = max(ts, versions[-1][0])
+        return ts
+
+    def __len__(self):
+        return len(self._data)

@@ -1,0 +1,82 @@
+"""Range-sharded regions (ref: unistore cluster.go:45 Cluster, mockstore
+region splitting).
+
+Regions are the unit of data parallelism: the distsql layer splits a scan
+into per-region tasks (ref: copr/coprocessor.go:331 buildCopTasks) and the
+mesh layer maps regions onto TPU devices (SURVEY.md §2.5). Epochs support
+the region-error/retry path: a split bumps the epoch, in-flight tasks with
+the stale epoch get EpochNotMatch and re-split, mirroring
+copr/coprocessor.go:1424 handleCopResponse.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+
+KEY_MAX = b"\xff" * 32
+
+
+@dataclass
+class Region:
+    region_id: int
+    start_key: bytes
+    end_key: bytes
+    epoch: int = 1
+
+    def contains(self, key: bytes) -> bool:
+        return self.start_key <= key < (self.end_key or KEY_MAX)
+
+
+class Cluster:
+    """All regions, sorted by start key, covering [b'', KEY_MAX)."""
+
+    def __init__(self):
+        self._regions: list[Region] = [Region(1, b"", KEY_MAX)]
+        self._next_id = 2
+
+    def regions(self) -> list[Region]:
+        return list(self._regions)
+
+    def region_by_id(self, rid: int) -> Region | None:
+        for r in self._regions:
+            if r.region_id == rid:
+                return r
+        return None
+
+    def split(self, key: bytes) -> Region:
+        """Split the region containing `key` at `key`; bumps both epochs
+        (ref: mockstore SplitKeys)."""
+        i = self._locate(key)
+        r = self._regions[i]
+        if r.start_key == key:
+            return r
+        new = Region(self._next_id, key, r.end_key, epoch=r.epoch + 1)
+        self._next_id += 1
+        r.end_key = key
+        r.epoch += 1
+        self._regions.insert(i + 1, new)
+        return new
+
+    def split_n(self, start: bytes, end: bytes, n: int, keyfn):
+        """Split [start, end) into n regions using keyfn(i) boundaries."""
+        for i in range(1, n):
+            self.split(keyfn(i))
+
+    def _locate(self, key: bytes) -> int:
+        starts = [r.start_key for r in self._regions]
+        i = bisect.bisect_right(starts, key) - 1
+        return max(i, 0)
+
+    def locate(self, key: bytes) -> Region:
+        return self._regions[self._locate(key)]
+
+    def regions_in_range(self, start: bytes, end: bytes) -> list[Region]:
+        out = []
+        for r in self._regions:
+            if (r.end_key or KEY_MAX) <= start:
+                continue
+            if r.start_key >= end:
+                break
+            out.append(r)
+        return out
