@@ -16,7 +16,21 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import jax  # noqa: E402
 
+# The environment's sitecustomize registers the axon TPU plugin in every
+# interpreter; any backend init then touches the single-client TPU tunnel.
+# Tests must be hermetic CPU — drop the factory before any backend inits.
+try:  # noqa: SIM105
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+jax.config.update("jax_platforms", "cpu")  # axon register() overrides the env var
 jax.config.update("jax_enable_x64", True)
+# persistent compile cache: repeated test runs skip XLA compiles
+jax.config.update("jax_compilation_cache_dir", "/tmp/tidb_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -203,7 +203,11 @@ class ExprCompiler:
             if et == "real":
                 ft = FieldType(TypeCode.NewDecimal, decimal=s)
                 x = v.value * float(10 ** s)
-                # MySQL rounds half away from zero, not half-to-even
+                # MySQL rounds half away from zero, not half-to-even.
+                # KNOWN DEVIATION: MySQL/TiDB convert double->decimal via the
+                # shortest decimal repr (so the double nearest 16.405 rounds
+                # like "16.405"); this kernel rounds the binary value, which
+                # can differ by 1 ulp of the target scale on repr midpoints.
                 scaled = jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5)).astype(jnp.int64)
                 return CompVal(scaled, v.null, ft)
         if cls in ("int", "time"):

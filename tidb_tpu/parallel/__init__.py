@@ -1,0 +1,10 @@
+from .mesh import region_mesh, stack_region_batches, run_sharded_partial_agg
+from .exchange import hash_partition_ids, exchange_group_aggregate
+
+__all__ = [
+    "region_mesh",
+    "stack_region_batches",
+    "run_sharded_partial_agg",
+    "hash_partition_ids",
+    "exchange_group_aggregate",
+]

@@ -1,0 +1,140 @@
+"""Mesh data parallelism: regions sharded over TPU devices.
+
+The reference fans per-region cop tasks out to store nodes over gRPC
+(ref: copr/coprocessor.go:806 worker pool; batch_coprocessor.go groups
+regions per store). The TPU-native shape (SURVEY.md §2.5): stack region
+batches on a leading axis, shard that axis over a 1-D `jax.sharding.Mesh`,
+run the fused DAG per region under `shard_map` + `vmap`, and psum the
+partial aggregate states over ICI — the collective replaces the host-side
+merge loop, which is the BASELINE.json north star:
+
+    "per-region partial aggregates are psum-reduced over the ICI mesh
+     before final merge"
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..chunk import Chunk, to_device_batch
+from ..chunk.device import DeviceBatch, DeviceColumn
+from ..exec.dag import Aggregation, DAGRequest
+from ..expr.compile import ExprCompiler, normalize_device_column
+from ..ops import apply_selection, scalar_aggregate
+from ..exec.builder import _agg_out_cols
+
+REGION_AXIS = "region"
+
+
+def region_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (REGION_AXIS,))
+
+
+def stack_region_batches(chunks: list[Chunk], capacity: int | None = None, n_total: int | None = None) -> DeviceBatch:
+    """Stack per-region chunks into one [R, cap] batch.
+
+    All regions pad to a common capacity and common string widths so the
+    stacked arrays are rectangular; `n_total` (>= len(chunks)) additionally
+    pads the region axis so R is divisible by the mesh size.
+    """
+    cap = capacity or max(1, max(c.num_rows() for c in chunks))
+    # common string width per column
+    str_widths: dict[int, int] = {}
+    for c in chunks:
+        for ci, col in enumerate(c.columns):
+            if col.is_varlen():
+                w = int((col.offsets[1:] - col.offsets[:-1]).max()) if len(col) else 1
+                str_widths[ci] = max(str_widths.get(ci, 1), w)
+    batches = [to_device_batch(c, capacity=cap, str_widths=str_widths or None) for c in chunks]
+    R = n_total or len(batches)
+    while len(batches) < R:
+        batches.append(to_device_batch(Chunk.empty(chunks[0].field_types()), capacity=cap, str_widths=str_widths or None))
+
+    def stack(*xs):
+        return jnp.stack(xs)
+
+    return jax.tree.map(stack, *batches)
+
+
+def run_sharded_partial_agg(dag: DAGRequest, stacked: DeviceBatch, mesh: Mesh):
+    """Scalar-aggregation pushdown over a region-sharded mesh.
+
+    DAG shape: TableScan [Selection] Aggregation(group_by=(), partial=True).
+    Each device: vmap the fused selection over its local regions, reduce the
+    partial states across local regions, then psum across the mesh — every
+    device ends with the global partial states (the final merge is a single
+    host-side finalize).
+
+    Returns list of per-agg state arrays (each [1] after the global merge).
+    """
+    executors = dag.executors
+    agg = executors[-1]
+    assert isinstance(agg, Aggregation) and not agg.group_by, "sharded scalar agg only"
+    input_fts = [c.ft for c in dag.scan().columns]
+
+    def per_region(cols_and_valid):
+        cols, valid = cols_and_valid
+        fts = input_fts
+        cvals = [normalize_device_column(c) for c in cols]
+        for ex in executors[1:-1]:
+            comp = ExprCompiler(fts)
+            from ..exec.dag import Selection as Sel
+
+            if isinstance(ex, Sel):
+                conds = comp.run(list(ex.conditions), cvals)
+                valid = apply_selection(valid, conds)
+            else:
+                raise TypeError(f"sharded pipeline supports scan+selection+agg, got {ex}")
+        comp = ExprCompiler(input_fts)
+        arg_exprs = [a for desc in agg.aggs for a in desc.args]
+        avals = comp.run(arg_exprs, cvals) if arg_exprs else []
+        aggs = []
+        k = 0
+        for desc in agg.aggs:
+            aggs.append((desc, avals[k : k + len(desc.args)]))
+            k += len(desc.args)
+        states = scalar_aggregate(aggs, valid, merge=agg.merge)
+        # flatten to arrays: per agg, per state col: (value[1], null[1])
+        flat = []
+        for st in states:
+            for v, nl in st:
+                flat.append((v, nl))
+        return flat
+
+    def device_fn(local: DeviceBatch):
+        # local: [R_local, cap] pytree
+        flat = jax.vmap(lambda c, v: per_region((c, v)))(local.cols, local.row_valid)
+        merged = []
+        for v, nl in flat:
+            # v: [R_local, 1]; merge across local regions then across mesh.
+            # Sum-merge is correct for count/sum states; NULL means "no rows
+            # seen" so the merged null = all-null (and its value lanes are 0).
+            allnull = jnp.all(nl, axis=0)
+            val = jnp.sum(jnp.where(nl, jnp.zeros((), v.dtype), v), axis=0)
+            val = jax.lax.psum(val, REGION_AXIS)
+            allnull = jax.lax.pmin(allnull.astype(jnp.int32), REGION_AXIS) > 0
+            merged.append((val, allnull))
+        return merged
+
+    from jax import shard_map
+
+    spec_batch = jax.tree.map(lambda _: P(REGION_AXIS), stacked)
+    out_spec = [(P(), P())] * _n_state_cols(agg)
+    fn = shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(spec_batch,),
+        out_specs=out_spec,
+    )
+    return jax.jit(fn)(stacked)
+
+
+def _n_state_cols(agg: Aggregation) -> int:
+    return sum(len(d.partial_fts()) for d in agg.aggs)
