@@ -1,0 +1,118 @@
+"""Driver benchmark: one JSON line on stdout.
+
+Flagship config (BASELINE.json #2 / north star): TPC-H Q6-shaped fused
+coprocessor program — scan -> selection (date range + discount between +
+quantity) -> partial SUM(extendedprice*discount), COUNT(*) — over an
+HBM-resident region batch, the exact pipeline the reference runs row-by-row
+in unistore's coprocessor (ref: unistore/cophandler/mpp_exec.go selExec/
+aggExec; closure_exec.go fused shape).
+
+value       = steady-state device throughput, million rows/sec (one chip)
+vs_baseline = speedup vs the SAME fused XLA program compiled for host CPU
+              (a vectorized-CPU baseline, strictly stronger than the
+              reference's row-at-a-time Go coprocessor — conservative).
+
+Diagnostics go to stderr; stdout is exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+ROWS = 1 << 22  # 4M rows resident per batch
+CPU_ROWS = 1 << 20  # smaller batch for the CPU baseline (same per-row work)
+
+
+def make_batch(n: int, seed: int = 0):
+    """Generate a Q6-shaped lineitem batch directly as device arrays."""
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _q6_dag
+    from tidb_tpu.chunk.device import DeviceBatch, DeviceColumn
+
+    dag, fts = _q6_dag()
+    rng = np.random.default_rng(seed)
+    year = rng.integers(1992, 1999, n)
+    month = rng.integers(1, 13, n)
+    day = rng.integers(1, 29, n)
+    # packed datetime layout (types/mytime.py pack_datetime), vectorized
+    ymd = (year * 13 + month) << 5 | day
+    shipdate = (ymd << 17) << 24
+    quantity = rng.integers(1, 51, n) * 100  # decimal(15,2) scaled
+    extprice = rng.integers(90000, 9000000, n)  # cents
+    discount = rng.integers(0, 11, n)  # 0.00..0.10 scaled by 100
+
+    cols_np = [shipdate.astype(np.int64), quantity.astype(np.int64),
+               extprice.astype(np.int64), discount.astype(np.int64)]
+    cols = [
+        DeviceColumn(jnp.asarray(c), jnp.zeros(n, bool), None, ft)
+        for c, ft in zip(cols_np, fts)
+    ]
+    return dag, DeviceBatch(cols, jnp.ones(n, bool), jnp.int32(n))
+
+
+def bench_device(device, n: int, iters: int, warmup: int = 2) -> float:
+    """Rows/sec of the fused program on `device` (steady state)."""
+    import jax
+
+    from tidb_tpu.exec.builder import build_program
+
+    with jax.default_device(device):
+        dag, batch = make_batch(n)
+        batch = jax.device_put(batch, device)
+        prog = build_program(dag, capacity=n, group_capacity=16)
+        fn = jax.jit(prog.fn)
+        t0 = time.perf_counter()
+        out = fn(batch)
+        jax.block_until_ready(out)
+        log(f"  [{device.platform}] first call (compile+run): {time.perf_counter()-t0:.2f}s")
+        for _ in range(warmup):
+            jax.block_until_ready(fn(batch))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(batch)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        # sanity: count aggregate > 0
+        packed, valid, n_rows, overflow = out
+        cnt = int(np.asarray(packed[1][0])[0])
+        assert cnt > 0 and not bool(overflow), (cnt, bool(overflow))
+        return n * iters / dt
+
+
+def main():
+    import jax
+
+    devs = jax.devices()
+    log(f"jax {jax.__version__}, devices: {devs}")
+    accel = devs[0]
+    cpu = jax.devices("cpu")[0] if accel.platform != "cpu" else accel
+
+    accel_rps = bench_device(accel, ROWS, iters=20)
+    log(f"device ({accel.platform}) throughput: {accel_rps/1e6:.1f} M rows/s")
+
+    if cpu is not accel:
+        cpu_rps = bench_device(cpu, CPU_ROWS, iters=3)
+    else:
+        cpu_rps = accel_rps
+    log(f"cpu baseline throughput: {cpu_rps/1e6:.1f} M rows/s")
+
+    print(json.dumps({
+        "metric": "q6_fused_filter_agg_throughput",
+        "value": round(accel_rps / 1e6, 2),
+        "unit": "Mrows/s/chip",
+        "vs_baseline": round(accel_rps / cpu_rps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

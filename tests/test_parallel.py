@@ -154,3 +154,40 @@ def test_exchange_group_agg_all_to_all():
             if valid[d, i]:
                 want[keys[d, i]] += 1
     assert got.tolist() == want.tolist()
+
+
+def test_sharded_min_max_first_merge():
+    """min/max/first_row partials must merge with their own ops, not sum."""
+    chunks, all_rows = region_chunks(seed=7)
+    mesh = region_mesh()
+    scan = TableScan(1, (ColumnInfo(1, FTS[0]), ColumnInfo(2, FTS[1])))
+    agg = Aggregation(
+        group_by=(),
+        aggs=(
+            AggDesc("min", (col(0, FTS[0]),)),
+            AggDesc("max", (col(1, FTS[1]),)),
+            AggDesc("first_row", (col(0, FTS[0]),)),
+        ),
+        partial=True,
+    )
+    dag = DAGRequest((scan, agg), output_offsets=(0, 1, 2))
+    stacked = stack_region_batches(chunks, n_total=8)
+    states = run_sharded_partial_agg(dag, stacked, mesh)
+    ints = [r[0].val for r in all_rows if not r[0].is_null()]
+    decs = [r[1].val for r in all_rows if not r[1].is_null()]
+    assert int(states[0][0][0]) == min(ints)
+    assert MyDecimal.from_scaled_int(int(states[1][0][0]), 2) == max(decs)
+    first = next(r[0] for r in all_rows if not r[0].is_null())
+    assert int(states[2][0][0]) == first.val
+
+
+def test_hash_partition_float_keys():
+    """DOUBLE partition keys must hash (f32 bitcast), not crash (#review)."""
+    from tidb_tpu.types import new_double
+
+    v = jnp.asarray(np.array([1.5, -2.25, 0.0, -0.0, 1.5]))
+    kv = CompVal(v, jnp.zeros(5, bool), new_double())
+    pid = np.asarray(hash_partition_ids([kv], 8))
+    assert ((0 <= pid) & (pid < 8)).all()
+    assert pid[0] == pid[4]  # equal doubles -> same partition
+    assert pid[2] == pid[3]  # -0.0 == 0.0

@@ -33,6 +33,11 @@ def hash_partition_ids(key_vals: list[CompVal], n_parts: int) -> jax.Array:
     h = jnp.broadcast_to(FNV_OFFSET, key_vals[0].null.shape)
     for kv in key_vals:
         for w in sort_key_arrays(kv):
+            if jnp.issubdtype(w.dtype, jnp.floating):
+                # real keys stay float in sort_key_arrays (TPU x64 emulation
+                # can't bitcast f64<->s64); a f32 bitcast is supported and
+                # equal doubles hash equal, which is all partitioning needs
+                w = jax.lax.bitcast_convert_type(w.astype(jnp.float32), jnp.int32).astype(jnp.int64)
             h = (h ^ w) * FNV_PRIME
     # avoid negative mod
     return jnp.abs(h % n_parts).astype(jnp.int32)

@@ -26,7 +26,6 @@ from ..chunk.device import DeviceBatch, DeviceColumn
 from ..exec.dag import Aggregation, DAGRequest
 from ..expr.compile import ExprCompiler, normalize_device_column
 from ..ops import apply_selection, scalar_aggregate
-from ..exec.builder import _agg_out_cols
 
 REGION_AXIS = "region"
 
@@ -108,19 +107,28 @@ def run_sharded_partial_agg(dag: DAGRequest, stacked: DeviceBatch, mesh: Mesh):
                 flat.append((v, nl))
         return flat
 
+    # merge op per partial-state column, by aggregate name (the schema in
+    # expr/agg.py partial_fts: count->[cnt], sum->[sum], avg->[cnt,sum], ...)
+    state_ops: list[str] = []
+    for desc in agg.aggs:
+        n_states = len(desc.partial_fts())
+        if desc.name in ("count", "sum", "avg", "bit_xor"):
+            # avg states are [count, sum] — both additive; bit_xor merge is xor
+            ops = ["sum"] * n_states if desc.name != "bit_xor" else ["xor"]
+        elif desc.name in ("min", "max", "first_row", "bit_and", "bit_or"):
+            ops = [desc.name if desc.name in ("min", "max") else
+                   ("and" if desc.name == "bit_and" else
+                    "or" if desc.name == "bit_or" else "first")] * n_states
+        else:
+            raise TypeError(f"no mesh merge for aggregate {desc.name!r}")
+        state_ops.extend(ops)
+
     def device_fn(local: DeviceBatch):
         # local: [R_local, cap] pytree
         flat = jax.vmap(lambda c, v: per_region((c, v)))(local.cols, local.row_valid)
         merged = []
-        for v, nl in flat:
-            # v: [R_local, 1]; merge across local regions then across mesh.
-            # Sum-merge is correct for count/sum states; NULL means "no rows
-            # seen" so the merged null = all-null (and its value lanes are 0).
-            allnull = jnp.all(nl, axis=0)
-            val = jnp.sum(jnp.where(nl, jnp.zeros((), v.dtype), v), axis=0)
-            val = jax.lax.psum(val, REGION_AXIS)
-            allnull = jax.lax.pmin(allnull.astype(jnp.int32), REGION_AXIS) > 0
-            merged.append((val, allnull))
+        for op, (v, nl) in zip(state_ops, flat):
+            merged.append(_merge_state(op, v, nl, REGION_AXIS))
         return merged
 
     from jax import shard_map
@@ -132,9 +140,60 @@ def run_sharded_partial_agg(dag: DAGRequest, stacked: DeviceBatch, mesh: Mesh):
         mesh=mesh,
         in_specs=(spec_batch,),
         out_specs=out_spec,
+        # first/bit states merge via all_gather + identical local reduce:
+        # replicated in fact, but not statically inferrable by the vma check
+        check_vma=False,
     )
     return jax.jit(fn)(stacked)
 
 
 def _n_state_cols(agg: Aggregation) -> int:
     return sum(len(d.partial_fts()) for d in agg.aggs)
+
+
+def _merge_state(op: str, v, nl, axis: str):
+    """Merge one partial-state column across local regions then the mesh.
+
+    v: [R_local, 1] values (NULL lanes zeroed), nl: [R_local, 1] null flags.
+    NULL means "no rows seen in this region"; the merged state is NULL only
+    if every region's is (ref: aggfuncs partial merge semantics). Sum-like
+    states ride psum over ICI (the north-star collective); min/max ride
+    pmin/pmax; bit/first states all_gather (tiny) and reduce locally.
+    """
+    allnull = jnp.all(nl, axis=0)
+    if op in ("sum", "xor", "or"):
+        fill = jnp.zeros((), v.dtype)
+    elif op == "and":
+        fill = jnp.full((), -1, v.dtype)
+    elif op == "min":
+        fill = (jnp.full((), jnp.inf, v.dtype) if jnp.issubdtype(v.dtype, jnp.floating)
+                else jnp.full((), jnp.iinfo(v.dtype).max, v.dtype))
+    elif op == "max":
+        fill = (jnp.full((), -jnp.inf, v.dtype) if jnp.issubdtype(v.dtype, jnp.floating)
+                else jnp.full((), jnp.iinfo(v.dtype).min, v.dtype))
+    else:  # first
+        fill = jnp.zeros((), v.dtype)
+    masked = jnp.where(nl, fill, v)
+
+    if op == "sum":
+        val = jax.lax.psum(jnp.sum(masked, axis=0), axis)
+    elif op == "min":
+        val = jax.lax.pmin(jnp.min(masked, axis=0), axis)
+    elif op == "max":
+        val = jax.lax.pmax(jnp.max(masked, axis=0), axis)
+    elif op in ("xor", "or", "and"):
+        red = {"xor": jnp.bitwise_xor, "or": jnp.bitwise_or, "and": jnp.bitwise_and}[op]
+        local = red.reduce(masked, axis=0)
+        gathered = jax.lax.all_gather(local, axis)  # [D, 1]
+        val = red.reduce(gathered, axis=0)
+    else:  # first: first non-null region in global region order
+        # global order == device-major: regions were stacked then sharded on
+        # the leading axis, so device d owns regions [d*R_local, (d+1)*R_local)
+        gv = jax.lax.all_gather(masked, axis).reshape((-1,) + v.shape[1:])
+        gn = jax.lax.all_gather(nl, axis).reshape((-1,) + nl.shape[1:])
+        idx = jnp.argmax(~gn, axis=0)
+        val = jnp.take_along_axis(gv, idx[None], axis=0)[0]
+    allnull = jax.lax.pmin(allnull.astype(jnp.int32), axis) > 0
+    if op in ("min", "max", "first"):
+        val = jnp.where(allnull, jnp.zeros((), v.dtype), val)
+    return val, allnull
