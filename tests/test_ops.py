@@ -285,3 +285,48 @@ class TestSelection:
         out = apply_selection(db.row_valid, [c])
         want = np.array([(not r[1].is_null()) and r[1].val > MyDecimal("0") for r in ch.rows()])
         assert np.asarray(out).tolist() == want.tolist()
+
+
+class TestBitAggs:
+    def test_scalar_bit_aggs(self):
+        """BIT_AND/OR/XOR on device (segmented-scan reduce), incl. MySQL
+        empty-set identities (ref: builtin bit agg semantics)."""
+        import jax.numpy as jnp
+
+        from tidb_tpu.expr.agg import AggDesc
+        from tidb_tpu.expr.compile import CompVal
+        from tidb_tpu.ops.aggregate import scalar_aggregate
+        from tidb_tpu.types import new_longlong
+
+        FT = new_longlong(unsigned=True)
+        vals = jnp.asarray([0b1100, 0b1010, 0b0110], dtype=jnp.int64)
+        nulls = jnp.asarray([False, False, True])  # NULL ignored
+        valid = jnp.ones(3, bool)
+        a = CompVal(vals, nulls, FT)
+        from tidb_tpu.expr import col as _col
+        descs = [AggDesc("bit_and", (_col(0, FT),)), AggDesc("bit_or", (_col(0, FT),)), AggDesc("bit_xor", (_col(0, FT),))]
+        sts = scalar_aggregate([(d, [a]) for d in descs], valid)
+        assert int(sts[0][0][0][0]) == 0b1000
+        assert int(sts[1][0][0][0]) == 0b1110
+        assert int(sts[2][0][0][0]) == 0b0110
+        # empty set: and -> all ones, or/xor -> 0, never NULL
+        sts = scalar_aggregate([(d, [a]) for d in descs], jnp.zeros(3, bool))
+        assert int(sts[0][0][0][0]) == -1 and not bool(sts[0][0][1][0])
+        assert int(sts[1][0][0][0]) == 0
+        assert int(sts[2][0][0][0]) == 0
+
+    def test_grouped_bit_aggs(self):
+        import jax.numpy as jnp
+
+        from tidb_tpu.expr.agg import AggDesc
+        from tidb_tpu.expr.compile import CompVal
+        from tidb_tpu.ops.aggregate import group_aggregate
+        from tidb_tpu.types import new_longlong
+
+        FT = new_longlong(unsigned=True)
+        g = CompVal(jnp.asarray([1, 2, 1, 2], dtype=jnp.int64), jnp.zeros(4, bool), new_longlong())
+        a = CompVal(jnp.asarray([0b11, 0b101, 0b10, 0b100], dtype=jnp.int64), jnp.zeros(4, bool), FT)
+        from tidb_tpu.expr import col as _col
+        res = group_aggregate([g], [(AggDesc("bit_or", (_col(1, FT),)), [a])], jnp.ones(4, bool), 8)
+        got = sorted(int(v) for v in res.states[0][0][0][: int(res.n_groups)])
+        assert got == sorted([0b11, 0b101])

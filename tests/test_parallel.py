@@ -177,8 +177,14 @@ def test_sharded_min_max_first_merge():
     decs = [r[1].val for r in all_rows if not r[1].is_null()]
     assert int(states[0][0][0]) == min(ints)
     assert MyDecimal.from_scaled_int(int(states[1][0][0]), 2) == max(decs)
-    first = next(r[0] for r in all_rows if not r[0].is_null())
-    assert int(states[2][0][0]) == first.val
+    # first_row states are [has, value]; value of first region with rows,
+    # NULL kept verbatim (row 0's datum here)
+    assert int(states[2][0][0]) == 1  # has
+    first = all_rows[0][0]
+    if first.is_null():
+        assert bool(states[3][1][0])
+    else:
+        assert int(states[3][0][0]) == first.val
 
 
 def test_hash_partition_float_keys():
@@ -191,3 +197,69 @@ def test_hash_partition_float_keys():
     assert ((0 <= pid) & (pid < 8)).all()
     assert pid[0] == pid[4]  # equal doubles -> same partition
     assert pid[2] == pid[3]  # -0.0 == 0.0
+
+
+def test_sharded_unsigned_min_max_merge():
+    """Unsigned BIGINT min/max states are raw two's-complement int64; the
+    mesh merge must compare in the flipped domain (#review: cross-region
+    MIN(unsigned) with values >= 2^63)."""
+    from tidb_tpu.types import Flag, new_longlong
+
+    UFT = new_longlong(unsigned=True)
+    big, small = (1 << 63) + 5, 10
+    chunks = [
+        Chunk.from_rows([UFT], [[Datum.u64(big)]]),
+        Chunk.from_rows([UFT], [[Datum.u64(small)]]),
+    ]
+    mesh = region_mesh()
+    scan = TableScan(1, (ColumnInfo(1, UFT),))
+    agg = Aggregation(
+        group_by=(),
+        aggs=(AggDesc("min", (col(0, UFT),)), AggDesc("max", (col(0, UFT),))),
+        partial=True,
+    )
+    dag = DAGRequest((scan, agg), output_offsets=(0, 1))
+    stacked = stack_region_batches(chunks, n_total=8)
+    states = run_sharded_partial_agg(dag, stacked, mesh)
+    assert int(states[0][0][0]) & 0xFFFFFFFFFFFFFFFF == small
+    assert int(states[1][0][0]) & 0xFFFFFFFFFFFFFFFF == big
+
+
+def test_sharded_first_row_skips_filtered_region():
+    """A region whose rows all fail the filter must not contribute its
+    first_row state (#review: garbage value from clipped gather)."""
+    FT = new_longlong()
+    # region 0 rows fail the predicate col0 > 100; region 1 passes
+    chunks = [
+        Chunk.from_rows([FT], [[Datum.i64(1)], [Datum.i64(2)]]),
+        Chunk.from_rows([FT], [[Datum.i64(500)], [Datum.i64(600)]]),
+    ]
+    mesh = region_mesh()
+    scan = TableScan(1, (ColumnInfo(1, FT),))
+    pred = func("gt", BOOL, col(0, FT), lit(100, new_longlong()))
+    agg = Aggregation(group_by=(), aggs=(AggDesc("first_row", (col(0, FT),)),), partial=True)
+    dag = DAGRequest((scan, Selection((pred,)), agg), output_offsets=(0,))
+    stacked = stack_region_batches(chunks, n_total=8)
+    states = run_sharded_partial_agg(dag, stacked, mesh)
+    assert int(states[0][0][0]) == 1  # has: some region saw rows
+    assert int(states[1][0][0]) == 500
+    assert not bool(states[1][1][0])
+
+
+def test_sharded_first_row_keeps_null_value():
+    """A legitimately-NULL first value must survive the merge (#review:
+    has/is-null conflation) — matches the reference executor's literal
+    first row."""
+    FT = new_longlong()
+    chunks = [
+        Chunk.from_rows([FT], [[Datum.NULL], [Datum.i64(2)]]),
+        Chunk.from_rows([FT], [[Datum.i64(500)]]),
+    ]
+    mesh = region_mesh()
+    scan = TableScan(1, (ColumnInfo(1, FT),))
+    agg = Aggregation(group_by=(), aggs=(AggDesc("first_row", (col(0, FT),)),), partial=True)
+    dag = DAGRequest((scan, agg), output_offsets=(0,))
+    stacked = stack_region_batches(chunks, n_total=8)
+    states = run_sharded_partial_agg(dag, stacked, mesh)
+    assert int(states[0][0][0]) == 1
+    assert bool(states[1][1][0])  # value is NULL, not 500

@@ -1,0 +1,308 @@
+"""Parser tests (ref: pkg/parser/parser_test.go patterns — statement zoo +
+TPC-H shapes)."""
+
+import pytest
+
+from tidb_tpu.parser import ParseError, ast as A, parse, parse_one, parse_expr
+
+
+def test_simple_select():
+    s = parse_one("SELECT 1")
+    assert isinstance(s, A.SelectStmt)
+    assert s.fields[0].expr.value == 1
+
+
+def test_select_star_where():
+    s = parse_one("select * from t where a > 10 and b = 'x'")
+    assert isinstance(s.fields[0].expr, A.Star)
+    assert s.from_clause.name == "t"
+    assert s.where.op == "and"
+
+
+def test_qualified_names():
+    s = parse_one("select db.t.a, t.b c, `weird col` from db.t `al`")
+    f0 = s.fields[0].expr
+    assert (f0.db, f0.table, f0.name) == ("db", "t", "a")
+    assert s.fields[1].alias == "c"
+    assert s.fields[2].expr.name == "weird col"
+    assert s.from_clause.alias == "al"
+
+
+def test_operator_precedence():
+    e = parse_expr("1 + 2 * 3")
+    assert e.op == "plus" and e.right.op == "mul"
+    e = parse_expr("a or b and c")
+    assert e.op == "or" and e.right.op == "and"
+    e = parse_expr("not a = b")  # NOT binds looser than =
+    assert e.op == "not" and e.operand.op == "eq"
+    e = parse_expr("1 | 2 & 3")
+    assert e.op == "bitor" and e.right.op == "bitand"
+    e = parse_expr("- a * b")
+    assert e.op == "mul" and isinstance(e.left, A.UnaryOp)
+
+
+def test_between_in_like_is():
+    e = parse_expr("a between 1 and 2")
+    assert isinstance(e, A.Between)
+    e = parse_expr("a not in (1, 2, 3)")
+    assert isinstance(e, A.InList) and e.negated and len(e.items) == 3
+    e = parse_expr("name like 'ab%' escape '#'")
+    assert isinstance(e, A.Like) and e.escape == "#"
+    e = parse_expr("x is not null")
+    assert isinstance(e, A.IsNull) and e.negated
+
+
+def test_case_cast_interval():
+    e = parse_expr("case when a > 0 then 'p' when a < 0 then 'n' else 'z' end")
+    assert isinstance(e, A.Case) and len(e.when_clauses) == 2
+    e = parse_expr("cast(a as decimal(10,2))")
+    assert isinstance(e, A.Cast) and e.to_type.length == 10 and e.to_type.decimal == 2
+    e = parse_expr("d + interval 7 day")
+    assert isinstance(e, A.FuncCall) and e.name == "date_add"
+
+
+def test_agg_funcs():
+    s = parse_one("select count(*), count(distinct a), sum(b), avg(c) from t group by d having sum(b) > 5")
+    assert isinstance(s.fields[0].expr, A.AggFunc)
+    assert isinstance(s.fields[0].expr.args[0], A.Star)
+    assert s.fields[1].expr.distinct
+    assert s.having is not None
+
+
+def test_joins():
+    s = parse_one("select * from a join b on a.x = b.x left join c on b.y = c.y")
+    j = s.from_clause
+    assert isinstance(j, A.Join) and j.kind == "left"
+    assert j.left.kind == "inner"
+    s2 = parse_one("select * from a, b where a.x = b.x")
+    assert s2.from_clause.kind == "cross"
+
+
+def test_subqueries():
+    s = parse_one("select * from t where a in (select b from u) and exists (select 1 from v)")
+    assert isinstance(s.where.left, A.InSubquery)
+    assert isinstance(s.where.right, A.Exists)
+    s = parse_one("select (select max(x) from u) m from t")
+    assert isinstance(s.fields[0].expr, A.SubqueryExpr)
+    s = parse_one("select * from (select a, b from t) dt where dt.a > 1")
+    assert isinstance(s.from_clause, A.SubqueryTable)
+
+
+def test_union_order_limit():
+    s = parse_one("select a from t union all select b from u order by 1 limit 5 offset 2")
+    assert isinstance(s, A.SetOprStmt) and s.all_flags == [True]
+    assert s.limit.count.value == 5 and s.limit.offset.value == 2
+
+
+def test_tpch_q6():
+    q = """
+    select sum(l_extendedprice * l_discount) as revenue from lineitem
+    where l_shipdate >= date '1994-01-01'
+      and l_shipdate < date '1994-01-01' + interval '1' year
+      and l_discount between 0.05 and 0.07 and l_quantity < 24
+    """
+    s = parse_one(q)
+    assert isinstance(s.fields[0].expr, A.AggFunc)
+    assert s.fields[0].alias == "revenue"
+
+
+def test_tpch_q1():
+    q = """
+    select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+      sum(l_extendedprice) as sum_base_price,
+      sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+      sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+      avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+      avg(l_discount) as avg_disc, count(*) as count_order
+    from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day
+    group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus
+    """
+    s = parse_one(q)
+    assert len(s.fields) == 10 and len(s.group_by) == 2 and len(s.order_by) == 2
+
+
+def test_tpch_q3():
+    q = """
+    select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+      o_orderdate, o_shippriority
+    from customer, orders, lineitem
+    where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+      and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+      and l_shipdate > date '1995-03-15'
+    group by l_orderkey, o_orderdate, o_shippriority
+    order by revenue desc, o_orderdate limit 10
+    """
+    s = parse_one(q)
+    assert s.order_by[0].desc and s.limit.count.value == 10
+    assert s.from_clause.kind == "cross"
+
+
+def test_create_table():
+    s = parse_one(
+        """CREATE TABLE IF NOT EXISTS t (
+            id bigint unsigned not null auto_increment primary key,
+            name varchar(64) default 'x' comment 'the name',
+            amount decimal(15, 2) not null,
+            created datetime default current_timestamp,
+            key idx_name (name(10)),
+            unique key uq (amount, name)
+        ) engine=innodb charset=utf8mb4 auto_increment=100"""
+    )
+    assert s.if_not_exists
+    assert s.columns[0].auto_increment and s.columns[0].primary_key
+    assert s.columns[0].type.unsigned
+    assert s.columns[2].type.decimal == 2
+    assert len(s.indexes) == 2 and s.indexes[1].unique
+    assert s.options["auto_increment"] == 100
+
+
+def test_create_table_pk_constraint():
+    s = parse_one("create table t (a int, b int, primary key (a, b))")
+    assert s.indexes[0].primary and s.indexes[0].columns == [("a", -1), ("b", -1)]
+
+
+def test_alter_table():
+    s = parse_one("alter table t add column c int not null after b, drop column d, add index i (c), rename to t2")
+    assert [sp.action for sp in s.specs] == ["add_column", "drop_column", "add_index", "rename"]
+    assert s.specs[0].position == "after:b"
+
+
+def test_dml():
+    s = parse_one("insert into t (a, b) values (1, 'x'), (2, 'y') on duplicate key update b = 'z'")
+    assert len(s.values) == 2 and len(s.on_duplicate) == 1
+    s = parse_one("insert into t set a = 1, b = 2")
+    assert s.columns == ["a", "b"]
+    s = parse_one("replace into t values (1)")
+    assert s.replace
+    s = parse_one("update t set a = a + 1 where b < 5 limit 10")
+    assert s.limit.count.value == 10
+    s = parse_one("delete from t where a = 1")
+    assert isinstance(s, A.DeleteStmt)
+    s = parse_one("insert into t select * from u")
+    assert s.select is not None
+
+
+def test_misc_stmts():
+    assert isinstance(parse_one("begin"), A.BeginStmt)
+    assert isinstance(parse_one("start transaction"), A.BeginStmt)
+    assert isinstance(parse_one("commit"), A.CommitStmt)
+    assert isinstance(parse_one("rollback"), A.RollbackStmt)
+    assert isinstance(parse_one("use test"), A.UseStmt)
+    s = parse_one("set @@global.tidb_mem_quota = 1024, autocommit = 1")
+    assert s.assignments[0][0] == "global"
+    assert s.assignments[1] [1] == "autocommit"
+    s = parse_one("show tables from db1 like 't%'")
+    assert s.kind == "tables" and s.db == "db1" and s.pattern == "t%"
+    s = parse_one("show create table t")
+    assert s.kind == "create_table"
+    s = parse_one("explain analyze select 1")
+    assert s.analyze
+    s = parse_one("analyze table t1, t2")
+    assert len(s.tables) == 2
+    s = parse_one("admin show ddl jobs")
+    assert s.kind == "show_ddl_jobs"
+    s = parse_one("admin check table t")
+    assert s.kind == "check_table"
+    s = parse_one("backup database tpch to 'local:///tmp/bk'")
+    assert s.kind == "backup" and s.storage == "local:///tmp/bk"
+    s = parse_one("drop table if exists a, b")
+    assert s.if_exists and len(s.tables) == 2
+    s = parse_one("truncate table t")
+    assert isinstance(s, A.TruncateTableStmt)
+    s = parse_one("rename table a to b")
+    assert isinstance(s, A.RenameTableStmt)
+    s = parse_one("create index i on t (a, b(5))")
+    assert isinstance(s, A.CreateIndexStmt)
+    s = parse_one("kill 42")
+    assert s.conn_id == 42
+
+
+def test_prepared():
+    s = parse_one("prepare s1 from 'select * from t where a = ?'")
+    assert isinstance(s, A.PrepareStmt)
+    s = parse_one("execute s1 using @x, @y")
+    assert s.using == ["x", "y"]
+    s = parse_one("select * from t where a = ? and b = ?")
+    markers = []
+
+    def walk(e):
+        if isinstance(e, A.ParamMarker):
+            markers.append(e.index)
+        for f in getattr(e, "__dict__", {}).values():
+            if isinstance(f, A.ExprNode):
+                walk(f)
+
+    walk(s.where)
+    assert markers == [0, 1]
+
+
+def test_multi_statement():
+    stmts = parse("select 1; select 2;")
+    assert len(stmts) == 2
+
+
+def test_comments_and_strings():
+    s = parse_one("select /* hi */ 'it''s', \"dq\" -- trailing\n from t")
+    assert s.fields[0].expr.value == "it's"
+    assert s.fields[1].expr.value == "dq"
+
+
+def test_errors():
+    with pytest.raises(ParseError):
+        parse_one("select from where")
+    with pytest.raises(ParseError):
+        parse_one("bogus statement")
+    with pytest.raises(ParseError):
+        parse_one("select 'unterminated")
+
+
+def test_variables():
+    e = parse_expr("@@tidb_distsql_scan_concurrency")
+    assert isinstance(e, A.Variable) and e.system
+    e = parse_expr("@uservar")
+    assert not e.system
+
+
+def test_load_data():
+    s = parse_one(
+        "load data local infile '/tmp/x.csv' into table t fields terminated by ',' "
+        "enclosed by '\"' lines terminated by '\\n' ignore 1 lines (a, b, c)"
+    )
+    assert s.fields_terminated == "," and s.ignore_lines == 1 and s.columns == ["a", "b", "c"]
+
+
+def test_union_parenthesized_branch_keeps_local_limit():
+    """(#review) A parenthesized union branch's ORDER/LIMIT is branch-local,
+    not hoisted to the union."""
+    s = parse_one("(select a from t order by a limit 1) union all (select b from u order by b limit 1)")
+    assert isinstance(s, A.SetOprStmt)
+    assert s.limit is None and s.order_by == []
+    assert s.selects[1].limit.count.value == 1 and s.selects[1].order_by[0].expr.name == "b"
+
+
+def test_bang_binds_tight():
+    """'!' binds at unary precedence, unlike NOT (#review)."""
+    e = parse_expr("!a in (1,2)")
+    assert isinstance(e, A.InList) and isinstance(e.expr, A.UnaryOp)
+
+
+def test_backquoted_name_never_a_call():
+    """`max`(a) is a column ref, not an aggregate (#review)."""
+    s = parse_one("select `max` from t")
+    assert isinstance(s.fields[0].expr, A.ColumnName)
+    with pytest.raises(ParseError):
+        parse_one("select `max`(a) from t")
+
+
+def test_db_table_star():
+    s = parse_one("select db.t.* from db.t")
+    st = s.fields[0].expr
+    assert isinstance(st, A.Star) and st.table == "t" and st.db == "db"
+
+
+def test_with_cte():
+    s = parse_one("with x as (select 1 a), y (b) as (select a from x) select * from y")
+    assert len(s.ctes) == 2
+    assert s.ctes[1].name == "y" and s.ctes[1].columns == ["b"]
+    s = parse_one("with recursive r as (select 1 union all select n + 1 from r) select * from r")
+    assert s.ctes[0].recursive

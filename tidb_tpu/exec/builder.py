@@ -104,6 +104,15 @@ def build_program(dag: DAGRequest, capacity: int, group_capacity: int = DEFAULT_
                         if a.name == "first_row":
                             gath = _gather(av, res.group_rep)[0]
                             gath = CompVal(gath.value, gath.null | ~res.group_valid, a.ft, raw=gath.raw)
+                            if ex.partial:
+                                # partial schema is [has, value]; every valid
+                                # group has >= 1 row by construction
+                                has = CompVal(
+                                    res.group_valid.astype(jnp.int64),
+                                    jnp.zeros_like(res.group_valid),
+                                    a.partial_fts()[0],
+                                )
+                                new_cols.append(has)
                             new_cols.append(gath)
                         else:
                             new_cols.extend(_agg_out_cols(a, next(st_iter), res.group_valid, ex.partial))

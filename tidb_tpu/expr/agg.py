@@ -14,7 +14,9 @@ Partial-state schemas (what crosses regions and what psum reduces):
   sum        [sum  argclass]                  merge: +   (NULL if no rows)
   avg        [count int64, sum argclass]      merge: +,+ (ref: aggfuncs avg)
   min / max  [val argclass]                   merge: min/max with null drop
-  first_row  [val argclass]                   merge: take first non-empty
+  first_row  [has int64, val argclass]        merge: first state with has>0
+             (has distinguishes "region saw no rows" from "first row's
+              value is NULL" — the value itself may legitimately be NULL)
 """
 
 from __future__ import annotations
@@ -98,8 +100,10 @@ class AggDesc:
             return [self._sum_ft(arg_ft)]
         if self.name == "avg":
             return [new_longlong(notnull=True), self._sum_ft(arg_ft)]
-        if self.name in ("min", "max", "first_row"):
+        if self.name in ("min", "max"):
             return [arg_ft.clone()]
+        if self.name == "first_row":
+            return [new_longlong(notnull=True), arg_ft.clone()]
         return [new_longlong(unsigned=True)]
 
     @staticmethod

@@ -58,6 +58,34 @@ def _masked(vals, mask, fill):
     return jnp.where(mask, vals, fill)
 
 
+_BIT_OPS = {
+    "bit_and": (jnp.bitwise_and, -1),  # identity all-ones (MySQL empty BIT_AND = 2^64-1)
+    "bit_or": (jnp.bitwise_or, 0),
+    "bit_xor": (jnp.bitwise_xor, 0),
+}
+
+
+def _seg_bitreduce(red, vals, seg, nseg, fill):
+    """Segmented bitwise reduce via associative scan (rows sorted by seg —
+    group_aggregate sorts, scalar_aggregate has one segment). There is no
+    jax.ops.segment_{and,or,xor}; the standard segmented-scan combine is
+    associative over sorted segment ids, then the last row of each segment
+    holds the segment's reduction."""
+    n = vals.shape[0]
+
+    def combine(c1, c2):
+        v1, s1 = c1
+        v2, s2 = c2
+        return jnp.where(s1 == s2, red(v1, v2), v2), s2
+
+    sv, _ = jax.lax.associative_scan(combine, (vals, seg))
+    pos = jnp.arange(n, dtype=jnp.int32)
+    last = jax.ops.segment_max(pos, seg, num_segments=nseg)
+    out = sv[jnp.clip(last, 0, n - 1)]
+    cnt = jax.ops.segment_sum(jnp.ones_like(seg), seg, num_segments=nseg)
+    return jnp.where(cnt > 0, out, jnp.int64(fill))
+
+
 def _agg_states_raw(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
     """Per-group partial states from raw rows."""
     name = desc.name
@@ -99,18 +127,35 @@ def _agg_states_raw(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
             v = op(_masked(av, mask, fill), seg, num_segments=nseg)
         return [(v, empty)]
     if name == "first_row":
-        if a.value.ndim == 2:
-            # grouped first_row over strings is served by the rep-row gather
-            # in exec/builder.py; this state path has no raw bytes to carry
-            raise NotImplementedError("first_row over string needs rep-row gather")
-        # first row in sorted order per segment (arbitrary row, like the
-        # reference's map-ordered first_row)
-        pos = jnp.arange(seg.shape[0], dtype=jnp.int32)
-        inseg = valid  # first_row keeps NULL argument values too
-        first = jax.ops.segment_min(jnp.where(inseg, pos, jnp.int32(2**31 - 1)), seg, num_segments=nseg)
-        first = jnp.clip(first, 0, seg.shape[0] - 1)
-        return [(a.value[first], a.null[first])]
+        return _first_row_state(a, valid, seg, nseg)
+    if name in _BIT_OPS:
+        red, fill = _BIT_OPS[name]
+        v = _seg_bitreduce(red, _masked(a.value.astype(jnp.int64), mask, jnp.int64(fill)), seg, nseg, fill)
+        # MySQL BIT_* never return NULL: empty set yields the identity
+        return [(v, jnp.zeros(nseg, bool))]
     raise NotImplementedError(f"aggregate {name} on device")
+
+
+def _first_row_state(a: CompVal, inseg, seg, nseg):
+    """first_row partial state: [has, value]. `has` = segment saw >=1 row;
+    the value is the literal first in-segment row's (value, null) — NULL
+    values are kept, matching the reference's first_row which takes the
+    first row verbatim (ref: aggfuncs/func_first_row.go). `has` lets the
+    cross-region merge skip empty/filtered-out regions without conflating
+    them with a legitimately-NULL first value."""
+    if a.value.ndim == 2:
+        # grouped first_row over strings is served by the rep-row gather
+        # in exec/builder.py; this state path has no raw bytes to carry
+        raise NotImplementedError("first_row over string needs rep-row gather")
+    n = seg.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    sentinel = jnp.int32(2**31 - 1)
+    first = jax.ops.segment_min(jnp.where(inseg, pos, sentinel), seg, num_segments=nseg)
+    has = first < n
+    first_c = jnp.clip(first, 0, n - 1)
+    val = jnp.where(has, a.value[first_c], jnp.zeros((), a.value.dtype))
+    null = jnp.where(has, a.null[first_c], True)
+    return [(has.astype(jnp.int64), jnp.zeros(nseg, bool)), (val, null)]
 
 
 def _agg_states_merge(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
@@ -136,6 +181,12 @@ def _agg_states_merge(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
     if name in ("min", "max"):
         return _agg_states_raw(desc, args, valid, seg, nseg)
     if name == "first_row":
+        # merge phase: states are [has, value]; take the first state whose
+        # region saw rows (has>0), keeping that state's value/null verbatim
+        has, val = args[0], args[1]
+        return _first_row_state(val, valid & (has.value > 0), seg, nseg)
+    if name in _BIT_OPS:
+        # reduce of reduces — same segmented bitwise kernel over state cols
         return _agg_states_raw(desc, args, valid, seg, nseg)
     raise NotImplementedError(f"merge of {name} on device")
 
@@ -154,6 +205,10 @@ def finalize_agg(desc: AggDesc, states: list, group_valid) -> tuple:
         num = s * jnp.int64(10 ** (tgt - sum_scale))
         out = _round_div(num, jnp.where(cnt == 0, jnp.int64(1), cnt))
         return out, snull | (cnt == 0)
+    if name == "first_row":
+        has = states[0][0]
+        v, nl = states[1]
+        return v, nl | (has == 0)
     # identity finalize
     v, nl = states[0][0], states[0][1]
     return v, nl

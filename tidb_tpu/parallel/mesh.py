@@ -107,28 +107,42 @@ def run_sharded_partial_agg(dag: DAGRequest, stacked: DeviceBatch, mesh: Mesh):
                 flat.append((v, nl))
         return flat
 
-    # merge op per partial-state column, by aggregate name (the schema in
-    # expr/agg.py partial_fts: count->[cnt], sum->[sum], avg->[cnt,sum], ...)
-    state_ops: list[str] = []
+    # merge plan per aggregate (the schema in expr/agg.py partial_fts:
+    # count->[cnt], sum->[sum], avg->[cnt,sum], first_row->[has,val], ...).
+    # Column entries are (op, unsigned): unsigned BIGINT min/max states are
+    # raw two's-complement int64 (ops/aggregate.py sign-flip trick), so the
+    # mesh merge must compare them in the flipped domain too. first_row's
+    # two state columns merge JOINTLY (value selected by the has column).
+    merge_plan: list[tuple] = []  # ("col", op, unsigned) | ("first_row",)
     for desc in agg.aggs:
-        n_states = len(desc.partial_fts())
+        sfts = desc.partial_fts()
         if desc.name in ("count", "sum", "avg", "bit_xor"):
             # avg states are [count, sum] — both additive; bit_xor merge is xor
-            ops = ["sum"] * n_states if desc.name != "bit_xor" else ["xor"]
-        elif desc.name in ("min", "max", "first_row", "bit_and", "bit_or"):
-            ops = [desc.name if desc.name in ("min", "max") else
-                   ("and" if desc.name == "bit_and" else
-                    "or" if desc.name == "bit_or" else "first")] * n_states
+            op = "sum" if desc.name != "bit_xor" else "xor"
+            merge_plan.extend(("col", op, False) for _ in sfts)
+        elif desc.name in ("min", "max"):
+            merge_plan.extend(("col", desc.name, ft.is_unsigned() and ft.is_int()) for ft in sfts)
+        elif desc.name in ("bit_and", "bit_or"):
+            merge_plan.extend(("col", "and" if desc.name == "bit_and" else "or", False) for _ in sfts)
+        elif desc.name == "first_row":
+            merge_plan.append(("first_row",))
         else:
             raise TypeError(f"no mesh merge for aggregate {desc.name!r}")
-        state_ops.extend(ops)
 
     def device_fn(local: DeviceBatch):
         # local: [R_local, cap] pytree
         flat = jax.vmap(lambda c, v: per_region((c, v)))(local.cols, local.row_valid)
         merged = []
-        for op, (v, nl) in zip(state_ops, flat):
-            merged.append(_merge_state(op, v, nl, REGION_AXIS))
+        k = 0
+        for entry in merge_plan:
+            if entry[0] == "first_row":
+                merged.extend(_merge_first_row(flat[k], flat[k + 1], REGION_AXIS))
+                k += 2
+            else:
+                _, op, unsigned = entry
+                v, nl = flat[k]
+                merged.append(_merge_state(op, v, nl, REGION_AXIS, unsigned=unsigned))
+                k += 1
         return merged
 
     from jax import shard_map
@@ -151,7 +165,7 @@ def _n_state_cols(agg: Aggregation) -> int:
     return sum(len(d.partial_fts()) for d in agg.aggs)
 
 
-def _merge_state(op: str, v, nl, axis: str):
+def _merge_state(op: str, v, nl, axis: str, unsigned: bool = False):
     """Merge one partial-state column across local regions then the mesh.
 
     v: [R_local, 1] values (NULL lanes zeroed), nl: [R_local, 1] null flags.
@@ -159,8 +173,15 @@ def _merge_state(op: str, v, nl, axis: str):
     if every region's is (ref: aggfuncs partial merge semantics). Sum-like
     states ride psum over ICI (the north-star collective); min/max ride
     pmin/pmax; bit/first states all_gather (tiny) and reduce locally.
+
+    unsigned min/max states hold unsigned values as raw two's-complement
+    int64 — compare in the sign-flipped domain (same trick as the kernel).
     """
     allnull = jnp.all(nl, axis=0)
+    flip = None
+    if unsigned and op in ("min", "max") and jnp.issubdtype(v.dtype, jnp.integer):
+        flip = jnp.int64(-0x8000000000000000)
+        v = v.astype(jnp.int64) ^ flip
     if op in ("sum", "xor", "or"):
         fill = jnp.zeros((), v.dtype)
     elif op == "and":
@@ -171,8 +192,8 @@ def _merge_state(op: str, v, nl, axis: str):
     elif op == "max":
         fill = (jnp.full((), -jnp.inf, v.dtype) if jnp.issubdtype(v.dtype, jnp.floating)
                 else jnp.full((), jnp.iinfo(v.dtype).min, v.dtype))
-    else:  # first
-        fill = jnp.zeros((), v.dtype)
+    else:
+        raise AssertionError(op)
     masked = jnp.where(nl, fill, v)
 
     if op == "sum":
@@ -181,19 +202,35 @@ def _merge_state(op: str, v, nl, axis: str):
         val = jax.lax.pmin(jnp.min(masked, axis=0), axis)
     elif op == "max":
         val = jax.lax.pmax(jnp.max(masked, axis=0), axis)
-    elif op in ("xor", "or", "and"):
+    else:  # xor / or / and: all_gather (tiny) then local bitwise reduce
         red = {"xor": jnp.bitwise_xor, "or": jnp.bitwise_or, "and": jnp.bitwise_and}[op]
         local = red.reduce(masked, axis=0)
         gathered = jax.lax.all_gather(local, axis)  # [D, 1]
         val = red.reduce(gathered, axis=0)
-    else:  # first: first non-null region in global region order
-        # global order == device-major: regions were stacked then sharded on
-        # the leading axis, so device d owns regions [d*R_local, (d+1)*R_local)
-        gv = jax.lax.all_gather(masked, axis).reshape((-1,) + v.shape[1:])
-        gn = jax.lax.all_gather(nl, axis).reshape((-1,) + nl.shape[1:])
-        idx = jnp.argmax(~gn, axis=0)
-        val = jnp.take_along_axis(gv, idx[None], axis=0)[0]
     allnull = jax.lax.pmin(allnull.astype(jnp.int32), axis) > 0
-    if op in ("min", "max", "first"):
-        val = jnp.where(allnull, jnp.zeros((), v.dtype), val)
+    if flip is not None:
+        val = val ^ flip
+    if op in ("min", "max"):
+        val = jnp.where(allnull, jnp.zeros((), val.dtype), val)
     return val, allnull
+
+
+def _merge_first_row(has_state, val_state, axis: str):
+    """first_row's [has, value] states merge jointly: the first region in
+    global region order (device-major — regions were stacked then sharded on
+    the leading axis) with has>0 supplies its (value, null) verbatim; NULL
+    first values are kept (ref: aggfuncs first_row takes the literal first
+    row). Returns the two merged state columns [has, value]."""
+    has, _ = has_state
+    v, nl = val_state
+    ghas = jax.lax.all_gather(has, axis).reshape((-1,) + has.shape[1:])
+    gv = jax.lax.all_gather(v, axis).reshape((-1,) + v.shape[1:])
+    gn = jax.lax.all_gather(nl, axis).reshape((-1,) + nl.shape[1:])
+    present = ghas > 0
+    idx = jnp.argmax(present, axis=0)
+    any_has = jnp.any(present, axis=0)
+    val = jnp.take_along_axis(gv, idx[None], axis=0)[0]
+    null = jnp.take_along_axis(gn, idx[None], axis=0)[0]
+    val = jnp.where(any_has & ~null, val, jnp.zeros((), v.dtype))
+    null = jnp.where(any_has, null, True)
+    return [(any_has.astype(jnp.int64), jnp.zeros_like(null)), (val, null)]

@@ -1,0 +1,8 @@
+"""SQL front end: lexer, AST, recursive-descent MySQL parser
+(ref: pkg/parser — goyacc grammar parser.y + ast/)."""
+
+from . import ast
+from .lexer import LexError, tokenize
+from .parser import ParseError, parse, parse_expr, parse_one
+
+__all__ = ["ast", "tokenize", "LexError", "ParseError", "parse", "parse_one", "parse_expr"]

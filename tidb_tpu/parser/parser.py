@@ -1,0 +1,1498 @@
+"""Recursive-descent MySQL parser (ref: pkg/parser/parser.y — 16.5k-line
+goyacc grammar; this covers the dialect subset the engine executes: full
+TPC-H SELECT shape, DML, DDL, txn control, SHOW/SET/EXPLAIN/ANALYZE/ADMIN,
+prepared statements, BACKUP/RESTORE).
+
+Expression precedence mirrors MySQL (ref: parser.y precedence decls):
+  OR < XOR < AND < NOT < comparison/IS/IN/LIKE/BETWEEN < | < & < shifts
+  < +- < */%  < ^ < unary < collate.
+"""
+
+from __future__ import annotations
+
+from . import ast as A
+from .lexer import LexError, T, Token, tokenize
+
+
+class ParseError(ValueError):
+    pass
+
+
+# Keywords that stop an alias from being swallowed.
+_RESERVED_AFTER_EXPR = {
+    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "UNION", "JOIN",
+    "INNER", "LEFT", "RIGHT", "CROSS", "ON", "USING", "AND", "OR", "XOR",
+    "NOT", "AS", "ASC", "DESC", "INTO", "FOR", "SET", "WHEN", "THEN",
+    "ELSE", "END", "BETWEEN", "LIKE", "IN", "IS", "EXISTS", "CASE",
+    "STRAIGHT_JOIN", "NATURAL", "OFFSET", "LOCK", "VALUES", "WITH",
+    "INTERVAL", "REGEXP", "RLIKE", "DIV", "MOD", "COLLATE", "DUPLICATE",
+    "KEY", "UPDATE", "ALL", "ANY", "SOME", "ESCAPE", "OVER", "WINDOW",
+}
+
+_AGG_FUNCS = {
+    "count", "sum", "avg", "min", "max", "group_concat", "bit_and",
+    "bit_or", "bit_xor", "std", "stddev", "stddev_pop", "stddev_samp",
+    "var_pop", "var_samp", "variance", "approx_count_distinct",
+}
+
+_TYPE_NAMES = {
+    "tinyint", "smallint", "mediumint", "int", "integer", "bigint",
+    "float", "double", "real", "decimal", "numeric", "dec", "fixed",
+    "char", "varchar", "binary", "varbinary", "text", "tinytext",
+    "mediumtext", "longtext", "blob", "tinyblob", "mediumblob", "longblob",
+    "date", "datetime", "timestamp", "time", "year", "bit", "bool",
+    "boolean", "enum", "set", "json", "signed", "unsigned",
+}
+
+
+def parse(sql: str) -> list:
+    """Parse one or more ;-separated statements."""
+    return Parser(sql).parse_statements()
+
+
+def parse_one(sql: str):
+    stmts = parse(sql)
+    if len(stmts) != 1:
+        raise ParseError(f"expected one statement, got {len(stmts)}")
+    return stmts[0]
+
+
+def parse_expr(text: str) -> A.ExprNode:
+    p = Parser(f"SELECT {text}")
+    stmt = p.parse_statements()[0]
+    return stmt.fields[0].expr
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        try:
+            self.toks = tokenize(sql)
+        except LexError as e:
+            raise ParseError(str(e)) from e
+        self.i = 0
+        self.n_params = 0
+
+    # ---- token helpers ----
+    def peek(self, ahead: int = 0) -> Token:
+        j = min(self.i + ahead, len(self.toks) - 1)
+        return self.toks[j]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind is not T.EOF:
+            self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind is T.IDENT and t.upper in kws
+
+    def eat_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.i += 1
+            return True
+        return False
+
+    def expect_kw(self, kw: str):
+        if not self.eat_kw(kw):
+            raise ParseError(f"expected {kw} at {self._where()}")
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind is T.OP and t.text in ops
+
+    def eat_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.i += 1
+            return True
+        return False
+
+    def expect_op(self, op: str):
+        if not self.eat_op(op):
+            raise ParseError(f"expected {op!r} at {self._where()}")
+
+    def _where(self) -> str:
+        t = self.peek()
+        frag = self.sql[max(0, t.pos - 20) : t.pos + 20]
+        return f"token {t.text!r} (…{frag}…)"
+
+    def ident(self) -> str:
+        t = self.peek()
+        if t.kind in (T.IDENT, T.QIDENT):
+            self.i += 1
+            return t.text
+        raise ParseError(f"expected identifier at {self._where()}")
+
+    # ---- statements ----
+    def parse_statements(self) -> list:
+        out = []
+        while self.peek().kind is not T.EOF:
+            if self.eat_op(";"):
+                continue
+            out.append(self.statement())
+            if self.peek().kind is not T.EOF:
+                self.expect_op(";")
+        return out
+
+    def statement(self):
+        t = self.peek()
+        if t.kind is not T.IDENT:
+            if t.kind is T.OP and t.text == "(":
+                return self.select_or_union()
+            raise ParseError(f"unexpected {self._where()}")
+        kw = t.upper
+        if kw in ("SELECT", "WITH"):
+            return self.select_or_union()
+        if kw == "INSERT" or kw == "REPLACE":
+            return self.insert_stmt(replace=kw == "REPLACE")
+        if kw == "UPDATE":
+            return self.update_stmt()
+        if kw == "DELETE":
+            return self.delete_stmt()
+        if kw == "CREATE":
+            return self.create_stmt()
+        if kw == "DROP":
+            return self.drop_stmt()
+        if kw == "ALTER":
+            return self.alter_stmt()
+        if kw == "RENAME":
+            return self.rename_stmt()
+        if kw == "TRUNCATE":
+            self.next()
+            self.eat_kw("TABLE")
+            return A.TruncateTableStmt(self.table_name())
+        if kw == "SET":
+            return self.set_stmt()
+        if kw == "USE":
+            self.next()
+            return A.UseStmt(self.ident())
+        if kw == "SHOW":
+            return self.show_stmt()
+        if kw in ("EXPLAIN", "DESC", "DESCRIBE"):
+            return self.explain_stmt()
+        if kw == "ANALYZE":
+            return self.analyze_stmt()
+        if kw in ("BEGIN", "START"):
+            self.next()
+            self.eat_kw("TRANSACTION")
+            return A.BeginStmt()
+        if kw == "COMMIT":
+            self.next()
+            return A.CommitStmt()
+        if kw == "ROLLBACK":
+            self.next()
+            return A.RollbackStmt()
+        if kw == "PREPARE":
+            self.next()
+            name = self.ident()
+            self.expect_kw("FROM")
+            s = self.next()
+            if s.kind is not T.STRING:
+                raise ParseError("PREPARE ... FROM expects a string")
+            return A.PrepareStmt(name, s.text)
+        if kw == "EXECUTE":
+            self.next()
+            name = self.ident()
+            using = []
+            if self.eat_kw("USING"):
+                while True:
+                    self.expect_op("@")
+                    using.append(self.ident())
+                    if not self.eat_op(","):
+                        break
+            return A.ExecuteStmt(name, using)
+        if kw == "DEALLOCATE":
+            self.next()
+            self.eat_kw("PREPARE")
+            return A.DeallocateStmt(self.ident())
+        if kw == "ADMIN":
+            return self.admin_stmt()
+        if kw == "KILL":
+            self.next()
+            q = self.eat_kw("QUERY")
+            self.eat_kw("TIDB", "CONNECTION")
+            return A.KillStmt(int(self.next().text), q)
+        if kw == "LOAD":
+            return self.load_data_stmt()
+        if kw in ("BACKUP", "RESTORE"):
+            return self.brie_stmt(kw.lower())
+        if kw == "TRACE":
+            self.next()
+            return A.TraceStmt(self.statement())
+        if kw == "FLASHBACK":
+            self.next()
+            self.expect_kw("TABLE")
+            tbl = self.table_name()
+            new = ""
+            if self.eat_kw("TO"):
+                new = self.ident()
+            return A.FlashbackStmt(tbl, new)
+        raise ParseError(f"unsupported statement start {kw} at {self._where()}")
+
+    # ---- SELECT / UNION ----
+    def select_or_union(self):
+        ctes = self.with_clause() if self.at_kw("WITH") else []
+        paren = self.at_op("(")
+        selects = [self.single_select()]
+        paren_flags = [paren]
+        all_flags = []
+        while self.at_kw("UNION"):
+            self.next()
+            all_flags.append(self.eat_kw("ALL") or (self.eat_kw("DISTINCT") and False))
+            paren_flags.append(self.at_op("("))
+            selects.append(self.single_select())
+        if len(selects) == 1:
+            s = selects[0]
+            if ctes:
+                s.ctes = ctes + getattr(s, "ctes", [])
+            return s
+        order_by, limit = [], None
+        if self.eat_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by = self.by_list()
+        if self.at_kw("LIMIT"):
+            limit = self.limit_clause()
+        # MySQL binds a trailing ORDER BY/LIMIT to the whole union; the last
+        # branch will have swallowed it — hoist it up, but only when the
+        # branch was NOT parenthesized (a parenthesized branch's ORDER/LIMIT
+        # is branch-local).
+        last = selects[-1]
+        if not order_by and not limit and not paren_flags[-1] and isinstance(last, A.SelectStmt):
+            order_by, limit = last.order_by, last.limit
+            last.order_by, last.limit = [], None
+        return A.SetOprStmt(selects, all_flags, order_by, limit, ctes)
+
+    def with_clause(self) -> list:
+        """WITH [RECURSIVE] name [(cols)] AS (subquery), ...
+        (ref: parser.y WithClause; ast.CommonTableExpression)."""
+        self.expect_kw("WITH")
+        recursive = self.eat_kw("RECURSIVE")
+        ctes = []
+        while True:
+            name = self.ident()
+            cols = []
+            if self.eat_op("("):
+                while True:
+                    cols.append(self.ident())
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+            self.expect_kw("AS")
+            self.expect_op("(")
+            sub = self.select_or_union()
+            self.expect_op(")")
+            ctes.append(A.CTE(name, cols, sub, recursive))
+            if not self.eat_op(","):
+                break
+        return ctes
+
+    def single_select(self) -> A.SelectStmt:
+        if self.eat_op("("):
+            s = self.select_or_union()
+            self.expect_op(")")
+            return s
+        self.expect_kw("SELECT")
+        distinct = False
+        while True:
+            if self.eat_kw("DISTINCT", "DISTINCTROW"):
+                distinct = True
+            elif self.eat_kw("ALL", "SQL_CALC_FOUND_ROWS", "STRAIGHT_JOIN", "SQL_NO_CACHE", "HIGH_PRIORITY"):
+                pass
+            else:
+                break
+        fields = [self.select_field()]
+        while self.eat_op(","):
+            fields.append(self.select_field())
+        frm = None
+        if self.eat_kw("FROM"):
+            frm = self.table_refs()
+        where = self.expr() if self.eat_kw("WHERE") else None
+        group_by, having = [], None
+        if self.eat_kw("GROUP"):
+            self.expect_kw("BY")
+            group_by = self.by_list()
+            self.eat_kw("WITH") and self.expect_kw("ROLLUP")
+        if self.eat_kw("HAVING"):
+            having = self.expr()
+        order_by = []
+        if self.eat_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by = self.by_list()
+        limit = self.limit_clause() if self.at_kw("LIMIT") else None
+        for_update = False
+        if self.eat_kw("FOR"):
+            self.expect_kw("UPDATE")
+            for_update = True
+        elif self.eat_kw("LOCK"):
+            self.expect_kw("IN")
+            self.expect_kw("SHARE")
+            self.expect_kw("MODE")
+        return A.SelectStmt(fields, frm, where, group_by, having, order_by, limit, distinct, for_update)
+
+    def select_field(self):
+        if self.at_op("*"):
+            self.next()
+            return A.SelectField(A.Star(), "")
+        # t.* / db.t.*
+        if self.peek().kind in (T.IDENT, T.QIDENT):
+            j = self.i
+            name = self.ident()
+            if self.at_op(".") and self.peek(1).kind in (T.IDENT, T.QIDENT) and self.peek(2).kind is T.OP and self.peek(2).text == "." and self.peek(3).kind is T.OP and self.peek(3).text == "*":
+                self.next()
+                tbl = self.ident()
+                self.next()
+                self.next()
+                return A.SelectField(A.Star(table=tbl, db=name), "")
+            if self.at_op(".") and self.peek(1).kind is T.OP and self.peek(1).text == "*":
+                self.next()
+                self.next()
+                return A.SelectField(A.Star(table=name), "")
+            self.i = j
+        e = self.expr()
+        alias = ""
+        if self.eat_kw("AS"):
+            t = self.next()
+            if t.kind in (T.IDENT, T.QIDENT, T.STRING):
+                alias = t.text
+            else:
+                raise ParseError(f"bad alias at {self._where()}")
+        elif self.peek().kind in (T.IDENT, T.QIDENT) and self.peek().upper not in _RESERVED_AFTER_EXPR:
+            alias = self.next().text
+        return A.SelectField(e, alias)
+
+    def by_list(self) -> list:
+        out = []
+        while True:
+            e = self.expr()
+            desc = False
+            if self.eat_kw("DESC"):
+                desc = True
+            else:
+                self.eat_kw("ASC")
+            out.append(A.ByItem(e, desc))
+            if not self.eat_op(","):
+                break
+        return out
+
+    def limit_clause(self) -> A.Limit:
+        self.expect_kw("LIMIT")
+        a = self.simple_limit_value()
+        if self.eat_op(","):
+            return A.Limit(self.simple_limit_value(), a)
+        if self.eat_kw("OFFSET"):
+            return A.Limit(a, self.simple_limit_value())
+        return A.Limit(a)
+
+    def simple_limit_value(self):
+        t = self.peek()
+        if t.kind is T.NUMBER:
+            self.next()
+            return A.Literal(int(t.text), "int")
+        if t.kind is T.PARAM:
+            self.next()
+            p = A.ParamMarker(self.n_params)
+            self.n_params += 1
+            return p
+        raise ParseError(f"expected LIMIT count at {self._where()}")
+
+    # ---- table refs ----
+    def table_refs(self):
+        left = self.table_factor()
+        while True:
+            natural = False
+            if self.at_kw("NATURAL"):
+                natural = True
+                self.next()
+            if self.eat_op(","):
+                right = self.table_factor()
+                left = A.Join(left, right, "cross")
+                continue
+            if self.eat_kw("STRAIGHT_JOIN"):
+                right = self.table_factor()
+                on = self.expr() if self.eat_kw("ON") else None
+                left = A.Join(left, right, "inner", on)
+                continue
+            kind = None
+            if self.at_kw("JOIN", "INNER", "CROSS"):
+                if self.eat_kw("INNER") or self.eat_kw("CROSS"):
+                    pass
+                self.expect_kw("JOIN")
+                kind = "inner"
+            elif self.at_kw("LEFT", "RIGHT"):
+                kind = "left" if self.eat_kw("LEFT") else (self.eat_kw("RIGHT") and "right")
+                self.eat_kw("OUTER")
+                self.expect_kw("JOIN")
+            else:
+                break
+            right = self.table_factor()
+            on, using = None, []
+            if not natural:
+                if self.eat_kw("ON"):
+                    on = self.expr()
+                elif self.eat_kw("USING"):
+                    self.expect_op("(")
+                    while True:
+                        using.append(self.ident())
+                        if not self.eat_op(","):
+                            break
+                    self.expect_op(")")
+            left = A.Join(left, right, kind, on, using)
+        return left
+
+    def table_factor(self):
+        if self.eat_op("("):
+            if self.at_kw("SELECT", "WITH") or self.at_op("("):
+                sub = self.select_or_union()
+                self.expect_op(")")
+                self.eat_kw("AS")
+                alias = self.ident()
+                return A.SubqueryTable(sub, alias)
+            refs = self.table_refs()
+            self.expect_op(")")
+            return refs
+        return self.table_name(allow_alias=True)
+
+    def table_name(self, allow_alias: bool = False) -> A.TableName:
+        name = self.ident()
+        db = ""
+        if self.eat_op("."):
+            db, name = name, self.ident()
+        alias = ""
+        hints = []
+        if allow_alias:
+            if self.eat_kw("AS"):
+                alias = self.ident()
+            elif self.peek().kind in (T.IDENT, T.QIDENT) and self.peek().upper not in _RESERVED_AFTER_EXPR and self.peek().upper not in ("USE", "IGNORE", "FORCE", "PARTITION"):
+                alias = self.next().text
+            while self.at_kw("USE", "IGNORE", "FORCE"):
+                kind = self.next().upper.lower()
+                self.expect_kw("INDEX") if self.at_kw("INDEX") else self.expect_kw("KEY")
+                self.expect_op("(")
+                idxs = []
+                if not self.at_op(")"):
+                    while True:
+                        idxs.append(self.ident())
+                        if not self.eat_op(","):
+                            break
+                self.expect_op(")")
+                hints.append((kind, idxs))
+        return A.TableName(name, db, alias, hints)
+
+    # ---- expressions: precedence climbing ----
+    def expr(self) -> A.ExprNode:
+        return self.or_expr()
+
+    def or_expr(self):
+        left = self.xor_expr()
+        while True:
+            if self.eat_kw("OR") or self.eat_op("||"):
+                left = A.BinaryOp("or", left, self.xor_expr())
+            else:
+                return left
+
+    def xor_expr(self):
+        left = self.and_expr()
+        while self.eat_kw("XOR"):
+            left = A.BinaryOp("xor", left, self.and_expr())
+        return left
+
+    def and_expr(self):
+        left = self.not_expr()
+        while True:
+            if self.eat_kw("AND") or self.eat_op("&&"):
+                left = A.BinaryOp("and", left, self.not_expr())
+            else:
+                return left
+
+    def not_expr(self):
+        if self.eat_kw("NOT"):
+            return A.UnaryOp("not", self.not_expr())
+        return self.predicate()
+
+    _CMP = {"=": "eq", "<=>": "nulleq", "<": "lt", "<=": "le", ">": "gt", ">=": "ge", "<>": "ne", "!=": "ne"}
+
+    def predicate(self):
+        left = self.bit_or_expr()
+        while True:
+            t = self.peek()
+            if t.kind is T.OP and t.text in self._CMP:
+                op = self._CMP[self.next().text]
+                if self.at_kw("ANY", "SOME", "ALL"):
+                    is_all = self.next().upper == "ALL"
+                    self.expect_op("(")
+                    sub = self.select_or_union()
+                    self.expect_op(")")
+                    left = A.CompareSubquery(left, op, sub, is_all)
+                else:
+                    left = A.BinaryOp(op, left, self.bit_or_expr())
+                continue
+            negated = False
+            j = self.i
+            if self.at_kw("NOT"):
+                if self.peek(1).kind is T.IDENT and self.peek(1).upper in ("IN", "LIKE", "BETWEEN", "REGEXP", "RLIKE"):
+                    self.next()
+                    negated = True
+                else:
+                    self.i = j
+                    return left
+            if self.eat_kw("IS"):
+                neg = self.eat_kw("NOT")
+                if self.eat_kw("NULL"):
+                    left = A.IsNull(left, neg)
+                elif self.eat_kw("TRUE"):
+                    left = A.IsTruth(left, True, neg)
+                elif self.eat_kw("FALSE"):
+                    left = A.IsTruth(left, False, neg)
+                else:
+                    raise ParseError(f"IS what? at {self._where()}")
+                continue
+            if self.eat_kw("IN"):
+                self.expect_op("(")
+                if self.at_kw("SELECT", "WITH"):
+                    sub = self.select_or_union()
+                    self.expect_op(")")
+                    left = A.InSubquery(left, sub, negated)
+                else:
+                    items = [self.expr()]
+                    while self.eat_op(","):
+                        items.append(self.expr())
+                    self.expect_op(")")
+                    left = A.InList(left, items, negated)
+                continue
+            if self.eat_kw("BETWEEN"):
+                lo = self.bit_or_expr()
+                self.expect_kw("AND")
+                hi = self.bit_or_expr()
+                left = A.Between(left, lo, hi, negated)
+                continue
+            if self.eat_kw("LIKE"):
+                pat = self.bit_or_expr()
+                esc = "\\"
+                if self.eat_kw("ESCAPE"):
+                    esc_t = self.next()
+                    esc = esc_t.text
+                left = A.Like(left, pat, esc, negated)
+                continue
+            if self.eat_kw("REGEXP", "RLIKE"):
+                left = A.Regexp(left, self.bit_or_expr(), negated)
+                continue
+            return left
+
+    def bit_or_expr(self):
+        left = self.bit_and_expr()
+        while self.at_op("|") and not self.at_op("||"):
+            self.next()
+            left = A.BinaryOp("bitor", left, self.bit_and_expr())
+        return left
+
+    def bit_and_expr(self):
+        left = self.shift_expr()
+        while self.at_op("&"):
+            self.next()
+            left = A.BinaryOp("bitand", left, self.shift_expr())
+        return left
+
+    def shift_expr(self):
+        left = self.add_expr()
+        while self.at_op("<<", ">>"):
+            op = "shiftleft" if self.next().text == "<<" else "shiftright"
+            left = A.BinaryOp(op, left, self.add_expr())
+        return left
+
+    def add_expr(self):
+        left = self.mul_expr()
+        while True:
+            if self.at_op("+"):
+                self.next()
+                right = self.mul_expr()
+                # date + INTERVAL n unit
+                if isinstance(right, A.Interval):
+                    left = A.FuncCall("date_add", [left, right])
+                else:
+                    left = A.BinaryOp("plus", left, right)
+            elif self.at_op("-"):
+                self.next()
+                right = self.mul_expr()
+                if isinstance(right, A.Interval):
+                    left = A.FuncCall("date_sub", [left, right])
+                else:
+                    left = A.BinaryOp("minus", left, right)
+            else:
+                return left
+
+    def mul_expr(self):
+        left = self.xor_bit_expr()
+        while True:
+            if self.at_op("*"):
+                self.next()
+                left = A.BinaryOp("mul", left, self.xor_bit_expr())
+            elif self.at_op("/"):
+                self.next()
+                left = A.BinaryOp("div", left, self.xor_bit_expr())
+            elif self.at_op("%") or self.at_kw("MOD"):
+                self.next()
+                left = A.BinaryOp("mod", left, self.xor_bit_expr())
+            elif self.at_kw("DIV"):
+                self.next()
+                left = A.BinaryOp("intdiv", left, self.xor_bit_expr())
+            else:
+                return left
+
+    def xor_bit_expr(self):
+        left = self.unary_expr()
+        while self.at_op("^"):
+            self.next()
+            left = A.BinaryOp("bitxor", left, self.unary_expr())
+        return left
+
+    def unary_expr(self):
+        if self.at_op("-"):
+            self.next()
+            return A.UnaryOp("unaryminus", self.unary_expr())
+        if self.at_op("+"):
+            self.next()
+            return self.unary_expr()
+        if self.at_op("~"):
+            self.next()
+            return A.UnaryOp("bitneg", self.unary_expr())
+        if self.at_op("!"):
+            # '!' binds at unary precedence (above comparison/IN/LIKE),
+            # unlike NOT (ref: parser.y precedence: '!' ~ NEG level)
+            self.next()
+            return A.UnaryOp("not", self.unary_expr())
+        if self.at_kw("BINARY"):
+            # BINARY expr — treat as cast to binary string (collation change)
+            j = self.i
+            self.next()
+            if self.peek().kind in (T.IDENT, T.QIDENT, T.STRING, T.NUMBER) or self.at_op("("):
+                return A.Cast(self.unary_expr(), A.TypeSpec("binary"))
+            self.i = j
+        return self.primary()
+
+    def primary(self) -> A.ExprNode:
+        t = self.peek()
+        if t.kind is T.NUMBER:
+            self.next()
+            if "." in t.text or "e" in t.text.lower():
+                kind = "float" if ("e" in t.text.lower()) else "decimal"
+                return A.Literal(t.text, kind)
+            return A.Literal(int(t.text), "int")
+        if t.kind is T.STRING:
+            self.next()
+            # adjacent string literal concat 'a' 'b'
+            text = t.text
+            while self.peek().kind is T.STRING:
+                text += self.next().text
+            return A.Literal(text, "str")
+        if t.kind is T.HEX:
+            self.next()
+            h = t.text[2:]
+            if len(h) % 2:
+                h = "0" + h
+            return A.Literal(bytes.fromhex(h), "hex")
+        if t.kind is T.PARAM:
+            self.next()
+            p = A.ParamMarker(self.n_params)
+            self.n_params += 1
+            return p
+        if t.kind is T.OP and t.text == "(":
+            self.next()
+            if self.at_kw("SELECT", "WITH"):
+                sub = self.select_or_union()
+                self.expect_op(")")
+                return A.SubqueryExpr(sub)
+            e = self.expr()
+            if self.eat_op(","):
+                items = [e, self.expr()]
+                while self.eat_op(","):
+                    items.append(self.expr())
+                self.expect_op(")")
+                return A.RowExpr(items)
+            self.expect_op(")")
+            return e
+        if t.kind is T.OP and t.text == "@":
+            self.next()
+            if self.eat_op("@"):
+                scope = ""
+                name = self.ident()
+                if name.lower() in ("global", "session") and self.eat_op("."):
+                    scope = name.lower()
+                    name = self.ident()
+                return A.Variable(name.lower(), True, scope)
+            return A.Variable(self.ident().lower(), False)
+        if t.kind is T.QIDENT:
+            return self.column_or_func()
+        if t.kind is T.IDENT:
+            kw = t.upper
+            if kw == "NULL":
+                self.next()
+                return A.Literal(None, "null")
+            if kw == "TRUE":
+                self.next()
+                return A.Literal(1, "bool")
+            if kw == "FALSE":
+                self.next()
+                return A.Literal(0, "bool")
+            if kw == "CASE":
+                return self.case_expr()
+            if kw == "CAST" or kw == "CONVERT":
+                return self.cast_expr(kw)
+            if kw == "EXISTS":
+                self.next()
+                self.expect_op("(")
+                sub = self.select_or_union()
+                self.expect_op(")")
+                return A.Exists(sub)
+            if kw == "NOT":
+                self.next()
+                return A.UnaryOp("not", self.not_expr())
+            if kw == "INTERVAL":
+                self.next()
+                v = self.bit_or_expr()
+                unit = self.ident().lower()
+                return A.Interval(v, unit)
+            if kw == "DEFAULT" and not (self.peek(1).kind is T.OP and self.peek(1).text == "("):
+                self.next()
+                return A.Default()
+            if kw in ("DATE", "TIME", "TIMESTAMP") and self.peek(1).kind is T.STRING:
+                self.next()
+                s = self.next()
+                return A.FuncCall("cast_literal_" + kw.lower(), [A.Literal(s.text, "str")])
+            return self.column_or_func()
+        raise ParseError(f"unexpected {self._where()}")
+
+    def case_expr(self):
+        self.expect_kw("CASE")
+        operand = None
+        if not self.at_kw("WHEN"):
+            operand = self.expr()
+        whens = []
+        while self.eat_kw("WHEN"):
+            cond = self.expr()
+            self.expect_kw("THEN")
+            whens.append((cond, self.expr()))
+        els = self.expr() if self.eat_kw("ELSE") else None
+        self.expect_kw("END")
+        return A.Case(operand, whens, els)
+
+    def cast_expr(self, kw: str):
+        self.next()
+        self.expect_op("(")
+        e = self.expr()
+        if kw == "CAST":
+            self.expect_kw("AS")
+            ts = self.type_spec()
+        else:  # CONVERT(expr, type)
+            self.expect_op(",")
+            ts = self.type_spec()
+        self.expect_op(")")
+        return A.Cast(e, ts)
+
+    def column_or_func(self) -> A.ExprNode:
+        quoted = self.peek().kind is T.QIDENT  # `max`(x) is never a call
+        name = self.ident()
+        # function call?
+        if self.at_op("(") and not quoted:
+            lname = name.lower()
+            self.next()
+            distinct = False
+            if lname in _AGG_FUNCS and self.eat_kw("DISTINCT"):
+                distinct = True
+            args: list = []
+            if self.at_op("*"):
+                self.next()
+                args = [A.Star()]
+            elif not self.at_op(")"):
+                args.append(self.func_arg())
+                while self.eat_op(","):
+                    args.append(self.func_arg())
+            self.expect_op(")")
+            if lname in _AGG_FUNCS:
+                # OVER (...) would make it a window func — not yet planned
+                return A.AggFunc(lname, args, distinct)
+            return A.FuncCall(lname, args)
+        # qualified column
+        table = db = ""
+        if self.eat_op("."):
+            table, name = name, self.ident()
+            if self.eat_op("."):
+                db, table, name = table, name, self.ident()
+        return A.ColumnName(name, table, db)
+
+    def func_arg(self):
+        # allow `sep AS x` style? no — but allow INTERVAL & SEPARATOR
+        if self.at_kw("SEPARATOR"):
+            self.next()
+            s = self.next()
+            return A.Literal(s.text, "str")
+        return self.expr()
+
+    # ---- type spec ----
+    def type_spec(self) -> A.TypeSpec:
+        name = self.ident().lower()
+        if name == "national":
+            name = self.ident().lower()
+        if name not in _TYPE_NAMES:
+            raise ParseError(f"unknown type {name!r} at {self._where()}")
+        if name in ("integer",):
+            name = "int"
+        if name in ("numeric", "dec", "fixed"):
+            name = "decimal"
+        if name in ("bool", "boolean"):
+            name = "tinyint"
+        if name == "real":
+            name = "double"
+        length = dec = -1
+        if self.eat_op("("):
+            if name in ("enum", "set"):
+                elems = []
+                while True:
+                    s = self.next()
+                    elems.append(s.text)
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+                ts = A.TypeSpec(name, elems=tuple(elems))
+                return self._type_attrs(ts)
+            length = int(self.next().text)
+            if self.eat_op(","):
+                dec = int(self.next().text)
+            self.expect_op(")")
+        ts = A.TypeSpec(name, length, dec)
+        return self._type_attrs(ts)
+
+    def _type_attrs(self, ts: A.TypeSpec) -> A.TypeSpec:
+        while True:
+            if self.eat_kw("UNSIGNED"):
+                ts.unsigned = True
+            elif self.eat_kw("SIGNED"):
+                pass
+            elif self.eat_kw("ZEROFILL"):
+                ts.zerofill = True
+            elif self.eat_kw("CHARACTER"):
+                self.expect_kw("SET")
+                ts.charset = self.ident().lower()
+            elif self.eat_kw("CHARSET"):
+                ts.charset = self.ident().lower()
+            elif self.eat_kw("COLLATE"):
+                ts.collate = self.ident().lower()
+            else:
+                return ts
+
+    # ---- DML ----
+    def insert_stmt(self, replace: bool) -> A.InsertStmt:
+        self.next()
+        ignore = self.eat_kw("IGNORE")
+        self.eat_kw("INTO")
+        table = self.table_name()
+        columns = []
+        if self.at_op("(") and not self._paren_is_select():
+            self.next()
+            while True:
+                columns.append(self.ident())
+                if not self.eat_op(","):
+                    break
+            self.expect_op(")")
+        values, select = [], None
+        if self.eat_kw("VALUES", "VALUE"):
+            while True:
+                self.expect_op("(")
+                row = []
+                if not self.at_op(")"):
+                    row.append(self.expr())
+                    while self.eat_op(","):
+                        row.append(self.expr())
+                self.expect_op(")")
+                values.append(row)
+                if not self.eat_op(","):
+                    break
+        elif self.at_kw("SELECT", "WITH") or self.at_op("("):
+            select = self.select_or_union()
+        elif self.eat_kw("SET"):
+            cols, row = [], []
+            while True:
+                cols.append(self.ident())
+                self.expect_op("=")
+                row.append(self.expr())
+                if not self.eat_op(","):
+                    break
+            columns, values = cols, [row]
+        on_dup = []
+        if self.eat_kw("ON"):
+            self.expect_kw("DUPLICATE")
+            self.expect_kw("KEY")
+            self.expect_kw("UPDATE")
+            while True:
+                c = self.column_name_simple()
+                self.expect_op("=")
+                on_dup.append(A.Assignment(c, self.expr()))
+                if not self.eat_op(","):
+                    break
+        return A.InsertStmt(table, columns, values, select, on_dup, replace, ignore)
+
+    def _paren_is_select(self) -> bool:
+        return self.at_op("(") and self.peek(1).kind is T.IDENT and self.peek(1).upper in ("SELECT", "WITH")
+
+    def column_name_simple(self) -> A.ColumnName:
+        name = self.ident()
+        table = db = ""
+        if self.eat_op("."):
+            table, name = name, self.ident()
+            if self.eat_op("."):
+                db, table, name = table, name, self.ident()
+        return A.ColumnName(name, table, db)
+
+    def update_stmt(self) -> A.UpdateStmt:
+        self.next()
+        self.eat_kw("IGNORE")
+        table = self.table_refs()
+        self.expect_kw("SET")
+        assigns = []
+        while True:
+            c = self.column_name_simple()
+            self.expect_op("=")
+            assigns.append(A.Assignment(c, self.expr()))
+            if not self.eat_op(","):
+                break
+        where = self.expr() if self.eat_kw("WHERE") else None
+        order_by = []
+        if self.eat_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by = self.by_list()
+        limit = self.limit_clause() if self.at_kw("LIMIT") else None
+        return A.UpdateStmt(table, assigns, where, order_by, limit)
+
+    def delete_stmt(self) -> A.DeleteStmt:
+        self.next()
+        self.eat_kw("IGNORE")
+        self.expect_kw("FROM")
+        table = self.table_name(allow_alias=True)
+        where = self.expr() if self.eat_kw("WHERE") else None
+        order_by = []
+        if self.eat_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by = self.by_list()
+        limit = self.limit_clause() if self.at_kw("LIMIT") else None
+        return A.DeleteStmt(table, where, order_by, limit)
+
+    def load_data_stmt(self) -> A.LoadDataStmt:
+        self.next()
+        self.expect_kw("DATA")
+        self.eat_kw("LOCAL")
+        self.expect_kw("INFILE")
+        path = self.next().text
+        self.eat_kw("IGNORE") or self.eat_kw("REPLACE")
+        self.expect_kw("INTO")
+        self.expect_kw("TABLE")
+        table = self.table_name()
+        stmt = A.LoadDataStmt(path, table)
+        if self.eat_kw("FIELDS", "COLUMNS"):
+            while True:
+                if self.eat_kw("TERMINATED"):
+                    self.expect_kw("BY")
+                    stmt.fields_terminated = self.next().text
+                elif self.eat_kw("ENCLOSED"):
+                    self.expect_kw("BY")
+                    stmt.fields_enclosed = self.next().text
+                elif self.eat_kw("OPTIONALLY"):
+                    self.expect_kw("ENCLOSED")
+                    self.expect_kw("BY")
+                    stmt.fields_enclosed = self.next().text
+                elif self.eat_kw("ESCAPED"):
+                    self.expect_kw("BY")
+                    self.next()
+                else:
+                    break
+        if self.eat_kw("LINES"):
+            self.expect_kw("TERMINATED")
+            self.expect_kw("BY")
+            stmt.lines_terminated = self.next().text
+        if self.eat_kw("IGNORE"):
+            stmt.ignore_lines = int(self.next().text)
+            self.expect_kw("LINES") if self.at_kw("LINES") else self.expect_kw("ROWS")
+        if self.eat_op("("):
+            while True:
+                stmt.columns.append(self.ident())
+                if not self.eat_op(","):
+                    break
+            self.expect_op(")")
+        return stmt
+
+    # ---- DDL ----
+    def create_stmt(self):
+        self.next()
+        if self.eat_kw("DATABASE", "SCHEMA"):
+            ine = False
+            if self.eat_kw("IF"):
+                self.expect_kw("NOT")
+                self.expect_kw("EXISTS")
+                ine = True
+            name = self.ident()
+            while self.at_kw("DEFAULT", "CHARACTER", "CHARSET", "COLLATE"):
+                self.eat_kw("DEFAULT")
+                if self.eat_kw("CHARACTER"):
+                    self.expect_kw("SET")
+                    self.eat_op("=")
+                    self.ident()
+                elif self.eat_kw("CHARSET"):
+                    self.eat_op("=")
+                    self.ident()
+                elif self.eat_kw("COLLATE"):
+                    self.eat_op("=")
+                    self.ident()
+            return A.CreateDatabaseStmt(name, ine)
+        if self.eat_kw("UNIQUE"):
+            self.expect_kw("INDEX")
+            return self._create_index(unique=True)
+        if self.eat_kw("INDEX"):
+            return self._create_index(unique=False)
+        self.expect_kw("TABLE")
+        ine = False
+        if self.eat_kw("IF"):
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            ine = True
+        table = self.table_name()
+        if self.eat_kw("LIKE"):
+            return A.CreateTableStmt(table, [], if_not_exists=ine, like=self.table_name())
+        columns, indexes, fks = [], [], []
+        self.expect_op("(")
+        while True:
+            if self.at_kw("PRIMARY"):
+                self.next()
+                self.expect_kw("KEY")
+                idx = A.IndexDef("primary", self._index_cols(), unique=True, primary=True)
+                indexes.append(idx)
+            elif self.at_kw("UNIQUE"):
+                self.next()
+                self.eat_kw("KEY") or self.eat_kw("INDEX")
+                name = ""
+                if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
+                    name = self.ident()
+                indexes.append(A.IndexDef(name, self._index_cols(), unique=True))
+            elif self.at_kw("KEY", "INDEX"):
+                self.next()
+                name = ""
+                if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
+                    name = self.ident()
+                indexes.append(A.IndexDef(name, self._index_cols()))
+            elif self.at_kw("CONSTRAINT", "FOREIGN"):
+                fk_name = ""
+                if self.eat_kw("CONSTRAINT"):
+                    if not self.at_kw("FOREIGN", "UNIQUE", "PRIMARY"):
+                        fk_name = self.ident()
+                if self.eat_kw("FOREIGN"):
+                    self.expect_kw("KEY")
+                    if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
+                        self.ident()
+                    cols = self._index_cols()
+                    self.expect_kw("REFERENCES")
+                    rt = self.table_name()
+                    rcols = self._index_cols()
+                    while self.eat_kw("ON"):
+                        self.eat_kw("DELETE") or self.eat_kw("UPDATE")
+                        self.eat_kw("CASCADE") or self.eat_kw("RESTRICT") or (self.eat_kw("SET") and self.eat_kw("NULL")) or (self.eat_kw("NO") and self.eat_kw("ACTION"))
+                    fks.append(A.ForeignKeyDef(fk_name, [c for c, _ in cols], rt, [c for c, _ in rcols]))
+                elif self.eat_kw("UNIQUE"):
+                    self.eat_kw("KEY") or self.eat_kw("INDEX")
+                    name = fk_name
+                    if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
+                        name = self.ident()
+                    indexes.append(A.IndexDef(name, self._index_cols(), unique=True))
+                elif self.eat_kw("PRIMARY"):
+                    self.expect_kw("KEY")
+                    indexes.append(A.IndexDef("primary", self._index_cols(), unique=True, primary=True))
+            else:
+                columns.append(self.column_def())
+            if not self.eat_op(","):
+                break
+        self.expect_op(")")
+        options = self._table_options()
+        select = None
+        if self.eat_kw("AS") or self.at_kw("SELECT"):
+            select = self.select_or_union()
+        return A.CreateTableStmt(table, columns, indexes, fks, ine, options, None, select)
+
+    def _create_index(self, unique: bool) -> A.CreateIndexStmt:
+        name = self.ident()
+        self.expect_kw("ON")
+        table = self.table_name()
+        cols = self._index_cols()
+        return A.CreateIndexStmt(name, table, cols, unique)
+
+    def _index_cols(self) -> list:
+        self.expect_op("(")
+        out = []
+        while True:
+            c = self.ident()
+            plen = -1
+            if self.eat_op("("):
+                plen = int(self.next().text)
+                self.expect_op(")")
+            self.eat_kw("ASC") or self.eat_kw("DESC")
+            out.append((c, plen))
+            if not self.eat_op(","):
+                break
+        self.expect_op(")")
+        return out
+
+    def column_def(self) -> A.ColumnDef:
+        name = self.ident()
+        ts = self.type_spec()
+        cd = A.ColumnDef(name, ts)
+        while True:
+            if self.eat_kw("NOT"):
+                self.expect_kw("NULL")
+                cd.not_null = True
+            elif self.eat_kw("NULL"):
+                pass
+            elif self.eat_kw("DEFAULT"):
+                cd.default = self.default_value()
+            elif self.eat_kw("AUTO_INCREMENT"):
+                cd.auto_increment = True
+            elif self.eat_kw("PRIMARY"):
+                self.expect_kw("KEY")
+                cd.primary_key = True
+            elif self.eat_kw("KEY"):
+                cd.primary_key = True
+            elif self.eat_kw("UNIQUE"):
+                self.eat_kw("KEY")
+                cd.unique = True
+            elif self.eat_kw("COMMENT"):
+                cd.comment = self.next().text
+            elif self.eat_kw("COLLATE"):
+                cd.type.collate = self.ident().lower()
+            elif self.eat_kw("CHARACTER"):
+                self.expect_kw("SET")
+                cd.type.charset = self.ident().lower()
+            elif self.eat_kw("ON"):
+                self.expect_kw("UPDATE")
+                fn = self.ident()
+                if self.eat_op("("):
+                    self.expect_op(")")
+                cd.on_update_now = fn.lower() in ("current_timestamp", "now")
+            elif self.eat_kw("REFERENCES"):
+                self.table_name()
+                self._index_cols()
+            else:
+                return cd
+
+    def default_value(self):
+        t = self.peek()
+        if t.kind is T.IDENT and t.upper in ("CURRENT_TIMESTAMP", "NOW"):
+            self.next()
+            if self.eat_op("("):
+                self.expect_op(")")
+            return A.FuncCall("now", [])
+        return self.unary_expr()
+
+    def _table_options(self) -> dict:
+        opts = {}
+        while True:
+            if self.eat_kw("ENGINE"):
+                self.eat_op("=")
+                opts["engine"] = self.ident()
+            elif self.eat_kw("AUTO_INCREMENT"):
+                self.eat_op("=")
+                opts["auto_increment"] = int(self.next().text)
+            elif self.eat_kw("DEFAULT"):
+                continue
+            elif self.eat_kw("CHARSET"):
+                self.eat_op("=")
+                opts["charset"] = self.ident().lower()
+            elif self.eat_kw("CHARACTER"):
+                self.expect_kw("SET")
+                self.eat_op("=")
+                opts["charset"] = self.ident().lower()
+            elif self.eat_kw("COLLATE"):
+                self.eat_op("=")
+                opts["collate"] = self.ident().lower()
+            elif self.eat_kw("COMMENT"):
+                self.eat_op("=")
+                opts["comment"] = self.next().text
+            else:
+                return opts
+
+    def drop_stmt(self):
+        self.next()
+        if self.eat_kw("DATABASE", "SCHEMA"):
+            ie = False
+            if self.eat_kw("IF"):
+                self.expect_kw("EXISTS")
+                ie = True
+            return A.DropDatabaseStmt(self.ident(), ie)
+        if self.eat_kw("INDEX"):
+            name = self.ident()
+            self.expect_kw("ON")
+            return A.DropIndexStmt(name, self.table_name())
+        self.expect_kw("TABLE")
+        ie = False
+        if self.eat_kw("IF"):
+            self.expect_kw("EXISTS")
+            ie = True
+        tables = [self.table_name()]
+        while self.eat_op(","):
+            tables.append(self.table_name())
+        return A.DropTableStmt(tables, ie)
+
+    def alter_stmt(self) -> A.AlterTableStmt:
+        self.next()
+        self.expect_kw("TABLE")
+        table = self.table_name()
+        specs = []
+        while True:
+            if self.eat_kw("ADD"):
+                if self.eat_kw("COLUMN"):
+                    cd = self.column_def()
+                    pos = ""
+                    if self.eat_kw("FIRST"):
+                        pos = "first"
+                    elif self.eat_kw("AFTER"):
+                        pos = "after:" + self.ident()
+                    specs.append(A.AlterTableSpec("add_column", column=cd, position=pos))
+                elif self.eat_kw("INDEX", "KEY"):
+                    name = ""
+                    if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
+                        name = self.ident()
+                    specs.append(A.AlterTableSpec("add_index", index=A.IndexDef(name, self._index_cols())))
+                elif self.eat_kw("UNIQUE"):
+                    self.eat_kw("INDEX") or self.eat_kw("KEY")
+                    name = ""
+                    if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
+                        name = self.ident()
+                    specs.append(A.AlterTableSpec("add_index", index=A.IndexDef(name, self._index_cols(), unique=True)))
+                elif self.eat_kw("PRIMARY"):
+                    self.expect_kw("KEY")
+                    specs.append(A.AlterTableSpec("add_index", index=A.IndexDef("primary", self._index_cols(), unique=True, primary=True)))
+                else:
+                    cd = self.column_def()
+                    pos = ""
+                    if self.eat_kw("FIRST"):
+                        pos = "first"
+                    elif self.eat_kw("AFTER"):
+                        pos = "after:" + self.ident()
+                    specs.append(A.AlterTableSpec("add_column", column=cd, position=pos))
+            elif self.eat_kw("DROP"):
+                if self.eat_kw("COLUMN"):
+                    specs.append(A.AlterTableSpec("drop_column", name=self.ident()))
+                elif self.eat_kw("INDEX", "KEY"):
+                    specs.append(A.AlterTableSpec("drop_index", name=self.ident()))
+                elif self.eat_kw("PRIMARY"):
+                    self.expect_kw("KEY")
+                    specs.append(A.AlterTableSpec("drop_index", name="primary"))
+                else:
+                    specs.append(A.AlterTableSpec("drop_column", name=self.ident()))
+            elif self.eat_kw("MODIFY"):
+                self.eat_kw("COLUMN")
+                cd = self.column_def()
+                specs.append(A.AlterTableSpec("modify_column", column=cd))
+            elif self.eat_kw("CHANGE"):
+                self.eat_kw("COLUMN")
+                old = self.ident()
+                cd = self.column_def()
+                specs.append(A.AlterTableSpec("change_column", column=cd, name=old))
+            elif self.eat_kw("RENAME"):
+                if self.eat_kw("INDEX"):
+                    old = self.ident()
+                    self.expect_kw("TO")
+                    specs.append(A.AlterTableSpec("rename_index", name=old, new_name=self.ident()))
+                else:
+                    self.eat_kw("TO") or self.eat_kw("AS")
+                    specs.append(A.AlterTableSpec("rename", new_name=self.ident()))
+            else:
+                raise ParseError(f"unsupported ALTER action at {self._where()}")
+            if not self.eat_op(","):
+                break
+        return A.AlterTableStmt(table, specs)
+
+    def rename_stmt(self) -> A.RenameTableStmt:
+        self.next()
+        self.expect_kw("TABLE")
+        pairs = []
+        while True:
+            old = self.table_name()
+            self.expect_kw("TO")
+            pairs.append((old, self.table_name()))
+            if not self.eat_op(","):
+                break
+        return A.RenameTableStmt(pairs)
+
+    # ---- SET / SHOW / EXPLAIN / ANALYZE / ADMIN / BRIE ----
+    def set_stmt(self) -> A.SetStmt:
+        self.next()
+        if self.eat_kw("NAMES"):
+            cs = self.next().text
+            out = [("session", "character_set_client", A.Literal(cs, "str"))]
+            if self.eat_kw("COLLATE"):
+                self.next()
+            return A.SetStmt(out)
+        assigns = []
+        while True:
+            scope = "session"
+            if self.eat_kw("GLOBAL"):
+                scope = "global"
+            elif self.eat_kw("SESSION", "LOCAL"):
+                scope = "session"
+            if self.at_op("@"):
+                self.next()
+                if self.eat_op("@"):
+                    name = self.ident()
+                    if name.lower() in ("global", "session") and self.eat_op("."):
+                        scope = name.lower()
+                        name = self.ident()
+                else:
+                    scope = "user"
+                    name = self.ident()
+            else:
+                name = self.ident()
+            if not (self.eat_op("=") or self.eat_op(":=")):
+                raise ParseError(f"expected = at {self._where()}")
+            if self.at_kw("ON", "OFF") and self.peek(1).kind in (T.OP, T.EOF) and (self.peek(1).text in (",", ";", "")):
+                v = A.Literal(self.next().text, "str")
+            else:
+                v = self.expr()
+            assigns.append((scope, name.lower(), v))
+            if not self.eat_op(","):
+                break
+        return A.SetStmt(assigns)
+
+    def show_stmt(self) -> A.ShowStmt:
+        self.next()
+        full = self.eat_kw("FULL")
+        glob = self.eat_kw("GLOBAL")
+        self.eat_kw("SESSION")
+        s = A.ShowStmt("", full=full, global_scope=glob)
+        if self.eat_kw("DATABASES", "SCHEMAS"):
+            s.kind = "databases"
+        elif self.eat_kw("TABLES"):
+            s.kind = "tables"
+            if self.eat_kw("FROM", "IN"):
+                s.db = self.ident()
+        elif self.eat_kw("COLUMNS", "FIELDS"):
+            s.kind = "columns"
+            self.expect_kw("FROM") if self.at_kw("FROM") else self.expect_kw("IN")
+            s.table = self.table_name()
+        elif self.eat_kw("CREATE"):
+            if self.eat_kw("TABLE"):
+                s.kind = "create_table"
+                s.table = self.table_name()
+            elif self.eat_kw("DATABASE"):
+                s.kind = "create_database"
+                s.db = self.ident()
+        elif self.eat_kw("INDEX", "INDEXES", "KEYS"):
+            s.kind = "index"
+            self.eat_kw("FROM") or self.eat_kw("IN")
+            s.table = self.table_name()
+        elif self.eat_kw("VARIABLES"):
+            s.kind = "variables"
+        elif self.eat_kw("STATUS"):
+            s.kind = "status"
+        elif self.eat_kw("WARNINGS"):
+            s.kind = "warnings"
+        elif self.eat_kw("ERRORS"):
+            s.kind = "errors"
+        elif self.eat_kw("PROCESSLIST"):
+            s.kind = "processlist"
+        elif self.eat_kw("ENGINES"):
+            s.kind = "engines"
+        elif self.eat_kw("COLLATION"):
+            s.kind = "collation"
+        elif self.eat_kw("CHARSET", "CHARACTER"):
+            self.eat_kw("SET")
+            s.kind = "charset"
+        elif self.eat_kw("STATS_META"):
+            s.kind = "stats_meta"
+        elif self.eat_kw("STATS_HISTOGRAMS"):
+            s.kind = "stats_histograms"
+        elif self.eat_kw("TABLE"):
+            self.expect_kw("STATUS")
+            s.kind = "table_status"
+            if self.eat_kw("FROM", "IN"):
+                s.db = self.ident()
+        elif self.eat_kw("GRANTS"):
+            s.kind = "grants"
+        elif self.eat_kw("PLUGINS"):
+            s.kind = "plugins"
+        else:
+            raise ParseError(f"unsupported SHOW at {self._where()}")
+        if self.eat_kw("LIKE"):
+            s.pattern = self.next().text
+        elif self.eat_kw("WHERE"):
+            s.where = self.expr()
+        return s
+
+    def explain_stmt(self):
+        self.next()
+        analyze = self.eat_kw("ANALYZE")
+        fmt = "row"
+        if self.eat_kw("FORMAT"):
+            self.expect_op("=")
+            fmt = self.next().text.lower()
+        # DESC table shorthand
+        if not analyze and self.peek().kind in (T.IDENT, T.QIDENT) and self.peek().upper not in (
+            "SELECT", "INSERT", "UPDATE", "DELETE", "REPLACE", "WITH",
+        ):
+            t = self.table_name()
+            return A.ShowStmt("columns", table=t)
+        return A.ExplainStmt(self.statement(), analyze, fmt)
+
+    def analyze_stmt(self) -> A.AnalyzeTableStmt:
+        self.next()
+        self.expect_kw("TABLE")
+        tables = [self.table_name()]
+        while self.eat_op(","):
+            tables.append(self.table_name())
+        cols = []
+        if self.eat_kw("COLUMNS"):
+            while True:
+                cols.append(self.ident())
+                if not self.eat_op(","):
+                    break
+        return A.AnalyzeTableStmt(tables, cols)
+
+    def admin_stmt(self) -> A.AdminStmt:
+        self.next()
+        if self.eat_kw("CHECK"):
+            self.expect_kw("TABLE")
+            tables = [self.table_name()]
+            while self.eat_op(","):
+                tables.append(self.table_name())
+            return A.AdminStmt("check_table", tables)
+        if self.eat_kw("CHECKSUM"):
+            self.expect_kw("TABLE")
+            tables = [self.table_name()]
+            while self.eat_op(","):
+                tables.append(self.table_name())
+            return A.AdminStmt("checksum_table", tables)
+        if self.eat_kw("SHOW"):
+            self.expect_kw("DDL")
+            if self.eat_kw("JOBS"):
+                return A.AdminStmt("show_ddl_jobs")
+            return A.AdminStmt("show_ddl")
+        if self.eat_kw("CANCEL"):
+            self.expect_kw("DDL")
+            self.expect_kw("JOBS")
+            ids = [int(self.next().text)]
+            while self.eat_op(","):
+                ids.append(int(self.next().text))
+            return A.AdminStmt("cancel_ddl_jobs", job_ids=ids)
+        raise ParseError(f"unsupported ADMIN at {self._where()}")
+
+    def brie_stmt(self, kind: str) -> A.BRIEStmt:
+        self.next()
+        tables = []
+        if self.eat_kw("TABLE"):
+            tables.append(self.table_name())
+            while self.eat_op(","):
+                tables.append(self.table_name())
+        elif self.eat_kw("DATABASE", "SCHEMA"):
+            if not self.at_kw("TO", "FROM"):
+                db = self.ident()
+                tables.append(A.TableName("*", db))
+        if kind == "backup":
+            self.expect_kw("TO")
+        else:
+            self.expect_kw("FROM")
+        storage = self.next().text
+        return A.BRIEStmt(kind, storage, tables)
