@@ -83,7 +83,7 @@ def bench_device(device, n: int, iters: int, warmup: int = 2) -> float:
         jax.block_until_ready(out)
         dt = time.perf_counter() - t0
         # sanity: count aggregate > 0
-        packed, valid, n_rows, overflow = out
+        packed, valid, n_rows, overflow, _ex_rows = out
         cnt = int(np.asarray(packed[1][0])[0])
         assert cnt > 0 and not bool(overflow), (cnt, bool(overflow))
         return n * iters / dt

@@ -1,0 +1,216 @@
+"""Device-kernel paths closed in round 2 (VERDICT weak #6 / next #8):
+string min/max + first_row (GatherState arg-extreme), DISTINCT aggregates,
+string filter truthiness, honest per-executor exec summaries, and the
+partial->merge roundtrip for the gather-served aggregates."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.exec import (
+    Aggregation,
+    ColumnInfo,
+    DAGRequest,
+    Selection,
+    TableScan,
+    run_dag_on_chunk,
+    run_dag_reference,
+)
+from tidb_tpu.exec.executor import datum_group_key
+from tidb_tpu.expr import AggDesc, AggMode, col, func, lit
+from tidb_tpu.types import Datum, MyDecimal, new_decimal, new_longlong, new_varchar
+
+BOOL = new_longlong(notnull=True)
+FTS = [new_longlong(), new_varchar(12), new_decimal(10, 2), new_longlong(unsigned=True)]
+
+
+def make_chunk(n=200, seed=3, null_p=0.06):
+    rng = np.random.default_rng(seed)
+    words = ["alpha", "beta", "Gamma", "delta", "", "zz", "omega9", "a", "ab"]
+    rows = []
+    for h in range(n):
+        def maybe(d):
+            return Datum.NULL if rng.random() < null_p else d
+
+        rows.append([
+            maybe(Datum.i64(int(rng.integers(0, 6)))),
+            maybe(Datum.string(words[int(rng.integers(len(words)))])),
+            maybe(Datum.dec(MyDecimal(f"{int(rng.integers(-5000, 5000))/100:.2f}"))),
+            maybe(Datum.u64(int(rng.integers(0, 2**63 - 1, dtype=np.int64)) + int(rng.integers(0, 3)))),
+        ])
+    return Chunk.from_rows(FTS, rows)
+
+
+def scan():
+    return TableScan(7, tuple(ColumnInfo(i + 1, ft) for i, ft in enumerate(FTS)))
+
+
+C = lambda i: col(i, FTS[i])
+
+
+def canon_rows(rows):
+    return sorted(tuple(datum_group_key(d) for d in r) for r in rows)
+
+
+def assert_parity(dag, ch, **kw):
+    dev = run_dag_on_chunk(dag, ch, **kw)
+    ref = run_dag_reference(dag, ch)
+    assert canon_rows(dev.rows()) == canon_rows(ref), (
+        f"\ndevice={canon_rows(dev.rows())[:4]}\nref   ={canon_rows(ref)[:4]}"
+    )
+    return dev
+
+
+class TestStringMinMax:
+    def test_grouped(self):
+        ch = make_chunk()
+        agg = Aggregation(
+            group_by=(C(0),),
+            aggs=(AggDesc("min", (C(1),)), AggDesc("max", (C(1),)), AggDesc("count", ())),
+        )
+        assert_parity(DAGRequest((scan(), agg), output_offsets=(0, 1, 2, 3)), ch)
+
+    def test_scalar(self):
+        ch = make_chunk(90)
+        agg = Aggregation(group_by=(), aggs=(AggDesc("min", (C(1),)), AggDesc("max", (C(1),))))
+        assert_parity(DAGRequest((scan(), agg), output_offsets=(0, 1)), ch)
+
+    def test_all_null_group(self):
+        rows = [[Datum.i64(1), Datum.NULL], [Datum.i64(1), Datum.NULL], [Datum.i64(2), Datum.string("x")]]
+        fts = [FTS[0], FTS[1]]
+        ch = Chunk.from_rows(fts, rows)
+        s = TableScan(7, (ColumnInfo(1, fts[0]), ColumnInfo(2, fts[1])))
+        agg = Aggregation(group_by=(col(0, fts[0]),), aggs=(AggDesc("min", (col(1, fts[1]),)),))
+        assert_parity(DAGRequest((s, agg), output_offsets=(0, 1)), ch)
+
+
+class TestFirstRow:
+    def test_first_row_is_earliest_row(self):
+        """Deterministic parity: device first_row == oracle's first in row
+        order (not merely 'any group member')."""
+        ch = make_chunk(150)
+        agg = Aggregation(
+            group_by=(C(0),),
+            aggs=(AggDesc("first_row", (C(1),)), AggDesc("first_row", (C(2),)), AggDesc("first_row", (C(3),))),
+        )
+        assert_parity(DAGRequest((scan(), agg), output_offsets=(0, 1, 2, 3)), ch)
+
+    def test_scalar_first_row_string(self):
+        ch = make_chunk(40)
+        agg = Aggregation(group_by=(), aggs=(AggDesc("first_row", (C(1),)),))
+        assert_parity(DAGRequest((scan(), agg), output_offsets=(0,)), ch)
+
+    def test_partial_then_merge_roundtrip(self):
+        """Partial1 on two halves, concat states, Final merge == Complete.
+        Covers the merge-mode first_row [has,value] routing (ADVICE medium)
+        and string min/max state merge."""
+        ch = make_chunk(160)
+        rows = ch.rows()
+        halves = [Chunk.from_rows(FTS, rows[:80]), Chunk.from_rows(FTS, rows[80:])]
+        partial = Aggregation(
+            group_by=(C(0),),
+            aggs=(AggDesc("first_row", (C(1),)), AggDesc("min", (C(1),)), AggDesc("first_row", (C(2),))),
+            partial=True,
+        )
+        # partial schema: [fr.has, fr.val(str), min.val(str), fr2.has, fr2.val(dec), g]
+        pdag = DAGRequest((scan(), partial), output_offsets=tuple(range(6)))
+        parts = [run_dag_on_chunk(pdag, h) for h in halves]
+        stacked = Chunk.concat(parts)
+        pfts = stacked.field_types()
+        merge_agg = Aggregation(
+            group_by=(col(5, pfts[5]),),
+            aggs=(
+                AggDesc("first_row", (col(0, pfts[0]), col(1, pfts[1])), mode=AggMode.Final),
+                AggDesc("min", (col(2, pfts[2]),), mode=AggMode.Final),
+                AggDesc("first_row", (col(3, pfts[3]), col(4, pfts[4])), mode=AggMode.Final),
+            ),
+            merge=True,
+        )
+        root = DAGRequest(
+            (TableScan(0, tuple(ColumnInfo(i, ft) for i, ft in enumerate(pfts))), merge_agg),
+            output_offsets=(0, 1, 2, 3),
+        )
+        final = run_dag_on_chunk(root, stacked)
+        complete = Aggregation(group_by=(C(0),), aggs=(AggDesc("first_row", (C(1),)), AggDesc("min", (C(1),)), AggDesc("first_row", (C(2),))))
+        oracle = run_dag_reference(DAGRequest((scan(), complete), output_offsets=(0, 1, 2, 3)), ch)
+        assert canon_rows(final.rows()) == canon_rows(oracle)
+
+
+class TestDistinct:
+    def test_grouped_count_sum_avg_distinct(self):
+        ch = make_chunk(250)
+        agg = Aggregation(
+            group_by=(C(0),),
+            aggs=(
+                AggDesc("count", (C(2),), distinct=True),
+                AggDesc("sum", (C(2),), distinct=True),
+                AggDesc("avg", (C(2),), distinct=True),
+                AggDesc("count", (C(2),)),  # non-distinct alongside
+            ),
+        )
+        assert_parity(DAGRequest((scan(), agg), output_offsets=(0, 1, 2, 3, 4)), ch)
+
+    def test_count_distinct_multi_arg(self):
+        ch = make_chunk(180)
+        agg = Aggregation(group_by=(C(0),), aggs=(AggDesc("count", (C(1), C(2)), distinct=True),))
+        assert_parity(DAGRequest((scan(), agg), output_offsets=(0, 1)), ch)
+
+    def test_scalar_distinct(self):
+        ch = make_chunk(120)
+        agg = Aggregation(group_by=(), aggs=(AggDesc("count", (C(1),), distinct=True), AggDesc("sum", (C(2),), distinct=True)))
+        assert_parity(DAGRequest((scan(), agg), output_offsets=(0, 1)), ch)
+
+    def test_distinct_string_count(self):
+        ch = make_chunk(140)
+        agg = Aggregation(group_by=(C(0),), aggs=(AggDesc("count", (C(1),), distinct=True),))
+        assert_parity(DAGRequest((scan(), agg), output_offsets=(0, 1)), ch)
+
+    def test_distinct_merge_raises(self):
+        ch = make_chunk(30)
+        agg = Aggregation(
+            group_by=(C(0),),
+            aggs=(AggDesc("sum", (C(2),), distinct=True, mode=AggMode.Final),),
+            merge=True,
+        )
+        dag = DAGRequest((scan(), agg), output_offsets=(0, 1))
+        with pytest.raises(NotImplementedError):
+            run_dag_on_chunk(dag, ch)
+
+
+class TestStringTruthiness:
+    def test_string_filter(self):
+        """WHERE <varchar col>: numeric-prefix truthiness (MySQL)."""
+        fts = [new_longlong(), new_varchar(10)]
+        vals = ["1", "0", "0.5x", "abc", "", " 12ab", "-0.0", "1e2", ".0", "2e-1", None, "+3"]
+        rows = [[Datum.i64(i), Datum.NULL if v is None else Datum.string(v)] for i, v in enumerate(vals)]
+        ch = Chunk.from_rows(fts, rows)
+        s = TableScan(7, (ColumnInfo(1, fts[0]), ColumnInfo(2, fts[1])))
+        dag = DAGRequest((s, Selection((col(1, fts[1]),))), output_offsets=(0,))
+        dev = run_dag_on_chunk(dag, ch)
+        ref = run_dag_reference(dag, ch)
+        got = sorted(r[0].val for r in dev.rows())
+        want = sorted(r[0].val for r in ref)
+        assert got == want == [0, 2, 5, 7, 9, 11]
+
+
+def test_exec_summary_rows_are_real():
+    """Per-executor produced-row counts come from the fused program."""
+    from tidb_tpu.store import TPUStore, CopRequest
+    from tidb_tpu.codec import tablecodec
+    from tidb_tpu.distsql import full_table_ranges
+
+    store = TPUStore()
+    tid = 9
+    fts = [new_longlong()]
+    n = 50
+    for h in range(n):
+        store.put_row(tid, h, [1], [Datum.i64(h)], ts=5)
+    s = TableScan(tid, (ColumnInfo(1, fts[0]),))
+    pred = func("lt", BOOL, col(0, fts[0]), lit(10, new_longlong()))
+    agg = Aggregation(group_by=(), aggs=(AggDesc("count", ()),))
+    dag = DAGRequest((s, Selection((pred,)), agg), output_offsets=(0,))
+    region = store.cluster.regions_in_range(b"", b"\xff" * 20)[0]
+    resp = store.coprocessor(CopRequest(dag, full_table_ranges(tid), start_ts=100, region_id=region.region_id, region_epoch=region.epoch))
+    assert resp.other_error is None and resp.region_error is None
+    rows_per_exec = [sm.num_produced_rows for sm in resp.exec_summaries]
+    assert rows_per_exec == [50, 10, 1]

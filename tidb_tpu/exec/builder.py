@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from ..chunk.device import DeviceBatch
 from ..expr.compile import CompVal, ExprCompiler, normalize_device_column
 from ..ops import apply_selection, group_aggregate, scalar_aggregate, topn
-from ..ops.aggregate import finalize_agg
+from ..ops.aggregate import GatherState, finalize_agg
 from ..types import FieldType
 from .dag import Aggregation, DAGRequest, Limit, Projection, Selection, TableScan, TopN, current_schema_fts
 
@@ -62,6 +62,9 @@ def build_program(dag: DAGRequest, capacity: int, group_capacity: int = DEFAULT_
         cols = [normalize_device_column(c) for c in batch.cols]
         valid = batch.row_valid
         overflow = jnp.bool_(False)
+        # per-executor produced-row counts, scan first (real numbers for the
+        # exec summaries — ref: tipb.ExecutorExecutionSummary NumProducedRows)
+        ex_rows = [batch.n_rows.astype(jnp.int64)]
 
         for ex in executors[1:]:
             comp = ExprCompiler(fts)
@@ -93,40 +96,23 @@ def build_program(dag: DAGRequest, capacity: int, group_capacity: int = DEFAULT_
                     k += len(a.args)
                 new_cols: list[CompVal] = []
                 if ex.group_by:
-                    # first_row is served by the representative-row gather
-                    # (any group row is a valid answer), which also covers
-                    # string columns with their raw bytes
-                    state_aggs = [(a, av) for a, av in aggs if a.name != "first_row"]
-                    res = group_aggregate(gvals, state_aggs, valid, group_capacity, merge=ex.merge)
+                    res = group_aggregate(gvals, aggs, valid, group_capacity, merge=ex.merge)
                     overflow = overflow | res.overflow
-                    st_iter = iter(res.states)
-                    for a, av in aggs:
-                        if a.name == "first_row":
-                            gath = _gather(av, res.group_rep)[0]
-                            gath = CompVal(gath.value, gath.null | ~res.group_valid, a.ft, raw=gath.raw)
-                            if ex.partial:
-                                # partial schema is [has, value]; every valid
-                                # group has >= 1 row by construction
-                                has = CompVal(
-                                    res.group_valid.astype(jnp.int64),
-                                    jnp.zeros_like(res.group_valid),
-                                    a.partial_fts()[0],
-                                )
-                                new_cols.append(has)
-                            new_cols.append(gath)
-                        else:
-                            new_cols.extend(_agg_out_cols(a, next(st_iter), res.group_valid, ex.partial))
+                    for (a, av), st in zip(aggs, res.states):
+                        new_cols.extend(_agg_result_cols(a, av, st, res.group_valid, ex.partial))
                     new_cols.extend(_gather(gvals, res.group_rep))
                     valid = res.group_valid
                 else:
                     states = scalar_aggregate(aggs, valid, merge=ex.merge)
-                    for a, st in zip(ex.aggs, states):
-                        new_cols.extend(_agg_out_cols(a, st, jnp.ones(1, bool), ex.partial))
-                    valid = jnp.ones(1, bool)
+                    ones = jnp.ones(1, bool)
+                    for (a, av), st in zip(aggs, states):
+                        new_cols.extend(_agg_result_cols(a, av, st, ones, ex.partial))
+                    valid = ones
                 cols = new_cols
                 fts = ex.output_fts()
             else:
                 raise TypeError(f"unsupported executor {ex}")
+            ex_rows.append(valid.sum().astype(jnp.int64))
 
         outs = [cols[i] for i in dag.output_offsets]
         packed = []
@@ -135,17 +121,31 @@ def build_program(dag: DAGRequest, capacity: int, group_capacity: int = DEFAULT_
                 packed.append((c.value, c.null, c.raw[0], c.raw[1]))
             else:
                 packed.append((c.value, c.null))
-        return packed, valid, valid.sum(), overflow
+        return packed, valid, valid.sum(), overflow, jnp.stack(ex_rows)
 
     jit_fn = jax.jit(program)
     return CompiledDAG(jit_fn, dag.output_fts(), capacity, group_capacity)
 
 
-def _agg_out_cols(a, states, group_valid, partial: bool) -> list[CompVal]:
+def _agg_result_cols(a, av: list[CompVal], st, group_valid, partial: bool) -> list[CompVal]:
+    """One aggregate's output columns from its states.
+
+    GatherState (first_row any mode, string min/max): gather the value
+    column — raw string bytes ride along — from the original rows; the wire
+    state for partial first_row is [has, value] (expr/agg.py schema)."""
+    if isinstance(st, GatherState):
+        has = st.has & group_valid
+        g = _gather([av[-1]], st.idx)[0]
+        null = g.null | ~has
+        out = []
+        if a.name == "first_row" and partial:
+            out.append(CompVal(has.astype(jnp.int64), jnp.zeros(has.shape, bool), a.partial_fts()[0]))
+        out.append(CompVal(g.value, null, a.ft, raw=g.raw))
+        return out
     fts = a.partial_fts()
     if partial:
-        return [CompVal(v, nl, ft) for (v, nl), ft in zip(states, fts)]
-    v, nl = finalize_agg(a, states, group_valid)
+        return [CompVal(v, nl, ft) for (v, nl), ft in zip(st, fts)]
+    v, nl = finalize_agg(a, st, group_valid)
     return [CompVal(v, nl, a.ft)]
 
 

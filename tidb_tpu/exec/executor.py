@@ -62,13 +62,16 @@ DEFAULT_PROGRAM_CACHE = ProgramCache()
 
 def drive_program(cache: ProgramCache, dag: DAGRequest, batch, group_capacity: int, max_retries: int = 3):
     """Run the fused program, growing group capacity on overflow
-    (the single overflow-retry contract — store and host driver share it)."""
+    (the single overflow-retry contract — store and host driver share it).
+
+    Returns (chunk, per-executor produced-row counts, scan first)."""
     gc = group_capacity
     for _ in range(max_retries + 1):
         prog = cache.get(dag, batch.capacity, gc)
-        packed, valid, n, overflow = prog.fn(batch)
+        packed, valid, n, overflow, ex_rows = prog.fn(batch)
         if not bool(overflow):
-            return decode_outputs(packed, valid, prog.out_fts)
+            counts = [int(x) for x in np.asarray(ex_rows)]
+            return decode_outputs(packed, valid, prog.out_fts), counts
         gc *= 4  # group/join capacity exceeded: recompile bigger
     raise RuntimeError("DAG overflow not resolved after retries")
 
@@ -84,7 +87,7 @@ def run_dag_on_chunk(
     cache = cache or DEFAULT_PROGRAM_CACHE
     cap = capacity or _pow2(max(chunk.num_rows(), 1))
     batch = to_device_batch(chunk, capacity=cap)
-    return drive_program(cache, dag, batch, group_capacity, max_retries)
+    return drive_program(cache, dag, batch, group_capacity, max_retries)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -107,7 +110,9 @@ def datum_group_key(d: Datum):
 
 
 class _RefAgg:
-    """One aggregate's accumulator (Complete mode)."""
+    """One aggregate's accumulator (Complete mode), incl. DISTINCT via a
+    seen-set (ref: executor/aggfuncs distinct wrappers) and the BIT_*
+    aggregates (ref: aggfuncs/func_bitfuncs.go)."""
 
     def __init__(self, desc: AggDesc):
         self.d = desc
@@ -116,9 +121,20 @@ class _RefAgg:
         self.extreme = None
         self.first = None
         self.has_first = False
+        self.bits = None
+        self.seen = set() if desc.distinct else None
 
     def update(self, args: list[Datum]):
         name = self.d.name
+        if self.seen is not None and name in ("count", "sum", "avg"):
+            # DISTINCT: rows with any NULL arg are skipped; each distinct
+            # arg tuple contributes once
+            if any(a.is_null() for a in args):
+                return
+            key = tuple(datum_group_key(a) for a in args)
+            if key in self.seen:
+                return
+            self.seen.add(key)
         if name == "count":
             if all(not a.is_null() for a in args):
                 self.count += 1
@@ -129,6 +145,17 @@ class _RefAgg:
                 self.first, self.has_first = a, True
             return
         if a.is_null():
+            return
+        if name in ("bit_and", "bit_or", "bit_xor"):
+            v = int(a.val) & ((1 << 64) - 1)
+            if self.bits is None:
+                self.bits = v
+            elif name == "bit_and":
+                self.bits &= v
+            elif name == "bit_or":
+                self.bits |= v
+            else:
+                self.bits ^= v
             return
         self.count += 1
         if name in ("sum", "avg"):
@@ -176,6 +203,10 @@ class _RefAgg:
             return Datum.dec(q.round(max(ft.decimal, 0)))
         if name in ("min", "max"):
             return self.extreme if self.extreme is not None else Datum.NULL
+        if name in ("bit_and", "bit_or", "bit_xor"):
+            if self.bits is None:  # empty: AND -> all ones, OR/XOR -> 0
+                return Datum.u64((1 << 64) - 1 if name == "bit_and" else 0)
+            return Datum.u64(self.bits)
         raise NotImplementedError(name)
 
 

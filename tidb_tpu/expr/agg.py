@@ -69,6 +69,10 @@ class AggDesc:
                     flen=(arg_ft.flen or 20) + 4,
                     decimal=min(max(arg_ft.decimal, 0) + 4, 30),
                 )
+        if self.name == "first_row" and self.mode in (AggMode.Final, AggMode.Partial2) and len(self.args) > 1:
+            # merge-mode first_row args are the [has, value] state columns;
+            # the result type is the value column's, not the has flag's
+            return self.args[-1].ft.clone()
         arg_ft = self.args[0].ft if self.args else new_longlong()
         if self.name in ("min", "max", "first_row"):
             return arg_ft.clone()

@@ -71,6 +71,97 @@ def _round_div(num, den):
     return sign * q
 
 
+def string_bytes(c: CompVal):
+    """(data [N, W] uint8, length [N] int32) for a string CompVal — the raw
+    bytes when they rode along, else unpacked from the packed compare words
+    (which cover the first STRING_WORDS*8 bytes)."""
+    if c.raw is not None:
+        return c.raw
+    words = c.value[:, :-1] ^ I64_MIN  # unflip the sign bit
+    length = c.value[:, -1].astype(jnp.int32)
+    shifts = jnp.array([56, 48, 40, 32, 24, 16, 8, 0], jnp.int64)
+    b = (words[:, :, None] >> shifts[None, None, :]) & 0xFF
+    data = b.reshape(words.shape[0], words.shape[1] * 8).astype(jnp.uint8)
+    return data, length
+
+
+def parse_f64_prefix(data, length):
+    """MySQL string->double: value of the longest numeric prefix, 0.0 when
+    none (ref: pkg/types/convert.go StrToFloat / getValidFloatPrefix —
+    leading spaces skipped, trailing garbage ignored, no error here).
+
+    Vectorized byte-at-a-time state machine over the static width W:
+    stage 0 leading spaces/sign, 1 sign seen, 2 integer digits, 3 fraction,
+    4 exponent sign, 5 exponent digits, 6 done.
+
+    Bit-exact vs strtod on CPU/x64 (mantissa and scale stay exact, division
+    is correctly rounded); under TPU f64 emulation the final divide can be
+    ~2 ulp off — same deviation class as the double->decimal note below."""
+    n, w = data.shape
+    ch_all = data.astype(jnp.int32)
+    stage = jnp.zeros(n, jnp.int32)
+    mant = jnp.zeros(n, jnp.float64)
+    frac = jnp.zeros(n, jnp.int32)
+    exp = jnp.zeros(n, jnp.int32)
+    neg = jnp.zeros(n, bool)
+    eneg = jnp.zeros(n, bool)
+    seen = jnp.zeros(n, bool)
+    for i in range(w):
+        ch = ch_all[:, i]
+        act = (i < length) & (stage < 6)
+        digit = act & (ch >= 48) & (ch <= 57)
+        is_sign = (ch == 43) | (ch == 45)
+        c_sp = act & (stage == 0) & (ch == 32)
+        c_sign = act & (stage == 0) & is_sign
+        c_int = digit & (stage <= 2)
+        c_dot = act & (stage <= 2) & (ch == 46)
+        c_frac = digit & (stage == 3)
+        c_e = act & ((stage == 2) | (stage == 3)) & ((ch == 101) | (ch == 69)) & seen
+        c_es = act & (stage == 4) & is_sign
+        c_exp = digit & ((stage == 4) | (stage == 5))
+        matched = c_sp | c_sign | c_int | c_dot | c_frac | c_e | c_es | c_exp
+        dv = (ch - 48).astype(jnp.float64)
+        mant = jnp.where(c_int | c_frac, mant * 10.0 + dv, mant)
+        frac = jnp.where(c_frac, frac + 1, frac)
+        exp = jnp.where(c_exp, jnp.minimum(exp * 10 + (ch - 48), 1000), exp)
+        neg = neg | (c_sign & (ch == 45))
+        eneg = eneg | (c_es & (ch == 45))
+        seen = seen | c_int | c_frac
+        stage = jnp.where(c_sign, 1, stage)
+        stage = jnp.where(c_int, 2, stage)
+        stage = jnp.where(c_dot, 3, stage)
+        stage = jnp.where(c_e, 4, stage)
+        stage = jnp.where(c_es | c_exp, 5, stage)
+        stage = jnp.where(act & ~matched, 6, stage)
+    e10 = jnp.clip(jnp.where(eneg, -exp, exp) - frac, -400, 400)
+    # mant holds an exactly-representable integer (<= ~19 digits drift only
+    # beyond 2^53); scale by an exact power of ten — dividing for negative
+    # exponents keeps short decimals like "0.5" bit-exact vs strtod, and
+    # jnp.power is NOT used (it loses ~1e-8 relative accuracy even in f64)
+    p = _pow10_f64(jnp.abs(e10))
+    out = jnp.where(e10 >= 0, mant * p, mant / p)
+    # MySQL clamps range overflow to +/-DBL_MAX, not inf
+    # (ref: pkg/types/convert.go StrToFloat ErrDataOutOfRange handling)
+    out = jnp.clip(out, -1.7976931348623157e308, 1.7976931348623157e308)
+    return jnp.where(seen, jnp.where(neg, -out, out), 0.0)
+
+
+def _pow10_f64(ae):
+    """Exact-where-possible 10**ae for non-negative int arrays: table lookup
+    (10^k is exactly representable for k <= 22) plus exponentiation by
+    squaring for the remainder (<= 400)."""
+    table = jnp.array([10.0 ** k for k in range(23)], jnp.float64)
+    small = jnp.minimum(ae, 22)
+    out = table[small]
+    r = ae - small
+    b = jnp.float64(10.0)
+    for _ in range(9):  # rem <= 378 < 2^9
+        out = jnp.where((r & 1) == 1, out * b, out)
+        b = b * b
+        r = r >> 1
+    return out
+
+
 def _words_cmp(a, b):
     """Lexicographic compare of [N, W] int64 word arrays -> (-1/0/1)[N]."""
     neq = a != b
@@ -182,6 +273,9 @@ class ExprCompiler:
         if cls == "real":
             if et == "real":
                 return v
+            if et == "string":
+                data, length = string_bytes(v)
+                return CompVal(parse_f64_prefix(data, length), v.null, FieldType(TypeCode.Double))
             if et == "decimal":
                 return CompVal(v.value.astype(jnp.float64) / float(10 ** _scale(v.ft)), v.null, FieldType(TypeCode.Double))
             if v.ft.is_unsigned():
@@ -192,6 +286,10 @@ class ExprCompiler:
             return CompVal(v.value.astype(jnp.float64), v.null, FieldType(TypeCode.Double))
         if cls == "decimal":
             s = _scale(v.ft) if scale is None else scale
+            if et == "string":
+                # via double (MySQL parses the numeric prefix first)
+                v = self._to_class(v, "real")
+                et = "real"
             if et == "decimal":
                 return self._rescale_dec(v, s)
             if et == "int":
@@ -557,6 +655,9 @@ class ExprCompiler:
         if dst == "decimal":
             return CompVal(self._to_class(a, "decimal", _scale(e.ft)).value, a.null, e.ft)
         if dst == "int":
+            if src == "string":
+                a = self._to_class(a, "real")
+                src = "real"
             if src == "real":
                 out = jnp.round(a.value).astype(jnp.int64)  # MySQL rounds
                 return CompVal(out, a.null, e.ft)

@@ -11,8 +11,27 @@ Slow by design; never on the hot path.
 
 from __future__ import annotations
 
+import re
+
 from ..types import Datum, DatumKind, FieldType, MyDecimal, MyTime, DIV_FRAC_INCR
 from .ir import ColumnRef, Const, Expr, ScalarFunc
+
+# MySQL string->number takes the longest valid numeric prefix
+# (ref: pkg/types/convert.go getValidFloatPrefix)
+_NUM_PREFIX = re.compile(r"^\s*[+-]?(\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?")
+
+
+def str_prefix_f64(s) -> float:
+    import math
+    import sys as _sys
+
+    if isinstance(s, (bytes, bytearray)):
+        s = bytes(s).decode("utf-8", "replace")
+    m = _NUM_PREFIX.match(s)
+    v = float(m.group(0)) if m else 0.0
+    if math.isinf(v):  # MySQL clamps to +/-DBL_MAX (convert.go StrToFloat)
+        v = math.copysign(_sys.float_info.max, v)
+    return v
 
 
 def _num(d: Datum):
@@ -50,10 +69,7 @@ def _truth(d: Datum) -> bool | None:
     if d.is_null():
         return None
     if d.kind in (DatumKind.String, DatumKind.Bytes):
-        try:
-            return float(d.val) != 0
-        except (TypeError, ValueError):
-            return False
+        return str_prefix_f64(d.val) != 0
     if d.kind == DatumKind.MysqlDecimal:
         return d.val.d != 0
     if d.kind == DatumKind.MysqlTime:

@@ -1,6 +1,6 @@
 from .keys import sort_key_arrays, lexsort, segments_from_sorted
 from .selection import apply_selection
-from .aggregate import GroupAggResult, group_aggregate, scalar_aggregate
+from .aggregate import GatherState, GroupAggResult, group_aggregate, scalar_aggregate
 from .topn import topn
 from .join import hash_join
 
@@ -9,6 +9,7 @@ __all__ = [
     "lexsort",
     "segments_from_sorted",
     "apply_selection",
+    "GatherState",
     "GroupAggResult",
     "group_aggregate",
     "scalar_aggregate",

@@ -38,9 +38,12 @@ I64_MIN_ = jnp.int64(-0x8000000000000000)
 class GroupAggResult:
     """Fixed-capacity aggregation output.
 
-    group_rep: int32 [G] representative input-row index per group (gather
-    group-by output columns from the original batch with it).
-    states: per agg, list of (value[G], null[G]) state/result columns.
+    group_rep: int32 [G] earliest original input-row index per group (gather
+    group-by output columns from the original batch with it; earliest matches
+    the row-at-a-time oracle's first-encountered semantics).
+    states: per agg, either a list of (value[G], null[G]) state/result
+    columns or a GatherState (the caller gathers the agg's value column —
+    and its raw string bytes — from the original batch).
     """
 
     group_rep: jax.Array
@@ -48,6 +51,22 @@ class GroupAggResult:
     n_groups: jax.Array
     overflow: jax.Array
     states: list
+
+
+@dataclass
+class GatherState:
+    """Per-group 'fetch this original row' aggregate state.
+
+    Serves first_row (any mode: the earliest original row of the group — in
+    merge mode the earliest partial state with has>0) and min/max over
+    strings (segmented lexicographic arg-extreme). Gathering from the
+    *original* batch lets string aggregates carry their raw bytes, which the
+    packed compare words alone cannot (ref: aggfuncs/func_first_row.go,
+    func_max_min.go — the reference keeps whole datums in its partial
+    results; here the row index plays that role)."""
+
+    idx: jax.Array  # int32 [G] original row index (clipped; dead when ~has)
+    has: jax.Array  # bool [G] group produced a state
 
 
 def _seg_sum(vals, seg, n, dtype=None):
@@ -112,10 +131,7 @@ def _agg_states_raw(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
             fill = jnp.inf if name == "min" else -jnp.inf
             v = op(_masked(a.value, mask, fill), seg, num_segments=nseg)
         elif a.value.ndim == 2:
-            # strings: packed words are sign-adjusted but per-word reduction
-            # is not lexicographic; handled via a per-segment arg-extreme on
-            # the first word only when strings fit one word (W+1 == 2).
-            raise NotImplementedError("min/max over strings on device TODO")
+            raise AssertionError("string min/max is routed via GatherState")
         elif a.ft.is_unsigned() and a.eval_type == "int":
             flip = jnp.int64(-0x8000000000000000)
             av = a.value.astype(jnp.int64) ^ flip
@@ -127,7 +143,7 @@ def _agg_states_raw(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
             v = op(_masked(av, mask, fill), seg, num_segments=nseg)
         return [(v, empty)]
     if name == "first_row":
-        return _first_row_state(a, valid, seg, nseg)
+        raise AssertionError("first_row is routed via GatherState")
     if name in _BIT_OPS:
         red, fill = _BIT_OPS[name]
         v = _seg_bitreduce(red, _masked(a.value.astype(jnp.int64), mask, jnp.int64(fill)), seg, nseg, fill)
@@ -136,26 +152,73 @@ def _agg_states_raw(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
     raise NotImplementedError(f"aggregate {name} on device")
 
 
-def _first_row_state(a: CompVal, inseg, seg, nseg):
-    """first_row partial state: [has, value]. `has` = segment saw >=1 row;
-    the value is the literal first in-segment row's (value, null) — NULL
-    values are kept, matching the reference's first_row which takes the
-    first row verbatim (ref: aggfuncs/func_first_row.go). `has` lets the
-    cross-region merge skip empty/filtered-out regions without conflating
-    them with a legitimately-NULL first value."""
-    if a.value.ndim == 2:
-        # grouped first_row over strings is served by the rep-row gather
-        # in exec/builder.py; this state path has no raw bytes to carry
-        raise NotImplementedError("first_row over string needs rep-row gather")
-    n = seg.shape[0]
-    pos = jnp.arange(n, dtype=jnp.int32)
-    sentinel = jnp.int32(2**31 - 1)
-    first = jax.ops.segment_min(jnp.where(inseg, pos, sentinel), seg, num_segments=nseg)
-    has = first < n
-    first_c = jnp.clip(first, 0, n - 1)
-    val = jnp.where(has, a.value[first_c], jnp.zeros((), a.value.dtype))
-    null = jnp.where(has, a.null[first_c], True)
-    return [(has.astype(jnp.int64), jnp.zeros(nseg, bool)), (val, null)]
+def _first_match_idx(mask_s, orig_s, seg, nseg, n):
+    """Per-segment earliest ORIGINAL row index among mask rows.
+
+    mask_s/orig_s are in sorted order (orig_s = perm, the original index of
+    each sorted position). Returns (idx[nseg] clipped, has[nseg])."""
+    fi = jax.ops.segment_min(jnp.where(mask_s, orig_s, jnp.int32(n)), seg, num_segments=nseg)
+    has = fi < n
+    return jnp.clip(fi, 0, n - 1), has
+
+
+def _arg_extreme_mask(words_s, cand, seg, nseg, maximize: bool):
+    """Narrow `cand` (sorted order) to rows holding the per-segment
+    lexicographic extreme of `words_s` ([n, K] int64, most significant word
+    first — the packed-string key layout). Word-by-word radix arg-extreme:
+    K static segment reduces, no data-dependent shapes."""
+    for k in range(words_s.shape[1]):
+        w = words_s[:, k]
+        if maximize:
+            best = jax.ops.segment_max(jnp.where(cand, w, I64_MIN_), seg, num_segments=nseg)
+        else:
+            best = jax.ops.segment_min(jnp.where(cand, w, I64_MAX), seg, num_segments=nseg)
+        cand = cand & (w == best[seg])
+    return cand
+
+
+def _distinct_states(desc: AggDesc, args: list, row_valid, gkeys: list, invalid_first, nseg):
+    """COUNT/SUM/AVG(DISTINCT ...) states via a secondary sort by
+    (validity, group keys, arg keys): the first row of each distinct
+    (group, args) combination contributes exactly once (ref: aggfuncs
+    distinct set semantics, executor/aggfuncs/func_count_distinct.go —
+    the sort replaces the hash set).
+
+    Group numbering matches the main sort's: both order valid-first by the
+    same group-key words, so segment ids depend only on distinct key ranks.
+    With no group keys (scalar agg) callers pass nseg=2 (slot 1 = invalid).
+    """
+    argkeys: list = []
+    amask = row_valid
+    for a in args:
+        amask = amask & ~a.null
+        argkeys.extend(sort_key_arrays(a))
+    perm2 = lexsort([invalid_first] + gkeys + argkeys)
+    valid2 = row_valid[perm2]
+    gkeys2 = [k[perm2] for k in gkeys]
+    if gkeys:
+        seg2, _ = segments_from_sorted(gkeys2, valid2)
+        seg2 = jnp.minimum(seg2, nseg - 1)
+    else:
+        seg2 = jnp.where(valid2, 0, 1).astype(jnp.int32)
+    allkeys2 = gkeys2 + [k[perm2] for k in argkeys]
+    diff = jnp.zeros(valid2.shape[0], bool)
+    for k in allkeys2:
+        diff = diff | jnp.concatenate([jnp.ones(1, bool), k[1:] != k[:-1]])
+    uniq = diff & valid2 & amask[perm2]
+    cnt = _seg_sum(uniq.astype(jnp.int64), seg2, nseg)
+    if desc.name == "count":
+        return [(cnt, jnp.zeros(nseg, bool))]
+    a0 = args[0]
+    a2 = a0.value[perm2]
+    if a0.eval_type == "real":
+        s = _seg_sum(jnp.where(uniq, a2, 0.0), seg2, nseg)
+    else:
+        s = _seg_sum(jnp.where(uniq, a2.astype(jnp.int64), jnp.int64(0)), seg2, nseg)
+    empty = cnt == 0
+    if desc.name == "sum":
+        return [(s, empty)]
+    return [(cnt, jnp.zeros(nseg, bool)), (s, empty)]
 
 
 def _agg_states_merge(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
@@ -181,10 +244,7 @@ def _agg_states_merge(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
     if name in ("min", "max"):
         return _agg_states_raw(desc, args, valid, seg, nseg)
     if name == "first_row":
-        # merge phase: states are [has, value]; take the first state whose
-        # region saw rows (has>0), keeping that state's value/null verbatim
-        has, val = args[0], args[1]
-        return _first_row_state(val, valid & (has.value > 0), seg, nseg)
+        raise AssertionError("first_row merge is routed via GatherState")
     if name in _BIT_OPS:
         # reduce of reduces — same segmented bitwise kernel over state cols
         return _agg_states_raw(desc, args, valid, seg, nseg)
@@ -214,6 +274,36 @@ def finalize_agg(desc: AggDesc, states: list, group_valid) -> tuple:
     return v, nl
 
 
+def _gather_or_distinct_state(desc, arg_vals, row_valid, merge, gkeys, invalid_first, nseg, seg, perm, n):
+    """GatherState / distinct states for the aggs that need them, else None.
+
+    first_row (all modes) and string min/max resolve to a per-group original
+    row index; DISTINCT count/sum/avg resolve via a secondary sort."""
+    name = desc.name
+    orig_s = perm.astype(jnp.int32)
+    if name == "first_row":
+        mask = row_valid
+        if merge:
+            # merge input states are [has, value]: earliest state with has>0
+            mask = mask & (arg_vals[0].value > 0)
+        idx, has = _first_match_idx(mask[perm], orig_s, seg, nseg, n)
+        return GatherState(idx, has)
+    if name in ("min", "max") and arg_vals and arg_vals[-1].value.ndim == 2:
+        a = arg_vals[-1]  # merge-mode state col == value col, same kernel
+        mask = (row_valid & ~a.null)[perm]
+        cand = _arg_extreme_mask(a.value[perm, :], mask, seg, nseg, name == "max")
+        idx, has = _first_match_idx(cand, orig_s, seg, nseg, n)
+        return GatherState(idx, has)
+    if desc.distinct and name in ("count", "sum", "avg") and arg_vals:
+        if merge:
+            raise NotImplementedError(
+                "DISTINCT aggregates are not decomposable into mergeable partials; "
+                "plan them in Complete mode (ref: AggregationPushDownSolver skips distinct)"
+            )
+        return _distinct_states(desc, arg_vals, row_valid, gkeys, invalid_first, nseg)
+    return None
+
+
 def group_aggregate(
     group_bys: list[CompVal],
     aggs: list,
@@ -239,19 +329,24 @@ def group_aggregate(
     nseg = group_capacity + 1
     seg = jnp.minimum(seg, nseg - 1)
 
-    # representative original row per group
-    pos = jnp.arange(n, dtype=jnp.int32)
-    first_pos = jax.ops.segment_min(jnp.where(valid_s, pos, jnp.int32(n)), seg, num_segments=nseg)
-    first_pos = jnp.clip(first_pos, 0, n - 1)
-    group_rep = perm[first_pos][:group_capacity].astype(jnp.int32)
+    # earliest original row per group (deterministic oracle parity)
+    group_rep_full, _ = _first_match_idx(valid_s, perm.astype(jnp.int32), seg, nseg, n)
+    group_rep = group_rep_full[:group_capacity]
     gids = jnp.arange(group_capacity, dtype=jnp.int32)
     group_valid = gids < n_groups
 
     states = []
     for desc, arg_vals in aggs:
-        av_s = [CompVal(a.value[perm] if a.value.ndim == 1 else a.value[perm, :], a.null[perm], a.ft, raw=None) for a in arg_vals]
-        fn = _agg_states_merge if merge else _agg_states_raw
-        st = fn(desc, av_s, valid_s, seg, nseg)
+        st = _gather_or_distinct_state(
+            desc, arg_vals, row_valid, merge, keys, invalid_first_key, nseg, seg, perm, n
+        )
+        if isinstance(st, GatherState):
+            states.append(GatherState(st.idx[:group_capacity], st.has[:group_capacity] & group_valid))
+            continue
+        if st is None:
+            av_s = [CompVal(a.value[perm] if a.value.ndim == 1 else a.value[perm, :], a.null[perm], a.ft, raw=None) for a in arg_vals]
+            fn = _agg_states_merge if merge else _agg_states_raw
+            st = fn(desc, av_s, valid_s, seg, nseg)
         st = [(v[:group_capacity], nl[:group_capacity]) for v, nl in st]
         st = [(v, nl | ~group_valid) for v, nl in st]
         states.append(st)
@@ -261,12 +356,24 @@ def group_aggregate(
 
 def scalar_aggregate(aggs: list, row_valid: jax.Array, merge: bool = False):
     """Aggregation without GROUP BY: always exactly one output row
-    (ref: SELECT count(*) over empty set returns 0)."""
+    (ref: SELECT count(*) over empty set returns 0).
+
+    States come back [1]-shaped; first_row / string min/max come back as a
+    GatherState ([1]-shaped idx/has) for the caller to gather."""
     n = row_valid.shape[0]
     seg = jnp.zeros(n, jnp.int32)
-    fn = _agg_states_merge if merge else _agg_states_raw
+    perm = jnp.arange(n, dtype=jnp.int32)
+    invalid_first = jnp.where(row_valid, jnp.int64(0), jnp.int64(1))
     states = []
     for desc, arg_vals in aggs:
-        st = fn(desc, arg_vals, row_valid, seg, 1)
-        states.append(st)
+        st = _gather_or_distinct_state(
+            desc, arg_vals, row_valid, merge, [], invalid_first, 2, seg, perm, n
+        )
+        if isinstance(st, GatherState):
+            states.append(GatherState(st.idx[:1], st.has[:1]))
+        elif st is not None:  # distinct states came back [2]-shaped
+            states.append([(v[:1], nl[:1]) for v, nl in st])
+        else:
+            fn = _agg_states_merge if merge else _agg_states_raw
+            states.append(fn(desc, arg_vals, row_valid, seg, 1))
     return states

@@ -9,17 +9,22 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..expr.compile import CompVal
+from ..expr.compile import CompVal, parse_f64_prefix, string_bytes
 
 
 def apply_selection(row_valid, conds: list[CompVal]):
     """AND of condition truthiness; NULL and false both drop the row
-    (SQL WHERE keeps rows where every condition is true and non-NULL)."""
+    (SQL WHERE keeps rows where every condition is true and non-NULL).
+
+    String conditions follow MySQL truthiness: the numeric prefix cast to
+    double must be non-zero (ref: types/convert.go StrToFloat; a bare string
+    in WHERE goes through implicit double conversion)."""
     out = row_valid
     for c in conds:
         if c.value.ndim == 2:
-            raise NotImplementedError("string-typed filter condition")
-        if c.eval_type == "real":
+            data, length = string_bytes(c)
+            t = parse_f64_prefix(data, length) != 0.0
+        elif c.eval_type == "real":
             t = c.value != 0.0
         else:
             t = c.value != 0

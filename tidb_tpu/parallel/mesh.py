@@ -25,7 +25,7 @@ from ..chunk import Chunk, to_device_batch
 from ..chunk.device import DeviceBatch, DeviceColumn
 from ..exec.dag import Aggregation, DAGRequest
 from ..expr.compile import ExprCompiler, normalize_device_column
-from ..ops import apply_selection, scalar_aggregate
+from ..ops import GatherState, apply_selection, scalar_aggregate
 
 REGION_AXIS = "region"
 
@@ -100,11 +100,24 @@ def run_sharded_partial_agg(dag: DAGRequest, stacked: DeviceBatch, mesh: Mesh):
             aggs.append((desc, avals[k : k + len(desc.args)]))
             k += len(desc.args)
         states = scalar_aggregate(aggs, valid, merge=agg.merge)
-        # flatten to arrays: per agg, per state col: (value[1], null[1])
+        # flatten to arrays: per agg, per state col: (value[1], null[1]);
+        # first_row comes back as a GatherState — materialize its [has,
+        # value] wire state here (numeric only on the mesh path)
         flat = []
-        for st in states:
-            for v, nl in st:
-                flat.append((v, nl))
+        for (desc, avs), st in zip(aggs, states):
+            if isinstance(st, GatherState):
+                vcol = avs[-1]
+                if vcol.value.ndim != 1:
+                    raise NotImplementedError(
+                        f"string-valued gather aggregate {desc.name!r} (first_row/min/max) over the mesh"
+                    )
+                val = jnp.where(st.has, vcol.value[st.idx], jnp.zeros((), vcol.value.dtype))
+                nl = jnp.where(st.has, vcol.null[st.idx], True)
+                flat.append((st.has.astype(jnp.int64), jnp.zeros(1, bool)))
+                flat.append((val, nl))
+            else:
+                for v, nl in st:
+                    flat.append((v, nl))
         return flat
 
     # merge plan per aggregate (the schema in expr/agg.py partial_fts:

@@ -67,6 +67,8 @@ class AggFunc(ExprNode):
     name: str  # count/sum/avg/min/max/group_concat/bit_and/bit_or/bit_xor/stddev/var_pop...
     args: list = field(default_factory=list)
     distinct: bool = False
+    order_by: list = field(default_factory=list)  # GROUP_CONCAT(... ORDER BY ...)
+    separator: Optional[str] = None  # GROUP_CONCAT(... SEPARATOR s)
 
 
 @dataclass

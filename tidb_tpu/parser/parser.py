@@ -124,6 +124,13 @@ class Parser:
             return t.text
         raise ParseError(f"expected identifier at {self._where()}")
 
+    def expect_number(self) -> int:
+        t = self.peek()
+        if t.kind is T.NUMBER:
+            self.i += 1
+            return int(t.text)
+        raise ParseError(f"expected number at {self._where()}")
+
     # ---- statements ----
     def parse_statements(self) -> list:
         out = []
@@ -209,10 +216,13 @@ class Parser:
         if kw == "ADMIN":
             return self.admin_stmt()
         if kw == "KILL":
+            # KILL [TIDB] [CONNECTION|QUERY] id (ref: parser.y KillStmt)
             self.next()
+            self.eat_kw("TIDB")
             q = self.eat_kw("QUERY")
-            self.eat_kw("TIDB", "CONNECTION")
-            return A.KillStmt(int(self.next().text), q)
+            if not q:
+                self.eat_kw("CONNECTION")
+            return A.KillStmt(self.expect_number(), q)
         if kw == "LOAD":
             return self.load_data_stmt()
         if kw in ("BACKUP", "RESTORE"):
@@ -246,6 +256,21 @@ class Parser:
             s = selects[0]
             if ctes:
                 s.ctes = ctes + getattr(s, "ctes", [])
+            # (SELECT ...) ORDER BY ... LIMIT ...: a parenthesized branch does
+            # not swallow trailing clauses. If the branch already has its own
+            # ORDER/LIMIT the outer ones apply AFTER it (MySQL derived-result
+            # semantics) — represent that as a single-branch SetOprStmt so
+            # neither clause set is lost.
+            if paren_flags[0] and (self.at_kw("ORDER") or self.at_kw("LIMIT")):
+                order_by, limit = [], None
+                if self.eat_kw("ORDER"):
+                    self.expect_kw("BY")
+                    order_by = self.by_list()
+                if self.at_kw("LIMIT"):
+                    limit = self.limit_clause()
+                if getattr(s, "order_by", None) or getattr(s, "limit", None):
+                    return A.SetOprStmt([s], [], order_by, limit, ctes)
+                s.order_by, s.limit = order_by, limit
             return s
         order_by, limit = [], None
         if self.eat_kw("ORDER"):
@@ -807,10 +832,19 @@ class Parser:
                 args.append(self.func_arg())
                 while self.eat_op(","):
                     args.append(self.func_arg())
+            gc_order, gc_sep = [], None
+            if lname == "group_concat":
+                # GROUP_CONCAT(expr [ORDER BY ...] [SEPARATOR str]) — the
+                # trailing clauses follow the arg without a comma
+                if self.eat_kw("ORDER"):
+                    self.expect_kw("BY")
+                    gc_order = self.by_list()
+                if self.eat_kw("SEPARATOR"):
+                    gc_sep = self.next().text
             self.expect_op(")")
             if lname in _AGG_FUNCS:
                 # OVER (...) would make it a window func — not yet planned
-                return A.AggFunc(lname, args, distinct)
+                return A.AggFunc(lname, args, distinct, gc_order, gc_sep)
             return A.FuncCall(lname, args)
         # qualified column
         table = db = ""
@@ -821,11 +855,6 @@ class Parser:
         return A.ColumnName(name, table, db)
 
     def func_arg(self):
-        # allow `sep AS x` style? no — but allow INTERVAL & SEPARATOR
-        if self.at_kw("SEPARATOR"):
-            self.next()
-            s = self.next()
-            return A.Literal(s.text, "str")
         return self.expr()
 
     # ---- type spec ----
@@ -835,6 +864,9 @@ class Parser:
             name = self.ident().lower()
         if name not in _TYPE_NAMES:
             raise ParseError(f"unknown type {name!r} at {self._where()}")
+        if name in ("signed", "unsigned"):
+            # CAST(x AS UNSIGNED [INT|INTEGER]) — eat the optional keyword
+            self.eat_kw("INT", "INTEGER")
         if name in ("integer",):
             name = "int"
         if name in ("numeric", "dec", "fixed"):
@@ -855,9 +887,9 @@ class Parser:
                 self.expect_op(")")
                 ts = A.TypeSpec(name, elems=tuple(elems))
                 return self._type_attrs(ts)
-            length = int(self.next().text)
+            length = self.expect_number()
             if self.eat_op(","):
-                dec = int(self.next().text)
+                dec = self.expect_number()
             self.expect_op(")")
         ts = A.TypeSpec(name, length, dec)
         return self._type_attrs(ts)
@@ -1009,7 +1041,7 @@ class Parser:
             self.expect_kw("BY")
             stmt.lines_terminated = self.next().text
         if self.eat_kw("IGNORE"):
-            stmt.ignore_lines = int(self.next().text)
+            stmt.ignore_lines = self.expect_number()
             self.expect_kw("LINES") if self.at_kw("LINES") else self.expect_kw("ROWS")
         if self.eat_op("("):
             while True:
@@ -1128,7 +1160,7 @@ class Parser:
             c = self.ident()
             plen = -1
             if self.eat_op("("):
-                plen = int(self.next().text)
+                plen = self.expect_number()
                 self.expect_op(")")
             self.eat_kw("ASC") or self.eat_kw("DESC")
             out.append((c, plen))
@@ -1195,7 +1227,7 @@ class Parser:
                 opts["engine"] = self.ident()
             elif self.eat_kw("AUTO_INCREMENT"):
                 self.eat_op("=")
-                opts["auto_increment"] = int(self.next().text)
+                opts["auto_increment"] = self.expect_number()
             elif self.eat_kw("DEFAULT"):
                 continue
             elif self.eat_kw("CHARSET"):
@@ -1473,9 +1505,9 @@ class Parser:
         if self.eat_kw("CANCEL"):
             self.expect_kw("DDL")
             self.expect_kw("JOBS")
-            ids = [int(self.next().text)]
+            ids = [self.expect_number()]
             while self.eat_op(","):
-                ids.append(int(self.next().text))
+                ids.append(self.expect_number())
             return A.AdminStmt("cancel_ddl_jobs", job_ids=ids)
         raise ParseError(f"unsupported ADMIN at {self._where()}")
 

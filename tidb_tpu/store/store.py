@@ -169,12 +169,16 @@ class TPUStore:
         t0 = time.monotonic_ns()
         batch = self.region_device_batch(region, req.ranges, req.dag, req.start_ts)
         try:
-            chunk = drive_program(self.programs, req.dag, batch, group_capacity)
+            chunk, ex_rows = drive_program(self.programs, req.dag, batch, group_capacity)
         except RuntimeError as exc:
             return CopResponse(other_error=str(exc))
         elapsed = time.monotonic_ns() - t0
+        # per-executor produced-row counts are real (measured inside the
+        # fused program); the time is the whole fused program's — XLA fuses
+        # the pipeline into one kernel, so per-operator time does not exist
+        # (ref: cop_handler.go:518-531 fills per-executor summaries)
         summaries = [
-            ExecSummary(time_processed_ns=elapsed, num_produced_rows=chunk.num_rows())
-            for _ in req.dag.executors
+            ExecSummary(time_processed_ns=elapsed, num_produced_rows=r)
+            for r in ex_rows
         ]
         return CopResponse(chunk=chunk, exec_summaries=summaries)
