@@ -69,7 +69,7 @@ def bench_device(device, n: int, iters: int, warmup: int = 2) -> float:
     with jax.default_device(device):
         dag, batch = make_batch(n)
         batch = jax.device_put(batch, device)
-        prog = build_program(dag, capacity=n, group_capacity=16)
+        prog = build_program(dag, n, group_capacity=16)
         fn = jax.jit(prog.fn)
         t0 = time.perf_counter()
         out = fn(batch)

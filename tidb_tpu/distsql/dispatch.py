@@ -40,13 +40,18 @@ def handle_ranges(table_id: int, pairs: list[tuple[int, int]]) -> list[KeyRange]
 
 @dataclass
 class KVRequest:
-    """(ref: kv.Request kv.go:528 — the slice the executor hands to distsql)."""
+    """(ref: kv.Request kv.go:528 — the slice the executor hands to distsql).
+
+    aux_chunks: join build-side operands broadcast to every region task
+    (resolved by the root executor from prior scans; ref: TiFlash broadcast
+    join, mpp_exec.go:669)."""
 
     dag: DAGRequest
     ranges: list
     start_ts: int
     concurrency: int = 4
     keep_order: bool = False
+    aux_chunks: list = field(default_factory=list)
 
 
 @dataclass
@@ -94,7 +99,7 @@ def select(store: TPUStore, req: KVRequest) -> SelectResult:
     summaries: list = []
 
     def run_task(i: int, task: CopTask, retries: int = MAX_RETRY):
-        creq = CopRequest(req.dag, task.ranges, req.start_ts, task.region_id, task.epoch)
+        creq = CopRequest(req.dag, task.ranges, req.start_ts, task.region_id, task.epoch, aux_chunks=req.aux_chunks)
         resp = store.coprocessor(creq)
         if resp.region_error is not None:
             if retries <= 0:

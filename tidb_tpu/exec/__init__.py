@@ -7,9 +7,11 @@ from .dag import (
     TableScan,
     TopN,
     ColumnInfo,
+    Join,
+    collect_scans,
 )
 from .builder import build_program, ProgramCache, CompiledDAG
-from .executor import run_dag_on_chunk, run_dag_reference
+from .executor import OverflowRetryError, run_dag_on_chunk, run_dag_on_chunks, run_dag_reference
 
 __all__ = [
     "Aggregation",
@@ -20,9 +22,13 @@ __all__ = [
     "TableScan",
     "TopN",
     "ColumnInfo",
+    "Join",
+    "collect_scans",
     "build_program",
     "ProgramCache",
     "CompiledDAG",
     "run_dag_on_chunk",
+    "run_dag_on_chunks",
+    "OverflowRetryError",
     "run_dag_reference",
 ]

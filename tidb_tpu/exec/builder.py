@@ -26,10 +26,10 @@ import jax.numpy as jnp
 
 from ..chunk.device import DeviceBatch
 from ..expr.compile import CompVal, ExprCompiler, normalize_device_column
-from ..ops import apply_selection, group_aggregate, scalar_aggregate, topn
+from ..ops import apply_selection, group_aggregate, hash_join, scalar_aggregate, topn
 from ..ops.aggregate import GatherState, finalize_agg
 from ..types import FieldType
-from .dag import Aggregation, DAGRequest, Limit, Projection, Selection, TableScan, TopN, current_schema_fts
+from .dag import Aggregation, DAGRequest, Join, Limit, Projection, Selection, TableScan, TopN, collect_scans, current_schema_fts
 
 DEFAULT_GROUP_CAPACITY = 4096
 
@@ -46,74 +46,143 @@ def _gather(cols: list[CompVal], idx) -> list[CompVal]:
 
 @dataclass
 class CompiledDAG:
-    fn: object  # jitted DeviceBatch -> (outputs, valid, n_rows, overflow)
+    fn: object  # jitted (DeviceBatch, ...) -> (outputs, valid, n_rows, overflow, ex_rows)
     out_fts: list[FieldType]
-    capacity: int
+    capacities: tuple  # one per scan, canonical order (dag.collect_scans)
     group_capacity: int
+    join_capacity: int
 
 
-def build_program(dag: DAGRequest, capacity: int, group_capacity: int = DEFAULT_GROUP_CAPACITY) -> CompiledDAG:
-    executors = dag.executors
-    scan = dag.scan()
-    input_fts = [c.ft for c in scan.columns]
+class _TraceState:
+    """Mutable trace-time accumulators shared across nested pipelines."""
 
-    def program(batch: DeviceBatch):
-        fts = input_fts
-        cols = [normalize_device_column(c) for c in batch.cols]
-        valid = batch.row_valid
-        overflow = jnp.bool_(False)
-        # per-executor produced-row counts, scan first (real numbers for the
-        # exec summaries — ref: tipb.ExecutorExecutionSummary NumProducedRows)
-        ex_rows = [batch.n_rows.astype(jnp.int64)]
+    def __init__(self):
+        self.overflow = jnp.bool_(False)
+        self.ex_rows: list = []
 
-        for ex in executors[1:]:
-            comp = ExprCompiler(fts)
-            if isinstance(ex, Selection):
-                conds = comp.run(list(ex.conditions), cols)
-                valid = apply_selection(valid, conds)
-            elif isinstance(ex, Projection):
-                cols = comp.run(list(ex.exprs), cols)
-                fts = [e.ft for e in ex.exprs]
-            elif isinstance(ex, Limit):
-                keep = jnp.cumsum(valid.astype(jnp.int32)) <= ex.limit
-                valid = valid & keep
-            elif isinstance(ex, TopN):
-                order_vals = comp.run([e for e, _ in ex.order_by], cols)
-                by = list(zip(order_vals, [d for _, d in ex.order_by]))
-                idx, out_valid = topn(by, valid, ex.limit)
-                cols = _gather(cols, idx)
-                valid = out_valid
-            elif isinstance(ex, Aggregation):
-                garg_exprs = []
-                for a in ex.aggs:
-                    garg_exprs.extend(a.args)
-                gvals = comp.run(list(ex.group_by), cols) if ex.group_by else []
-                avals = comp.run(list(garg_exprs), cols) if garg_exprs else []
-                aggs = []
-                k = 0
-                for a in ex.aggs:
-                    aggs.append((a, avals[k : k + len(a.args)]))
-                    k += len(a.args)
-                new_cols: list[CompVal] = []
-                if ex.group_by:
-                    res = group_aggregate(gvals, aggs, valid, group_capacity, merge=ex.merge)
-                    overflow = overflow | res.overflow
-                    for (a, av), st in zip(aggs, res.states):
-                        new_cols.extend(_agg_result_cols(a, av, st, res.group_valid, ex.partial))
-                    new_cols.extend(_gather(gvals, res.group_rep))
-                    valid = res.group_valid
-                else:
-                    states = scalar_aggregate(aggs, valid, merge=ex.merge)
-                    ones = jnp.ones(1, bool)
-                    for (a, av), st in zip(aggs, states):
-                        new_cols.extend(_agg_result_cols(a, av, st, ones, ex.partial))
-                    valid = ones
-                cols = new_cols
-                fts = ex.output_fts()
+
+def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, state: _TraceState):
+    """Trace one executor pipeline; recursion handles Join build sides.
+
+    batches are consumed in canonical scan order (dag.collect_scans);
+    `cursor` is the trace-time index of the next unconsumed batch."""
+    scan = executors[0]
+    assert isinstance(scan, TableScan), "pipeline must start with a scan"
+    batch = batches[cursor[0]]
+    cursor[0] += 1
+    fts = [c.ft for c in scan.columns]
+    cols = [normalize_device_column(c) for c in batch.cols]
+    valid = batch.row_valid
+    # per-executor produced-row counts, scan first (real numbers for the
+    # exec summaries — ref: tipb.ExecutorExecutionSummary NumProducedRows)
+    state.ex_rows.append(batch.n_rows.astype(jnp.int64))
+
+    for ex in executors[1:]:
+        comp = ExprCompiler(fts)
+        if isinstance(ex, Selection):
+            conds = comp.run(list(ex.conditions), cols)
+            valid = apply_selection(valid, conds)
+        elif isinstance(ex, Projection):
+            cols = comp.run(list(ex.exprs), cols)
+            fts = [e.ft for e in ex.exprs]
+        elif isinstance(ex, Limit):
+            keep = jnp.cumsum(valid.astype(jnp.int32)) <= ex.limit
+            valid = valid & keep
+        elif isinstance(ex, TopN):
+            order_vals = comp.run([e for e, _ in ex.order_by], cols)
+            by = list(zip(order_vals, [d for _, d in ex.order_by]))
+            idx, out_valid = topn(by, valid, ex.limit)
+            cols = _gather(cols, idx)
+            valid = out_valid
+        elif isinstance(ex, Join):
+            bcols, bvalid, bfts = _run_pipeline(ex.build, batches, cursor, group_capacity, join_capacity, state)
+            bcomp = ExprCompiler(bfts)
+            bkeys = bcomp.run(list(ex.build_keys), bcols)
+            pkeys = comp.run(list(ex.probe_keys), cols)
+            _check_join_key_types(pkeys, bkeys)
+            res = hash_join(bkeys, pkeys, bvalid, valid, join_capacity, ex.join_type)
+            state.overflow = state.overflow | res.overflow
+            if ex.join_type in ("semi", "anti"):
+                # probe schema preserved, rows filtered by match-existence
+                valid = res.out_valid
             else:
-                raise TypeError(f"unsupported executor {ex}")
-            ex_rows.append(valid.sum().astype(jnp.int64))
+                nb = bvalid.shape[0]
+                p_g = _gather(cols, res.probe_idx)
+                b_g = _gather(bcols, jnp.clip(res.build_idx, 0, nb - 1))
+                b_g = [CompVal(c.value, c.null | res.build_null, c.ft, raw=c.raw) for c in b_g]
+                cols = p_g + b_g
+                valid = res.out_valid
+                if ex.join_type == "left_outer":
+                    bfts = [f.clone_nullable() for f in bfts]
+                fts = fts + bfts
+        elif isinstance(ex, Aggregation):
+            garg_exprs = []
+            for a in ex.aggs:
+                garg_exprs.extend(a.args)
+            gvals = comp.run(list(ex.group_by), cols) if ex.group_by else []
+            avals = comp.run(list(garg_exprs), cols) if garg_exprs else []
+            aggs = []
+            k = 0
+            for a in ex.aggs:
+                aggs.append((a, avals[k : k + len(a.args)]))
+                k += len(a.args)
+            new_cols: list[CompVal] = []
+            if ex.group_by:
+                res = group_aggregate(gvals, aggs, valid, group_capacity, merge=ex.merge)
+                state.overflow = state.overflow | res.overflow
+                for (a, av), st in zip(aggs, res.states):
+                    new_cols.extend(_agg_result_cols(a, av, st, res.group_valid, ex.partial))
+                new_cols.extend(_gather(gvals, res.group_rep))
+                valid = res.group_valid
+            else:
+                states = scalar_aggregate(aggs, valid, merge=ex.merge)
+                ones = jnp.ones(1, bool)
+                for (a, av), st in zip(aggs, states):
+                    new_cols.extend(_agg_result_cols(a, av, st, ones, ex.partial))
+                valid = ones
+            cols = new_cols
+            fts = ex.output_fts()
+        else:
+            raise TypeError(f"unsupported executor {ex}")
+        state.ex_rows.append(valid.sum().astype(jnp.int64))
 
+    return cols, valid, fts
+
+
+def _check_join_key_types(pkeys: list[CompVal], bkeys: list[CompVal]):
+    """Join keys must normalize to identical sort-key layouts; the planner
+    is responsible for inserting casts (ref: hash join key unification in
+    pkg/planner/core — e.g. decimal keys are brought to one scale)."""
+    assert len(pkeys) == len(bkeys), "join key arity mismatch"
+    for p, b in zip(pkeys, bkeys):
+        pe, be = p.eval_type, b.eval_type
+        if pe != be:
+            raise TypeError(f"join key class mismatch: {pe} vs {be} (insert casts)")
+        if pe == "decimal" and max(p.ft.decimal, 0) != max(b.ft.decimal, 0):
+            raise TypeError("join key decimal scale mismatch (insert casts)")
+        if pe == "int" and p.ft.is_unsigned() != b.ft.is_unsigned():
+            raise TypeError("join key signedness mismatch (insert casts)")
+
+
+def build_program(
+    dag: DAGRequest,
+    capacities,
+    group_capacity: int = DEFAULT_GROUP_CAPACITY,
+    join_capacity: int | None = None,
+) -> CompiledDAG:
+    """Compile the whole DAG tree (probe pipeline + all join build
+    pipelines) into one fused XLA program over a tuple of device batches."""
+    if isinstance(capacities, int):
+        capacities = (capacities,)
+    capacities = tuple(capacities)
+    n_scans = len(collect_scans(dag.executors))
+    assert len(capacities) == n_scans, f"need {n_scans} batch capacities, got {len(capacities)}"
+    join_capacity = join_capacity or max(capacities)
+
+    def program(*batches):
+        state = _TraceState()
+        cursor = [0]
+        cols, valid, _ = _run_pipeline(dag.executors, batches, cursor, group_capacity, join_capacity, state)
         outs = [cols[i] for i in dag.output_offsets]
         packed = []
         for c in outs:
@@ -121,10 +190,10 @@ def build_program(dag: DAGRequest, capacity: int, group_capacity: int = DEFAULT_
                 packed.append((c.value, c.null, c.raw[0], c.raw[1]))
             else:
                 packed.append((c.value, c.null))
-        return packed, valid, valid.sum(), overflow, jnp.stack(ex_rows)
+        return packed, valid, valid.sum(), state.overflow, jnp.stack(state.ex_rows)
 
     jit_fn = jax.jit(program)
-    return CompiledDAG(jit_fn, dag.output_fts(), capacity, group_capacity)
+    return CompiledDAG(jit_fn, dag.output_fts(), capacities, group_capacity, join_capacity)
 
 
 def _agg_result_cols(a, av: list[CompVal], st, group_valid, partial: bool) -> list[CompVal]:
@@ -155,11 +224,20 @@ class ProgramCache:
     def __init__(self):
         self._cache: dict = {}
 
-    def get(self, dag: DAGRequest, capacity: int, group_capacity: int = DEFAULT_GROUP_CAPACITY) -> CompiledDAG:
-        key = (dag.fingerprint(), capacity, group_capacity)
+    def get(
+        self,
+        dag: DAGRequest,
+        capacities,
+        group_capacity: int = DEFAULT_GROUP_CAPACITY,
+        join_capacity: int | None = None,
+    ) -> CompiledDAG:
+        if isinstance(capacities, int):
+            capacities = (capacities,)
+        capacities = tuple(capacities)
+        key = (dag.fingerprint(), capacities, group_capacity, join_capacity)
         prog = self._cache.get(key)
         if prog is None:
-            prog = build_program(dag, capacity, group_capacity)
+            prog = build_program(dag, capacities, group_capacity, join_capacity)
             self._cache[key] = prog
         return prog
 

@@ -95,6 +95,42 @@ class Aggregation:
 
 
 @dataclass(frozen=True)
+class Join:
+    """Equi hash join (ref: tipb.Join; unistore/cophandler/mpp_exec.go:844
+    joinExec; root-side design pkg/executor/join/hash_join_v2.go:658).
+
+    The enclosing pipeline is the PROBE side (preserved by left_outer, like
+    the reference's probe stream); `build` is a scan-first sub-pipeline for
+    the build side — its scans consume the request's broadcast aux batches
+    (the TiFlash broadcast-exchange analog, mpp_exec.go:669 Broadcast mode).
+    Output schema: probe columns ++ build columns (semi/anti: probe only).
+
+    Key expressions must agree in eval class/scale/signedness between the
+    two sides — the planner inserts casts, as the reference's hash join
+    requires identical key types (join key normalization in planner core).
+    """
+
+    build: tuple  # tuple[executor, ...] — scan-first build pipeline
+    probe_keys: tuple  # tuple[Expr, ...] over the probe schema
+    build_keys: tuple  # tuple[Expr, ...] over the build schema
+    join_type: str = "inner"  # inner | left_outer | semi | anti
+
+    def __post_init__(self):
+        if self.join_type not in ("inner", "left_outer", "semi", "anti"):
+            raise ValueError(f"unknown join type {self.join_type!r}")
+        if len(self.probe_keys) != len(self.build_keys):
+            raise ValueError("join key arity mismatch")
+
+    def fingerprint(self):
+        return (
+            ("join", self.join_type)
+            + tuple(e.fingerprint() for e in self.build)
+            + ("pk",) + tuple(k.fingerprint() for k in self.probe_keys)
+            + ("bk",) + tuple(k.fingerprint() for k in self.build_keys)
+        )
+
+
+@dataclass(frozen=True)
 class TopN:
     """(ref: tipb.TopN; mpp_exec.go:526 topNExec)."""
 
@@ -152,6 +188,39 @@ def current_schema_fts(executors) -> list[FieldType]:
             fts = [e.ft for e in ex.exprs]
         elif isinstance(ex, Aggregation):
             fts = ex.output_fts()
+        elif isinstance(ex, Join):
+            if ex.join_type in ("semi", "anti"):
+                pass  # probe schema unchanged
+            else:
+                build_fts = current_schema_fts(ex.build)
+                if ex.join_type == "left_outer":
+                    build_fts = [f.clone_nullable() for f in build_fts]
+                fts = fts + build_fts
         else:
             raise TypeError(f"unknown executor {ex}")
     return fts
+
+
+def executor_walk(executors) -> list:
+    """Executors flattened in execution-summary order: scan first, a Join's
+    build pipeline entries before the Join itself — exactly the order the
+    fused program appends per-executor row counts."""
+    out = [executors[0]]
+    for ex in executors[1:]:
+        if isinstance(ex, Join):
+            out.extend(executor_walk(ex.build))
+        out.append(ex)
+    return out
+
+
+def collect_scans(executors) -> list[TableScan]:
+    """All TableScans in canonical order: pipeline order, recursing into a
+    Join's build side at the Join's position. Device batches (and oracle
+    chunks) are supplied in exactly this order."""
+    out: list[TableScan] = []
+    for ex in executors:
+        if isinstance(ex, TableScan):
+            out.append(ex)
+        elif isinstance(ex, Join):
+            out.extend(collect_scans(ex.build))
+    return out

@@ -18,7 +18,7 @@ from ..expr.agg import AggDesc
 from ..expr.eval_ref import RefEvaluator, compare, _truth
 from ..types import Datum, DatumKind, FieldType, MyDecimal, MyTime
 from .builder import DEFAULT_GROUP_CAPACITY, CompiledDAG, ProgramCache, build_program
-from .dag import Aggregation, DAGRequest, Limit, Projection, Selection, TableScan, TopN
+from .dag import Aggregation, DAGRequest, Join, Limit, Projection, Selection, TableScan, TopN, current_schema_fts
 
 
 def _pow2(n: int) -> int:
@@ -60,20 +60,53 @@ def decode_outputs(packed, valid, out_fts) -> Chunk:
 DEFAULT_PROGRAM_CACHE = ProgramCache()
 
 
-def drive_program(cache: ProgramCache, dag: DAGRequest, batch, group_capacity: int, max_retries: int = 3):
-    """Run the fused program, growing group capacity on overflow
+def drive_program(cache: ProgramCache, dag: DAGRequest, batches, group_capacity: int, max_retries: int = 3, join_capacity: int | None = None):
+    """Run the fused program, growing group/join capacity on overflow
     (the single overflow-retry contract — store and host driver share it).
 
+    batches: one DeviceBatch per scan in canonical order (dag.collect_scans)
+    — a single batch is accepted for single-scan DAGs.
     Returns (chunk, per-executor produced-row counts, scan first)."""
+    if not isinstance(batches, (list, tuple)):
+        batches = [batches]
+    caps = tuple(b.capacity for b in batches)
     gc = group_capacity
+    jc = join_capacity or max(caps)
     for _ in range(max_retries + 1):
-        prog = cache.get(dag, batch.capacity, gc)
-        packed, valid, n, overflow, ex_rows = prog.fn(batch)
+        prog = cache.get(dag, caps, gc, jc)
+        packed, valid, n, overflow, ex_rows = prog.fn(*batches)
         if not bool(overflow):
             counts = [int(x) for x in np.asarray(ex_rows)]
             return decode_outputs(packed, valid, prog.out_fts), counts
         gc *= 4  # group/join capacity exceeded: recompile bigger
-    raise RuntimeError("DAG overflow not resolved after retries")
+        jc *= 4
+    raise OverflowRetryError("DAG overflow not resolved after retries")
+
+
+class OverflowRetryError(RuntimeError):
+    """Capacity growth retries exhausted; caller may fall back to the
+    row-at-a-time oracle (the host fallback SURVEY §7 promises)."""
+
+
+def run_dag_on_chunks(
+    dag: DAGRequest,
+    chunks: list,
+    cache: ProgramCache | None = None,
+    group_capacity: int = DEFAULT_GROUP_CAPACITY,
+    max_retries: int = 3,
+    oracle_fallback: bool = True,
+) -> Chunk:
+    """Device path over one chunk per scan; falls back to the reference
+    evaluator when capacity retries are exhausted (degenerate fan-out)."""
+    cache = cache or DEFAULT_PROGRAM_CACHE
+    batches = [to_device_batch(c, capacity=_pow2(max(c.num_rows(), 1))) for c in chunks]
+    try:
+        return drive_program(cache, dag, batches, group_capacity, max_retries)[0]
+    except OverflowRetryError:
+        if not oracle_fallback:
+            raise
+        rows = run_dag_reference(dag, chunks)
+        return Chunk.from_rows(dag.output_fts(), rows)
 
 
 def run_dag_on_chunk(
@@ -159,18 +192,7 @@ class _RefAgg:
             return
         self.count += 1
         if name in ("sum", "avg"):
-            if self.sum is None:
-                if a.kind in (DatumKind.Float64, DatumKind.Float32):
-                    self.sum = float(a.val)
-                elif a.kind == DatumKind.MysqlDecimal:
-                    self.sum = a.val
-                else:
-                    self.sum = MyDecimal(a.val, 0)
-            else:
-                if isinstance(self.sum, float):
-                    self.sum += float(a.val)
-                else:
-                    self.sum = self.sum + (a.val if a.kind == DatumKind.MysqlDecimal else MyDecimal(a.val, 0))
+            self._add_sum(a)
         elif name in ("min", "max"):
             if self.extreme is None:
                 self.extreme = a
@@ -180,6 +202,73 @@ class _RefAgg:
                     self.extreme = a
         else:
             raise NotImplementedError(name)
+
+    def _add_sum(self, a: Datum):
+        if self.sum is None:
+            if a.kind in (DatumKind.Float64, DatumKind.Float32):
+                self.sum = float(a.val)
+            elif a.kind == DatumKind.MysqlDecimal:
+                self.sum = a.val
+            else:
+                self.sum = MyDecimal(a.val, 0)
+        else:
+            if isinstance(self.sum, float):
+                self.sum += float(a.val)
+            else:
+                self.sum = self.sum + (a.val if a.kind == DatumKind.MysqlDecimal else MyDecimal(a.val, 0))
+
+    def merge_update(self, args: list[Datum]):
+        """Consume partial-state columns (Partial2/Final modes) — the state
+        schemas of expr/agg.py (ref: aggfuncs MergePartialResult)."""
+        name = self.d.name
+        if self.seen is not None and name in ("count", "sum", "avg"):
+            raise NotImplementedError("DISTINCT partials are not mergeable")
+        if name == "count":
+            if not args[0].is_null():
+                self.count += int(args[0].val)
+            return
+        if name == "avg":
+            c, s = args
+            if not c.is_null():
+                self.count += int(c.val)
+            if not s.is_null():
+                self._add_sum(s)
+            return
+        if name == "sum":
+            if not args[0].is_null():
+                self.count += 1
+                self._add_sum(args[0])
+            return
+        if name == "first_row":
+            has, val = args
+            if not has.is_null() and int(has.val) > 0 and not self.has_first:
+                self.first, self.has_first = val, True
+            return
+        # min/max/bit_*: state column == value column, same combine
+        self.update(args)
+
+    def partial_result(self) -> list[Datum]:
+        """Emit this accumulator's partial-state columns (Partial1 mode)."""
+        name = self.d.name
+        pf = self.d.partial_fts()
+        if name == "count":
+            return [Datum.i64(self.count)]
+        if name == "sum":
+            return [self._sum_datum(pf[0])]
+        if name == "avg":
+            return [Datum.i64(self.count), self._sum_datum(pf[1])]
+        if name in ("min", "max"):
+            return [self.extreme if self.extreme is not None else Datum.NULL]
+        if name == "first_row":
+            return [Datum.i64(1 if self.has_first else 0), self.first if self.has_first else Datum.NULL]
+        return [self.result()]  # bit_*: state == result
+
+    def _sum_datum(self, ft: FieldType) -> Datum:
+        if self.sum is None:
+            return Datum.NULL
+        if isinstance(self.sum, float):
+            return Datum.f64(self.sum)
+        return Datum.dec(self.sum.round(max(ft.decimal, 0)))
 
     def result(self) -> Datum:
         name = self.d.name
@@ -210,10 +299,22 @@ class _RefAgg:
         raise NotImplementedError(name)
 
 
-def run_dag_reference(dag: DAGRequest, chunk: Chunk) -> list[list[Datum]]:
+def run_dag_reference(dag: DAGRequest, chunks) -> list[list[Datum]]:
+    """Row-at-a-time oracle over one chunk per scan (canonical order);
+    accepts a bare Chunk for single-scan DAGs."""
+    if isinstance(chunks, Chunk):
+        chunks = [chunks]
     ev = RefEvaluator()
+    cursor = [0]
+    rows = _ref_pipeline(dag.executors, chunks, cursor, ev)
+    return [[r[i] for i in dag.output_offsets] for r in rows]
+
+
+def _ref_pipeline(executors, chunks, cursor, ev) -> list[list[Datum]]:
+    chunk = chunks[cursor[0]]
+    cursor[0] += 1
     rows = chunk.rows()
-    for ex in dag.executors[1:]:
+    for ex in executors[1:]:
         if isinstance(ex, Selection):
             rows = [r for r in rows if all(_truth(ev.eval(c, r)) for c in ex.conditions)]
         elif isinstance(ex, Projection):
@@ -239,8 +340,9 @@ def run_dag_reference(dag: DAGRequest, chunk: Chunk) -> list[list[Datum]]:
                 return 0
 
             rows = sorted(rows, key=functools.cmp_to_key(cmp_rows))[: ex.limit]
+        elif isinstance(ex, Join):
+            rows = _ref_join(ex, rows, chunks, cursor, ev)
         elif isinstance(ex, Aggregation):
-            assert not ex.partial and not ex.merge, "oracle runs Complete mode"
             groups: dict = {}
             order: list = []
             for r in rows:
@@ -250,7 +352,11 @@ def run_dag_reference(dag: DAGRequest, chunk: Chunk) -> list[list[Datum]]:
                     order.append(key)
                 accs, _ = groups[key]
                 for acc, a in zip(accs, ex.aggs):
-                    acc.update([ev.eval(x, r) for x in a.args])
+                    args = [ev.eval(x, r) for x in a.args]
+                    if ex.merge:
+                        acc.merge_update(args)
+                    else:
+                        acc.update(args)
             if not ex.group_by:
                 if not rows:
                     groups[()] = ([_RefAgg(a) for a in ex.aggs], [])
@@ -258,7 +364,53 @@ def run_dag_reference(dag: DAGRequest, chunk: Chunk) -> list[list[Datum]]:
             rows = []
             for key in order:
                 accs, gvals = groups[key]
-                rows.append([acc.result() for acc in accs] + gvals)
+                out: list[Datum] = []
+                for acc in accs:
+                    if ex.partial:
+                        out.extend(acc.partial_result())
+                    else:
+                        out.append(acc.result())
+                rows.append(out + gvals)
         else:
             raise TypeError(f"unsupported executor {ex}")
-    return [[r[i] for i in dag.output_offsets] for r in rows]
+    return rows
+
+
+def _ref_join(ex: Join, probe_rows, chunks, cursor, ev) -> list[list[Datum]]:
+    """Hash-join oracle (ref: mpp_exec.go:844 joinExec — build a key map,
+    probe row by row; NULL keys never match)."""
+    build_rows = _ref_pipeline(ex.build, chunks, cursor, ev)
+    nb_cols = len(current_schema_fts(ex.build))
+
+    def key_of(row, exprs):
+        ds = [ev.eval(k, row) for k in exprs]
+        if any(d.is_null() for d in ds):
+            return None
+        return tuple(datum_group_key(d) for d in ds)
+
+    table: dict = {}
+    for br in build_rows:
+        k = key_of(br, ex.build_keys)
+        if k is not None:
+            table.setdefault(k, []).append(br)
+
+    out: list[list[Datum]] = []
+    for pr in probe_rows:
+        k = key_of(pr, ex.probe_keys)
+        matches = table.get(k, []) if k is not None else []
+        if ex.join_type == "inner":
+            out.extend(pr + br for br in matches)
+        elif ex.join_type == "left_outer":
+            if matches:
+                out.extend(pr + br for br in matches)
+            else:
+                out.append(pr + [Datum.NULL] * nb_cols)
+        elif ex.join_type == "semi":
+            if matches:
+                out.append(pr)
+        elif ex.join_type == "anti":
+            if not matches:
+                out.append(pr)
+        else:
+            raise TypeError(f"unknown join type {ex.join_type}")
+    return out

@@ -24,7 +24,7 @@ from ..codec import tablecodec
 from ..codec.rowcodec import RowEncoder, decode_row_to_datum_map
 from ..exec.builder import DEFAULT_GROUP_CAPACITY, ProgramCache
 from ..exec.dag import DAGRequest
-from ..exec.executor import drive_program, _pow2
+from ..exec.executor import OverflowRetryError, drive_program, run_dag_reference, _pow2
 from ..types import Datum
 from .kv import MemKV
 from .region import Cluster, Region
@@ -40,13 +40,19 @@ class KeyRange:
 
 @dataclass
 class CopRequest:
-    """(ref: coprocessor.Request: tp=DAG, data, ranges, start_ts)."""
+    """(ref: coprocessor.Request: tp=DAG, data, ranges, start_ts).
+
+    aux_chunks: broadcast operands for the DAG's join build sides, one per
+    non-probe scan in canonical order (the TiFlash broadcast-exchange analog
+    — ref: mpp_exec.go:669 Broadcast partition mode). Every region task of a
+    broadcast join carries the same chunks; the device upload is shared."""
 
     dag: DAGRequest
     ranges: list
     start_ts: int
     region_id: int = 0
     region_epoch: int = 0
+    aux_chunks: list = field(default_factory=list)
 
 
 @dataclass
@@ -77,6 +83,7 @@ class TPUStore:
         self._write_ver = 0
         self._chunk_cache: dict = {}
         self._batch_cache: dict = {}
+        self._aux_batch_cache: dict = {}  # id(chunk) -> DeviceBatch (broadcast reuse)
         self._row_encoder = RowEncoder()
 
     # -- write path (ref: table.AddRecord -> memdb -> prewrite/commit) ------
@@ -159,6 +166,26 @@ class TPUStore:
         self._batch_cache[bkey] = batch
         return batch
 
+    _AUX_CACHE_MAX = 16
+
+    def _aux_batch(self, chunk: Chunk) -> DeviceBatch:
+        """Broadcast build-side chunk -> DeviceBatch, uploaded once per
+        chunk object (all region tasks of a join share the operand).
+
+        Bounded LRU: a long-lived store must not pin HBM for every build
+        side ever joined (the chunk ref also keeps the id() key valid)."""
+        key = id(chunk)
+        cached = self._aux_batch_cache.get(key)
+        if cached is not None and cached[0] is chunk:
+            self._aux_batch_cache.pop(key)  # refresh LRU position
+            self._aux_batch_cache[key] = cached
+            return cached[1]
+        batch = to_device_batch(chunk, capacity=_pow2(max(chunk.num_rows(), 1)))
+        self._aux_batch_cache[key] = (chunk, batch)
+        while len(self._aux_batch_cache) > self._AUX_CACHE_MAX:
+            self._aux_batch_cache.pop(next(iter(self._aux_batch_cache)))
+        return batch
+
     # -- the coprocessor endpoint -------------------------------------------
     def coprocessor(self, req: CopRequest, group_capacity: int = DEFAULT_GROUP_CAPACITY) -> CopResponse:
         region = self.cluster.region_by_id(req.region_id)
@@ -168,9 +195,21 @@ class TPUStore:
             return CopResponse(region_error=f"epoch_not_match: have {region.epoch}, got {req.region_epoch}")
         t0 = time.monotonic_ns()
         batch = self.region_device_batch(region, req.ranges, req.dag, req.start_ts)
+        batches = [batch] + [self._aux_batch(c) for c in req.aux_chunks]
         try:
-            chunk, ex_rows = drive_program(self.programs, req.dag, batch, group_capacity)
-        except RuntimeError as exc:
+            chunk, ex_rows = drive_program(self.programs, req.dag, batches, group_capacity)
+        except OverflowRetryError:
+            # degenerate fan-out: fall back to the row-at-a-time oracle
+            # (the host fallback SURVEY §7 / exec/builder.py promise)
+            from ..exec.dag import executor_walk
+
+            region_chunk = self.region_chunk(region, req.ranges, req.dag, req.start_ts)
+            rows = run_dag_reference(req.dag, [region_chunk] + list(req.aux_chunks))
+            chunk = Chunk.from_rows(req.dag.output_fts(), rows)
+            # fallback summaries: aligned with the device path's per-executor
+            # walk (build pipelines included); counts are the final row count
+            ex_rows = [chunk.num_rows()] * len(executor_walk(req.dag.executors))
+        except (RuntimeError, TypeError, NotImplementedError) as exc:
             return CopResponse(other_error=str(exc))
         elapsed = time.monotonic_ns() - t0
         # per-executor produced-row counts are real (measured inside the

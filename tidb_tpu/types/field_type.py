@@ -158,6 +158,10 @@ class FieldType:
     def clone(self) -> "FieldType":
         return FieldType(self.tp, self.flag, self.flen, self.decimal, self.charset, self.collate, self.elems)
 
+    def clone_nullable(self) -> "FieldType":
+        """Copy with NotNull dropped (outer-join null extension)."""
+        return FieldType(self.tp, self.flag & ~Flag.NotNull, self.flen, self.decimal, self.charset, self.collate, self.elems)
+
     def __hash__(self):
         return hash((self.tp, int(self.flag), self.flen, self.decimal, self.collate))
 
