@@ -83,9 +83,9 @@ def bench_device(device, n: int, iters: int, warmup: int = 2) -> float:
         jax.block_until_ready(out)
         dt = time.perf_counter() - t0
         # sanity: count aggregate > 0
-        packed, valid, n_rows, overflow, _ex_rows = out
+        packed, valid, n_rows, (g_ovf, j_ovf), _ex_rows = out
         cnt = int(np.asarray(packed[1][0])[0])
-        assert cnt > 0 and not bool(overflow), (cnt, bool(overflow))
+        assert cnt > 0 and not bool(g_ovf) and not bool(j_ovf), (cnt,)
         return n * iters / dt
 
 

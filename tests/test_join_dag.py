@@ -119,6 +119,26 @@ class TestJoinTypes:
             run_dag_on_chunks(dag, [lch, och])
 
 
+def test_join_max_key_vs_null_collision():
+    """A legitimate BIGINT-max join key must not collide with the +max mask
+    used for NULL-key build rows (regression: unusable rows must sort
+    strictly after usable rows of the max-key run)."""
+    fts = [new_longlong()]
+    mx = (1 << 63) - 1
+    brows = [[Datum.NULL], [Datum.i64(mx)], [Datum.NULL], [Datum.i64(5)]]
+    prows = [[Datum.i64(mx)], [Datum.i64(5)], [Datum.NULL]]
+    pch, bch = Chunk.from_rows(fts, prows), Chunk.from_rows(fts, brows)
+    ps = TableScan(1, (ColumnInfo(1, fts[0]),))
+    bs = TableScan(2, (ColumnInfo(1, fts[0]),))
+    for jt in ("inner", "left_outer", "semi", "anti"):
+        join = Join(build=(bs,), probe_keys=(col(0, fts[0]),), build_keys=(col(0, fts[0]),), join_type=jt)
+        offs = (0, 1) if jt in ("inner", "left_outer") else (0,)
+        dag = DAGRequest((ps, join), output_offsets=offs)
+        dev = run_dag_on_chunks(dag, [pch, bch])
+        ref = run_dag_reference(dag, [pch, bch])
+        assert canon(dev.rows()) == canon(ref), jt
+
+
 def test_overflow_oracle_fallback():
     """Degenerate fan-out (all keys equal) exhausts capacity retries and
     transparently falls back to the row-at-a-time oracle."""

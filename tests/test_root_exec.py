@@ -1,0 +1,189 @@
+"""Root executor (VERDICT next #4): a logical Complete-mode DAG splits into
+per-region Partial1 + root Final merge invisibly; per-region TopN/Limit are
+re-applied globally. Every test compares against the single-shot oracle over
+all rows — the merge must be caller-invisible."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.codec import tablecodec
+from tidb_tpu.distsql import execute_root, full_table_ranges, split_dag
+from tidb_tpu.exec import (
+    Aggregation,
+    ColumnInfo,
+    DAGRequest,
+    Join,
+    Limit,
+    Selection,
+    TableScan,
+    TopN,
+    run_dag_reference,
+)
+from tidb_tpu.exec.executor import datum_group_key
+from tidb_tpu.expr import AggDesc, col, func, lit
+from tidb_tpu.store import TPUStore
+from tidb_tpu.types import Datum, MyDecimal, new_decimal, new_longlong, new_varchar
+
+BOOL = new_longlong(notnull=True)
+TID = 77
+FTS = [new_longlong(), new_decimal(10, 2), new_varchar(8), new_longlong(unsigned=True)]
+C = lambda i: col(i, FTS[i])
+
+
+def canon(rows):
+    return sorted(tuple(datum_group_key(d) for d in r) for r in rows)
+
+
+def fill_store(n=260, regions=4, seed=11, null_p=0.05):
+    store = TPUStore()
+    rng = np.random.default_rng(seed)
+    rows = []
+    words = ["ox", "ant", "bee", "Cat", "dog", ""]
+    for h in range(n):
+        def maybe(d):
+            return Datum.NULL if rng.random() < null_p else d
+
+        row = [
+            maybe(Datum.i64(int(rng.integers(0, 7)))),
+            maybe(Datum.dec(MyDecimal(f"{int(rng.integers(-9999, 9999))/100:.2f}"))),
+            maybe(Datum.string(words[int(rng.integers(len(words)))])),
+            maybe(Datum.u64(int(rng.integers(0, 1 << 62)))),
+        ]
+        rows.append(row)
+        store.put_row(TID, h, [1, 2, 3, 4], row, ts=10)
+    for i in range(1, regions):
+        store.cluster.split(tablecodec.encode_row_key(TID, i * n // regions))
+    return store, rows
+
+
+def scan():
+    return TableScan(TID, tuple(ColumnInfo(i + 1, ft) for i, ft in enumerate(FTS)))
+
+
+def check(store, rows, dag, sort=True):
+    got = execute_root(store, dag, full_table_ranges(TID), start_ts=100)
+    want = run_dag_reference(dag, Chunk.from_rows(FTS, rows))
+    if sort:
+        assert canon(got.rows()) == canon(want)
+    else:
+        g = [tuple(datum_group_key(d) for d in r) for r in got.rows()]
+        w = [tuple(datum_group_key(d) for d in r) for r in want]
+        assert g == w, f"\ngot ={g[:4]}\nwant={w[:4]}"
+    return got
+
+
+class TestRootExecutor:
+    def test_grouped_agg_split(self):
+        store, rows = fill_store()
+        agg = Aggregation(
+            group_by=(C(0), C(2)),
+            aggs=(
+                AggDesc("count", ()),
+                AggDesc("sum", (C(1),)),
+                AggDesc("avg", (C(1),)),
+                AggDesc("min", (C(2),)),       # string min via gather state
+                AggDesc("max", (C(3),)),       # unsigned max
+                AggDesc("first_row", (C(1),)),
+            ),
+        )
+        dag = DAGRequest((scan(), agg), output_offsets=tuple(range(8)))
+        plan = split_dag(dag)
+        assert plan.root_dag is not None and plan.push_dag.executors[-1].partial
+        check(store, rows, dag)
+
+    def test_scalar_agg_split(self):
+        store, rows = fill_store(n=150, regions=3)
+        agg = Aggregation(group_by=(), aggs=(AggDesc("count", ()), AggDesc("sum", (C(1),)), AggDesc("min", (C(1),))))
+        dag = DAGRequest((scan(), agg), output_offsets=(0, 1, 2))
+        check(store, rows, dag)
+
+    def test_multi_region_topn_reapplied(self):
+        """Per-region TopN concatenation is NOT the global TopN — the root
+        must re-apply (VERDICT weak #5)."""
+        store, rows = fill_store(n=200, regions=4)
+        t = TopN(order_by=((C(1), True), (C(0), False)), limit=7)
+        dag = DAGRequest((scan(), t), output_offsets=(0, 1, 2))
+        got = check(store, rows, dag, sort=False)
+        assert got.num_rows() == 7
+
+    def test_multi_region_limit_reapplied(self):
+        store, rows = fill_store(n=120, regions=3)
+        dag = DAGRequest((scan(), Limit(10)), output_offsets=(0, 1))
+        got = execute_root(store, dag, full_table_ranges(TID), start_ts=100)
+        assert got.num_rows() == 10
+        # rows must come from the table (limit over unordered scan is any-10)
+        table = {tuple(datum_group_key(d) for d in (r[0], r[1])) for r in rows}
+        for r in got.rows():
+            assert tuple(datum_group_key(d) for d in r) in table
+
+    def test_distinct_agg_runs_at_root(self):
+        store, rows = fill_store(n=180, regions=3)
+        agg = Aggregation(group_by=(C(0),), aggs=(AggDesc("count", (C(1),), distinct=True), AggDesc("sum", (C(1),))))
+        dag = DAGRequest((scan(), agg), output_offsets=(0, 1, 2))
+        plan = split_dag(dag)
+        assert plan.push_dag.executors[-1] is plan.push_dag.executors[0] or not isinstance(plan.push_dag.executors[-1], Aggregation)
+        check(store, rows, dag)
+
+    def test_having_after_agg(self):
+        """Selection after the aggregation (HAVING) runs at root over the
+        merged finals."""
+        store, rows = fill_store(n=200, regions=4)
+        agg = Aggregation(group_by=(C(0),), aggs=(AggDesc("count", ()), AggDesc("sum", (C(1),))))
+        having = Selection((func("gt", BOOL, col(0, agg.aggs[0].ft), lit(20, new_longlong())),))
+        t = TopN(order_by=((col(1, agg.aggs[1].ft), True),), limit=3)
+        dag = DAGRequest((scan(), agg, having, t), output_offsets=(0, 1, 2))
+        check(store, rows, dag, sort=False)
+
+    def test_selection_then_agg(self):
+        store, rows = fill_store(n=220, regions=4)
+        sel = Selection((func("ge", BOOL, C(1), lit("0.00", new_decimal(3, 2))),))
+        agg = Aggregation(group_by=(C(2),), aggs=(AggDesc("avg", (C(1),)), AggDesc("count", ())))
+        dag = DAGRequest((scan(), sel, agg), output_offsets=(0, 1, 2))
+        check(store, rows, dag)
+
+    def test_plain_scan_no_root(self):
+        store, rows = fill_store(n=90, regions=3)
+        dag = DAGRequest((scan(), Selection((func("isnull", BOOL, C(2)),))), output_offsets=(0, 2))
+        plan = split_dag(dag)
+        assert plan.root_dag is None
+        check(store, rows, dag)
+
+    def test_empty_table(self):
+        store = TPUStore()
+        agg = Aggregation(group_by=(), aggs=(AggDesc("count", ()),))
+        dag = DAGRequest((scan(), agg), output_offsets=(0,))
+        got = execute_root(store, dag, full_table_ranges(TID), start_ts=100)
+        assert got.num_rows() == 1 and got.row(0)[0].val == 0
+
+
+def test_q3_via_root_executor():
+    """The hand-rolled Q3 merge from test_join_dag, now through the generic
+    root executor: logical DAG in, globally-correct TopN out."""
+    import tests.test_join_dag as J
+
+    lrows, orows, crows = J.make_tables(nl=300, no=60, nc=20)
+    store = TPUStore()
+    for h, r in enumerate(lrows):
+        store.put_row(1, h, [1, 2, 3, 4], r, ts=10)
+    for h, r in enumerate(orows):
+        store.put_row(2, h, [1, 2, 3, 4], r, ts=10)
+    for h, r in enumerate(crows):
+        store.put_row(3, h, [1, 2], r, ts=10)
+    for frac in (1, 2):
+        store.cluster.split(tablecodec.encode_row_key(1, frac * 100))
+
+    from tidb_tpu.distsql import KVRequest, select
+
+    ls, os_, cs = J.scans()
+    och = select(store, KVRequest(DAGRequest((os_,), output_offsets=tuple(range(4))), full_table_ranges(2), start_ts=100)).merged()
+    cch = select(store, KVRequest(DAGRequest((cs,), output_offsets=tuple(range(2))), full_table_ranges(3), start_ts=100)).merged()
+
+    base = J.q3_dag(partial=False)
+    topn = TopN(order_by=((col(0, base.executors[-1].aggs[0].ft), True),), limit=10)
+    dag = DAGRequest(base.executors + (topn,), output_offsets=base.output_offsets)
+    got = execute_root(store, dag, full_table_ranges(1), start_ts=100, aux_chunks=[och, cch])
+    want = run_dag_reference(dag, [Chunk.from_rows(J.LFTS, lrows), Chunk.from_rows(J.OFTS, orows), Chunk.from_rows(J.CFTS, crows)])
+    got_rev = sorted(str(r[0].val) for r in got.rows())
+    want_rev = sorted(str(r[0].val) for r in want)
+    assert got_rev == want_rev

@@ -1,3 +1,13 @@
-from .dispatch import KVRequest, SelectResult, select, full_table_ranges
+from .dispatch import KVRequest, SelectResult, select, full_table_ranges, handle_ranges
+from .root import RootPlan, execute_root, split_dag
 
-__all__ = ["KVRequest", "SelectResult", "select", "full_table_ranges"]
+__all__ = [
+    "KVRequest",
+    "SelectResult",
+    "select",
+    "full_table_ranges",
+    "handle_ranges",
+    "RootPlan",
+    "execute_root",
+    "split_dag",
+]

@@ -1,0 +1,119 @@
+"""Root executor: split a logical DAG into a per-region pushdown plan and a
+root merge plan, dispatch, and merge — the component the reference spreads
+over physical-plan task splitting and the root executors
+(ref: pkg/planner/core finishCopTask / PhysicalHashAgg partial-final split;
+root merge pkg/executor/aggregate/agg_hash_executor.go:430; ordered result
+merge pkg/distsql/select_result.go:63).
+
+Split rules (first merge point wins; everything before it is row-local and
+pushes verbatim — scans, selections, projections, broadcast joins):
+
+  Aggregation  push Partial1, root runs the Final merge re-group; DISTINCT
+               aggregates are not decomposable -> whole agg stays at root
+               (ref: AggregationPushDownSolver skips distinct)
+  TopN         pushed per region AND re-applied at root (global top-k is
+               contained in the union of per-region top-k)
+  Limit        pushed per region and re-applied at root
+
+Executors after the merge point run at root unchanged: the Final merge
+reproduces the Complete aggregation's output schema, so HAVING selections,
+root TopN/Limit and output offsets apply as written.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from ..chunk import Chunk
+from ..exec.builder import DEFAULT_GROUP_CAPACITY, ProgramCache
+from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, Join, Limit, Projection, Selection, TableScan, TopN, current_schema_fts
+from ..exec.executor import run_dag_on_chunks
+from ..expr.agg import AggDesc, AggMode
+from ..expr.ir import col
+from .dispatch import KVRequest, SelectResult, select
+
+
+@dataclass
+class RootPlan:
+    """The two halves of a split plan. root_dag is None when the pushdown
+    result needs no root computation (plain scan shapes) — the per-region
+    chunks concatenate in task (range) order, which also serves keep_order."""
+
+    push_dag: DAGRequest
+    root_dag: DAGRequest | None
+
+
+def _merge_aggregation(agg: Aggregation) -> Aggregation:
+    """Build the root Final-merge Aggregation over the Partial1 output
+    schema [agg states..., group cols...]."""
+    merge_descs = []
+    idx = 0
+    for d in agg.aggs:
+        pf = d.partial_fts()
+        args = tuple(col(idx + i, pf[i]) for i in range(len(pf)))
+        idx += len(pf)
+        merge_descs.append(AggDesc(d.name, args, mode=AggMode.Final, distinct=d.distinct, ft=d.ft))
+    group_refs = tuple(col(idx + i, g.ft) for i, g in enumerate(agg.group_by))
+    return Aggregation(group_by=group_refs, aggs=tuple(merge_descs), merge=True)
+
+
+def split_dag(dag: DAGRequest) -> RootPlan:
+    executors = dag.executors
+    push: list = []
+    root: list = []
+    i = 0
+    while i < len(executors):
+        ex = executors[i]
+        if isinstance(ex, (TableScan, Selection, Projection, Join)):
+            push.append(ex)
+            i += 1
+            continue
+        if isinstance(ex, Aggregation):
+            if any(d.distinct for d in ex.aggs):
+                # not decomposable: aggregate wholly at root
+                root = list(executors[i:])
+            else:
+                push.append(replace(ex, partial=True))
+                root = [_merge_aggregation(ex)] + list(executors[i + 1 :])
+            break
+        if isinstance(ex, (TopN, Limit)):
+            push.append(ex)  # per-region pre-prune
+            root = list(executors[i:])  # re-apply globally, then the rest
+            break
+        raise TypeError(f"unknown executor {ex}")
+    push_fts = current_schema_fts(push)
+    push_dag = DAGRequest(tuple(push), output_offsets=tuple(range(len(push_fts))), time_zone=dag.time_zone, flags=dag.flags)
+    if not root:
+        # fully pushable: apply the original offsets region-side
+        return RootPlan(replace(push_dag, output_offsets=dag.output_offsets), None)
+    virtual_scan = TableScan(0, tuple(ColumnInfo(-100 - i, ft) for i, ft in enumerate(push_fts)))
+    root_dag = DAGRequest((virtual_scan, *root), output_offsets=dag.output_offsets, time_zone=dag.time_zone, flags=dag.flags)
+    return RootPlan(push_dag, root_dag)
+
+
+def execute_root(
+    store,
+    dag: DAGRequest,
+    ranges: list,
+    start_ts: int,
+    aux_chunks: list | None = None,
+    concurrency: int = 4,
+    cache: ProgramCache | None = None,
+    group_capacity: int = DEFAULT_GROUP_CAPACITY,
+) -> Chunk:
+    """Run a logical (Complete-mode) DAG over the store: split, dispatch the
+    pushdown half per region, merge at root. The caller-visible result is
+    identical to running the whole DAG over all rows at once."""
+    plan = split_dag(dag)
+    res: SelectResult = select(
+        store,
+        KVRequest(plan.push_dag, ranges, start_ts, concurrency=concurrency, aux_chunks=aux_chunks or []),
+    )
+    merged = res.merged()
+    if merged is None:
+        merged = Chunk.empty(plan.push_dag.output_fts())
+    if plan.root_dag is None:
+        return merged
+    # run_dag_on_chunks has the oracle fallback — a root merge whose group
+    # count outgrows every capacity retry degrades, not crashes
+    return run_dag_on_chunks(plan.root_dag, [merged], cache=cache, group_capacity=group_capacity)

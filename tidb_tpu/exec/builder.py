@@ -54,10 +54,15 @@ class CompiledDAG:
 
 
 class _TraceState:
-    """Mutable trace-time accumulators shared across nested pipelines."""
+    """Mutable trace-time accumulators shared across nested pipelines.
+
+    Group and join overflow are SEPARATE flags so the retry driver grows
+    only the capacity that actually overflowed (a 4x-per-retry growth on
+    the wrong knob wastes HBM and compile time)."""
 
     def __init__(self):
-        self.overflow = jnp.bool_(False)
+        self.group_overflow = jnp.bool_(False)
+        self.join_overflow = jnp.bool_(False)
         self.ex_rows: list = []
 
 
@@ -101,7 +106,7 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
             pkeys = comp.run(list(ex.probe_keys), cols)
             _check_join_key_types(pkeys, bkeys)
             res = hash_join(bkeys, pkeys, bvalid, valid, join_capacity, ex.join_type)
-            state.overflow = state.overflow | res.overflow
+            state.join_overflow = state.join_overflow | res.overflow
             if ex.join_type in ("semi", "anti"):
                 # probe schema preserved, rows filtered by match-existence
                 valid = res.out_valid
@@ -129,7 +134,7 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
             new_cols: list[CompVal] = []
             if ex.group_by:
                 res = group_aggregate(gvals, aggs, valid, group_capacity, merge=ex.merge)
-                state.overflow = state.overflow | res.overflow
+                state.group_overflow = state.group_overflow | res.overflow
                 for (a, av), st in zip(aggs, res.states):
                     new_cols.extend(_agg_result_cols(a, av, st, res.group_valid, ex.partial))
                 new_cols.extend(_gather(gvals, res.group_rep))
@@ -190,7 +195,7 @@ def build_program(
                 packed.append((c.value, c.null, c.raw[0], c.raw[1]))
             else:
                 packed.append((c.value, c.null))
-        return packed, valid, valid.sum(), state.overflow, jnp.stack(state.ex_rows)
+        return packed, valid, valid.sum(), (state.group_overflow, state.join_overflow), jnp.stack(state.ex_rows)
 
     jit_fn = jax.jit(program)
     return CompiledDAG(jit_fn, dag.output_fts(), capacities, group_capacity, join_capacity)

@@ -74,12 +74,15 @@ def drive_program(cache: ProgramCache, dag: DAGRequest, batches, group_capacity:
     jc = join_capacity or max(caps)
     for _ in range(max_retries + 1):
         prog = cache.get(dag, caps, gc, jc)
-        packed, valid, n, overflow, ex_rows = prog.fn(*batches)
-        if not bool(overflow):
+        packed, valid, n, (g_ovf, j_ovf), ex_rows = prog.fn(*batches)
+        g_ovf, j_ovf = bool(g_ovf), bool(j_ovf)
+        if not g_ovf and not j_ovf:
             counts = [int(x) for x in np.asarray(ex_rows)]
             return decode_outputs(packed, valid, prog.out_fts), counts
-        gc *= 4  # group/join capacity exceeded: recompile bigger
-        jc *= 4
+        if g_ovf:
+            gc *= 4  # grow only the capacity that overflowed
+        if j_ovf:
+            jc *= 4
     raise OverflowRetryError("DAG overflow not resolved after retries")
 
 

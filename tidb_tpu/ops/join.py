@@ -68,15 +68,18 @@ def hash_join(
     I64_MAX = jnp.int64(0x7FFFFFFFFFFFFFFF)
 
     # Mask unusable (invalid / NULL-key) build rows to +max so the sorted
-    # array is globally ordered by key words alone — searchsorted needs that.
-    # Phantom matches against the masked tail are removed by clipping hi to
-    # nb_usable below.
+    # array is globally ordered by key words alone — searchsorted needs
+    # that. A LEGITIMATE +max key (BIGINT max, +inf) collides with the mask
+    # value, so an unusable-last tiebreak key forces every masked row behind
+    # the usable rows of the max-key run; all unusable rows then occupy
+    # exactly the tail positions [nb_usable, nb), which the hi clip below
+    # removes.
     def _maskmax(k):
         top = jnp.inf if jnp.issubdtype(k.dtype, jnp.floating) else I64_MAX
         return jnp.where(b_usable, k, top)
 
     bkeys = [_maskmax(k) for k in bkeys]
-    bperm = lexsort(bkeys)
+    bperm = lexsort(bkeys, extra_key=(~b_usable).astype(jnp.int64))
     bkeys_s = [k[bperm] for k in bkeys]
     nb_usable = b_usable.sum()
 

@@ -15,6 +15,7 @@ the distsql layer exercises the same retry/re-split path as the reference
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -84,6 +85,7 @@ class TPUStore:
         self._chunk_cache: dict = {}
         self._batch_cache: dict = {}
         self._aux_batch_cache: dict = {}  # id(chunk) -> DeviceBatch (broadcast reuse)
+        self._aux_lock = threading.Lock()  # select() fans tasks over threads
         self._row_encoder = RowEncoder()
 
     # -- write path (ref: table.AddRecord -> memdb -> prewrite/commit) ------
@@ -175,15 +177,17 @@ class TPUStore:
         Bounded LRU: a long-lived store must not pin HBM for every build
         side ever joined (the chunk ref also keeps the id() key valid)."""
         key = id(chunk)
-        cached = self._aux_batch_cache.get(key)
-        if cached is not None and cached[0] is chunk:
-            self._aux_batch_cache.pop(key)  # refresh LRU position
-            self._aux_batch_cache[key] = cached
-            return cached[1]
+        with self._aux_lock:
+            cached = self._aux_batch_cache.get(key)
+            if cached is not None and cached[0] is chunk:
+                self._aux_batch_cache.pop(key)  # refresh LRU position
+                self._aux_batch_cache[key] = cached
+                return cached[1]
         batch = to_device_batch(chunk, capacity=_pow2(max(chunk.num_rows(), 1)))
-        self._aux_batch_cache[key] = (chunk, batch)
-        while len(self._aux_batch_cache) > self._AUX_CACHE_MAX:
-            self._aux_batch_cache.pop(next(iter(self._aux_batch_cache)))
+        with self._aux_lock:
+            self._aux_batch_cache[key] = (chunk, batch)
+            while len(self._aux_batch_cache) > self._AUX_CACHE_MAX:
+                self._aux_batch_cache.pop(next(iter(self._aux_batch_cache)))
         return batch
 
     # -- the coprocessor endpoint -------------------------------------------
@@ -194,21 +198,25 @@ class TPUStore:
         if req.region_epoch != region.epoch:
             return CopResponse(region_error=f"epoch_not_match: have {region.epoch}, got {req.region_epoch}")
         t0 = time.monotonic_ns()
-        batch = self.region_device_batch(region, req.ranges, req.dag, req.start_ts)
-        batches = [batch] + [self._aux_batch(c) for c in req.aux_chunks]
         try:
+            batch = self.region_device_batch(region, req.ranges, req.dag, req.start_ts)
+            batches = [batch] + [self._aux_batch(c) for c in req.aux_chunks]
             chunk, ex_rows = drive_program(self.programs, req.dag, batches, group_capacity)
         except OverflowRetryError:
             # degenerate fan-out: fall back to the row-at-a-time oracle
             # (the host fallback SURVEY §7 / exec/builder.py promise)
-            from ..exec.dag import executor_walk
+            try:
+                from ..exec.dag import executor_walk
 
-            region_chunk = self.region_chunk(region, req.ranges, req.dag, req.start_ts)
-            rows = run_dag_reference(req.dag, [region_chunk] + list(req.aux_chunks))
-            chunk = Chunk.from_rows(req.dag.output_fts(), rows)
-            # fallback summaries: aligned with the device path's per-executor
-            # walk (build pipelines included); counts are the final row count
-            ex_rows = [chunk.num_rows()] * len(executor_walk(req.dag.executors))
+                region_chunk = self.region_chunk(region, req.ranges, req.dag, req.start_ts)
+                rows = run_dag_reference(req.dag, [region_chunk] + list(req.aux_chunks))
+                chunk = Chunk.from_rows(req.dag.output_fts(), rows)
+                # fallback summaries: aligned with the device path's
+                # per-executor walk (build pipelines included); counts are
+                # the final row count
+                ex_rows = [chunk.num_rows()] * len(executor_walk(req.dag.executors))
+            except (RuntimeError, TypeError, NotImplementedError, ValueError) as exc:
+                return CopResponse(other_error=f"oracle fallback failed: {exc}")
         except (RuntimeError, TypeError, NotImplementedError) as exc:
             return CopResponse(other_error=str(exc))
         elapsed = time.monotonic_ns() - t0
