@@ -26,8 +26,10 @@ Package map (mirrors reference layers, SURVEY.md §1):
              (ref: pkg/distsql, pkg/store/copr)
   parallel/  Mesh sharding, psum partial-agg merge, all_to_all exchange
              (ref: MPP — pkg/planner/core/fragment.go, cophandler/mpp_exec.go)
-  sql/       SQL front end: parser, planner, session, catalog
-             (ref: pkg/parser, pkg/planner, pkg/session)
+  parser/    Standalone MySQL-dialect lexer + recursive-descent parser -> AST
+             (ref: pkg/parser — a leaf package, like the reference's)
+  sql/       SQL front end: catalog, AST->DAG planner, session
+             (ref: pkg/infoschema+pkg/meta, pkg/planner, pkg/session)
 """
 
 import jax as _jax

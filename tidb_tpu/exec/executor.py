@@ -45,6 +45,26 @@ def decode_outputs(packed, valid, out_fts) -> Chunk:
                 if not null[j]:
                     blob[offs[j] : offs[j + 1]] = data[j, : length[j]]
             cols.append(Column(ft, None, null, offs, blob))
+        elif ft.is_string() and np.asarray(out[0]).ndim == 2:
+            # string column without raw bytes (e.g. CASE/IF over string
+            # operands): reconstruct from the packed compare words — covers
+            # the first STRING_WORDS*8 bytes, the packed-key contract
+            words, null = np.asarray(out[0]), np.asarray(out[1])
+            words, null = words[idx], null[idx]
+            w = words.shape[1] - 1
+            payload = (words[:, :w].astype(np.uint64) ^ np.uint64(1 << 63))
+            length = np.minimum(np.maximum(words[:, w], 0), w * 8).astype(np.int64)
+            length = np.where(null, 0, length)
+            byte_mat = np.zeros((len(idx), w * 8), np.uint8)
+            for k in range(w):
+                for b in range(8):
+                    byte_mat[:, k * 8 + b] = ((payload[:, k] >> np.uint64(56 - 8 * b)) & np.uint64(0xFF)).astype(np.uint8)
+            offs = np.zeros(len(idx) + 1, np.int64)
+            np.cumsum(length, out=offs[1:])
+            blob = np.zeros(int(offs[-1]), np.uint8)
+            for j in range(len(idx)):
+                blob[offs[j] : offs[j + 1]] = byte_mat[j, : length[j]]
+            cols.append(Column(ft, None, null.copy(), offs, blob))
         else:
             v, null = out
             v = np.asarray(v)[idx]

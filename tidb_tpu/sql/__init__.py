@@ -1,0 +1,16 @@
+from .catalog import Catalog, CatalogError, TableMeta, field_type_from_spec
+from .planner import PlanError, PlannedQuery, plan_select
+from .session import Result, Session, SQLError
+
+__all__ = [
+    "Catalog",
+    "CatalogError",
+    "TableMeta",
+    "field_type_from_spec",
+    "PlanError",
+    "PlannedQuery",
+    "plan_select",
+    "Result",
+    "Session",
+    "SQLError",
+]

@@ -1,0 +1,162 @@
+"""Catalog: table metadata keyed by name — the dict-backed infoschema/meta
+analog (ref: pkg/infoschema InfoSchema, pkg/meta/model TableInfo/ColumnInfo;
+schema versioning and the domain reload loop collapse to a monotonic version
+counter in one process).
+
+CREATE TABLE feeds this from the parsed AST; the planner resolves names
+through it; the session allocates row handles from its per-table autoid
+(ref: pkg/meta/autoid)."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+
+from ..parser import ast as A
+from ..types import Collation, FieldType, Flag, TypeCode, new_datetime, new_decimal, new_double, new_longlong, new_varchar
+
+
+class CatalogError(ValueError):
+    pass
+
+
+def field_type_from_spec(ts: A.TypeSpec, not_null: bool = False) -> FieldType:
+    """TypeSpec (DDL/CAST AST) -> FieldType (ref: pkg/parser/types -> tipb
+    ColumnInfo mapping in pkg/tablecodec)."""
+    name = ts.name
+    if name in ("tinyint", "smallint", "mediumint", "int", "bigint", "year", "bit"):
+        ft = new_longlong(unsigned=ts.unsigned or name == "bit", notnull=not_null)
+        return ft
+    if name in ("float", "double"):
+        return FieldType(TypeCode.Double, flag=Flag.NotNull if not_null else Flag(0))
+    if name == "decimal":
+        prec = ts.length if ts.length > 0 else 10
+        scale = ts.decimal if ts.decimal >= 0 else 0
+        ft = new_decimal(prec, scale)
+        if not_null:
+            ft = FieldType(ft.tp, ft.flag | Flag.NotNull, ft.flen, ft.decimal)
+        return ft
+    if name in ("char", "varchar", "binary", "varbinary", "text", "tinytext", "mediumtext", "longtext",
+                "blob", "tinyblob", "mediumblob", "longblob", "enum", "set", "json"):
+        flen = ts.length if ts.length > 0 else 255
+        ft = new_varchar(flen)
+        if not_null:
+            ft = FieldType(ft.tp, ft.flag | Flag.NotNull, ft.flen, ft.decimal, ft.charset, ft.collate)
+        return ft
+    if name in ("date", "datetime", "timestamp"):
+        fsp = ts.decimal if ts.decimal > 0 else 0
+        ft = new_datetime(fsp)
+        if not_null:
+            ft = FieldType(ft.tp, ft.flag | Flag.NotNull, ft.flen, ft.decimal)
+        return ft
+    if name == "time":  # duration stored as int64 nanoseconds
+        return new_longlong(notnull=not_null)
+    raise CatalogError(f"unsupported column type {name!r}")
+
+
+@dataclass
+class ColumnMeta:
+    name: str
+    col_id: int
+    ft: FieldType
+    default: object = None  # parsed AST default, evaluated at insert
+    auto_increment: bool = False
+
+
+@dataclass
+class IndexMeta:
+    """(ref: meta/model IndexInfo)."""
+
+    name: str
+    index_id: int
+    col_names: list
+    unique: bool = False
+
+
+@dataclass
+class TableMeta:
+    name: str
+    table_id: int
+    columns: list  # [ColumnMeta]
+    indices: list = field(default_factory=list)  # [IndexMeta]
+    handle_col: str | None = None  # integer PRIMARY KEY column used as row handle
+    _next_handle: itertools.count = None  # autoid allocator (ref: meta/autoid)
+    row_count: int = 0  # maintained by DML; the planner's only "statistic"
+
+    def __post_init__(self):
+        if self._next_handle is None:
+            self._next_handle = itertools.count(1)
+
+    def col(self, name: str) -> ColumnMeta:
+        for c in self.columns:
+            if c.name == name.lower():
+                return c
+        raise CatalogError(f"unknown column {name!r} in table {self.name!r}")
+
+    def col_ids(self) -> list:
+        return [c.col_id for c in self.columns]
+
+    def fts(self) -> list:
+        return [c.ft for c in self.columns]
+
+    def alloc_handle(self) -> int:
+        return next(self._next_handle)
+
+
+class Catalog:
+    """name -> TableMeta, with monotonically increasing table/index ids
+    (ref: infoschema; ids from meta's global id allocator)."""
+
+    def __init__(self):
+        self._tables: dict[str, TableMeta] = {}
+        self._next_id = itertools.count(1001)
+        self._lock = threading.Lock()
+        self.version = 0  # schema version (ref: domain schema lease)
+
+    def create_table(self, stmt: A.CreateTableStmt) -> TableMeta:
+        name = stmt.table.name.lower()
+        with self._lock:
+            if name in self._tables:
+                if stmt.if_not_exists:
+                    return self._tables[name]
+                raise CatalogError(f"table {name!r} already exists")
+            cols = []
+            handle_col = None
+            for i, cd in enumerate(stmt.columns):
+                ft = field_type_from_spec(cd.type, cd.not_null or cd.primary_key)
+                cols.append(ColumnMeta(cd.name.lower(), i + 1, ft, cd.default, cd.auto_increment))
+                if cd.primary_key and ft.is_int():
+                    handle_col = cd.name.lower()
+            indices = []
+            for j, idx in enumerate(getattr(stmt, "indexes", []) or []):
+                iname = getattr(idx, "name", "") or f"idx_{j}"
+                icols = [c[0].lower() if isinstance(c, tuple) else str(c).lower() for c in idx.columns]
+                if getattr(idx, "primary", False) and len(icols) == 1:
+                    c = next((c for c in cols if c.name == icols[0]), None)
+                    if c is not None and c.ft.is_int():
+                        handle_col = icols[0]
+                        continue
+                indices.append(IndexMeta(iname, next(self._next_id), icols, getattr(idx, "unique", False)))
+            tbl = TableMeta(name, next(self._next_id), cols, indices, handle_col)
+            self._tables[name] = tbl
+            self.version += 1
+            return tbl
+
+    def drop_table(self, name: str, if_exists: bool = False):
+        with self._lock:
+            if name.lower() not in self._tables:
+                if if_exists:
+                    return
+                raise CatalogError(f"unknown table {name!r}")
+            del self._tables[name.lower()]
+            self.version += 1
+
+    def table(self, name: str) -> TableMeta:
+        t = self._tables.get(name.lower())
+        if t is None:
+            raise CatalogError(f"unknown table {name!r}")
+        return t
+
+    def tables(self) -> list:
+        return sorted(self._tables)

@@ -1,0 +1,769 @@
+"""Planner: AST -> DAGRequest (ref: pkg/planner/optimize.go:135 Optimize ->
+logical rules -> physical plan -> plan_to_pb.go lowering — collapsed here
+into one direct lowering pass, because the engine's only physical form is
+the fused coprocessor DAG; the reference's pushdown DECISIONS live in
+distsql/root.py split_dag, its EXPRESSION serialization is the ir.Expr tree
+itself).
+
+What this pass does (reference rule analogs in parens):
+  - name resolution over the FROM tables (expression/column resolution)
+  - join planning: probe = largest table by row count, greedy equi-join
+    chaining (JoinReOrderSolver's greedy variant); per-table conjuncts push
+    into each side's pipeline (PPDSolver)
+  - aggregation planning incl. implicit first_row for bare columns and
+    DISTINCT -> group-by rewrite (AggregationEliminator family)
+  - HAVING/ORDER BY resolution against the agg output schema with alias
+    support; ORDER BY+LIMIT -> TopN (PushDownTopNOptimizer's shape)
+  - select-list projection / output offsets
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, Join, Limit, Projection, Selection, TableScan, TopN
+from ..expr.agg import AGG_FUNCS, AggDesc
+from ..expr.ir import Expr, col, func, lit
+from ..parser import ast as A
+from ..types import Datum, FieldType, MyDecimal, MyTime, TypeCode, new_datetime, new_decimal, new_double, new_longlong, new_varchar
+from .catalog import Catalog, CatalogError, TableMeta, field_type_from_spec
+
+BOOL = new_longlong()
+SORT_NO_LIMIT = 1 << 20  # ORDER BY without LIMIT: TopN with a high bound
+                         # (full external sort is a later component)
+
+
+class PlanError(ValueError):
+    pass
+
+
+@dataclass
+class PlannedQuery:
+    """A lowered SELECT: the logical DAG plus what the executor needs to
+    dispatch it (probe table for region ranges, build tables to broadcast)."""
+
+    dag: DAGRequest
+    probe_table: TableMeta
+    build_tables: list  # [TableMeta] in canonical scan order (after probe)
+    column_names: list  # output column labels
+    offset: int = 0  # LIMIT offset — applied by the session on final rows
+
+
+# --------------------------------------------------------------------------
+# scopes
+# --------------------------------------------------------------------------
+
+@dataclass
+class _TableRef:
+    meta: TableMeta
+    alias: str
+    offset: int  # column offset of this table in the combined schema
+
+
+class _Scope:
+    """Combined-schema name resolution (ref: expression resolver)."""
+
+    def __init__(self, tables: list):
+        self.tables = tables  # [_TableRef]
+
+    def resolve(self, c: A.ColumnName):
+        name = c.name.lower()
+        tbl = c.table.lower()
+        hits = []
+        for tr in self.tables:
+            if tbl and tr.alias != tbl and tr.meta.name != tbl:
+                continue
+            for i, cm in enumerate(tr.meta.columns):
+                if cm.name == name:
+                    hits.append((tr.offset + i, cm.ft))
+        if not hits:
+            raise PlanError(f"unknown column {c}")
+        if len(hits) > 1:
+            raise PlanError(f"ambiguous column {c}")
+        return hits[0]
+
+    def tables_of(self, node: A.ExprNode) -> set:
+        """Aliases of tables referenced under `node`."""
+        out: set = set()
+
+        def walk(n):
+            if isinstance(n, A.ColumnName):
+                name, tbl = n.name.lower(), n.table.lower()
+                for tr in self.tables:
+                    if tbl and tr.alias != tbl and tr.meta.name != tbl:
+                        continue
+                    if any(cm.name == name for cm in tr.meta.columns):
+                        out.add(tr.alias)
+                        return
+                raise PlanError(f"unknown column {n}")
+            for f_ in getattr(n, "__dataclass_fields__", {}):
+                v = getattr(n, f_)
+                if isinstance(v, A.ExprNode):
+                    walk(v)
+                elif isinstance(v, list):
+                    for it in v:
+                        if isinstance(it, A.ExprNode):
+                            walk(it)
+                        elif isinstance(it, tuple):
+                            for x in it:
+                                if isinstance(x, A.ExprNode):
+                                    walk(x)
+
+        walk(node)
+        return out
+
+
+# --------------------------------------------------------------------------
+# expression lowering
+# --------------------------------------------------------------------------
+
+_CMP_OPS = {"eq", "ne", "lt", "le", "gt", "ge", "nulleq"}
+_LOGIC_OPS = {"and", "or", "xor"}
+_BIT_OPS = {"bitand", "bitor", "bitxor", "shiftleft", "shiftright"}
+
+
+def _dec_scale(ft: FieldType) -> int:
+    return max(ft.decimal, 0)
+
+
+def _unify_fts(fts: list) -> FieldType:
+    """Result type of branch-valued expressions (IF/CASE/COALESCE)."""
+    ets = [ft.eval_type() for ft in fts]
+    if "string" in ets:
+        return new_varchar(max((ft.flen if ft.flen > 0 else 255) for ft in fts))
+    if "real" in ets:
+        return new_double()
+    if "decimal" in ets:
+        s = max(_dec_scale(ft) for ft in fts)
+        return new_decimal(30, s)
+    if "time" in ets:
+        return new_datetime()
+    return new_longlong()
+
+
+def _arith_ft(op: str, lft: FieldType, rft: FieldType) -> FieldType:
+    le, re = lft.eval_type(), rft.eval_type()
+    if op in _BIT_OPS:
+        return new_longlong(unsigned=True)
+    if op == "intdiv":
+        return new_longlong()
+    if "real" in (le, re):
+        return new_double()
+    if op == "div":
+        # decimal division: scale + 4 (ref: types DivFracIncr)
+        s = max(_dec_scale(lft), _dec_scale(rft)) + 4
+        return new_decimal(30, min(s, 30))
+    if "decimal" in (le, re):
+        s1, s2 = _dec_scale(lft), _dec_scale(rft)
+        if op == "mul":
+            return new_decimal(30, min(s1 + s2, 30))
+        if op == "mod":
+            return new_decimal(30, max(s1, s2))
+        return new_decimal(30, max(s1, s2))  # plus/minus
+    unsigned = lft.is_unsigned() or rft.is_unsigned()
+    return new_longlong(unsigned=unsigned and op in ("plus", "mul"))
+
+
+_FUNC_FTS = {
+    "abs": "same", "ceil": "int_of", "ceiling": "int_of", "floor": "int_of",
+    "sqrt": "real", "exp": "real", "ln": "real", "log": "real", "pow": "real",
+    "power": "real", "sign": "int", "length": "int", "strcmp": "int",
+    "year": "int", "month": "int", "day": "int", "dayofmonth": "int",
+    "hour": "int", "minute": "int", "second": "int", "weekday": "int",
+    "to_days": "int",
+}
+
+_FUNC_RENAME = {"ceiling": "ceil", "power": "pow", "dayofmonth": "day", "substring": "substr", "log": "ln"}
+
+
+class _Lowerer:
+    """AST expression -> ir.Expr against a base scope, optionally through an
+    aggregation output schema (agg scope)."""
+
+    def __init__(self, scope: _Scope, aliases: dict | None = None):
+        self.scope = scope
+        self.aliases = aliases or {}
+        # agg context (installed by the SELECT planner when aggregating)
+        self.group_asts: list = []
+        self.agg_descs: list = []  # [AggDesc] in output order
+        self.agg_asts: list = []  # matching AST nodes
+        self.n_agg_cols = 0
+        self.in_agg_ctx = False
+
+    def _expand_alias(self, name: str) -> Expr:
+        """Lower an alias's defining expression with the alias itself masked
+        out (SELECT salary*2 AS salary must not recurse forever)."""
+        target = self.aliases.pop(name)
+        try:
+            return self.lower(target)
+        finally:
+            self.aliases[name] = target
+
+    # -- agg scope helpers --------------------------------------------------
+    def _group_index(self, node) -> int | None:
+        for i, g in enumerate(self.group_asts):
+            if g == node:
+                return i
+        return None
+
+    def _agg_ref(self, desc: AggDesc, ast_node) -> Expr:
+        for i, (d, a) in enumerate(zip(self.agg_descs, self.agg_asts)):
+            if a == ast_node:
+                return col(i, d.ft)
+        self.agg_descs.append(desc)
+        self.agg_asts.append(ast_node)
+        return col(len(self.agg_descs) - 1, desc.ft)
+
+    def lower_agg_func(self, n: A.AggFunc) -> Expr:
+        name = n.name
+        if name in ("std", "stddev", "stddev_pop"):
+            name = "stddev_pop"
+        if name in ("variance", "var_pop"):
+            name = "var_pop"
+        if name not in AGG_FUNCS:
+            raise PlanError(f"aggregate {n.name!r} not supported yet")
+        if name == "count" and len(n.args) == 1 and isinstance(n.args[0], A.Star):
+            args = ()
+        else:
+            args = tuple(self.lower_base(a) for a in n.args)
+        desc = AggDesc(name, args, distinct=n.distinct)
+        return self._agg_ref(desc, n)
+
+    # -- entry points ---------------------------------------------------------
+    def lower(self, n: A.ExprNode) -> Expr:
+        """Lower in the current context (agg-aware when in_agg_ctx)."""
+        if self.in_agg_ctx:
+            return self.lower_in_agg(n)
+        return self.lower_base(n)
+
+    def lower_in_agg(self, n: A.ExprNode) -> Expr:
+        """Lower against the aggregation OUTPUT schema: agg funcs and
+        group-by expressions become column refs; bare columns outside both
+        get an implicit first_row (MySQL loose group-by)."""
+        gi = self._group_index(n)
+        if gi is not None:
+            # group key columns sit after the agg columns
+            g_expr = self.lower_base(self.group_asts[gi])
+            return _DeferredGroupRef(gi, g_expr.ft)
+        if isinstance(n, A.AggFunc):
+            return self.lower_agg_func(n)
+        if isinstance(n, A.ColumnName):
+            if not n.table and n.name.lower() in self.aliases:
+                return self._expand_alias(n.name.lower())
+            fr = AggDesc("first_row", (self.lower_base(n),))
+            return self._agg_ref(fr, n)
+        if isinstance(n, A.Literal):
+            return self.lower_base(n)
+        # recurse structurally: rebuild the node with lowered children
+        return self._structural(n, self.lower_in_agg)
+
+    def _structural(self, n, rec):
+        """Lower a compound node by dispatching on type with `rec` for
+        children (shared between base and agg contexts)."""
+        if isinstance(n, A.BinaryOp):
+            l, r = rec(n.left), rec(n.right)
+            return self._binary(n.op, l, r)
+        if isinstance(n, A.UnaryOp):
+            a = rec(n.operand)
+            if n.op == "not":
+                return func("not", BOOL, a)
+            if n.op == "unaryminus":
+                ft = a.ft if a.ft.eval_type() in ("decimal",) else (new_double() if a.ft.eval_type() == "real" else new_longlong())
+                return func("unaryminus", ft, a)
+            if n.op == "bitneg":
+                return func("bitneg", new_longlong(unsigned=True), a)
+            raise PlanError(f"unary op {n.op}")
+        if isinstance(n, A.IsNull):
+            e = func("isnull", BOOL, rec(n.expr))
+            return func("not", BOOL, e) if n.negated else e
+        if isinstance(n, A.Between):
+            x = rec(n.expr)
+            lo, hi = self._coerce_const(x, rec(n.low)), self._coerce_const(x, rec(n.high))
+            e = func("between", BOOL, x, lo, hi)
+            return func("not", BOOL, e) if n.negated else e
+        if isinstance(n, A.InList):
+            x = rec(n.expr)
+            items = [self._coerce_const(x, rec(i)) for i in n.items]
+            e = func("in", BOOL, x, *items)
+            return func("not", BOOL, e) if n.negated else e
+        if isinstance(n, A.Like):
+            e = func("like", BOOL, rec(n.expr), rec(n.pattern))
+            return func("not", BOOL, e) if n.negated else e
+        if isinstance(n, A.Case):
+            whens = n.when_clauses
+            args = []
+            for cond, res in whens:
+                c = self._binary("eq", rec(n.operand), rec(cond)) if n.operand is not None else rec(cond)
+                args.append((c, rec(res)))
+            else_e = rec(n.else_clause) if n.else_clause is not None else None
+            branch_fts = [r.ft for _, r in args] + ([else_e.ft] if else_e is not None else [])
+            ft = _unify_fts(branch_fts)
+            flat = []
+            for c, r in args:
+                flat.extend((c, r))
+            if else_e is not None:
+                flat.append(else_e)
+            return func("case", ft, *flat)
+        if isinstance(n, A.Cast):
+            ft = field_type_from_spec(n.to_type)
+            if n.to_type.name == "signed":
+                ft = new_longlong()
+            elif n.to_type.name == "unsigned":
+                ft = new_longlong(unsigned=True)
+            return func("cast", ft, rec(n.expr))
+        if isinstance(n, A.FuncCall):
+            return self._func_call(n, rec)
+        raise PlanError(f"unsupported expression {type(n).__name__}")
+
+    def _func_call(self, n: A.FuncCall, rec):
+        name = _FUNC_RENAME.get(n.name, n.name)
+        args = [rec(a) for a in n.args]
+        if name == "if":
+            ft = _unify_fts([args[1].ft, args[2].ft])
+            return func("if", ft, *args)
+        if name == "ifnull":
+            return func("ifnull", _unify_fts([a.ft for a in args]), *args)
+        if name == "coalesce":
+            return func("coalesce", _unify_fts([a.ft for a in args]), *args)
+        if name == "round":
+            a = args[0]
+            if a.ft.eval_type() == "decimal":
+                d = 0
+                if len(args) > 1:
+                    d = _const_int(args[1])
+                return func("round", new_decimal(30, max(d, 0)), *args)
+            ft = new_double() if a.ft.eval_type() == "real" else new_longlong()
+            return func("round", ft, *args)
+        if name == "substr":
+            return func("substr", args[0].ft.clone(), *args)
+        if name in _FUNC_FTS:
+            kind = _FUNC_FTS[name]
+            a = args[0]
+            if kind == "same":
+                ft = a.ft.clone()
+            elif kind == "real":
+                ft = new_double()
+            elif kind == "int_of":
+                ft = new_longlong() if a.ft.eval_type() != "real" else new_double()
+            else:
+                ft = new_longlong()
+            return func(name, ft, *args)
+        raise PlanError(f"function {n.name!r} not supported yet")
+
+    # -- base lowering --------------------------------------------------------
+    def lower_base(self, n: A.ExprNode) -> Expr:
+        if isinstance(n, A.Literal):
+            return _lower_literal(n)
+        if isinstance(n, A.ColumnName):
+            # real columns shadow select aliases (MySQL resolution order for
+            # WHERE); aliases only cover names with no underlying column
+            try:
+                idx, ft = self.scope.resolve(n)
+                return col(idx, ft)
+            except PlanError:
+                if not n.table and n.name.lower() in self.aliases:
+                    return self._expand_alias(n.name.lower())
+                raise
+        if isinstance(n, A.AggFunc):
+            raise PlanError(f"aggregate {n.name} in a non-aggregated context")
+        return self._structural(n, self.lower_base)
+
+    def _binary(self, op: str, l: Expr, r: Expr) -> Expr:
+        if op in _CMP_OPS:
+            l, r = self._coerce_pair(l, r)
+            return func(op, BOOL, l, r)
+        if op in _LOGIC_OPS:
+            return func(op, BOOL, l, r)
+        ft = _arith_ft(op, l.ft, r.ft)
+        return func(op, ft, l, r)
+
+    def _coerce_pair(self, l: Expr, r: Expr):
+        return self._coerce_const(r, l), self._coerce_const(l, r)
+
+    @staticmethod
+    def _coerce_const(target: Expr, e: Expr) -> Expr:
+        """String literals compared with time columns re-parse as datetime
+        consts (MySQL implicit temporal coercion)."""
+        from ..expr.ir import Const
+
+        if (
+            isinstance(e, Const)
+            and target.ft.is_time()
+            and e.ft.is_string()
+            and e.datum.val is not None
+        ):
+            return lit(str(e.datum.val), new_datetime())
+        return e
+
+
+class _DeferredGroupRef(Expr):
+    """Placeholder for a group-key column whose final index depends on the
+    number of agg output columns (resolved by the SELECT planner)."""
+
+    __slots__ = ("gi", "ft")
+
+    def __init__(self, gi: int, ft: FieldType):
+        self.gi = gi
+        self.ft = ft
+
+    def fingerprint(self):
+        raise AssertionError("deferred ref must be resolved before use")
+
+
+def _resolve_deferred(e: Expr, n_aggs: int) -> Expr:
+    if isinstance(e, _DeferredGroupRef):
+        return col(n_aggs + e.gi, e.ft)
+    from ..expr.ir import ScalarFunc
+
+    if isinstance(e, ScalarFunc):
+        return func(e.op, e.ft, *(_resolve_deferred(a, n_aggs) for a in e.args))
+    return e
+
+
+def _const_int(e: Expr) -> int:
+    from ..expr.ir import Const
+
+    if isinstance(e, Const) and e.datum.val is not None:
+        return int(e.datum.val)
+    raise PlanError("constant integer expected")
+
+
+def _lower_literal(n: A.Literal) -> Expr:
+    if n.kind == "null":
+        return lit(None, new_longlong())
+    if n.kind in ("int", "bool"):
+        v = int(n.value)
+        if -(1 << 63) <= v < (1 << 63):
+            return lit(v, new_longlong())
+        return lit(v, new_longlong(unsigned=True))
+    if n.kind == "decimal":
+        text = str(n.value)
+        scale = len(text.split(".", 1)[1]) if "." in text else 0
+        e = lit(None, new_decimal(max(len(text), 1), scale))
+        from ..expr.ir import Const
+
+        return Const(Datum.dec(MyDecimal(text)), e.ft)
+    if n.kind == "float":
+        return lit(float(str(n.value)), new_double())
+    if n.kind == "str":
+        return lit(str(n.value), new_varchar(max(len(str(n.value)), 1)))
+    if n.kind == "hex":
+        return lit(bytes(n.value).decode("latin1"), new_varchar(max(len(n.value), 1)))
+    raise PlanError(f"literal kind {n.kind}")
+
+
+# --------------------------------------------------------------------------
+# FROM / join planning
+# --------------------------------------------------------------------------
+
+def _flatten_from(node, catalog: Catalog) -> list:
+    """FROM tree -> [(TableMeta, alias, kind, on_expr)] left-deep order.
+    JOIN ... USING(cols) desugars to ON equality conjuncts."""
+    if isinstance(node, A.TableName):
+        meta = catalog.table(node.name)
+        return [(meta, (node.alias or node.name).lower(), "inner", None)]
+    if isinstance(node, A.Join):
+        left = _flatten_from(node.left, catalog)
+        right = _flatten_from(node.right, catalog)
+        if len(right) != 1:
+            raise PlanError("right-nested joins not supported")
+        meta, alias, _, _ = right[0]
+        kind = {"inner": "inner", "cross": "inner", "left": "left"}.get(node.kind)
+        if kind is None:
+            raise PlanError(f"join kind {node.kind!r} not supported")
+        on = node.on
+        if node.using:
+            for cname in node.using:
+                cn = cname.lower() if isinstance(cname, str) else cname.name.lower()
+                lt = next((la for lm, la, _, _ in left if any(c.name == cn for c in lm.columns)), None)
+                if lt is None:
+                    raise PlanError(f"USING column {cn!r} not found on the left side")
+                eq = A.BinaryOp("eq", A.ColumnName(cn, lt), A.ColumnName(cn, alias))
+                on = eq if on is None else A.BinaryOp("and", on, eq)
+        return left + [(meta, alias, kind, on)]
+    raise PlanError(f"unsupported FROM clause {type(node).__name__}")
+
+
+def _split_conjuncts(e: A.ExprNode | None) -> list:
+    if e is None:
+        return []
+    if isinstance(e, A.BinaryOp) and e.op == "and":
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+def _equi_sides(e: A.ExprNode):
+    if isinstance(e, A.BinaryOp) and e.op == "eq":
+        return e.left, e.right
+    return None
+
+
+def _has_agg(n) -> bool:
+    if isinstance(n, A.AggFunc):
+        return True
+    for f_ in getattr(n, "__dataclass_fields__", {}):
+        v = getattr(n, f_)
+        if isinstance(v, A.ExprNode) and _has_agg(v):
+            return True
+        if isinstance(v, list):
+            for it in v:
+                if isinstance(it, A.ExprNode) and _has_agg(it):
+                    return True
+                if isinstance(it, tuple) and any(isinstance(x, A.ExprNode) and _has_agg(x) for x in it):
+                    return True
+    return False
+
+
+def _field_label(f: A.SelectField) -> str:
+    if f.alias:
+        return f.alias
+    if isinstance(f.expr, A.ColumnName):
+        return f.expr.name
+    if isinstance(f.expr, A.AggFunc):
+        return f"{f.expr.name}(...)"
+    return "expr"
+
+
+def _unify_join_key(pk: Expr, bk: Expr):
+    """Bring both key sides to one eval class/scale (ref: hash join key
+    unification in the planner — casts inserted so the kernel's normalized
+    key words agree)."""
+    pe, be = pk.ft.eval_type(), bk.ft.eval_type()
+    if pe == be:
+        if pe == "decimal" and _dec_scale(pk.ft) != _dec_scale(bk.ft):
+            s = max(_dec_scale(pk.ft), _dec_scale(bk.ft))
+            tgt = new_decimal(30, s)
+            return func("cast", tgt, pk), func("cast", tgt, bk)
+        if pe == "int" and pk.ft.is_unsigned() != bk.ft.is_unsigned():
+            tgt = new_longlong(unsigned=False)
+            return func("cast", tgt, pk), func("cast", tgt, bk)
+        return pk, bk
+    classes = {pe, be}
+    if "real" in classes:
+        tgt = new_double()
+    elif "decimal" in classes and classes <= {"decimal", "int"}:
+        s = max(_dec_scale(pk.ft), _dec_scale(bk.ft))
+        tgt = new_decimal(30, s)
+    elif classes <= {"int", "time"}:
+        tgt = new_longlong()
+    else:
+        raise PlanError(f"cannot join keys of classes {pe} and {be}")
+
+    def cast(e):
+        return e if e.ft.eval_type() == tgt.eval_type() and _dec_scale(e.ft) == _dec_scale(tgt) else func("cast", tgt, e)
+
+    return cast(pk), cast(bk)
+
+
+def plan_select(stmt: A.SelectStmt, catalog: Catalog) -> PlannedQuery:
+    if stmt.from_clause is None:
+        raise PlanError("SELECT without FROM is evaluated by the session")
+    if stmt.ctes:
+        raise PlanError("CTEs not supported yet")
+    flat = _flatten_from(stmt.from_clause, catalog)
+
+    # ---- join order: probe = largest table (row-count stat); LEFT JOIN
+    # pins the textual order (outer semantics are order-sensitive)
+    has_left = any(kind == "left" for _, _, kind, _ in flat)
+    if not has_left and len(flat) > 1:
+        probe_i = max(range(len(flat)), key=lambda i: flat[i][0].row_count)
+        flat = [flat[probe_i]] + flat[:probe_i] + flat[probe_i + 1 :]
+
+    # ---- scope over the combined schema in placement order
+    trefs = []
+    off = 0
+    for meta, alias, _, _ in flat:
+        trefs.append(_TableRef(meta, alias, off))
+        off += len(meta.columns)
+    scope = _Scope(trefs)
+    aliases = {f.alias.lower(): f.expr for f in stmt.fields if isinstance(f, A.SelectField) and f.alias}
+    low = _Lowerer(scope, aliases)
+
+    # ---- conjunct classification (PPDSolver analog)
+    where_conj = _split_conjuncts(stmt.where)
+    on_conj_per_join: dict[int, list] = {}
+    for i, (_, _, kind, on) in enumerate(flat):
+        if on is None:
+            continue
+        if kind == "left":
+            on_conj_per_join[i] = _split_conjuncts(on)
+        else:
+            where_conj.extend(_split_conjuncts(on))  # inner: ON == WHERE
+
+    # WHERE conjuncts on a LEFT JOIN's null-supplied side must run AFTER
+    # null extension (post-join residual), never inside the build pipeline
+    left_build_aliases = {trefs[i].alias for i in range(1, len(trefs)) if flat[i][2] == "left"}
+    local: dict[str, list] = {tr.alias: [] for tr in trefs}
+    equi: list = []  # (tables frozenset, lhs_ast, rhs_ast)
+    residual: list = []
+    for c in where_conj:
+        tabs = scope.tables_of(c)
+        if len(tabs) <= 1:
+            alias1 = next(iter(tabs)) if tabs else None
+            if alias1 is not None and alias1 not in left_build_aliases:
+                local[alias1].append(c)
+            else:
+                residual.append(c)  # const condition / left-side filter
+            continue
+        sides = _equi_sides(c)
+        if sides is not None and len(tabs) == 2:
+            lt, rt = scope.tables_of(sides[0]), scope.tables_of(sides[1])
+            if len(lt) == 1 and len(rt) == 1 and lt != rt:
+                equi.append((tabs, sides[0], sides[1]))
+                continue
+        residual.append(c)
+
+    # ---- probe pipeline
+    probe_meta, probe_alias = trefs[0].meta, trefs[0].alias
+    executors: list = [TableScan(probe_meta.table_id, tuple(ColumnInfo(c.col_id, c.ft) for c in probe_meta.columns))]
+    if local[probe_alias]:
+        executors.append(Selection(tuple(low.lower_base(c) for c in local[probe_alias])))
+
+    # ---- joins (left-deep, broadcast build sides)
+    placed = {probe_alias}
+    build_tables = []
+    for i in range(1, len(trefs)):
+        tr = trefs[i]
+        meta, alias, kind = flat[i][0], tr.alias, flat[i][2]
+        local_scope = _Scope([_TableRef(meta, alias, 0)])
+        local_low = _Lowerer(local_scope)
+        build_execs: list = [TableScan(meta.table_id, tuple(ColumnInfo(c.col_id, c.ft) for c in meta.columns))]
+
+        join_preds = []
+        pool = equi
+        if kind == "left":
+            # ON conjuncts: build-local filters go inside the build
+            # pipeline; equi preds become keys; anything else is unsupported
+            pool = []
+            for c in on_conj_per_join.get(i, []):
+                tabs = scope.tables_of(c)
+                if tabs == {alias}:
+                    local[alias].append(c)
+                    continue
+                sides = _equi_sides(c)
+                if sides is not None and len(tabs) == 2:
+                    pool.append((tabs, sides[0], sides[1]))
+                    continue
+                raise PlanError("LEFT JOIN ON supports equi conditions and build-side filters only")
+        if local[alias]:
+            build_execs.append(Selection(tuple(local_low.lower_base(c) for c in local[alias])))
+
+        probe_keys, build_keys = [], []
+        remaining = []
+        for tabs, l_ast, r_ast in pool:
+            if alias in tabs and tabs - {alias} <= placed:
+                l_tabs = scope.tables_of(l_ast)
+                b_ast, p_ast = (l_ast, r_ast) if l_tabs == {alias} else (r_ast, l_ast)
+                pk = low.lower_base(p_ast)
+                bk = local_low.lower_base(b_ast)
+                pk, bk = _unify_join_key(pk, bk)
+                probe_keys.append(pk)
+                build_keys.append(bk)
+            else:
+                remaining.append((tabs, l_ast, r_ast))
+        if kind != "left":
+            equi = remaining
+        if not probe_keys:
+            # cartesian product: constant keys (every row matches)
+            probe_keys = [lit(1, new_longlong(notnull=True))]
+            build_keys = [lit(1, new_longlong(notnull=True))]
+        executors.append(
+            Join(
+                build=tuple(build_execs),
+                probe_keys=tuple(probe_keys),
+                build_keys=tuple(build_keys),
+                join_type="left_outer" if kind == "left" else "inner",
+            )
+        )
+        placed.add(alias)
+        build_tables.append(meta)
+    if equi:
+        # equi preds that never matched a join step (e.g. cycles) filter post-join
+        for tabs, l_ast, r_ast in equi:
+            residual.append(A.BinaryOp("eq", l_ast, r_ast))
+    if residual:
+        executors.append(Selection(tuple(low.lower_base(c) for c in residual)))
+
+    # ---- select list: expand * / t.* first
+    fields: list = []
+    for f in stmt.fields:
+        e = f.expr if isinstance(f, A.SelectField) else f
+        if isinstance(e, A.Star):
+            for tr in trefs:
+                if e.table and tr.alias != e.table.lower() and tr.meta.name != e.table.lower():
+                    continue
+                for cm in tr.meta.columns:
+                    fields.append(A.SelectField(A.ColumnName(cm.name, tr.alias), cm.name))
+        else:
+            fields.append(f)
+
+    def positional(e):
+        """ORDER BY 1 / GROUP BY 2 = select-list position (MySQL)."""
+        if isinstance(e, A.Literal) and e.kind == "int":
+            i = int(e.value)
+            if not (1 <= i <= len(fields)):
+                raise PlanError(f"ORDER/GROUP BY position {i} out of range")
+            return fields[i - 1].expr
+        return e
+
+    # ---- aggregation
+    group_asts = [positional(b.expr) for b in stmt.group_by]
+    need_agg = bool(group_asts) or any(_has_agg(f.expr) for f in fields) or (
+        stmt.having is not None and _has_agg(stmt.having)
+    )
+    if stmt.distinct and not need_agg:
+        # SELECT DISTINCT a, b == GROUP BY a, b (AggregationEliminator dual)
+        group_asts = [f.expr for f in fields]
+        need_agg = True
+
+    names = [_field_label(f) for f in fields]
+
+    if need_agg:
+        low.group_asts = group_asts
+        low.in_agg_ctx = True
+        out_exprs = [low.lower_in_agg(f.expr) for f in fields]
+        having_e = low.lower_in_agg(stmt.having) if stmt.having is not None else None
+        order_items = [(low.lower_in_agg(positional(b.expr)), b.desc) for b in stmt.order_by]
+        n_aggs = len(low.agg_descs)
+        out_exprs = [_resolve_deferred(e, n_aggs) for e in out_exprs]
+        having_e = _resolve_deferred(having_e, n_aggs) if having_e is not None else None
+        order_items = [(_resolve_deferred(e, n_aggs), d) for e, d in order_items]
+        groups = tuple(low.lower_base(g) for g in group_asts)
+        executors.append(Aggregation(group_by=groups, aggs=tuple(low.agg_descs)))
+        if having_e is not None:
+            executors.append(Selection((having_e,)))
+    else:
+        out_exprs = [low.lower_base(f.expr) for f in fields]
+        order_items = [(low.lower_base(positional(b.expr)), b.desc) for b in stmt.order_by]
+
+    # ---- order / limit
+    def limit_val(e):
+        if e is None:
+            return None
+        if isinstance(e, A.Literal) and e.kind in ("int", "bool"):
+            return int(e.value)
+        if isinstance(e, int):
+            return e
+        raise PlanError("LIMIT expects integer literals")
+
+    limit_n = offset_n = None
+    if stmt.limit is not None:
+        limit_n = limit_val(stmt.limit.count)
+        offset_n = limit_val(stmt.limit.offset) or 0
+    if order_items:
+        bound = (limit_n + offset_n) if limit_n is not None else SORT_NO_LIMIT
+        executors.append(TopN(order_by=tuple(order_items), limit=bound))
+    elif limit_n is not None:
+        executors.append(Limit(limit_n + offset_n))
+
+    # ---- projection / offsets
+    from ..expr.ir import ColumnRef
+
+    if all(isinstance(e, ColumnRef) for e in out_exprs):
+        offsets = tuple(e.index for e in out_exprs)
+    else:
+        executors.append(Projection(tuple(out_exprs)))
+        offsets = tuple(range(len(out_exprs)))
+
+    dag = DAGRequest(tuple(executors), output_offsets=offsets)
+    return PlannedQuery(dag, probe_meta, build_tables, names, offset=offset_n or 0)
