@@ -234,6 +234,38 @@ class TestReviewRegressions:
         with pytest.raises(SQLError, match="column count"):
             sess.execute("INSERT INTO w (a) SELECT id, age FROM emp")
 
+    def test_update_pk_moves_row(self, sess):
+        sess.execute("CREATE TABLE pk (id BIGINT PRIMARY KEY, v INT)")
+        sess.execute("INSERT INTO pk VALUES (1, 10)")
+        sess.execute("UPDATE pk SET id = 5 WHERE id = 1")
+        assert sess.execute("SELECT count(*) FROM pk").scalar() == 1
+        with pytest.raises(SQLError, match="duplicate entry"):
+            sess.execute("INSERT INTO pk VALUES (5, 99)")
+        sess.execute("INSERT INTO pk VALUES (1, 99)")  # old key is free again
+        with pytest.raises(SQLError, match="duplicate entry"):
+            sess.execute("UPDATE pk SET id = 5 WHERE id = 1")
+
+    def test_non_int_pk_rejected(self, sess):
+        with pytest.raises(CatalogError, match="PRIMARY KEY"):
+            sess.execute("CREATE TABLE sp (a VARCHAR(10) PRIMARY KEY)")
+        with pytest.raises(CatalogError, match="PRIMARY KEY"):
+            sess.execute("CREATE TABLE cp (a INT, b INT, PRIMARY KEY (a, b))")
+
+    def test_star_textual_order_after_reorder(self, sess):
+        sess.execute("CREATE TABLE small (k BIGINT PRIMARY KEY, s VARCHAR(4))")
+        sess.execute("INSERT INTO small VALUES (30, 'x')")
+        # emp has more rows -> becomes probe; * must still list small first
+        r = sess.execute("SELECT * FROM small, emp WHERE small.k = emp.age AND emp.id = 1")
+        assert r.columns[:2] == ["k", "s"] and r.values()[0][:2] == [30, "x"]
+
+    def test_ambiguous_column(self, sess):
+        sess.execute("CREATE TABLE amb1 (x INT, a INT)")
+        sess.execute("CREATE TABLE amb2 (x INT, b INT)")
+        sess.execute("INSERT INTO amb1 VALUES (1, 1)")
+        sess.execute("INSERT INTO amb2 VALUES (1, 2)")
+        with pytest.raises(PlanError, match="ambiguous"):
+            sess.execute("SELECT a FROM amb1, amb2 WHERE x > 0 AND amb1.a = amb2.b")
+
 
 class TestMeta:
     def test_show_tables(self, sess):

@@ -1,0 +1,205 @@
+"""Grouped aggregation over the mesh — the MPP partial/exchange/final
+pipeline as ONE shard_map program (ref: unistore/cophandler/mpp_exec.go
+aggExec:999 below exchSenderExec:609, receiver-side final agg above
+exchRecvExec:723; fragment planning pkg/planner/core/fragment.go:116).
+
+Per device, in a single fused XLA computation:
+  1. flatten the device's local regions into one row block, run the scan
+     expressions + selection,
+  2. Partial1 group aggregation (sort/segment kernel) -> a local group-state
+     table [G_local],
+  3. hash-partition the group states by group key and `all_to_all` them over
+     the ICI mesh — every device ends up owning one hash partition of the
+     global group space (ref: ExchangeSender Hash mode, fnv64 row hash),
+  4. merge-mode group aggregation over the owned states -> FINAL values for
+     the owned groups. No host round-trip between phases.
+
+The host wrapper gathers the per-device final tables and decodes one result
+Chunk. Group keys may be strings (packed-word keys, first 32 bytes); string
+AGGREGATE VALUES (min/max/first_row over varchar) are not exchangeable yet
+and raise."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..chunk.device import DeviceBatch
+from ..exec.dag import Aggregation, DAGRequest, Selection
+from ..exec.executor import decode_outputs
+from ..expr.compile import CompVal, ExprCompiler, normalize_device_column
+from ..ops import apply_selection, group_aggregate
+from ..ops.aggregate import GatherState, finalize_agg
+from .exchange import hash_partition_ids, scatter_to_buckets
+from .mesh import REGION_AXIS
+
+
+def _flatten_local(local: DeviceBatch):
+    """[R_local, cap] region-stacked batch -> flat [R_local*cap] columns."""
+    cols = []
+    for c in local.cols:
+        data = c.data.reshape((-1,) + c.data.shape[2:])
+        null = c.null.reshape(-1)
+        length = c.length.reshape(-1) if c.length is not None else None
+        cols.append(type(c)(data, null, length, c.ft))
+    return cols, local.row_valid.reshape(-1)
+
+
+def _materialize_gather(desc, arg_vals, st: GatherState, final: bool = False):
+    """GatherState -> concrete state columns (numeric only — string gather
+    values cannot ride the exchange buffers yet). Partial form keeps the
+    [has, value] wire schema for first_row; `final` collapses to the single
+    result column."""
+    vcol = arg_vals[-1]
+    if vcol.value.ndim != 1:
+        raise NotImplementedError(
+            f"string-valued gather aggregate {desc.name!r} (first_row/min/max) over the mesh"
+        )
+    val = jnp.where(st.has, vcol.value[st.idx], jnp.zeros((), vcol.value.dtype))
+    null = jnp.where(st.has, vcol.null[st.idx], True)
+    if desc.name == "first_row" and not final:
+        return [(st.has.astype(jnp.int64), jnp.zeros(st.has.shape, bool)), (val, null)]
+    return [(val, null)]
+
+
+def run_sharded_grouped_agg(
+    dag: DAGRequest,
+    stacked: DeviceBatch,
+    mesh,
+    group_capacity: int = 1024,
+    bucket_cap: int | None = None,
+):
+    """Execute TableScan [Selection] Aggregation(group_by) over a
+    region-sharded mesh; returns (chunk, overflow flag).
+
+    The Aggregation node is taken as the LOGICAL (Complete-mode) shape; the
+    partial/final split happens inside. Output chunk layout matches the
+    single-chip executor: [agg results..., group keys...]."""
+    executors = dag.executors
+    agg = executors[-1]
+    assert isinstance(agg, Aggregation) and agg.group_by, "grouped mesh agg needs GROUP BY"
+    if any(d.distinct for d in agg.aggs):
+        raise NotImplementedError("DISTINCT aggregates are not mesh-decomposable")
+    input_fts = [c.ft for c in dag.scan().columns]
+    n_parts = mesh.devices.size
+    bcap = bucket_cap or group_capacity
+
+    def device_fn(local: DeviceBatch):
+        cols, valid = _flatten_local(local)
+        cvals = [normalize_device_column(c) for c in cols]
+        for ex in executors[1:-1]:
+            comp = ExprCompiler(input_fts)
+            if isinstance(ex, Selection):
+                conds = comp.run(list(ex.conditions), cvals)
+                valid = apply_selection(valid, conds)
+            else:
+                raise TypeError(f"mesh pipeline supports scan+selection+agg, got {ex}")
+        comp = ExprCompiler(input_fts)
+        gvals = comp.run(list(agg.group_by), cvals)
+        arg_exprs = [a for d in agg.aggs for a in d.args]
+        avals = comp.run(arg_exprs, cvals) if arg_exprs else []
+        aggs = []
+        k = 0
+        for d in agg.aggs:
+            aggs.append((d, avals[k : k + len(d.args)]))
+            k += len(d.args)
+
+        # -- phase 1: local Partial1 ------------------------------------
+        res = group_aggregate(gvals, aggs, valid, group_capacity, merge=False)
+        p1_overflow = res.overflow
+        state_cols: list[tuple] = []  # flat (value, null) per state column
+        state_fts: list = []
+        for (d, av), st in zip(aggs, res.states):
+            if isinstance(st, GatherState):
+                mat = _materialize_gather(d, av, st)
+            else:
+                mat = st
+            state_cols.extend(mat)
+            state_fts.extend(d.partial_fts())
+        gkey_cols = []
+        for gv in gvals:
+            if gv.value.ndim == 2:
+                gkey_cols.append((gv.value[res.group_rep, :], gv.null[res.group_rep]))
+            else:
+                gkey_cols.append((gv.value[res.group_rep], gv.null[res.group_rep]))
+        gvalid = res.group_valid
+
+        # -- phase 2: hash-exchange the group-state rows -----------------
+        key_cvs = [
+            CompVal(v, nl, g.ft) for (v, nl), g in zip(gkey_cols, agg.group_by)
+        ]
+        part = hash_partition_ids(key_cvs, n_parts)
+        flat_arrays = [a for v, nl in state_cols + gkey_cols for a in (v, nl)]
+        bufs, bvalid, ex_overflow = scatter_to_buckets(flat_arrays, gvalid, part, n_parts, bcap)
+        recv = [jax.lax.all_to_all(b, REGION_AXIS, 0, 0, tiled=False) for b in bufs]
+        rvalid = jax.lax.all_to_all(bvalid, REGION_AXIS, 0, 0, tiled=False)
+        flat = [r.reshape((-1,) + r.shape[2:]) for r in recv]
+        fvalid = rvalid.reshape(-1)
+
+        # -- phase 3: merge-mode aggregation on the owned partition ------
+        n_state = len(state_cols)
+        it = iter(range(0, 2 * n_state, 2))
+        owned_states = [(flat[i], flat[i + 1].astype(bool)) for i in it]
+        base = 2 * n_state
+        owned_gkeys = [
+            CompVal(flat[base + 2 * j], flat[base + 2 * j + 1].astype(bool), g.ft)
+            for j, g in enumerate(agg.group_by)
+        ]
+        merge_aggs = []
+        si = 0
+        for d, _ in aggs:
+            n = len(d.partial_fts())
+            args = [
+                CompVal(owned_states[si + i][0], owned_states[si + i][1], state_fts[si + i])
+                for i in range(n)
+            ]
+            merge_aggs.append((d, args))
+            si += n
+        fin = group_aggregate(owned_gkeys, merge_aggs, fvalid, group_capacity, merge=True)
+        f_overflow = fin.overflow
+
+        out_cols = []
+        for (d, av), st in zip(merge_aggs, fin.states):
+            if isinstance(st, GatherState):
+                st = GatherState(st.idx, st.has & fin.group_valid)
+                out_cols.extend(_materialize_gather(d, av, st, final=True))
+            else:
+                v, nl = finalize_agg(d, st, fin.group_valid)
+                out_cols.append((v, nl))
+        for gk in owned_gkeys:
+            if gk.value.ndim == 2:
+                out_cols.append((gk.value[fin.group_rep, :], gk.null[fin.group_rep] | ~fin.group_valid))
+            else:
+                out_cols.append((gk.value[fin.group_rep], gk.null[fin.group_rep] | ~fin.group_valid))
+        overflow = (
+            jax.lax.pmax(p1_overflow.astype(jnp.int32), REGION_AXIS)
+            | jax.lax.pmax(ex_overflow.astype(jnp.int32), REGION_AXIS)
+            | jax.lax.pmax(f_overflow.astype(jnp.int32), REGION_AXIS)
+        ) > 0
+        flat_out = [a for v, nl in out_cols for a in (v, nl)]
+        return tuple([fin.group_valid] + flat_out + [overflow])
+
+    spec_batch = jax.tree.map(lambda _: P(REGION_AXIS), stacked)
+    n_group = len(agg.group_by)
+    n_out_cols = len(agg.aggs) + n_group
+    out_spec = [P(REGION_AXIS)] * (1 + 2 * n_out_cols) + [P()]
+    fn = shard_map(device_fn, mesh=mesh, in_specs=(spec_batch,), out_specs=tuple(out_spec), check_vma=False)
+    outs = jax.jit(fn)(stacked)
+    group_valid = np.asarray(outs[0]).reshape(-1)
+    overflow = bool(np.asarray(outs[-1]).reshape(-1)[0])
+    flat_out = outs[1:-1]
+
+    # decode: [agg results..., group keys...] with Complete-mode fts
+    out_fts = [d.ft for d in agg.aggs] + [g.ft for g in agg.group_by]
+    packed = []
+    for i, ft in enumerate(out_fts):
+        # out_specs P(REGION_AXIS) already concatenated the device tables
+        # along axis 0: [D*G_cap] (or [D*G_cap, W+1] for string keys)
+        v = np.asarray(flat_out[2 * i])
+        nl = np.asarray(flat_out[2 * i + 1]).reshape(-1)
+        packed.append((v, nl))
+    chunk = decode_outputs(packed, group_valid, out_fts)
+    return chunk, overflow

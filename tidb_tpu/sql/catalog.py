@@ -126,17 +126,25 @@ class Catalog:
             for i, cd in enumerate(stmt.columns):
                 ft = field_type_from_spec(cd.type, cd.not_null or cd.primary_key)
                 cols.append(ColumnMeta(cd.name.lower(), i + 1, ft, cd.default, cd.auto_increment))
-                if cd.primary_key and ft.is_int():
+                if cd.primary_key:
+                    if not ft.is_int():
+                        # uniqueness would be silently unenforced otherwise
+                        raise CatalogError(
+                            "non-integer PRIMARY KEY not supported yet (integer handle columns only)"
+                        )
                     handle_col = cd.name.lower()
             indices = []
             for j, idx in enumerate(getattr(stmt, "indexes", []) or []):
                 iname = getattr(idx, "name", "") or f"idx_{j}"
                 icols = [c[0].lower() if isinstance(c, tuple) else str(c).lower() for c in idx.columns]
-                if getattr(idx, "primary", False) and len(icols) == 1:
+                if getattr(idx, "primary", False):
                     c = next((c for c in cols if c.name == icols[0]), None)
-                    if c is not None and c.ft.is_int():
+                    if len(icols) == 1 and c is not None and c.ft.is_int():
                         handle_col = icols[0]
                         continue
+                    raise CatalogError(
+                        "non-integer/composite PRIMARY KEY not supported yet (integer handle columns only)"
+                    )
                 indices.append(IndexMeta(iname, next(self._next_id), icols, getattr(idx, "unique", False)))
             tbl = TableMeta(name, next(self._next_id), cols, indices, handle_col)
             self._tables[name] = tbl

@@ -83,31 +83,27 @@ class _Scope:
         return hits[0]
 
     def tables_of(self, node: A.ExprNode) -> set:
-        """Aliases of tables referenced under `node`."""
+        """Aliases of tables referenced under `node`; ambiguous unqualified
+        columns raise (MySQL ER_NON_UNIQ_ERROR), mirroring resolve()."""
         out: set = set()
 
         def walk(n):
             if isinstance(n, A.ColumnName):
                 name, tbl = n.name.lower(), n.table.lower()
-                for tr in self.tables:
-                    if tbl and tr.alias != tbl and tr.meta.name != tbl:
-                        continue
-                    if any(cm.name == name for cm in tr.meta.columns):
-                        out.add(tr.alias)
-                        return
-                raise PlanError(f"unknown column {n}")
-            for f_ in getattr(n, "__dataclass_fields__", {}):
-                v = getattr(n, f_)
-                if isinstance(v, A.ExprNode):
-                    walk(v)
-                elif isinstance(v, list):
-                    for it in v:
-                        if isinstance(it, A.ExprNode):
-                            walk(it)
-                        elif isinstance(it, tuple):
-                            for x in it:
-                                if isinstance(x, A.ExprNode):
-                                    walk(x)
+                hits = [
+                    tr.alias
+                    for tr in self.tables
+                    if (not tbl or tr.alias == tbl or tr.meta.name == tbl)
+                    and any(cm.name == name for cm in tr.meta.columns)
+                ]
+                if not hits:
+                    raise PlanError(f"unknown column {n}")
+                if len(hits) > 1:
+                    raise PlanError(f"ambiguous column {n}")
+                out.add(hits[0])
+                return
+            for c in _ast_children(n):
+                walk(c)
 
         walk(node)
         return out
@@ -116,6 +112,23 @@ class _Scope:
 # --------------------------------------------------------------------------
 # expression lowering
 # --------------------------------------------------------------------------
+
+def _ast_children(n):
+    """Child ExprNodes of an AST node (one walker for every traversal —
+    covers ExprNode fields, lists, and tuple entries like Case clauses)."""
+    for f_ in getattr(n, "__dataclass_fields__", {}):
+        v = getattr(n, f_)
+        if isinstance(v, A.ExprNode):
+            yield v
+        elif isinstance(v, list):
+            for it in v:
+                if isinstance(it, A.ExprNode):
+                    yield it
+                elif isinstance(it, tuple):
+                    for x in it:
+                        if isinstance(x, A.ExprNode):
+                            yield x
+
 
 _CMP_OPS = {"eq", "ne", "lt", "le", "gt", "ge", "nulleq"}
 _LOGIC_OPS = {"and", "or", "xor"}
@@ -501,17 +514,7 @@ def _equi_sides(e: A.ExprNode):
 def _has_agg(n) -> bool:
     if isinstance(n, A.AggFunc):
         return True
-    for f_ in getattr(n, "__dataclass_fields__", {}):
-        v = getattr(n, f_)
-        if isinstance(v, A.ExprNode) and _has_agg(v):
-            return True
-        if isinstance(v, list):
-            for it in v:
-                if isinstance(it, A.ExprNode) and _has_agg(it):
-                    return True
-                if isinstance(it, tuple) and any(isinstance(x, A.ExprNode) and _has_agg(x) for x in it):
-                    return True
-    return False
+    return any(_has_agg(c) for c in _ast_children(n))
 
 
 def _field_label(f: A.SelectField) -> str:
@@ -564,6 +567,7 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog) -> PlannedQuery:
 
     # ---- join order: probe = largest table (row-count stat); LEFT JOIN
     # pins the textual order (outer semantics are order-sensitive)
+    textual_order = [(meta, alias) for meta, alias, _, _ in flat]  # for SELECT *
     has_left = any(kind == "left" for _, _, kind, _ in flat)
     if not has_left and len(flat) > 1:
         probe_i = max(range(len(flat)), key=lambda i: flat[i][0].row_count)
@@ -684,16 +688,17 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog) -> PlannedQuery:
     if residual:
         executors.append(Selection(tuple(low.lower_base(c) for c in residual)))
 
-    # ---- select list: expand * / t.* first
+    # ---- select list: expand * / t.* first — in TEXTUAL FROM order (the
+    # probe reorder must not change the user-visible column order)
     fields: list = []
     for f in stmt.fields:
         e = f.expr if isinstance(f, A.SelectField) else f
         if isinstance(e, A.Star):
-            for tr in trefs:
-                if e.table and tr.alias != e.table.lower() and tr.meta.name != e.table.lower():
+            for meta, alias in textual_order:
+                if e.table and alias != e.table.lower() and meta.name != e.table.lower():
                     continue
-                for cm in tr.meta.columns:
-                    fields.append(A.SelectField(A.ColumnName(cm.name, tr.alias), cm.name))
+                for cm in meta.columns:
+                    fields.append(A.SelectField(A.ColumnName(cm.name, alias), cm.name))
         else:
             fields.append(f)
 

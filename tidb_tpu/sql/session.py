@@ -246,12 +246,26 @@ class Session:
             assigns.append((cm, lw.lower_base(a.expr)))
         ev = RefEvaluator()
         wts = self._next_ts()
+        moves_handle = meta.handle_col is not None and any(cm.name == meta.handle_col for cm, _ in assigns)
         for handle, row in matched:
             new_row = list(row)
             for cm, e in assigns:
                 # MySQL applies SET left-to-right over already-updated values
                 new_row[col_pos[cm.name]] = _coerce_datum(ev.eval(e, new_row), cm.ft)
-            self.store.put_row(meta.table_id, handle, meta.col_ids(), new_row, wts)
+            new_handle = handle
+            if moves_handle:
+                d = new_row[col_pos[meta.handle_col]]
+                if d.is_null():
+                    raise SQLError(f"column {meta.handle_col!r} cannot be NULL")
+                new_handle = int(d.val)
+            if new_handle != handle:
+                # PK change moves the row to a new key (ref: updateRecord's
+                # remove+add when the handle changes)
+                nkey = tablecodec.encode_row_key(meta.table_id, new_handle)
+                if self.store.kv.get(nkey, wts) is not None:
+                    raise SQLError(f"duplicate entry {new_handle} for key PRIMARY")
+                self.store.delete_row(meta.table_id, handle, wts)
+            self.store.put_row(meta.table_id, new_handle, meta.col_ids(), new_row, wts)
         return Result(affected=len(matched))
 
     def _delete(self, stmt: A.DeleteStmt) -> Result:
