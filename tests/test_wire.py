@@ -1,0 +1,123 @@
+"""Serialized coprocessor seam (VERDICT next #7): DAGRequest and chunks
+round-trip through bytes with zero result diff; dispatch can route every
+cop request through the bytes boundary (the sidecar shape)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.codec import tablecodec
+from tidb_tpu.codec.wire import (
+    decode_chunk,
+    decode_cop_response,
+    decode_dag,
+    encode_chunk,
+    encode_cop_request,
+    encode_dag,
+)
+from tidb_tpu.distsql import KVRequest, full_table_ranges, select
+from tidb_tpu.exec import Aggregation, ColumnInfo, DAGRequest, Join, Limit, Selection, TableScan, TopN
+from tidb_tpu.expr import AggDesc, AggMode, col, func, lit
+from tidb_tpu.store import TPUStore
+from tidb_tpu.types import Datum, MyDecimal, MyTime, new_datetime, new_decimal, new_longlong, new_varchar
+
+BOOL = new_longlong(notnull=True)
+
+
+def sample_dag():
+    fts = [new_longlong(), new_decimal(10, 2), new_varchar(8), new_datetime()]
+    C = lambda i: col(i, fts[i])
+    scan = TableScan(9, tuple(ColumnInfo(i + 1, ft) for i, ft in enumerate(fts)))
+    build = TableScan(10, (ColumnInfo(1, fts[0]), ColumnInfo(2, fts[2])))
+    join = Join(
+        build=(build, Selection((func("like", BOOL, col(1, fts[2]), lit("a%", new_varchar(2))),))),
+        probe_keys=(C(0),),
+        build_keys=(col(0, fts[0]),),
+        join_type="left_outer",
+    )
+    sel = Selection((
+        func("and", BOOL,
+             func("ge", BOOL, C(3), lit("2020-01-01", new_datetime())),
+             func("between", BOOL, C(1), lit("1.00", new_decimal(3, 2)), lit("9.99", new_decimal(3, 2)))),
+    ))
+    agg = Aggregation(
+        group_by=(C(2),),
+        aggs=(AggDesc("sum", (C(1),)), AggDesc("count", (), mode=AggMode.Partial1), AggDesc("first_row", (C(3),))),
+        partial=True,
+    )
+    t = TopN(order_by=((C(1), True),), limit=12)
+    return DAGRequest((scan, sel, join, agg, t), output_offsets=(0, 1, 2), time_zone="UTC", flags=3)
+
+
+def test_dag_roundtrip_bitexact():
+    dag = sample_dag()
+    b = encode_dag(dag)
+    dag2 = decode_dag(b)
+    assert dag2 == dag  # frozen dataclasses: full structural equality
+    assert dag2.fingerprint() == dag.fingerprint()
+    assert encode_dag(dag2) == b  # stable re-encode
+
+
+def test_chunk_roundtrip():
+    fts = [new_longlong(), new_longlong(unsigned=True), new_decimal(8, 3), new_varchar(12), new_datetime()]
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(57):
+        rows.append([
+            Datum.i64(int(rng.integers(-1000, 1000))) if i % 7 else Datum.NULL,
+            Datum.u64(int(rng.integers(0, 2**63))),
+            Datum.dec(MyDecimal(f"{int(rng.integers(-99999, 99999))/1000:.3f}")),
+            Datum.string("αβ" if i % 5 == 0 else f"s{i}") if i % 6 else Datum.NULL,
+            Datum.time(MyTime.from_ymd(2020 + i % 5, 1 + i % 12, 1 + i % 28)),
+        ])
+    ch = Chunk.from_rows(fts, rows)
+    ch2 = decode_chunk(encode_chunk(ch))
+    from tidb_tpu.exec.executor import datum_group_key
+
+    assert [[datum_group_key(d) for d in r] for r in ch2.rows()] == [
+        [datum_group_key(d) for d in r] for r in ch.rows()
+    ]
+
+
+def test_dispatch_through_wire_zero_diff():
+    """select() with use_wire=True: every cop request/response crosses the
+    bytes boundary; results identical to the in-process path."""
+    store = TPUStore()
+    tid = 4
+    fts = [new_longlong(), new_decimal(10, 2)]
+    rng = np.random.default_rng(1)
+    for h in range(150):
+        store.put_row(tid, h, [1, 2], [Datum.i64(int(rng.integers(0, 9))), Datum.dec(MyDecimal(f"{h}.25"))], ts=5)
+    store.cluster.split(tablecodec.encode_row_key(tid, 75))
+    scan = TableScan(tid, (ColumnInfo(1, fts[0]), ColumnInfo(2, fts[1])))
+    agg = Aggregation(group_by=(col(0, fts[0]),), aggs=(AggDesc("count", ()), AggDesc("sum", (col(1, fts[1]),))), partial=True)
+    dag = DAGRequest((scan, agg), output_offsets=tuple(range(3)))
+
+    plain = select(store, KVRequest(dag, full_table_ranges(tid), start_ts=100))
+    wired = select(store, KVRequest(dag, full_table_ranges(tid), start_ts=100, use_wire=True))
+    from tidb_tpu.exec.executor import datum_group_key
+
+    def canon(res):
+        return sorted(tuple(datum_group_key(d) for d in r) for c in res.chunks for r in c.rows())
+
+    assert canon(wired) == canon(plain)
+    # summaries and paging survive the wire too
+    assert all(len(sm) == 2 for sm in wired.exec_summaries)
+
+
+def test_wire_paging():
+    store = TPUStore()
+    tid = 6
+    for h in range(40):
+        store.put_row(tid, h, [1], [Datum.i64(h)], ts=5)
+    scan = TableScan(tid, (ColumnInfo(1, new_longlong()),))
+    dag = DAGRequest((scan,), output_offsets=(0,))
+    res = select(store, KVRequest(dag, full_table_ranges(tid), start_ts=100, paging_size=15, use_wire=True))
+    assert len(res.chunks) == 3
+    assert sorted(r[0].val for c in res.chunks for r in c.rows()) == list(range(40))
+
+
+def test_wire_malformed_request():
+    store = TPUStore()
+    resp = decode_cop_response(store.coprocessor_bytes(b"\x01\x02garbage"))
+    assert resp.other_error and "bad request" in resp.other_error

@@ -52,6 +52,9 @@ class KVRequest:
     concurrency: int = 4
     keep_order: bool = False
     aux_chunks: list = field(default_factory=list)
+    paging_size: int | None = None  # per-page row budget (ref: kv.Request Paging)
+    use_wire: bool = False  # route every cop request through the serialized
+    # bytes seam (coprocessor_bytes) instead of in-process objects
 
 
 @dataclass
@@ -99,21 +102,37 @@ def select(store: TPUStore, req: KVRequest) -> SelectResult:
     summaries: list = []
 
     def run_task(i: int, task: CopTask, retries: int = MAX_RETRY):
-        creq = CopRequest(req.dag, task.ranges, req.start_ts, task.region_id, task.epoch, aux_chunks=req.aux_chunks)
-        resp = store.coprocessor(creq)
-        if resp.region_error is not None:
-            if retries <= 0:
-                raise RuntimeError(f"region retries exhausted: {resp.region_error}")
-            # re-split this task's ranges against the fresh region view
-            sub = _build_tasks(store, task.ranges)
-            outs = []
-            for s in sub:
-                outs.extend(run_task(i, s, retries - 1))
-            return outs
-        if resp.other_error is not None:
-            raise RuntimeError(resp.other_error)
-        summaries.append(resp.exec_summaries)
-        return [resp.chunk]
+        """One cop task; drives the paging loop when paging is on
+        (ref: copr/coprocessor.go:1393 handleCopPagingResult — each page's
+        lastRange seeds the next request until the task drains)."""
+        out_chunks: list = []
+        ranges = task.ranges
+        while True:
+            creq = CopRequest(
+                req.dag, ranges, req.start_ts, task.region_id, task.epoch,
+                aux_chunks=req.aux_chunks, paging_size=req.paging_size,
+            )
+            if req.use_wire:
+                from ..codec.wire import decode_cop_response, encode_cop_request
+
+                resp = decode_cop_response(store.coprocessor_bytes(encode_cop_request(creq)))
+            else:
+                resp = store.coprocessor(creq)
+            if resp.region_error is not None:
+                if retries <= 0:
+                    raise RuntimeError(f"region retries exhausted: {resp.region_error}")
+                # re-split the REMAINING ranges against the fresh region view
+                sub = _build_tasks(store, ranges)
+                for s in sub:
+                    out_chunks.extend(run_task(i, s, retries - 1))
+                return out_chunks
+            if resp.other_error is not None:
+                raise RuntimeError(resp.other_error)
+            summaries.append(resp.exec_summaries)
+            out_chunks.append(resp.chunk)
+            if resp.last_range is None:
+                return out_chunks
+            ranges = resp.last_range
 
     if req.concurrency > 1 and len(tasks) > 1:
         with ThreadPoolExecutor(max_workers=req.concurrency) as pool:

@@ -46,7 +46,13 @@ class CopRequest:
     aux_chunks: broadcast operands for the DAG's join build sides, one per
     non-probe scan in canonical order (the TiFlash broadcast-exchange analog
     — ref: mpp_exec.go:669 Broadcast partition mode). Every region task of a
-    broadcast join carries the same chunks; the device upload is shared."""
+    broadcast join carries the same chunks; the device upload is shared.
+
+    paging_size: when set, the scan stops after at most this many rows and
+    the response carries `last_range`, the resume cursor for the next page
+    (ref: copr/coprocessor.go:1393 handleCopPagingResult; store side
+    cop_handler.go:210 lastRange). Row-local DAGs only — aggregations
+    cannot produce correct partials from a partial scan."""
 
     dag: DAGRequest
     ranges: list
@@ -54,6 +60,7 @@ class CopRequest:
     region_id: int = 0
     region_epoch: int = 0
     aux_chunks: list = field(default_factory=list)
+    paging_size: int | None = None
 
 
 @dataclass
@@ -71,6 +78,7 @@ class CopResponse:
     region_error: str | None = None
     other_error: str | None = None
     exec_summaries: list = field(default_factory=list)
+    last_range: list | None = None  # [KeyRange] resume cursor; None = drained
 
 
 class TPUStore:
@@ -122,8 +130,8 @@ class TPUStore:
         cached = self._chunk_cache.get(rkey)
         if cached is not None:
             return cached
-        fts_by_id = {c.col_id: c.ft for c in scan.columns}
         fts = [c.ft for c in scan.columns]
+        fts_by_id = {c.col_id: c.ft for c in scan.columns}
         rows = []
         for rng in ranges:
             start = max(rng.start, region.start_key)
@@ -131,21 +139,49 @@ class TPUStore:
             if start >= end:
                 continue
             for key, val in self.kv.scan(start, end, start_ts):
-                try:
-                    _, handle = tablecodec.decode_row_key(key)
-                except ValueError:
-                    continue
-                dmap = decode_row_to_datum_map(val, fts_by_id)
-                row = []
-                for c in scan.columns:
-                    if c.col_id == -1:  # handle column (_tidb_rowid)
-                        row.append(Datum.i64(handle))
-                    else:
-                        row.append(dmap[c.col_id])
-                rows.append(row)
+                row = self._decode_row(key, val, scan, fts_by_id)
+                if row is not None:
+                    rows.append(row)
         ch = Chunk.from_rows(fts, rows)
         self._chunk_cache[rkey] = ch
         return ch
+
+    def _decode_row(self, key: bytes, val: bytes, scan, fts_by_id: dict):
+        try:
+            _, handle = tablecodec.decode_row_key(key)
+        except ValueError:
+            return None
+        dmap = decode_row_to_datum_map(val, fts_by_id)
+        row = []
+        for c in scan.columns:
+            if c.col_id == -1:  # handle column (_tidb_rowid)
+                row.append(Datum.i64(handle))
+            else:
+                row.append(dmap[c.col_id])
+        return row
+
+    def _paged_region_chunk(self, region: Region, ranges: list, dag: DAGRequest, start_ts: int, limit: int):
+        """Scan at most `limit` rows of region ∩ ranges; returns
+        (chunk, resume_ranges | None). The resume cursor is the first
+        unscanned key, exactly the reference's lastRange contract
+        (ref: cop_handler.go:210-224)."""
+        scan = dag.scan()
+        fts = [c.ft for c in scan.columns]
+        fts_by_id = {c.col_id: c.ft for c in scan.columns}
+        rows: list = []
+        for ri, rng in enumerate(ranges):
+            start = max(rng.start, region.start_key)
+            end = min(rng.end, region.end_key)
+            if start >= end:
+                continue
+            for key, val in self.kv.scan(start, end, start_ts):
+                if len(rows) >= limit:
+                    resume = [KeyRange(key, rng.end)] + list(ranges[ri + 1 :])
+                    return Chunk.from_rows(fts, rows), resume
+                row = self._decode_row(key, val, scan, fts_by_id)
+                if row is not None:
+                    rows.append(row)
+        return Chunk.from_rows(fts, rows), None
 
     def region_device_batch(self, region: Region, ranges, dag: DAGRequest, start_ts: int, capacity: int | None = None) -> DeviceBatch:
         ch = self.region_chunk(region, ranges, dag, start_ts)
@@ -190,6 +226,20 @@ class TPUStore:
                 self._aux_batch_cache.pop(next(iter(self._aux_batch_cache)))
         return batch
 
+    # -- the serialized endpoint (the sidecar seam) -------------------------
+    def coprocessor_bytes(self, req_bytes: bytes) -> bytes:
+        """Serve one cop request from wire bytes to wire bytes — the
+        process-boundary shape of the coprocessor endpoint (ref:
+        unistore/rpc.go:260 CmdCop dispatch over serialized protos). A
+        sidecar server loop is exactly `recv -> coprocessor_bytes -> send`."""
+        from ..codec.wire import decode_cop_request, encode_cop_response
+
+        try:
+            req = decode_cop_request(req_bytes)
+        except Exception as exc:  # malformed bytes must not kill the server
+            return encode_cop_response(CopResponse(other_error=f"bad request: {exc}"))
+        return encode_cop_response(self.coprocessor(req))
+
     # -- the coprocessor endpoint -------------------------------------------
     def coprocessor(self, req: CopRequest, group_capacity: int = DEFAULT_GROUP_CAPACITY) -> CopResponse:
         region = self.cluster.region_by_id(req.region_id)
@@ -198,8 +248,24 @@ class TPUStore:
         if req.region_epoch != region.epoch:
             return CopResponse(region_error=f"epoch_not_match: have {region.epoch}, got {req.region_epoch}")
         t0 = time.monotonic_ns()
+        last_range = None
+        page = None
         try:
-            batch = self.region_device_batch(region, req.ranges, req.dag, req.start_ts)
+            if req.paging_size is not None:
+                from ..exec.dag import Aggregation as _Agg, Limit as _Limit, TopN as _TopN, executor_walk
+
+                if req.paging_size <= 0:
+                    return CopResponse(other_error=f"invalid paging_size {req.paging_size}")
+                if any(isinstance(e, (_Agg, _TopN, _Limit)) for e in executor_walk(req.dag.executors)):
+                    # per-page agg/top-k/limit results are not mergeable by
+                    # concatenation — row-local DAGs only (scan/sel/proj/join)
+                    return CopResponse(other_error="paging requires a row-local DAG (no aggregation/TopN/Limit)")
+                page, last_range = self._paged_region_chunk(
+                    region, req.ranges, req.dag, req.start_ts, req.paging_size
+                )
+                batch = to_device_batch(page, capacity=_pow2(max(page.num_rows(), 1)))
+            else:
+                batch = self.region_device_batch(region, req.ranges, req.dag, req.start_ts)
             batches = [batch] + [self._aux_batch(c) for c in req.aux_chunks]
             chunk, ex_rows = drive_program(self.programs, req.dag, batches, group_capacity)
         except OverflowRetryError:
@@ -208,7 +274,7 @@ class TPUStore:
             try:
                 from ..exec.dag import executor_walk
 
-                region_chunk = self.region_chunk(region, req.ranges, req.dag, req.start_ts)
+                region_chunk = page if page is not None else self.region_chunk(region, req.ranges, req.dag, req.start_ts)
                 rows = run_dag_reference(req.dag, [region_chunk] + list(req.aux_chunks))
                 chunk = Chunk.from_rows(req.dag.output_fts(), rows)
                 # fallback summaries: aligned with the device path's
@@ -228,4 +294,4 @@ class TPUStore:
             ExecSummary(time_processed_ns=elapsed, num_produced_rows=r)
             for r in ex_rows
         ]
-        return CopResponse(chunk=chunk, exec_summaries=summaries)
+        return CopResponse(chunk=chunk, exec_summaries=summaries, last_range=last_range)
