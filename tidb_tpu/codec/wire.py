@@ -240,13 +240,22 @@ def r_agg_desc(r: Reader) -> AggDesc:
 
 # -------------------------------------------------------------- executors
 
-_EX_SCAN, _EX_SEL, _EX_PROJ, _EX_AGG, _EX_TOPN, _EX_LIMIT, _EX_JOIN = range(1, 8)
+_EX_SCAN, _EX_SEL, _EX_PROJ, _EX_AGG, _EX_TOPN, _EX_LIMIT, _EX_JOIN, _EX_ISCAN = range(1, 9)
 
 
 def w_executor(w: Writer, ex):
-    from ..exec.dag import Aggregation, ColumnInfo, Join, Limit, Projection, Selection, TableScan, TopN
+    from ..exec.dag import Aggregation, ColumnInfo, IndexScan, Join, Limit, Projection, Selection, TableScan, TopN
 
-    if isinstance(ex, TableScan):
+    if isinstance(ex, IndexScan):
+        w.u8(_EX_ISCAN)
+        w.i64(ex.table_id)
+        w.i64(ex.index_id)
+        w.bool_(ex.desc)
+        w.i32(len(ex.columns))
+        for c in ex.columns:
+            w.i64(c.col_id)
+            w_ft(w, c.ft)
+    elif isinstance(ex, TableScan):
         w.u8(_EX_SCAN)
         w.i64(ex.table_id)
         w.bool_(ex.desc)
@@ -301,9 +310,15 @@ def w_executor(w: Writer, ex):
 
 
 def r_executor(r: Reader):
-    from ..exec.dag import Aggregation, ColumnInfo, Join, Limit, Projection, Selection, TableScan, TopN
+    from ..exec.dag import Aggregation, ColumnInfo, IndexScan, Join, Limit, Projection, Selection, TableScan, TopN
 
     tag = r.u8()
+    if tag == _EX_ISCAN:
+        tid = r.i64()
+        iid = r.i64()
+        desc = r.bool_()
+        cols = tuple(ColumnInfo(r.i64(), r_ft(r)) for _ in range(r.i32()))
+        return IndexScan(tid, iid, cols, desc)
     if tag == _EX_SCAN:
         tid = r.i64()
         desc = r.bool_()

@@ -26,7 +26,7 @@ from dataclasses import dataclass, replace
 
 from ..chunk import Chunk
 from ..exec.builder import DEFAULT_GROUP_CAPACITY, ProgramCache
-from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, Join, Limit, Projection, Selection, TableScan, TopN, current_schema_fts
+from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, IndexScan, Join, Limit, Projection, Selection, TableScan, TopN, current_schema_fts
 from ..exec.executor import run_dag_on_chunks
 from ..expr.agg import AggDesc, AggMode
 from ..expr.ir import col
@@ -64,7 +64,7 @@ def split_dag(dag: DAGRequest) -> RootPlan:
     i = 0
     while i < len(executors):
         ex = executors[i]
-        if isinstance(ex, (TableScan, Selection, Projection, Join)):
+        if isinstance(ex, (TableScan, IndexScan, Selection, Projection, Join)):
             push.append(ex)
             i += 1
             continue

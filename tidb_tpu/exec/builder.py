@@ -29,7 +29,7 @@ from ..expr.compile import CompVal, ExprCompiler, normalize_device_column
 from ..ops import apply_selection, group_aggregate, hash_join, scalar_aggregate, topn
 from ..ops.aggregate import GatherState, finalize_agg
 from ..types import FieldType
-from .dag import Aggregation, DAGRequest, Join, Limit, Projection, Selection, TableScan, TopN, collect_scans, current_schema_fts
+from .dag import Aggregation, DAGRequest, IndexScan, Join, Limit, Projection, Selection, TableScan, TopN, collect_scans, current_schema_fts
 
 DEFAULT_GROUP_CAPACITY = 4096
 
@@ -72,7 +72,7 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
     batches are consumed in canonical scan order (dag.collect_scans);
     `cursor` is the trace-time index of the next unconsumed batch."""
     scan = executors[0]
-    assert isinstance(scan, TableScan), "pipeline must start with a scan"
+    assert isinstance(scan, (TableScan, IndexScan)), "pipeline must start with a scan"
     batch = batches[cursor[0]]
     cursor[0] += 1
     fts = [c.ft for c in scan.columns]

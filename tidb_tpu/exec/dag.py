@@ -41,6 +41,27 @@ class TableScan:
 
 
 @dataclass(frozen=True)
+class IndexScan:
+    """(ref: tipb.IndexScan; executor mpp_exec.go:255 indexScanExec).
+
+    Reads index entries `t{tid}_i{iid}{vals...}{handle}` instead of rows;
+    output schema is the stored entry layout: the indexed columns in index
+    order, then the int64 handle (col_id -1). A covering query runs
+    entirely off this scan; an index lookup uses it to produce handles for
+    a second table read."""
+
+    table_id: int
+    index_id: int
+    columns: tuple  # tuple[ColumnInfo, ...] — index cols then handle(-1)
+    desc: bool = False
+
+    def fingerprint(self):
+        return ("iscan", self.table_id, self.index_id, self.desc) + tuple(
+            c.fingerprint() for c in self.columns
+        )
+
+
+@dataclass(frozen=True)
 class Selection:
     """(ref: tipb.Selection; mpp_exec.go:1121 selExec)."""
 
@@ -167,8 +188,8 @@ class DAGRequest:
     def fingerprint(self):
         return tuple(e.fingerprint() for e in self.executors) + ("out",) + tuple(self.output_offsets)
 
-    def scan(self) -> TableScan:
-        assert isinstance(self.executors[0], TableScan)
+    def scan(self):
+        assert isinstance(self.executors[0], (TableScan, IndexScan))
         return self.executors[0]
 
     def output_fts(self) -> list[FieldType]:
@@ -180,7 +201,7 @@ def current_schema_fts(executors) -> list[FieldType]:
     """Schema of the last executor's output."""
     fts: list[FieldType] = []
     for ex in executors:
-        if isinstance(ex, TableScan):
+        if isinstance(ex, (TableScan, IndexScan)):
             fts = [c.ft for c in ex.columns]
         elif isinstance(ex, (Selection, Limit, TopN)):
             pass  # schema unchanged
@@ -219,7 +240,7 @@ def collect_scans(executors) -> list[TableScan]:
     chunks) are supplied in exactly this order."""
     out: list[TableScan] = []
     for ex in executors:
-        if isinstance(ex, TableScan):
+        if isinstance(ex, (TableScan, IndexScan)):
             out.append(ex)
         elif isinstance(ex, Join):
             out.extend(collect_scans(ex.build))

@@ -151,6 +151,30 @@ class Catalog:
             self.version += 1
             return tbl
 
+    def add_index(self, table: str, index_name: str, col_names: list, unique: bool = False) -> IndexMeta:
+        """CREATE INDEX metadata step (the backfill is the session's job —
+        ref: pkg/ddl add-index schema change + backfill)."""
+        with self._lock:
+            tbl = self.table(table)
+            if any(i.name == index_name for i in tbl.indices):
+                raise CatalogError(f"index {index_name!r} already exists")
+            for cn in col_names:
+                tbl.col(cn)  # validates
+            im = IndexMeta(index_name, next(self._next_id), [c.lower() for c in col_names], unique)
+            tbl.indices.append(im)
+            self.version += 1
+            return im
+
+    def drop_index(self, table: str, index_name: str) -> IndexMeta:
+        with self._lock:
+            tbl = self.table(table)
+            im = next((i for i in tbl.indices if i.name == index_name), None)
+            if im is None:
+                raise CatalogError(f"unknown index {index_name!r} on {table!r}")
+            tbl.indices = [i for i in tbl.indices if i is not im]
+            self.version += 1
+            return im
+
     def drop_table(self, name: str, if_exists: bool = False):
         with self._lock:
             if name.lower() not in self._tables:

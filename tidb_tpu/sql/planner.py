@@ -21,11 +21,11 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, Join, Limit, Projection, Selection, TableScan, TopN
+from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, IndexScan, Join, Limit, Projection, Selection, TableScan, TopN
 from ..expr.agg import AGG_FUNCS, AggDesc
 from ..expr.ir import Expr, col, func, lit
 from ..parser import ast as A
-from ..types import Datum, FieldType, MyDecimal, MyTime, TypeCode, new_datetime, new_decimal, new_double, new_longlong, new_varchar
+from ..types import Datum, DatumKind, FieldType, MyDecimal, MyTime, TypeCode, new_datetime, new_decimal, new_double, new_longlong, new_varchar
 from .catalog import Catalog, CatalogError, TableMeta, field_type_from_spec
 
 BOOL = new_longlong()
@@ -47,6 +47,8 @@ class PlannedQuery:
     build_tables: list  # [TableMeta] in canonical scan order (after probe)
     column_names: list  # output column labels
     offset: int = 0  # LIMIT offset — applied by the session on final rows
+    ranges: list | None = None  # pruned scan ranges (ranger); None = full table
+    access_path: str = "table"  # table | table-range | index(<name>)
 
 
 # --------------------------------------------------------------------------
@@ -441,6 +443,38 @@ def _const_int(e: Expr) -> int:
     raise PlanError("constant integer expected")
 
 
+def _coerce_datum(d: Datum, ft: FieldType) -> Datum:
+    """Datum -> column type (insert/update path; ref: table.CastValue)."""
+    if d.is_null():
+        return d
+    et = ft.eval_type()
+    if et == "decimal":
+        if d.kind == DatumKind.MysqlDecimal:
+            return Datum.dec(d.val.round(max(ft.decimal, 0)))
+        return Datum.dec(MyDecimal(str(d.val)).round(max(ft.decimal, 0)))
+    if et == "real":
+        return Datum.f64(float(d.val.to_float() if d.kind == DatumKind.MysqlDecimal else d.val))
+    if et == "int":
+        if d.kind in (DatumKind.String, DatumKind.Bytes):
+            from ..expr.eval_ref import str_prefix_f64
+
+            return Datum.i64(int(round(str_prefix_f64(d.val))))
+        if d.kind == DatumKind.MysqlDecimal:
+            return Datum.i64(int(d.val.round(0).to_int()))
+        if ft.is_unsigned():
+            return Datum.u64(int(d.val))
+        return Datum.i64(int(d.val))
+    if et == "time":
+        if d.kind == DatumKind.MysqlTime:
+            return d
+        return Datum.time(MyTime.parse(str(d.val), max(ft.decimal, 0)))
+    if et == "string":
+        if d.kind in (DatumKind.String, DatumKind.Bytes):
+            return d
+        return Datum.string(str(d.val))
+    return d
+
+
 def _lower_literal(n: A.Literal) -> Expr:
     if n.kind == "null":
         return lit(None, new_longlong())
@@ -515,6 +549,45 @@ def _has_agg(n) -> bool:
     if isinstance(n, A.AggFunc):
         return True
     return any(_has_agg(c) for c in _ast_children(n))
+
+
+def _referenced_columns(stmt: A.SelectStmt, meta: TableMeta) -> set:
+    """All column names a single-table SELECT touches (star = every
+    column) — the covering-index eligibility set."""
+    names: set = set()
+    star = [False]
+
+    def walk(n):
+        if isinstance(n, A.Star):
+            star[0] = True
+            return
+        if isinstance(n, A.ColumnName):
+            names.add(n.name.lower())
+            return
+        if isinstance(n, A.AggFunc):
+            # count(*) references no columns — its Star is not select-star
+            for a in n.args:
+                if not isinstance(a, A.Star):
+                    walk(a)
+            for b in n.order_by:
+                walk(b.expr)
+            return
+        for c in _ast_children(n):
+            walk(c)
+
+    for f in stmt.fields:
+        walk(f.expr if isinstance(f, A.SelectField) else f)
+    if stmt.where is not None:
+        walk(stmt.where)
+    for b in stmt.group_by:
+        walk(b.expr)
+    if stmt.having is not None:
+        walk(stmt.having)
+    for b in stmt.order_by:
+        walk(b.expr)
+    if star[0]:
+        names |= {c.name for c in meta.columns}
+    return names
 
 
 def _field_label(f: A.SelectField) -> str:
@@ -617,9 +690,76 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog) -> PlannedQuery:
                 continue
         residual.append(c)
 
-    # ---- probe pipeline
+    # ---- access path (ranger): covering index scan / PK handle pruning
+    from .ranger import handle_ranges_from_intervals, index_ranges_from_intervals, intervals_for_column
+
     probe_meta, probe_alias = trefs[0].meta, trefs[0].alias
-    executors: list = [TableScan(probe_meta.table_id, tuple(ColumnInfo(c.col_id, c.ft) for c in probe_meta.columns))]
+    scan_ranges = None
+    access_path = "table"
+    probe_scan = TableScan(probe_meta.table_id, tuple(ColumnInfo(c.col_id, c.ft) for c in probe_meta.columns))
+
+    def _const_of(ft):
+        """Literal -> Datum of the column's type for range building. When
+        the coercion is LOSSY (1.5 rounded to 2 for an int column) the
+        original bound semantics would prune matching rows — decline, the
+        conjunct stays as a plain filter (ref: ranger's points conversion
+        refuses inexact casts)."""
+        from ..expr.eval_ref import compare
+
+        numeric = (DatumKind.Int64, DatumKind.Uint64, DatumKind.Float32, DatumKind.Float64, DatumKind.MysqlDecimal)
+
+        def ev(lit_ast):
+            d = _lower_literal(lit_ast).datum
+            cd = _coerce_datum(d, ft)
+            if d.kind in numeric and cd.kind in numeric and compare(d, cd) != 0:
+                return None
+            return cd
+
+        return ev
+
+    if len(trefs) == 1 and probe_meta.indices:
+        # covering index: every referenced column lives in the index (or is
+        # the handle) AND its first column is range-constrained
+        # (ref: physical access-path selection, find_best_task.go)
+        from .catalog import ColumnMeta
+
+        referenced = _referenced_columns(stmt, probe_meta)
+        for idx in probe_meta.indices:
+            covered = set(idx.col_names) | ({probe_meta.handle_col} if probe_meta.handle_col else set())
+            if not referenced <= covered:
+                continue
+            first = probe_meta.col(idx.col_names[0])
+            ivs = intervals_for_column(local[probe_alias], first.name, _const_of(first.ft))
+            if ivs is None:
+                continue
+            # entry layout = [index cols..., handle]; the resolution schema
+            # must align slot for slot with the IndexScan output
+            vcols = [probe_meta.col(cn) for cn in idx.col_names]
+            vmetas = [ColumnMeta(c.name, c.col_id, c.ft) for c in vcols]
+            handle_ft = new_longlong(notnull=True)
+            if probe_meta.handle_col and probe_meta.handle_col not in idx.col_names:
+                vmetas.append(ColumnMeta(probe_meta.handle_col, -1, handle_ft))
+            else:
+                vmetas.append(ColumnMeta("_tidb_rowid", -1, handle_ft))
+            virtual = TableMeta(probe_meta.name, probe_meta.table_id, vmetas, [], probe_meta.handle_col)
+            icols = tuple(ColumnInfo(c.col_id, c.ft) for c in vmetas)
+            probe_scan = IndexScan(probe_meta.table_id, idx.index_id, icols)
+            scan_ranges = index_ranges_from_intervals(probe_meta.table_id, idx.index_id, ivs)
+            access_path = f"index({idx.name})"
+            # rebind resolution to the index entry schema
+            trefs = [_TableRef(virtual, probe_alias, 0)]
+            scope = _Scope(trefs)
+            low = _Lowerer(scope, aliases)
+            break
+    if access_path == "table" and probe_meta.handle_col is not None:
+        hcol = probe_meta.col(probe_meta.handle_col)
+        ivs = intervals_for_column(local[probe_alias], hcol.name, _const_of(hcol.ft))
+        if ivs is not None:
+            scan_ranges = handle_ranges_from_intervals(probe_meta.table_id, ivs)
+            access_path = "table-range"
+
+    # ---- probe pipeline
+    executors: list = [probe_scan]
     if local[probe_alias]:
         executors.append(Selection(tuple(low.lower_base(c) for c in local[probe_alias])))
 
@@ -771,4 +911,7 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog) -> PlannedQuery:
         offsets = tuple(range(len(out_exprs)))
 
     dag = DAGRequest(tuple(executors), output_offsets=offsets)
-    return PlannedQuery(dag, probe_meta, build_tables, names, offset=offset_n or 0)
+    return PlannedQuery(
+        dag, probe_meta, build_tables, names,
+        offset=offset_n or 0, ranges=scan_ranges, access_path=access_path,
+    )

@@ -25,7 +25,7 @@ from ..parser.parser import parse_one
 from ..store import TPUStore
 from ..types import Datum, DatumKind, FieldType, MyDecimal, MyTime, new_longlong
 from .catalog import Catalog, CatalogError, TableMeta
-from .planner import PlanError, _Lowerer, _Scope, _TableRef, plan_select
+from .planner import PlanError, _Lowerer, _Scope, _TableRef, _coerce_datum, plan_select
 
 HANDLE_FT = new_longlong(notnull=True)
 
@@ -97,6 +97,10 @@ class Session:
             return Result()
         if isinstance(stmt, (A.UseStmt, A.CreateDatabaseStmt)):
             return Result()  # single implicit database
+        if isinstance(stmt, A.CreateIndexStmt):
+            return self._create_index(stmt)
+        if isinstance(stmt, A.DropIndexStmt):
+            return self._drop_index(stmt)
         if isinstance(stmt, A.ShowStmt):
             return self._show(stmt)
         if isinstance(stmt, A.ExplainStmt):
@@ -114,10 +118,14 @@ class Session:
         plan = plan_select(stmt, self.catalog)
         ts = self._next_ts()
         aux = [self._fetch_table_chunk(t, ts) for t in plan.build_tables]
+        # empty ranges (ranger proved the predicate unsatisfiable) flow
+        # through: execute_root dispatches zero tasks and the root merge
+        # still produces scalar-agg rows (count(*) of nothing = 0)
+        ranges = plan.ranges if plan.ranges is not None else full_table_ranges(plan.probe_table.table_id)
         chunk = execute_root(
             self.store,
             plan.dag,
-            full_table_ranges(plan.probe_table.table_id),
+            ranges,
             start_ts=ts,
             aux_chunks=aux,
         )
@@ -137,6 +145,87 @@ class Session:
         ev = RefEvaluator()
         d = ev.eval(lw.lower_base(node), [])
         return _coerce_datum(d, ft)
+
+    def _create_index(self, stmt: A.CreateIndexStmt) -> Result:
+        """CREATE INDEX: catalog change + backfill of existing rows
+        (ref: ddl add-index write-reorg backfill, pkg/ddl/backfilling.go —
+        single process, so one synchronous pass)."""
+        meta = self.catalog.table(stmt.table.name)
+        cols = [c[0] if isinstance(c, tuple) else str(c) for c in stmt.columns]
+        im = self.catalog.add_index(stmt.table.name, stmt.index_name, cols, stmt.unique)
+        ts = self._next_ts()
+        rows = self._scan_rows_with_handles(meta, None, ts)
+        wts = self._next_ts()
+        pos = {c.name: i for i, c in enumerate(meta.columns)}
+        seen: dict = {}
+        for handle, row in rows:
+            vals = [row[pos[cn]] for cn in im.col_names]
+            if im.unique and not any(d.is_null() for d in vals):
+                k = tuple(str(d) for d in vals)
+                if k in seen:
+                    self.catalog.drop_index(stmt.table.name, im.name)  # roll back
+                    raise SQLError(f"duplicate entry for unique key {im.name!r} during backfill")
+                seen[k] = handle
+            self.store.put_index(
+                tablecodec.encode_index_key(meta.table_id, im.index_id, vals + [Datum.i64(handle)]), b"\x00", wts
+            )
+        return Result(affected=len(rows))
+
+    def _drop_index(self, stmt: A.DropIndexStmt) -> Result:
+        """Catalog change through the locked/versioned path, then tombstone
+        every entry of the dropped index (no KV leak)."""
+        meta = self.catalog.table(stmt.table.name)
+        im = self.catalog.drop_index(stmt.table.name, stmt.index_name)
+        wts = self._next_ts()
+        prefix = tablecodec.encode_index_key(meta.table_id, im.index_id, [])
+        for key, _ in list(self.store.kv.scan(prefix, prefix + b"\xff", wts)):
+            self.store.put_index(key, None, wts)
+        return Result()
+
+    def _check_unique(self, meta: TableMeta, datums: list, handle: int, ts: int):
+        """Unique-index duplicate check (ref: ER_DUP_ENTRY; MySQL allows
+        multiple NULLs in a unique index)."""
+        pos = {c.name: i for i, c in enumerate(meta.columns)}
+        for idx in meta.indices:
+            if not idx.unique:
+                continue
+            vals = [datums[pos[cn]] for cn in idx.col_names]
+            if any(d.is_null() for d in vals):
+                continue
+            prefix = tablecodec.encode_index_key(meta.table_id, idx.index_id, vals)
+            for key, _ in self.store.kv.scan(prefix, prefix + b"\xff", ts):
+                other = self._index_keys_handle(key)
+                if other is not None and other != handle:
+                    raise SQLError(
+                        f"duplicate entry for unique key {idx.name!r}"
+                    )
+
+    @staticmethod
+    def _index_keys_handle(key: bytes) -> int | None:
+        """Trailing handle datum of an index entry key."""
+        from ..codec.datum_codec import decode_datums
+
+        prefix_len = 1 + 8 + 2 + 8
+        try:
+            ds = decode_datums(key[prefix_len:])
+            return int(ds[-1].val)
+        except Exception:
+            return None
+
+    def _index_keys(self, meta: TableMeta, datums: list, handle: int) -> list:
+        """Index entry keys for one row: t{tid}_i{iid}{vals...}{handle}
+        (ref: tablecodec index layout; non-unique style — the handle rides
+        in the key, the value is a placeholder)."""
+        pos = {c.name: i for i, c in enumerate(meta.columns)}
+        out = []
+        for idx in meta.indices:
+            vals = [datums[pos[cn]] for cn in idx.col_names] + [Datum.i64(handle)]
+            out.append(tablecodec.encode_index_key(meta.table_id, idx.index_id, vals))
+        return out
+
+    def _write_indexes(self, meta, datums, handle, ts, delete=False):
+        for key in self._index_keys(meta, datums, handle):
+            self.store.put_index(key, None if delete else b"\x00", ts)
 
     def _insert(self, stmt: A.InsertStmt) -> Result:
         meta = self.catalog.table(stmt.table.name)
@@ -183,11 +272,29 @@ class Session:
                     continue
                 if not stmt.replace:
                     raise SQLError(f"duplicate entry {handle} for key PRIMARY")
+            if exists and stmt.replace and meta.indices:
+                # REPLACE drops the old row's index entries first; the old
+                # row is fetched by its known key (no table scan)
+                old_row = self._read_row(meta, handle, ts)
+                if old_row is not None:
+                    self._write_indexes(meta, old_row, handle, ts, delete=True)
+            self._check_unique(meta, datums, handle, ts)
             self.store.put_row(meta.table_id, handle, meta.col_ids(), datums, ts)
+            self._write_indexes(meta, datums, handle, ts)
             if not exists:
                 n += 1
                 meta.row_count += 1
         return Result(affected=n)
+
+    def _read_row(self, meta: TableMeta, handle: int, ts: int) -> list | None:
+        """Point read of one row by handle (ref: PointGet)."""
+        from ..codec.rowcodec import decode_row_to_datum_map
+
+        val = self.store.kv.get(tablecodec.encode_row_key(meta.table_id, handle), ts)
+        if val is None:
+            return None
+        dmap = decode_row_to_datum_map(val, {c.col_id: c.ft for c in meta.columns})
+        return [dmap[c.col_id] for c in meta.columns]
 
     def _scan_rows_with_handles(self, meta: TableMeta, where: A.ExprNode | None, ts: int,
                                 order_by: list | None = None, limit=None):
@@ -265,7 +372,10 @@ class Session:
                 if self.store.kv.get(nkey, wts) is not None:
                     raise SQLError(f"duplicate entry {new_handle} for key PRIMARY")
                 self.store.delete_row(meta.table_id, handle, wts)
+            self._write_indexes(meta, row, handle, wts, delete=True)
+            self._check_unique(meta, new_row, new_handle, wts)
             self.store.put_row(meta.table_id, new_handle, meta.col_ids(), new_row, wts)
+            self._write_indexes(meta, new_row, new_handle, wts)
         return Result(affected=len(matched))
 
     def _delete(self, stmt: A.DeleteStmt) -> Result:
@@ -273,8 +383,9 @@ class Session:
         ts = self._next_ts()
         matched = self._scan_rows_with_handles(meta, stmt.where, ts, stmt.order_by, stmt.limit)
         wts = self._next_ts()
-        for handle, _ in matched:
+        for handle, row in matched:
             self.store.delete_row(meta.table_id, handle, wts)
+            self._write_indexes(meta, row, handle, wts, delete=True)
         meta.row_count -= len(matched)
         return Result(affected=len(matched))
 
@@ -283,8 +394,9 @@ class Session:
         ts = self._next_ts()
         matched = self._scan_rows_with_handles(meta, None, ts)
         wts = self._next_ts()
-        for handle, _ in matched:
+        for handle, row in matched:
             self.store.delete_row(meta.table_id, handle, wts)
+            self._write_indexes(meta, row, handle, wts, delete=True)
         meta.row_count = 0
         return Result(affected=len(matched))
 
@@ -310,39 +422,8 @@ class Session:
         from ..distsql import split_dag
 
         rp = split_dag(plan.dag)
-        lines = [f"push[{type(e).__name__}]" for e in rp.push_dag.executors]
+        lines = [f"access: {plan.access_path}"]
+        lines += [f"push[{type(e).__name__}]" for e in rp.push_dag.executors]
         if rp.root_dag is not None:
             lines += [f"root[{type(e).__name__}]" for e in rp.root_dag.executors[1:]]
         return Result(columns=["plan"], rows=[[Datum.string(s)] for s in lines])
-
-
-def _coerce_datum(d: Datum, ft: FieldType) -> Datum:
-    """Datum -> column type (insert/update path; ref: table.CastValue)."""
-    if d.is_null():
-        return d
-    et = ft.eval_type()
-    if et == "decimal":
-        if d.kind == DatumKind.MysqlDecimal:
-            return Datum.dec(d.val.round(max(ft.decimal, 0)))
-        return Datum.dec(MyDecimal(str(d.val)).round(max(ft.decimal, 0)))
-    if et == "real":
-        return Datum.f64(float(d.val.to_float() if d.kind == DatumKind.MysqlDecimal else d.val))
-    if et == "int":
-        if d.kind in (DatumKind.String, DatumKind.Bytes):
-            from ..expr.eval_ref import str_prefix_f64
-
-            return Datum.i64(int(round(str_prefix_f64(d.val))))
-        if d.kind == DatumKind.MysqlDecimal:
-            return Datum.i64(int(d.val.round(0).to_int()))
-        if ft.is_unsigned():
-            return Datum.u64(int(d.val))
-        return Datum.i64(int(d.val))
-    if et == "time":
-        if d.kind == DatumKind.MysqlTime:
-            return d
-        return Datum.time(MyTime.parse(str(d.val), max(ft.decimal, 0)))
-    if et == "string":
-        if d.kind in (DatumKind.String, DatumKind.Bytes):
-            return d
-        return Datum.string(str(d.val))
-    return d

@@ -147,6 +147,10 @@ class TPUStore:
         return ch
 
     def _decode_row(self, key: bytes, val: bytes, scan, fts_by_id: dict):
+        from ..exec.dag import IndexScan
+
+        if isinstance(scan, IndexScan):
+            return self._decode_index_entry(key, scan)
         try:
             _, handle = tablecodec.decode_row_key(key)
         except ValueError:
@@ -159,6 +163,24 @@ class TPUStore:
             else:
                 row.append(dmap[c.col_id])
         return row
+
+    def _decode_index_entry(self, key: bytes, scan):
+        """Index key `t{tid}_i{iid}{vals...}{handle}` -> one row of the
+        IndexScan schema (index cols then handle; ref: indexScanExec
+        mpp_exec.go:255 decoding index entries back to datums)."""
+        from ..codec.datum_codec import decode_datums
+
+        prefix_len = 1 + 8 + 2 + 8  # 't' + tid + '_i' + iid
+        if len(key) <= prefix_len:
+            return None
+        fts = [c.ft for c in scan.columns]
+        try:
+            datums = decode_datums(key[prefix_len:], fts)
+        except (ValueError, IndexError):
+            return None
+        if len(datums) != len(scan.columns):
+            return None
+        return datums
 
     def _paged_region_chunk(self, region: Region, ranges: list, dag: DAGRequest, start_ts: int, limit: int):
         """Scan at most `limit` rows of region ∩ ranges; returns
