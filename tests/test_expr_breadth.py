@@ -1,0 +1,280 @@
+"""Expression breadth pass (VERDICT next #10): string functions, date
+arithmetic, general_ci collation, stddev/var and group_concat — device vs
+oracle parity plus end-to-end SQL."""
+
+import math
+
+import numpy as np
+import pytest
+
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.exec import Aggregation, ColumnInfo, DAGRequest, Projection, Selection, TableScan, run_dag_on_chunk, run_dag_reference
+from tidb_tpu.exec.executor import datum_group_key
+from tidb_tpu.expr import AggDesc, col, func, lit
+from tidb_tpu.sql import Session
+from tidb_tpu.types import (
+    Collation,
+    Datum,
+    FieldType,
+    MyDecimal,
+    MyTime,
+    TypeCode,
+    new_datetime,
+    new_decimal,
+    new_longlong,
+    new_varchar,
+)
+
+BOOL = new_longlong(notnull=True)
+VC = new_varchar(16)
+
+
+def canon(rows, fts=None):
+    return sorted(tuple(datum_group_key(d) for d in r) for r in rows)
+
+
+def str_chunk(vals):
+    fts = [new_longlong(), VC]
+    rows = [[Datum.i64(i), Datum.NULL if v is None else Datum.string(v)] for i, v in enumerate(vals)]
+    return Chunk.from_rows(fts, rows), fts
+
+
+def parity(dag, ch, sort=True):
+    dev = run_dag_on_chunk(dag, ch)
+    ref = run_dag_reference(dag, ch)
+    if sort:
+        assert canon(dev.rows()) == canon(ref)
+    else:
+        assert [tuple(datum_group_key(d) for d in r) for r in dev.rows()] == [
+            tuple(datum_group_key(d) for d in r) for r in ref
+        ]
+    return dev
+
+
+class TestStringFuncs:
+    def test_upper_lower_trim(self):
+        ch, fts = str_chunk(["Hello", "  padded  ", "MIXed cASE", "", None, "  x"])
+        s = TableScan(1, (ColumnInfo(1, fts[0]), ColumnInfo(2, fts[1])))
+        C1 = col(1, fts[1])
+        proj = Projection((
+            func("upper", VC, C1),
+            func("lower", VC, C1),
+            func("trim", VC, C1),
+            func("ltrim", VC, C1),
+            func("rtrim", VC, C1),
+        ))
+        parity(DAGRequest((s, proj), output_offsets=(0, 1, 2, 3, 4)), ch, sort=False)
+
+    def test_concat_substr(self):
+        ch, fts = str_chunk(["ab", "xyz", "", None, "long-ish value"])
+        s = TableScan(1, (ColumnInfo(1, fts[0]), ColumnInfo(2, fts[1])))
+        C0, C1 = col(0, fts[0]), col(1, fts[1])
+        proj = Projection((
+            func("concat", new_varchar(40), C1, lit("-", new_varchar(1)), C1),
+            func("substr", VC, C1, lit(2, new_longlong())),
+            func("substr", VC, C1, lit(2, new_longlong()), lit(3, new_longlong())),
+            func("substr", VC, C1, lit(-3, new_longlong())),
+        ))
+        parity(DAGRequest((s, proj), output_offsets=(0, 1, 2, 3)), ch, sort=False)
+
+    def test_replace_falls_back_to_oracle(self):
+        """replace() is host-only: the root path must degrade via the
+        oracle fallback, not crash."""
+        from tidb_tpu.exec import run_dag_on_chunks
+
+        ch, fts = str_chunk(["aXbXc", "nope", None])
+        s = TableScan(1, (ColumnInfo(1, fts[0]), ColumnInfo(2, fts[1])))
+        proj = Projection((func("replace", VC, col(1, fts[1]), lit("X", VC), lit("-", VC)),))
+        dag = DAGRequest((s, proj), output_offsets=(0,))
+        out = run_dag_on_chunks(dag, [ch])
+        assert [r[0].val for r in out.rows()] == ["a-b-c", "nope", None]
+
+
+class TestCollationCI:
+    def ci_ft(self):
+        return FieldType(TypeCode.Varchar, flen=16, collate=Collation.Utf8MB4GeneralCI)
+
+    def test_ci_compare_and_group(self):
+        ci = self.ci_ft()
+        fts = [new_longlong(), ci]
+        rows = [[Datum.i64(i), Datum.string(v)] for i, v in enumerate(["Apple", "APPLE", "apple", "Banana", "banana", "cherry"])]
+        ch = Chunk.from_rows(fts, rows)
+        s = TableScan(1, (ColumnInfo(1, fts[0]), ColumnInfo(2, fts[1])))
+        # eq compare is case-insensitive
+        sel = Selection((func("eq", BOOL, col(1, ci), lit("apple", new_varchar(8))),))
+        dev = parity(DAGRequest((s, sel), output_offsets=(0,)), ch)
+        assert dev.num_rows() == 3
+        # GROUP BY folds case into one group
+        agg = Aggregation(group_by=(col(1, ci),), aggs=(AggDesc("count", ()),))
+        dev = run_dag_on_chunk(DAGRequest((s, agg), output_offsets=(0,)), ch)
+        ref = run_dag_reference(DAGRequest((s, agg), output_offsets=(0,)), ch)
+        assert sorted(r[0].val for r in dev.rows()) == sorted(r[0].val for r in ref) == [1, 2, 3]
+
+    def test_binary_collation_stays_sensitive(self):
+        fts = [new_longlong(), new_varchar(8)]  # default binary collate
+        rows = [[Datum.i64(i), Datum.string(v)] for i, v in enumerate(["a", "A"])]
+        ch = Chunk.from_rows(fts, rows)
+        s = TableScan(1, (ColumnInfo(1, fts[0]), ColumnInfo(2, fts[1])))
+        sel = Selection((func("eq", BOOL, col(1, fts[1]), lit("a", new_varchar(1))),))
+        dev = parity(DAGRequest((s, sel), output_offsets=(0,)), ch)
+        assert dev.num_rows() == 1
+
+
+class TestDateArith:
+    def date_chunk(self):
+        fts = [new_datetime()]
+        dates = [(2020, 1, 31), (2019, 12, 31), (2020, 2, 29), (1999, 6, 15), (2024, 3, 1)]
+        rows = [[Datum.time(MyTime.from_ymd(y, m, d))] for y, m, d in dates]
+        return Chunk.from_rows(fts, rows), fts
+
+    @pytest.mark.parametrize("unit,n", [("day", 40), ("day", -60), ("month", 1), ("month", -13), ("year", 1), ("week", 3), ("hour", 30), ("quarter", 5)])
+    def test_date_add_units(self, unit, n):
+        ch, fts = self.date_chunk()
+        s = TableScan(1, (ColumnInfo(1, fts[0]),))
+        proj = Projection((func("date_add", new_datetime(), col(0, fts[0]), lit(n, new_longlong()), lit(unit, new_varchar(8))),))
+        parity(DAGRequest((s, proj), output_offsets=(0,)), ch, sort=False)
+
+    def test_month_end_clamp(self):
+        """'2020-01-31' + 1 month = '2020-02-29' (leap clamp)."""
+        ch, fts = self.date_chunk()
+        s = TableScan(1, (ColumnInfo(1, fts[0]),))
+        proj = Projection((func("date_add", new_datetime(), col(0, fts[0]), lit(1, new_longlong()), lit("month", new_varchar(8))),))
+        dev = run_dag_on_chunk(DAGRequest((s, proj), output_offsets=(0,)), ch)
+        assert str(dev.row(0)[0].val).startswith("2020-02-29")
+
+    def test_datediff(self):
+        ch, fts = self.date_chunk()
+        s = TableScan(1, (ColumnInfo(1, fts[0]),))
+        proj = Projection((func("datediff", new_longlong(), col(0, fts[0]), lit("2020-01-01", new_datetime())),))
+        dev = parity(DAGRequest((s, proj), output_offsets=(0,)), ch, sort=False)
+        assert dev.row(0)[0].val == 30  # 2020-01-31 vs 2020-01-01
+
+
+class TestMomentAggs:
+    def test_stddev_var_parity(self):
+        fts = [new_longlong(), new_decimal(8, 2)]
+        rng = np.random.default_rng(4)
+        rows = [[Datum.i64(int(rng.integers(0, 4))), Datum.dec(MyDecimal(f"{int(rng.integers(-999, 999))/100:.2f}"))] for _ in range(120)]
+        rows.append([Datum.i64(9), Datum.dec(MyDecimal("5.00"))])  # n=1 group
+        ch = Chunk.from_rows(fts, rows)
+        s = TableScan(1, (ColumnInfo(1, fts[0]), ColumnInfo(2, fts[1])))
+        agg = Aggregation(
+            group_by=(col(0, fts[0]),),
+            aggs=(
+                AggDesc("var_pop", (col(1, fts[1]),)),
+                AggDesc("stddev_pop", (col(1, fts[1]),)),
+                AggDesc("var_samp", (col(1, fts[1]),)),
+                AggDesc("stddev_samp", (col(1, fts[1]),)),
+            ),
+        )
+        dag = DAGRequest((s, agg), output_offsets=(0, 1, 2, 3, 4))
+        dev = run_dag_on_chunk(dag, ch)
+        ref = run_dag_reference(dag, ch)
+
+        def fl(rows_):
+            out = []
+            for r in rows_:
+                out.append(tuple(None if d.is_null() else round(float(d.val), 9) if isinstance(d.val, float) else d.val for d in r))
+            return sorted(out, key=str)
+
+        assert fl(dev.rows()) == fl(ref)
+
+    def test_sql_stddev_group_concat(self):
+        s = Session()
+        s.execute("CREATE TABLE m (id BIGINT PRIMARY KEY, g INT, v DOUBLE, w VARCHAR(8))")
+        s.execute("INSERT INTO m VALUES (1,1,2.0,'a'), (2,1,4.0,'b'), (3,1,6.0,'c'), (4,2,5.0,'z')")
+        r = s.execute("SELECT g, stddev(v), var_pop(v), group_concat(w SEPARATOR '|') FROM m GROUP BY g ORDER BY g")
+        row1 = r.rows[0]
+        assert row1[0].val == 1
+        assert abs(row1[1].val - math.sqrt(8.0 / 3)) < 1e-9
+        assert abs(row1[2].val - 8.0 / 3) < 1e-9
+        assert row1[3].val == "a|b|c"
+        assert r.rows[1][3].val == "z"
+        # var_samp of a single row is NULL
+        assert s.execute("SELECT var_samp(v) FROM m WHERE g = 2").scalar() is None
+
+    def test_moment_aggs_split_over_regions(self):
+        """stddev states are additive: Partial1 per region + Final merge."""
+        from tidb_tpu.codec import tablecodec
+
+        s = Session()
+        s.execute("CREATE TABLE mm (id BIGINT PRIMARY KEY, v DOUBLE)")
+        vals = ", ".join(f"({i}, {i * 0.5})" for i in range(200))
+        s.execute(f"INSERT INTO mm (id, v) VALUES {vals}")
+        tid = s.catalog.table("mm").table_id
+        s.store.cluster.split(tablecodec.encode_row_key(tid, 100))
+        got = s.execute("SELECT var_pop(v), stddev_samp(v) FROM mm").rows[0]
+        data = [i * 0.5 for i in range(200)]
+        mean = sum(data) / len(data)
+        var_pop = sum((x - mean) ** 2 for x in data) / len(data)
+        var_samp = sum((x - mean) ** 2 for x in data) / (len(data) - 1)
+        assert abs(got[0].val - var_pop) < 1e-6
+        assert abs(got[1].val - math.sqrt(var_samp)) < 1e-6
+
+
+class TestSQLBreadth:
+    def test_sql_string_and_date(self):
+        s = Session()
+        s.execute("CREATE TABLE e (id BIGINT PRIMARY KEY, name VARCHAR(20), hired DATETIME)")
+        s.execute("INSERT INTO e VALUES (1, '  Ada  ', '2020-01-31 00:00:00'), (2, 'bob', '2019-06-15 00:00:00')")
+        r = s.execute("SELECT upper(trim(name)), concat(name, '!') FROM e ORDER BY id")
+        assert r.values()[0][0] == "ADA"
+        assert r.values()[1][1] == "bob!"
+        r = s.execute("SELECT id FROM e WHERE hired + INTERVAL 1 MONTH > '2020-02-28' ORDER BY id")
+        assert [x for x, in r.values()] == [1]
+        r = s.execute("SELECT datediff('2020-03-01', hired) FROM e WHERE id = 1")
+        assert r.scalar() == 30
+        r = s.execute("SELECT replace(name, 'o', '0') FROM e WHERE id = 2")
+        assert r.scalar() == "b0b"
+
+
+class TestReviewRegressions2:
+    def test_update_unique_failure_keeps_index(self):
+        """A failed UPDATE must not tombstone index entries (no corruption)."""
+        from tidb_tpu.sql import SQLError
+
+        s = Session()
+        s.execute("CREATE TABLE u (id BIGINT PRIMARY KEY, a INT)")
+        s.execute("INSERT INTO u VALUES (1, 5), (2, 6)")
+        s.execute("CREATE UNIQUE INDEX ua ON u (a)")
+        with pytest.raises(SQLError):
+            s.execute("UPDATE u SET a = 6 WHERE id = 1")
+        assert s.execute("SELECT count(*) FROM u WHERE a = 5").scalar() == 1
+
+    def test_in_duplicates_no_double_scan(self):
+        s = Session()
+        s.execute("CREATE TABLE t2 (id BIGINT PRIMARY KEY)")
+        s.execute("INSERT INTO t2 VALUES (4), (5), (6)")
+        assert s.execute("SELECT count(*) FROM t2 WHERE id IN (5, 5)").scalar() == 1
+        r = s.execute("SELECT id FROM t2 WHERE id IN (5, 5, 4) OR id = 4 ORDER BY id") if False else None
+        assert s.execute("SELECT count(*) FROM t2 WHERE id IN (4, 5, 5, 6)").scalar() == 3
+        assert s.execute("SELECT count(*) FROM t2 WHERE id >= 4 AND id IN (4, 5)").scalar() == 2
+
+    def test_distinct_new_aggs(self):
+        s = Session()
+        s.execute("CREATE TABLE d (id BIGINT PRIMARY KEY, g INT)")
+        s.execute("INSERT INTO d VALUES (1,1),(2,1),(3,1),(4,2)")
+        assert s.execute("SELECT group_concat(DISTINCT g) FROM d").scalar() == "1,2"
+        assert abs(s.execute("SELECT var_pop(DISTINCT g) FROM d").scalar() - 0.25) < 1e-12
+
+    def test_device_like_ci(self):
+        from tidb_tpu.types import Collation, FieldType, TypeCode
+        from tidb_tpu.expr.ir import func as F, col as C, lit as L
+
+        ci = FieldType(TypeCode.Varchar, flen=16, collate=Collation.Utf8MB4GeneralCI)
+        fts = [new_longlong(), ci]
+        rows = [[Datum.i64(i), Datum.string(v)] for i, v in enumerate(["Apple", "apple", "grape"])]
+        ch = Chunk.from_rows(fts, rows)
+        s = TableScan(1, (ColumnInfo(1, fts[0]), ColumnInfo(2, fts[1])))
+        sel = Selection((F("like", BOOL, C(1, ci), L("app%", new_varchar(4))),))
+        dev = parity(DAGRequest((s, sel), output_offsets=(0,)), ch)
+        assert dev.num_rows() == 2
+
+    def test_substr_null_pos(self):
+        fts = [new_varchar(8), new_longlong()]
+        rows = [[Datum.string("hello"), Datum.NULL], [Datum.string("hello"), Datum.i64(2)]]
+        ch = Chunk.from_rows(fts, rows)
+        s = TableScan(1, (ColumnInfo(1, fts[0]), ColumnInfo(2, fts[1])))
+        proj = Projection((func("substr", VC, col(0, fts[0]), col(1, fts[1])),))
+        dev = parity(DAGRequest((s, proj), output_offsets=(0,)), ch, sort=False)
+        assert dev.row(0)[0].is_null()

@@ -223,6 +223,9 @@ def w_agg_desc(w: Writer, d: AggDesc):
     w.s(d.name)
     w.u8(int(d.mode))
     w.bool_(d.distinct)
+    w.bool_(d.extra is not None)
+    if d.extra is not None:
+        w.s(d.extra)
     w.i32(len(d.args))
     for a in d.args:
         w_expr(w, a)
@@ -233,9 +236,10 @@ def r_agg_desc(r: Reader) -> AggDesc:
     name = r.s()
     mode = AggMode(r.u8())
     distinct = r.bool_()
+    extra = r.s() if r.bool_() else None
     args = tuple(r_expr(r) for _ in range(r.i32()))
     ft = r_ft(r)
-    return AggDesc(name, args, mode=mode, distinct=distinct, ft=ft)
+    return AggDesc(name, args, mode=mode, distinct=distinct, ft=ft, extra=extra)
 
 
 # -------------------------------------------------------------- executors

@@ -52,9 +52,32 @@ def _merge_aggregation(agg: Aggregation) -> Aggregation:
         pf = d.partial_fts()
         args = tuple(col(idx + i, pf[i]) for i in range(len(pf)))
         idx += len(pf)
-        merge_descs.append(AggDesc(d.name, args, mode=AggMode.Final, distinct=d.distinct, ft=d.ft))
+        merge_descs.append(AggDesc(d.name, args, mode=AggMode.Final, distinct=d.distinct, ft=d.ft, extra=d.extra))
     group_refs = tuple(col(idx + i, g.ft) for i, g in enumerate(agg.group_by))
     return Aggregation(group_by=group_refs, aggs=tuple(merge_descs), merge=True)
+
+
+def _has_host_only_op(ex) -> bool:
+    """Expressions the device whitelist excludes (the runtime-blocklist
+    analog of infer_pushdown.go IsPushDownEnabled): keep them at root where
+    the oracle fallback can evaluate them."""
+    from ..expr.ir import ScalarFunc
+
+    HOST_ONLY = {"replace"}
+
+    def walk(e):
+        if isinstance(e, ScalarFunc):
+            if e.op in HOST_ONLY:
+                return True
+            return any(walk(a) for a in e.args)
+        return False
+
+    exprs = []
+    if isinstance(ex, Selection):
+        exprs = ex.conditions
+    elif isinstance(ex, Projection):
+        exprs = ex.exprs
+    return any(walk(e) for e in exprs)
 
 
 def split_dag(dag: DAGRequest) -> RootPlan:
@@ -65,11 +88,14 @@ def split_dag(dag: DAGRequest) -> RootPlan:
     while i < len(executors):
         ex = executors[i]
         if isinstance(ex, (TableScan, IndexScan, Selection, Projection, Join)):
+            if isinstance(ex, (Selection, Projection)) and _has_host_only_op(ex):
+                root = list(executors[i:])
+                break
             push.append(ex)
             i += 1
             continue
         if isinstance(ex, Aggregation):
-            if any(d.distinct for d in ex.aggs):
+            if any(d.distinct or d.name == "group_concat" for d in ex.aggs):
                 # not decomposable: aggregate wholly at root
                 root = list(executors[i:])
             else:

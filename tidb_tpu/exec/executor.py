@@ -125,7 +125,9 @@ def run_dag_on_chunks(
     batches = [to_device_batch(c, capacity=_pow2(max(c.num_rows(), 1))) for c in chunks]
     try:
         return drive_program(cache, dag, batches, group_capacity, max_retries)[0]
-    except OverflowRetryError:
+    except (OverflowRetryError, NotImplementedError):
+        # capacity exhaustion OR a host-only operator (replace,
+        # group_concat): the row-at-a-time oracle is the documented fallback
         if not oracle_fallback:
             raise
         rows = run_dag_reference(dag, chunks)
@@ -150,13 +152,15 @@ def run_dag_on_chunk(
 # Reference interpreter (oracle)
 # ---------------------------------------------------------------------------
 
-def datum_group_key(d: Datum):
+def datum_group_key(d: Datum, ft: FieldType | None = None):
     if d.is_null():
         return (0, None)
     if d.kind == DatumKind.MysqlDecimal:
         return (1, str(d.val.d.normalize()))
     if d.kind in (DatumKind.String, DatumKind.Bytes):
         v = d.val.encode() if isinstance(d.val, str) else bytes(d.val)
+        if ft is not None and ft.is_ci():
+            v = v.upper()  # general_ci: one group per case-folded key
         return (1, v)
     if d.kind == DatumKind.MysqlTime:
         return (1, d.val.packed)
@@ -178,11 +182,17 @@ class _RefAgg:
         self.first = None
         self.has_first = False
         self.bits = None
+        self.fsum = 0.0  # float moments for stddev/var
+        self.sumsq = 0.0
+        self.strs: list = []  # group_concat pieces
         self.seen = set() if desc.distinct else None
 
     def update(self, args: list[Datum]):
         name = self.d.name
-        if self.seen is not None and name in ("count", "sum", "avg"):
+        if self.seen is not None and name in (
+            "count", "sum", "avg", "group_concat",
+            "stddev_pop", "stddev_samp", "var_pop", "var_samp",
+        ):
             # DISTINCT: rows with any NULL arg are skipped; each distinct
             # arg tuple contributes once
             if any(a.is_null() for a in args):
@@ -216,6 +226,15 @@ class _RefAgg:
         self.count += 1
         if name in ("sum", "avg"):
             self._add_sum(a)
+        elif name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+            v = a.val.to_float() if a.kind == DatumKind.MysqlDecimal else float(a.val)
+            self.fsum += v
+            self.sumsq += v * v
+        elif name == "group_concat":
+            v = a.val if isinstance(a.val, str) else (
+                bytes(a.val).decode("utf-8", "surrogateescape") if isinstance(a.val, (bytes, bytearray)) else str(a.val)
+            )
+            self.strs.append(v)
         elif name in ("min", "max"):
             if self.extreme is None:
                 self.extreme = a
@@ -244,7 +263,7 @@ class _RefAgg:
         """Consume partial-state columns (Partial2/Final modes) — the state
         schemas of expr/agg.py (ref: aggfuncs MergePartialResult)."""
         name = self.d.name
-        if self.seen is not None and name in ("count", "sum", "avg"):
+        if self.seen is not None and name not in ("min", "max", "first_row"):
             raise NotImplementedError("DISTINCT partials are not mergeable")
         if name == "count":
             if not args[0].is_null():
@@ -267,6 +286,16 @@ class _RefAgg:
             if not has.is_null() and int(has.val) > 0 and not self.has_first:
                 self.first, self.has_first = val, True
             return
+        if name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+            c, s, q = args
+            if not c.is_null():
+                self.count += int(c.val)
+            if not s.is_null():
+                self.fsum += float(s.val)
+                self.sumsq += float(q.val)
+            return
+        if name == "group_concat":
+            raise NotImplementedError("group_concat partials are not mergeable (root-only aggregate)")
         # min/max/bit_*: state column == value column, same combine
         self.update(args)
 
@@ -284,6 +313,8 @@ class _RefAgg:
             return [self.extreme if self.extreme is not None else Datum.NULL]
         if name == "first_row":
             return [Datum.i64(1 if self.has_first else 0), self.first if self.has_first else Datum.NULL]
+        if name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+            return [Datum.i64(self.count), Datum.f64(self.fsum), Datum.f64(self.sumsq)]
         return [self.result()]  # bit_*: state == result
 
     def _sum_datum(self, ft: FieldType) -> Datum:
@@ -319,6 +350,22 @@ class _RefAgg:
             if self.bits is None:  # empty: AND -> all ones, OR/XOR -> 0
                 return Datum.u64((1 << 64) - 1 if name == "bit_and" else 0)
             return Datum.u64(self.bits)
+        if name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+            import math
+
+            n = self.count
+            if n == 0 or (name.endswith("samp") and n < 2):
+                return Datum.NULL
+            mean = self.fsum / n
+            if name.endswith("samp"):
+                var = max(self.sumsq - n * mean * mean, 0.0) / (n - 1)
+            else:
+                var = max(self.sumsq / n - mean * mean, 0.0)
+            return Datum.f64(math.sqrt(var) if name.startswith("stddev") else var)
+        if name == "group_concat":
+            if not self.strs:
+                return Datum.NULL
+            return Datum.string((self.d.extra if self.d.extra is not None else ",").join(self.strs))
         raise NotImplementedError(name)
 
 
@@ -369,7 +416,7 @@ def _ref_pipeline(executors, chunks, cursor, ev) -> list[list[Datum]]:
             groups: dict = {}
             order: list = []
             for r in rows:
-                key = tuple(datum_group_key(ev.eval(g, r)) for g in ex.group_by)
+                key = tuple(datum_group_key(ev.eval(g, r), g.ft) for g in ex.group_by)
                 if key not in groups:
                     groups[key] = ([_RefAgg(a) for a in ex.aggs], [ev.eval(g, r) for g in ex.group_by])
                     order.append(key)
@@ -409,7 +456,7 @@ def _ref_join(ex: Join, probe_rows, chunks, cursor, ev) -> list[list[Datum]]:
         ds = [ev.eval(k, row) for k in exprs]
         if any(d.is_null() for d in ds):
             return None
-        return tuple(datum_group_key(d) for d in ds)
+        return tuple(datum_group_key(d, k.ft) for d, k in zip(ds, exprs))
 
     table: dict = {}
     for br in build_rows:

@@ -27,7 +27,13 @@ from dataclasses import dataclass
 from ..types import FieldType, TypeCode, new_longlong
 from .ir import Expr
 
-AGG_FUNCS = frozenset({"count", "sum", "avg", "min", "max", "first_row", "bit_and", "bit_or", "bit_xor"})
+AGG_FUNCS = frozenset({
+    "count", "sum", "avg", "min", "max", "first_row", "bit_and", "bit_or", "bit_xor",
+    # moment-based: states [count, sum, sum_sq] are additive -> mesh-mergeable
+    "stddev_pop", "stddev_samp", "var_pop", "var_samp",
+    # host-only (varlen accumulation): planned at root, oracle-evaluated
+    "group_concat",
+})
 
 
 class AggMode(enum.IntEnum):
@@ -44,6 +50,7 @@ class AggDesc:
     mode: AggMode = AggMode.Complete
     distinct: bool = False
     ft: FieldType | None = None  # result type (final); inferred if None
+    extra: str | None = None  # group_concat SEPARATOR
 
     def __post_init__(self):
         if self.name not in AGG_FUNCS:
@@ -78,6 +85,12 @@ class AggDesc:
             return arg_ft.clone()
         if self.name in ("bit_and", "bit_or", "bit_xor"):
             return new_longlong(unsigned=True)
+        if self.name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+            return FieldType(TypeCode.Double)  # always DOUBLE (MySQL)
+        if self.name == "group_concat":
+            from ..types import new_varchar
+
+            return new_varchar(1024)
         et = arg_ft.eval_type()
         if self.name == "sum":
             if et == "real":
@@ -108,6 +121,11 @@ class AggDesc:
             return [arg_ft.clone()]
         if self.name == "first_row":
             return [new_longlong(notnull=True), arg_ft.clone()]
+        if self.name in ("stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+            # additive moment states (ref: aggfuncs varPop partial results)
+            return [new_longlong(notnull=True), FieldType(TypeCode.Double), FieldType(TypeCode.Double)]
+        if self.name == "group_concat":
+            return [self.infer_ft() if self.ft is None else self.ft.clone()]
         return [new_longlong(unsigned=True)]
 
     @staticmethod
@@ -122,6 +140,7 @@ class AggDesc:
             self.name,
             int(self.mode),
             self.distinct,
+            self.extra,
             self.ft.tp,
             self.ft.decimal,
         ) + tuple(a.fingerprint() for a in self.args)

@@ -162,6 +162,28 @@ def _pow10_f64(ae):
     return out
 
 
+# the civil-calendar math is shared with the host path — branchless, so the
+# same functions run on Python ints and int64 lanes (types/mytime.py)
+from ..types.mytime import civil_from_days as _ymd_from_days
+from ..types.mytime import days_from_civil as _days_from_ymd
+from ..types.mytime import days_in_month as _days_in_month_vec
+
+
+def fold_words_ci(words):
+    """ASCII-case-fold packed compare words (a-z -> A-Z), keeping the
+    length word — general_ci collation compare on device (ref:
+    pkg/util/collate generalCICollator, ASCII subset). Byte-local subtract
+    of 0x20 never borrows (0x61-0x20 = 0x41 > 0)."""
+    payload = words[..., :-1] ^ I64_MIN
+    adj = jnp.zeros_like(payload)
+    for b in range(8):
+        sh = 56 - 8 * b
+        byte = (payload >> sh) & 0xFF
+        is_lower = (byte >= 0x61) & (byte <= 0x7A)
+        adj = adj + jnp.where(is_lower, jnp.int64(0x20) << sh, jnp.int64(0))
+    return jnp.concatenate([(payload - adj) ^ I64_MIN, words[..., -1:]], axis=-1)
+
+
 def _words_cmp(a, b):
     """Lexicographic compare of [N, W] int64 word arrays -> (-1/0/1)[N]."""
     neq = a != b
@@ -450,7 +472,10 @@ class ExprCompiler:
         """Return (-1/0/1)[N] semantic comparison of a vs b."""
         cls = self._common_class(a, b)
         if cls == "string":
-            return _words_cmp(a.value, b.value)
+            av, bv = a.value, b.value
+            if a.ft.is_ci() or b.ft.is_ci():
+                av, bv = fold_words_ci(av), fold_words_ci(bv)
+            return _words_cmp(av, bv)
         if cls == "real":
             av, bv = self._to_class(a, "real").value, self._to_class(b, "real").value
             return (jnp.sign(av - bv)).astype(jnp.int32)
@@ -783,7 +808,10 @@ class ExprCompiler:
 
     def _op_strcmp(self, e):
         a, b = self._eval(e.args[0]), self._eval(e.args[1])
-        return CompVal(_words_cmp(a.value, b.value).astype(jnp.int64), a.null | b.null, e.ft)
+        av, bv = a.value, b.value
+        if a.ft.is_ci() or b.ft.is_ci():
+            av, bv = fold_words_ci(av), fold_words_ci(bv)
+        return CompVal(_words_cmp(av, bv).astype(jnp.int64), a.null | b.null, e.ft)
 
     def _op_like(self, e):
         """LIKE with constant pattern; device support for exact / 'prefix%' /
@@ -797,6 +825,11 @@ class ExprCompiler:
         if a.raw is None:
             raise NotImplementedError("LIKE needs raw string column")
         data, length = a.raw
+        if a.ft.is_ci() or pat.ft.is_ci():
+            # general_ci LIKE: fold both subject and pattern (ASCII)
+            hit = (data >= 0x61) & (data <= 0x7A)
+            data = jnp.where(hit, data - 0x20, data)
+            p = p.upper()
         import numpy as np
 
         if p.endswith("%") and "%" not in p[:-1] and "_" not in p:
@@ -824,7 +857,168 @@ class ExprCompiler:
         return eq & (length >= k)
 
     def _op_substr(self, e):
-        raise NotImplementedError("substr on device TODO; host fallback")
+        """SUBSTR(s, pos[, len]) — per-row byte shift via gather."""
+        a = self._eval(e.args[0])
+        data, length = string_bytes(a)
+        pos_cv = self._eval(e.args[1])
+        pos = pos_cv.value.astype(jnp.int32)
+        null = a.null | pos_cv.null
+        # MySQL: 1-based; negative counts from the end; 0 -> ''
+        start = jnp.where(pos > 0, pos - 1, length + pos)
+        bad = (pos == 0) | (start < 0)
+        start = jnp.clip(start, 0, length)
+        avail = jnp.maximum(length - start, 0)
+        if len(e.args) > 2:
+            want_cv = self._eval(e.args[2])
+            null = null | want_cv.null
+            new_len = jnp.clip(want_cv.value.astype(jnp.int32), 0, avail)
+        else:
+            new_len = avail
+        new_len = jnp.where(bad, 0, new_len)
+        w = data.shape[1]
+        idx = jnp.clip(jnp.arange(w)[None, :] + start[:, None], 0, w - 1)
+        shifted = jnp.take_along_axis(data, idx, axis=1)
+        shifted = jnp.where(jnp.arange(w)[None, :] < new_len[:, None], shifted, 0)
+        return self._string_result(shifted, new_len, null, e.ft)
+
+    def _string_result(self, data, length, null, ft):
+        return CompVal(pack_string_words(data, length), null, ft, raw=(data, length))
+
+    def _op_upper(self, e):
+        return self._case_fold(e, upper=True)
+
+    def _op_lower(self, e):
+        return self._case_fold(e, upper=False)
+
+    def _case_fold(self, e, upper: bool):
+        a = self._eval(e.args[0])
+        data, length = string_bytes(a)
+        if upper:
+            hit = (data >= 0x61) & (data <= 0x7A)
+            out = jnp.where(hit, data - 0x20, data)
+        else:
+            hit = (data >= 0x41) & (data <= 0x5A)
+            out = jnp.where(hit, data + 0x20, data)
+        return self._string_result(out, length, a.null, e.ft)
+
+    def _op_concat(self, e):
+        """CONCAT(...) — pairwise fold; NULL if any arg NULL (MySQL)."""
+        args = [self._as_string(self._eval(x)) for x in e.args]
+        out = args[0]
+        for b in args[1:]:
+            out = self._concat2(out, b)
+        d, ln = out.raw
+        return self._string_result(d, ln, out.null, e.ft)
+
+    def _as_string(self, a: CompVal) -> CompVal:
+        if a.value.ndim == 2:
+            data, length = string_bytes(a)
+            return CompVal(a.value, a.null, a.ft, raw=(data, length))
+        raise NotImplementedError("concat of non-string operands on device (cast first)")
+
+    @staticmethod
+    def _concat2(a: CompVal, b: CompVal) -> CompVal:
+        da, la = a.raw
+        db, lb = b.raw
+        wa, wb = da.shape[1], db.shape[1]
+        w = wa + wb
+        pos = jnp.arange(w)[None, :]
+        a_pad = jnp.pad(da, ((0, 0), (0, w - wa)))
+        b_pad = jnp.pad(db, ((0, 0), (0, w - wb)))
+        from_b_idx = jnp.clip(pos - la[:, None], 0, w - 1)
+        b_shift = jnp.take_along_axis(b_pad, from_b_idx, axis=1)
+        out = jnp.where(pos < la[:, None], a_pad, b_shift)
+        ln = la + lb
+        out = jnp.where(pos < ln[:, None], out, 0)
+        return CompVal(a.value, a.null | b.null, a.ft, raw=(out, ln.astype(jnp.int32)))
+
+    def _op_trim(self, e):
+        return self._trim(e, left=True, right=True)
+
+    def _op_ltrim(self, e):
+        return self._trim(e, left=True, right=False)
+
+    def _op_rtrim(self, e):
+        return self._trim(e, left=False, right=True)
+
+    def _trim(self, e, left: bool, right: bool):
+        a = self._eval(e.args[0])
+        data, length = string_bytes(a)
+        w = data.shape[1]
+        pos = jnp.arange(w)[None, :]
+        in_str = pos < length[:, None]
+        is_sp = (data == 0x20) & in_str
+        lead = jnp.zeros(data.shape[0], jnp.int32)
+        if left:
+            # leading spaces: cumulative product of the space mask
+            run = jnp.cumprod(jnp.where(in_str, is_sp, True).astype(jnp.int32), axis=1)
+            lead = jnp.minimum((run * in_str.astype(jnp.int32)).sum(axis=1), length)
+        trail = jnp.zeros(data.shape[0], jnp.int32)
+        if right:
+            # walk from the end: src index for the k-th-from-last byte
+            src = length[:, None] - 1 - pos
+            rev_bytes = jnp.take_along_axis(data, jnp.clip(src, 0, w - 1), axis=1)
+            is_sp_end = jnp.where(src >= 0, rev_bytes == 0x20, False)
+            run_t = jnp.cumprod(is_sp_end.astype(jnp.int32), axis=1)
+            trail = jnp.minimum(run_t.sum(axis=1), length)
+        new_len = jnp.maximum(length - lead - trail, 0)
+        idx = jnp.clip(pos + lead[:, None], 0, w - 1)
+        shifted = jnp.take_along_axis(data, idx, axis=1)
+        shifted = jnp.where(pos < new_len[:, None], shifted, 0)
+        return self._string_result(shifted, new_len.astype(jnp.int32), a.null, e.ft)
+
+    def _op_replace(self, e):
+        raise NotImplementedError("replace() is host-only (data-dependent lengths); planner keeps it at root")
+
+    # -- date arithmetic (vectorized civil-calendar math) ---------------------
+    def _op_date_add(self, e):
+        return self._date_shift(e, +1)
+
+    def _op_date_sub(self, e):
+        return self._date_shift(e, -1)
+
+    def _date_shift(self, e, sign: int):
+        """packed datetime +/- INTERVAL n unit (ref: builtin_time date_add;
+        semantics types/mytime.py datetime_add — Hinnant civil-from-days)."""
+        d = self._eval(e.args[0])
+        n = self._eval(e.args[1])
+        unit = e.args[2].datum.val  # const string (planner contract)
+        p = d.value
+        micro = p & 0xFFFFFF
+        rest = p >> 24
+        hms = rest & ((1 << 17) - 1)
+        ymd = rest >> 17
+        day = ymd & 31
+        ym = ymd >> 5
+        y, m = ym // 13, ym % 13
+        sec, minute, hour = hms & 63, (hms >> 6) & 63, hms >> 12
+        nn = sign * n.value.astype(jnp.int64)
+        unit_secs = {"second": 1, "minute": 60, "hour": 3600, "day": 86400, "week": 7 * 86400}
+        if unit in unit_secs:
+            total = _days_from_ymd(y, m, day) * 86400 + hour * 3600 + minute * 60 + sec + nn * unit_secs[unit]
+            days, secs = total // 86400, total % 86400
+            y, m, day = _ymd_from_days(days)
+            hour, minute, sec = secs // 3600, (secs // 60) % 60, secs % 60
+        elif unit in ("month", "quarter", "year"):
+            months = nn * {"month": 1, "quarter": 3, "year": 12}[unit]
+            t = y * 12 + (m - 1) + months
+            y, m = t // 12, t % 12 + 1
+            day = jnp.minimum(day, _days_in_month_vec(y, m))
+        else:
+            raise NotImplementedError(f"interval unit {unit!r}")
+        packed = (((y * 13 + m) << 5 | day) << 17 | (hour << 12 | minute << 6 | sec)) << 24 | micro
+        return CompVal(packed, d.null | n.null, e.ft)
+
+    def _op_datediff(self, e):
+        a, b = self._eval(e.args[0]), self._eval(e.args[1])
+
+        def days_of(v):
+            ymd = v.value >> 41
+            day = ymd & 31
+            ym = ymd >> 5
+            return _days_from_ymd(ym // 13, ym % 13, day)
+
+        return CompVal(days_of(a) - days_of(b), a.null | b.null, e.ft)
 
     # -- time extraction (packed layout, types/mytime.py) ---------------------
     def _time_parts(self, a: CompVal):

@@ -77,14 +77,17 @@ def _truth(d: Datum) -> bool | None:
     return d.val != 0
 
 
-def compare(a: Datum, b: Datum) -> int | None:
-    """3-way semantic compare; None if either side NULL."""
+def compare(a: Datum, b: Datum, ci: bool = False) -> int | None:
+    """3-way semantic compare; None if either side NULL. ci = ASCII
+    case-fold both sides first (general_ci collations)."""
     if a.is_null() or b.is_null():
         return None
     cls = _class2(a, b)
     if cls == "string":
         av = a.val.encode() if isinstance(a.val, str) else bytes(a.val)
         bv = b.val.encode() if isinstance(b.val, str) else bytes(b.val)
+        if ci:
+            av, bv = av.upper(), bv.upper()
         return (av > bv) - (av < bv)
     if cls == "real":
         av, bv = _as_float(a), _as_float(b)
@@ -216,9 +219,13 @@ class RefEvaluator:
         return self._result_num(abs(a.val), e.ft)
 
     # -- comparison ----------------------------------------------------------
+    @staticmethod
+    def _ci(e) -> bool:
+        return any(a.ft.is_string() and a.ft.is_ci() for a in e.args)
+
     def _cmp_op(self, e, row, pred):
         a, b = self._args(e, row)
-        c = compare(a, b)
+        c = compare(a, b, ci=self._ci(e))
         if c is None:
             return Datum.NULL
         return Datum.i64(1 if pred(c) else 0)
@@ -255,7 +262,7 @@ class RefEvaluator:
         saw_null = False
         for arg in e.args[1:]:
             b = self.eval(arg, row)
-            c = compare(a, b)
+            c = compare(a, b, ci=self._ci(e))
             if c is None:
                 saw_null = True
             elif c == 0:
@@ -264,7 +271,8 @@ class RefEvaluator:
 
     def _op_between(self, e, row):
         a, lo, hi = self._args(e, row)
-        c1, c2 = compare(a, lo), compare(a, hi)
+        ci = self._ci(e)
+        c1, c2 = compare(a, lo, ci=ci), compare(a, hi, ci=ci)
         if c1 is None or c2 is None:
             return Datum.NULL
         return Datum.i64(1 if c1 >= 0 and c2 <= 0 else 0)
@@ -498,13 +506,15 @@ class RefEvaluator:
             return Datum.NULL
         s = a.val if isinstance(a.val, str) else a.val.decode("utf-8", "surrogateescape")
         pat = p.val if isinstance(p.val, str) else p.val.decode()
+        if self._ci(e):
+            s, pat = s.upper(), pat.upper()
         rx = re.escape(pat).replace(re.escape("%"), ".*").replace(re.escape("_"), ".")
         return Datum.i64(1 if re.fullmatch(rx, s, re.S) else 0)
 
     def _op_substr(self, e, row):
         args = self._args(e, row)
         a = args[0]
-        if a.is_null():
+        if any(x.is_null() for x in args):
             return Datum.NULL
         s = a.val if isinstance(a.val, str) else a.val.decode("utf-8", "surrogateescape")
         pos = int(args[1].val)
@@ -519,6 +529,80 @@ class RefEvaluator:
         ln = int(args[2].val) if len(args) > 2 else None
         out = s[start : start + ln] if ln is not None else s[start:]
         return Datum.string(out)
+
+    @staticmethod
+    def _sval(d: Datum) -> str:
+        v = d.val
+        if isinstance(v, str):
+            return v
+        if isinstance(v, (bytes, bytearray)):
+            return bytes(v).decode("utf-8", "surrogateescape")
+        if isinstance(v, MyDecimal):
+            return str(v)
+        return str(v)
+
+    def _op_concat(self, e, row):
+        args = self._args(e, row)
+        if any(a.is_null() for a in args):
+            return Datum.NULL
+        return Datum.string("".join(self._sval(a) for a in args))
+
+    def _str1(self, e, row, fn):
+        (a,) = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        return Datum.string(fn(self._sval(a)))
+
+    def _op_upper(self, e, row):
+        return self._str1(e, row, str.upper)
+
+    def _op_lower(self, e, row):
+        return self._str1(e, row, str.lower)
+
+    def _op_trim(self, e, row):
+        return self._str1(e, row, lambda s: s.strip(" "))
+
+    def _op_ltrim(self, e, row):
+        return self._str1(e, row, lambda s: s.lstrip(" "))
+
+    def _op_rtrim(self, e, row):
+        return self._str1(e, row, lambda s: s.rstrip(" "))
+
+    def _op_replace(self, e, row):
+        a, frm, to = self._args(e, row)
+        if a.is_null() or frm.is_null() or to.is_null():
+            return Datum.NULL
+        f = self._sval(frm)
+        if f == "":
+            return Datum.string(self._sval(a))
+        return Datum.string(self._sval(a).replace(f, self._sval(to)))
+
+    # -- date arithmetic ------------------------------------------------------
+    def _op_date_add(self, e, row):
+        return self._date_shift(e, row, +1)
+
+    def _op_date_sub(self, e, row):
+        return self._date_shift(e, row, -1)
+
+    def _date_shift(self, e, row, sign: int):
+        from ..types.mytime import datetime_add
+
+        d, n = self.eval(e.args[0], row), self.eval(e.args[1], row)
+        unit = e.args[2].datum.val  # const string
+        if d.is_null() or n.is_null():
+            return Datum.NULL
+        t = d.val if isinstance(d.val, MyTime) else MyTime(int(d.val))
+        return Datum.time(MyTime(datetime_add(t.packed, sign * int(n.val), str(unit)), t.fsp))
+
+    def _op_datediff(self, e, row):
+        from ..types.mytime import days_from_civil
+
+        a, b = self._args(e, row)
+        if a.is_null() or b.is_null():
+            return Datum.NULL
+        ya, ma, da = self._time_parts(a)[:3]
+        yb, mb, db = self._time_parts(b)[:3]
+        return Datum.i64(days_from_civil(ya, ma, da) - days_from_civil(yb, mb, db))
 
     # -- time ----------------------------------------------------------------
     def _time_parts(self, a: Datum):

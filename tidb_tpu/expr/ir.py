@@ -38,8 +38,11 @@ SCALAR_OPS = frozenset(
         "ceil", "floor", "round", "sqrt", "exp", "log", "ln", "pow", "sign",
         # string (device subset; packed-word ops)
         "like", "length", "strcmp", "substr",
+        "concat", "upper", "lower", "trim", "ltrim", "rtrim", "replace",
         # date/time extraction from packed datetime
         "year", "month", "day", "hour", "minute", "second", "weekday", "to_days", "extract",
+        # date arithmetic (unit rides as a const string arg)
+        "date_add", "date_sub", "datediff",
         # bit
         "bitand", "bitor", "bitxor", "bitneg", "shiftleft", "shiftright",
     }

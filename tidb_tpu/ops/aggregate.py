@@ -77,6 +77,18 @@ def _masked(vals, mask, fill):
     return jnp.where(mask, vals, fill)
 
 
+_VAR_FUNCS = frozenset({"stddev_pop", "stddev_samp", "var_pop", "var_samp"})
+
+
+def _as_f64(a: CompVal):
+    """Value lane as float64 (stddev/var are always DOUBLE in MySQL)."""
+    if a.eval_type == "real":
+        return a.value
+    if a.eval_type == "decimal":
+        return a.value.astype(jnp.float64) / float(10 ** max(a.ft.decimal, 0))
+    return a.value.astype(jnp.float64)
+
+
 _BIT_OPS = {
     "bit_and": (jnp.bitwise_and, -1),  # identity all-ones (MySQL empty BIT_AND = 2^64-1)
     "bit_or": (jnp.bitwise_or, 0),
@@ -144,6 +156,17 @@ def _agg_states_raw(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
         return [(v, empty)]
     if name == "first_row":
         raise AssertionError("first_row is routed via GatherState")
+    if name in _VAR_FUNCS:
+        # moment states [count, sum, sum_sq] — additive, mesh-mergeable
+        # (ref: executor/aggfuncs/func_varpop.go partial results)
+        v = _as_f64(a)
+        cnt = _seg_sum(mask.astype(jnp.int64), seg, nseg)
+        s = _seg_sum(_masked(v, mask, 0.0), seg, nseg)
+        q = _seg_sum(_masked(v * v, mask, 0.0), seg, nseg)
+        nn = cnt == 0
+        return [(cnt, jnp.zeros(nseg, bool)), (s, nn), (q, nn)]
+    if name == "group_concat":
+        raise NotImplementedError("group_concat on device (root-only, oracle-evaluated)")
     if name in _BIT_OPS:
         red, fill = _BIT_OPS[name]
         v = _seg_bitreduce(red, _masked(a.value.astype(jnp.int64), mask, jnp.int64(fill)), seg, nseg, fill)
@@ -210,12 +233,17 @@ def _distinct_states(desc: AggDesc, args: list, row_valid, gkeys: list, invalid_
     if desc.name == "count":
         return [(cnt, jnp.zeros(nseg, bool))]
     a0 = args[0]
+    empty = cnt == 0
+    if desc.name in _VAR_FUNCS:
+        v2 = _as_f64(a0)[perm2]
+        s = _seg_sum(jnp.where(uniq, v2, 0.0), seg2, nseg)
+        q = _seg_sum(jnp.where(uniq, v2 * v2, 0.0), seg2, nseg)
+        return [(cnt, jnp.zeros(nseg, bool)), (s, empty), (q, empty)]
     a2 = a0.value[perm2]
     if a0.eval_type == "real":
         s = _seg_sum(jnp.where(uniq, a2, 0.0), seg2, nseg)
     else:
         s = _seg_sum(jnp.where(uniq, a2.astype(jnp.int64), jnp.int64(0)), seg2, nseg)
-    empty = cnt == 0
     if desc.name == "sum":
         return [(s, empty)]
     return [(cnt, jnp.zeros(nseg, bool)), (s, empty)]
@@ -243,6 +271,15 @@ def _agg_states_merge(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
         return out
     if name in ("min", "max"):
         return _agg_states_raw(desc, args, valid, seg, nseg)
+    if name in _VAR_FUNCS:
+        # additive moment states: sum each of [count, sum, sum_sq]
+        cnt_a, s_a, q_a = args
+        mask = valid & ~s_a.null
+        cnt = _seg_sum(_masked(cnt_a.value.astype(jnp.int64), valid, jnp.int64(0)), seg, nseg)
+        s = _seg_sum(_masked(s_a.value, mask, 0.0), seg, nseg)
+        q = _seg_sum(_masked(q_a.value, mask, 0.0), seg, nseg)
+        nn = cnt == 0
+        return [(cnt, jnp.zeros(nseg, bool)), (s, nn), (q, nn)]
     if name == "first_row":
         raise AssertionError("first_row merge is routed via GatherState")
     if name in _BIT_OPS:
@@ -269,6 +306,19 @@ def finalize_agg(desc: AggDesc, states: list, group_valid) -> tuple:
         has = states[0][0]
         v, nl = states[1]
         return v, nl | (has == 0)
+    if name in _VAR_FUNCS:
+        cnt = states[0][0]
+        s, q = states[1][0], states[2][0]
+        n = jnp.maximum(cnt, 1).astype(jnp.float64)
+        mean = s / n
+        if name.endswith("samp"):
+            var = jnp.maximum(q - n * mean * mean, 0.0) / jnp.maximum(n - 1.0, 1.0)
+            null = cnt < 2  # sample stats undefined for n < 2 (MySQL NULL)
+        else:
+            var = jnp.maximum(q / n - mean * mean, 0.0)
+            null = cnt == 0
+        out = jnp.sqrt(var) if name.startswith("stddev") else var
+        return out, null
     # identity finalize
     v, nl = states[0][0], states[0][1]
     return v, nl
@@ -294,7 +344,7 @@ def _gather_or_distinct_state(desc, arg_vals, row_valid, merge, gkeys, invalid_f
         cand = _arg_extreme_mask(a.value[perm, :], mask, seg, nseg, name == "max")
         idx, has = _first_match_idx(cand, orig_s, seg, nseg, n)
         return GatherState(idx, has)
-    if desc.distinct and name in ("count", "sum", "avg") and arg_vals:
+    if desc.distinct and name in ({"count", "sum", "avg"} | _VAR_FUNCS) and arg_vals:
         if merge:
             raise NotImplementedError(
                 "DISTINCT aggregates are not decomposable into mergeable partials; "

@@ -39,7 +39,14 @@ def sort_key_arrays(v: CompVal, desc: bool = False) -> list[jax.Array]:
     """
     nf = 1 - v.null.astype(jnp.int64)  # null -> 0 (sorts first ascending)
     if v.value.ndim == 2:
-        arrs = [nf] + [v.value[:, i] for i in range(v.value.shape[1])]
+        words = v.value
+        if v.ft.is_ci():
+            # general_ci: fold before keying so 'a' and 'A' share a group /
+            # sort slot / join bucket (ref: collate.GetCollator key form)
+            from ..expr.compile import fold_words_ci
+
+            words = fold_words_ci(words)
+        arrs = [nf] + [words[:, i] for i in range(words.shape[1])]
     elif v.eval_type == "real":
         arrs = [nf, _float_sortable(v.value)]
     elif v.ft.is_unsigned() and v.eval_type == "int":

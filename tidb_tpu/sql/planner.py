@@ -241,7 +241,12 @@ class _Lowerer:
             args = ()
         else:
             args = tuple(self.lower_base(a) for a in n.args)
-        desc = AggDesc(name, args, distinct=n.distinct)
+        extra = None
+        if name == "group_concat":
+            if n.order_by:
+                raise PlanError("GROUP_CONCAT(... ORDER BY) not supported yet")
+            extra = n.separator if n.separator is not None else ","
+        desc = AggDesc(name, args, distinct=n.distinct, extra=extra)
         return self._agg_ref(desc, n)
 
     # -- entry points ---------------------------------------------------------
@@ -332,7 +337,33 @@ class _Lowerer:
 
     def _func_call(self, n: A.FuncCall, rec):
         name = _FUNC_RENAME.get(n.name, n.name)
+        if name in ("date_add", "date_sub", "adddate", "subdate"):
+            name = "date_add" if name in ("date_add", "adddate") else "date_sub"
+            d = rec(n.args[0])
+            iv = n.args[1]
+            if not isinstance(iv, A.Interval):
+                raise PlanError(f"{name} expects an INTERVAL argument")
+            unit = iv.unit.lower()
+            if unit not in ("second", "minute", "hour", "day", "week", "month", "quarter", "year"):
+                raise PlanError(f"interval unit {unit!r} not supported")
+            nexpr = rec(iv.value)
+            if not d.ft.is_time():
+                d = func("cast", new_datetime(), d)
+            return func(name, d.ft.clone(), d, nexpr, lit(unit, new_varchar(8)))
         args = [rec(a) for a in n.args]
+        if name == "datediff":
+            a, b = args
+            # string-literal dates re-parse as datetime consts (either side)
+            a2 = self._coerce_const(b if b.ft.is_time() else lit("", new_datetime()), a)
+            b2 = self._coerce_const(a2 if a2.ft.is_time() else lit("", new_datetime()), b)
+            for x in (a2, b2):
+                if not x.ft.is_time():
+                    raise PlanError("datediff expects date/datetime arguments")
+            return func("datediff", new_longlong(), a2, b2)
+        if name in ("concat", "upper", "ucase", "lower", "lcase", "trim", "ltrim", "rtrim", "replace"):
+            name = {"ucase": "upper", "lcase": "lower"}.get(name, name)
+            flen = sum(max(a.ft.flen, 0) or 255 for a in args) if name == "concat" else (args[0].ft.flen if args[0].ft.flen > 0 else 255)
+            return func(name, new_varchar(max(flen, 1)), *args)
         if name == "if":
             ft = _unify_fts([args[1].ft, args[2].ft])
             return func("if", ft, *args)

@@ -59,7 +59,54 @@ def intervals_for_column(conjuncts: list, col_name: str, eval_const) -> list | N
         out = _intersect(out, s)
         if not out:
             return []  # provably empty
+    return _merge(out)
+
+
+def _merge(ivs: list) -> list:
+    """Sort and merge overlapping intervals so no key range is emitted
+    twice (IN (5,5) must not scan the row twice)."""
+
+    # sort: unbounded lows first, then by low value, inclusive before exclusive
+    def sort_key(iv):
+        if iv.low is None:
+            return (0, 0, 0)
+        return (1, _SortDatum(iv.low), 0 if iv.low_inc else 1)
+
+    ivs = sorted(ivs, key=sort_key)
+    out: list = [ivs[0]]
+    for iv in ivs[1:]:
+        last = out[-1]
+        # does iv start within (or adjacent-inclusively to) last?
+        overlaps = last.high is None
+        if not overlaps and iv.low is not None:
+            c = _cmp(iv.low, last.high)
+            overlaps = c < 0 or (c == 0 and (iv.low_inc or last.high_inc))
+        elif not overlaps:
+            overlaps = True  # iv.low unbounded
+        if overlaps:
+            # extend last.high if iv reaches further
+            if last.high is not None and (
+                iv.high is None or _cmp(iv.high, last.high) > 0 or (_cmp(iv.high, last.high) == 0 and iv.high_inc)
+            ):
+                out[-1] = Interval(last.low, iv.high, last.low_inc, iv.high_inc)
+        else:
+            out.append(iv)
     return out
+
+
+class _SortDatum:
+    """Orderable wrapper over Datum for interval sorting."""
+
+    __slots__ = ("d",)
+
+    def __init__(self, d):
+        self.d = d
+
+    def __lt__(self, other):
+        return _cmp(self.d, other.d) < 0
+
+    def __eq__(self, other):
+        return _cmp(self.d, other.d) == 0
 
 
 def _conjunct_intervals(c, col_name: str, eval_const) -> list | None:

@@ -272,13 +272,13 @@ class Session:
                     continue
                 if not stmt.replace:
                     raise SQLError(f"duplicate entry {handle} for key PRIMARY")
+            self._check_unique(meta, datums, handle, ts)  # before any mutation
             if exists and stmt.replace and meta.indices:
-                # REPLACE drops the old row's index entries first; the old
-                # row is fetched by its known key (no table scan)
+                # REPLACE drops the old row's index entries; the old row is
+                # fetched by its known key (no table scan)
                 old_row = self._read_row(meta, handle, ts)
                 if old_row is not None:
                     self._write_indexes(meta, old_row, handle, ts, delete=True)
-            self._check_unique(meta, datums, handle, ts)
             self.store.put_row(meta.table_id, handle, meta.col_ids(), datums, ts)
             self._write_indexes(meta, datums, handle, ts)
             if not exists:
@@ -365,15 +365,18 @@ class Session:
                 if d.is_null():
                     raise SQLError(f"column {meta.handle_col!r} cannot be NULL")
                 new_handle = int(d.val)
+            # ALL constraint checks before ANY mutation — a failed UPDATE
+            # must not leave tombstoned index entries behind
             if new_handle != handle:
-                # PK change moves the row to a new key (ref: updateRecord's
-                # remove+add when the handle changes)
                 nkey = tablecodec.encode_row_key(meta.table_id, new_handle)
                 if self.store.kv.get(nkey, wts) is not None:
                     raise SQLError(f"duplicate entry {new_handle} for key PRIMARY")
+            self._check_unique(meta, new_row, new_handle, wts)
+            if new_handle != handle:
+                # PK change moves the row to a new key (ref: updateRecord's
+                # remove+add when the handle changes)
                 self.store.delete_row(meta.table_id, handle, wts)
             self._write_indexes(meta, row, handle, wts, delete=True)
-            self._check_unique(meta, new_row, new_handle, wts)
             self.store.put_row(meta.table_id, new_handle, meta.col_ids(), new_row, wts)
             self._write_indexes(meta, new_row, new_handle, wts)
         return Result(affected=len(matched))

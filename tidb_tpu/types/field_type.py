@@ -139,6 +139,15 @@ class FieldType:
     def not_null(self) -> bool:
         return bool(self.flag & Flag.NotNull)
 
+    def is_ci(self) -> bool:
+        """Case-insensitive collation (ref: pkg/util/collate general_ci;
+        ASCII fold — the _general_ci subset this engine implements)."""
+        return self.collate in (
+            Collation.Utf8GeneralCI,
+            Collation.Utf8MB4GeneralCI,
+            Collation.Utf8MB4_0900AICI,
+        )
+
     # ---- evaluation class (ref: pkg/types/field_type.go EvalType) ---------
     def eval_type(self) -> str:
         if self.is_int():

@@ -26,6 +26,64 @@ def pack_datetime(year: int, month: int, day: int, hour: int = 0, minute: int = 
     return ((ymd << 17) | hms) << 24 | microsecond
 
 
+def days_from_civil(y, m, d):
+    """Days since 1970-01-01 (proleptic Gregorian; Hinnant's algorithm with
+    floor division — ref: types/time.go calcDaynr semantics).
+
+    Branchless on purpose: works identically for Python ints AND numpy/jnp
+    arrays (the device date kernels call this with int64 lanes), so the
+    calendar math exists exactly once."""
+    y = y - (m <= 2)
+    era = y // 400
+    yoe = y - era * 400
+    mp = (m + 9) % 12
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def civil_from_days(z):
+    z = z + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + 3 - 12 * (mp >= 10)
+    return y + (m <= 2), m, d
+
+
+def days_in_month(y, m):
+    """Branchless (scalar or array): 31 minus the 30-day months minus the
+    February adjustment (28/29)."""
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    is30 = (m == 4) | (m == 6) | (m == 9) | (m == 11)
+    return 31 - is30 * 1 - (m == 2) * (3 - leap * 1)
+
+
+_UNIT_SECONDS = {"second": 1, "minute": 60, "hour": 3600, "day": 86400, "week": 7 * 86400}
+
+
+def datetime_add(packed: int, n: int, unit: str) -> int:
+    """packed datetime + INTERVAL n unit (ref: types/time.go AddDate /
+    builtin_time date_add). Month/quarter/year clamp the day to the target
+    month's length (MySQL: '2020-01-31' + 1 month = '2020-02-29')."""
+    y, m, d, hh, mm, ss, micro = unpack_datetime(packed)
+    if unit in _UNIT_SECONDS:
+        total = days_from_civil(y, m, d) * 86400 + hh * 3600 + mm * 60 + ss + n * _UNIT_SECONDS[unit]
+        days, secs = total // 86400, total % 86400
+        y, m, d = civil_from_days(days)
+        hh, mm, ss = secs // 3600, (secs // 60) % 60, secs % 60
+    else:
+        months = {"month": n, "quarter": 3 * n, "year": 12 * n}[unit]
+        t = y * 12 + (m - 1) + months
+        y, m = t // 12, t % 12 + 1
+        d = min(d, days_in_month(y, m))
+    return pack_datetime(y, m, d, hh, mm, ss, micro)
+
+
 def unpack_datetime(packed: int) -> tuple[int, int, int, int, int, int, int]:
     microsecond = packed & ((1 << 24) - 1)
     rest = packed >> 24
