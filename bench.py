@@ -1,116 +1,528 @@
-"""Driver benchmark: one JSON line on stdout.
+"""Driver benchmark: one JSON line on stdout, full diagnostics on stderr.
 
-Flagship config (BASELINE.json #2 / north star): TPC-H Q6-shaped fused
-coprocessor program — scan -> selection (date range + discount between +
-quantity) -> partial SUM(extendedprice*discount), COUNT(*) — over an
-HBM-resident region batch, the exact pipeline the reference runs row-by-row
-in unistore's coprocessor (ref: unistore/cophandler/mpp_exec.go selExec/
-aggExec; closure_exec.go fused shape).
+Covers the five BASELINE.json configs (BASELINE.md):
+  1 scalar_agg  SELECT count(*), sum(c), avg(c) WHERE c > k   (min slice)
+  2 q6          TPC-H Q6 fused filter + sum(price*disc)       (headline)
+  3 q1          TPC-H Q1 multi-key GROUP BY, 6 aggregates
+  4 topn        ORDER BY col LIMIT 100 over the full batch
+  5 q3          Q3 join (lineitem x orders x customer) + group agg
 
-value       = steady-state device throughput, million rows/sec (one chip)
-vs_baseline = speedup vs the SAME fused XLA program compiled for host CPU
-              (a vectorized-CPU baseline, strictly stronger than the
-              reference's row-at-a-time Go coprocessor — conservative).
+Measurement contract (VERDICT r1 "what's weak" #1/#2):
+  - steady-state = K kernel executions inside ONE dispatch (lax.fori_loop
+    whose body depends on the previous iteration's result, so XLA cannot
+    hoist it), with jax.block_until_ready around every timed call. This is
+    the honest HBM-resident number: host->device transfer (which dominates
+    on the tunneled axon platform) is amortized 1/K and each timed call
+    provably performs K full passes.
+  - median-of-calls rows/s AND achieved GB/s (input bytes actually read),
+    with a hard assert that GB/s stays below any plausible HBM roofline
+  - parity gate: each config first runs at small N and is diffed against
+    the row-at-a-time oracle; the big run records a result checksum
+  - vs_baseline = same fused XLA program on host CPU (vectorized — strictly
+    stronger than the reference's row-at-a-time Go coprocessor);
+    vs_oracle = measured row-at-a-time interpreter (the mocktikv analog,
+    extrapolated from a smaller N), reported alongside.
 
-Diagnostics go to stderr; stdout is exactly one JSON line.
+value = config #2 (Q6) device throughput, Mrows/s on one chip.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import statistics
 import sys
 import time
 
 import numpy as np
+
+# sort-heavy XLA programs take minutes to compile on the tunneled TPU
+# backend (~30-200s per sort op, execution sub-ms); the persistent cache
+# makes every bench run after the first start in seconds
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(__file__), ".xla_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-ROWS = 1 << 22  # 4M rows resident per batch
-CPU_ROWS = 1 << 20  # smaller batch for the CPU baseline (same per-row work)
+ROWS = 1 << 22  # 4M resident rows per batch
+CPU_ROWS = 1 << 19
+PARITY_ROWS = 1 << 12
+ORACLE_ROWS = 1 << 13
+ITERS = 6
+# generous upper bound on single-chip HBM bandwidth (v5e ~0.82 TB/s,
+# v5p ~2.77 TB/s); any claimed number above this is a measurement bug
+HBM_ROOFLINE_GBS = 3000.0
 
 
-def make_batch(n: int, seed: int = 0):
-    """Generate a Q6-shaped lineitem batch directly as device arrays."""
-    import jax.numpy as jnp
+# --------------------------------------------------------------------------
+# data + configs
+# --------------------------------------------------------------------------
 
-    from __graft_entry__ import _q6_dag
-    from tidb_tpu.chunk.device import DeviceBatch, DeviceColumn
-
-    dag, fts = _q6_dag()
+def _make_tables(n, seed=0):
+    """Columnar TPC-H-shaped arrays (numpy, converted per config)."""
     rng = np.random.default_rng(seed)
     year = rng.integers(1992, 1999, n)
     month = rng.integers(1, 13, n)
     day = rng.integers(1, 29, n)
-    # packed datetime layout (types/mytime.py pack_datetime), vectorized
     ymd = (year * 13 + month) << 5 | day
-    shipdate = (ymd << 17) << 24
-    quantity = rng.integers(1, 51, n) * 100  # decimal(15,2) scaled
-    extprice = rng.integers(90000, 9000000, n)  # cents
-    discount = rng.integers(0, 11, n)  # 0.00..0.10 scaled by 100
+    shipdate = (ymd << 17) << 24  # packed datetime (types/mytime.py layout)
+    return {
+        "shipdate": shipdate.astype(np.int64),
+        "qty": (rng.integers(1, 51, n) * 100).astype(np.int64),  # dec(15,2)
+        "price": rng.integers(90000, 9000000, n).astype(np.int64),  # cents
+        "disc": rng.integers(0, 11, n).astype(np.int64),  # dec(15,2) 0.00-0.10
+        "rflag": rng.integers(0, 3, n).astype(np.uint8),  # A/N/R
+        "lstat": rng.integers(0, 2, n).astype(np.uint8),  # O/F
+        "okey": rng.integers(0, max(n // 4, 1), n).astype(np.int64),
+    }
 
-    cols_np = [shipdate.astype(np.int64), quantity.astype(np.int64),
-               extprice.astype(np.int64), discount.astype(np.int64)]
-    cols = [
-        DeviceColumn(jnp.asarray(c), jnp.zeros(n, bool), None, ft)
-        for c, ft in zip(cols_np, fts)
+
+def _dev_batch(cols_np, fts, jnp):
+    from tidb_tpu.chunk.device import DeviceBatch, DeviceColumn
+
+    n = len(cols_np[0][0]) if isinstance(cols_np[0], tuple) else len(cols_np[0])
+    out = []
+    for c, ft in zip(cols_np, fts):
+        if isinstance(c, tuple):  # (bytes [n,1], lengths) string column
+            data, lens = c
+            out.append(DeviceColumn(jnp.asarray(data), jnp.zeros(n, bool), jnp.asarray(lens), ft))
+        else:
+            out.append(DeviceColumn(jnp.asarray(c), jnp.zeros(n, bool), None, ft))
+    return DeviceBatch(out, jnp.ones(n, bool), jnp.int32(n))
+
+
+def _str_col(codes: np.ndarray, alphabet: bytes):
+    data = np.frombuffer(alphabet, np.uint8)[codes][:, None]
+    return data, np.ones(len(codes), np.int32)
+
+
+class Config:
+    def __init__(self, name, build):
+        self.name = name
+        self.build = build  # n -> (dag, [DeviceBatch]) device-resident
+
+
+def _configs():
+    import jax.numpy as jnp
+
+    from tidb_tpu.exec import Aggregation, ColumnInfo, DAGRequest, Join, Selection, TableScan, TopN
+    from tidb_tpu.expr import AggDesc, col, func, lit
+    from tidb_tpu.types import new_datetime, new_decimal, new_longlong, new_varchar
+
+    BOOL = new_longlong(notnull=True)
+    DT, D15 = new_datetime(), new_decimal(15, 2)
+    V1 = new_varchar(1)
+
+    def scalar_agg(n, seed=0):
+        t = _make_tables(n, seed)
+        fts = [D15]
+        scan = TableScan(1, (ColumnInfo(1, D15),))
+        c = col(0, D15)
+        sel = Selection((func("gt", BOOL, c, lit("120.00", new_decimal(6, 2))),))
+        agg = Aggregation(group_by=(), aggs=(AggDesc("count", ()), AggDesc("sum", (c,)), AggDesc("avg", (c,))))
+        dag = DAGRequest((scan, sel, agg), output_offsets=(0, 1, 2))
+        return dag, [_dev_batch([t["qty"]], fts, jnp)]
+
+    def q6(n, seed=0):
+        t = _make_tables(n, seed)
+        fts = [DT, D15, D15, D15]
+        scan = TableScan(1, tuple(ColumnInfo(i + 1, ft) for i, ft in enumerate(fts)))
+        C = lambda i: col(i, fts[i])
+        pred = func(
+            "and", BOOL,
+            func("ge", BOOL, C(0), lit("1994-01-01", DT)),
+            func(
+                "and", BOOL,
+                func("lt", BOOL, C(0), lit("1995-01-01", DT)),
+                func(
+                    "and", BOOL,
+                    func("between", BOOL, C(3), lit("0.05", new_decimal(3, 2)), lit("0.07", new_decimal(3, 2))),
+                    func("lt", BOOL, C(1), lit(24, new_longlong())),
+                ),
+            ),
+        )
+        revenue = func("mul", new_decimal(31, 4), C(2), C(3))
+        agg = Aggregation(group_by=(), aggs=(AggDesc("sum", (revenue,)), AggDesc("count", ())))
+        dag = DAGRequest((scan, Selection((pred,)), agg), output_offsets=(0, 1))
+        cols = [t["shipdate"], t["qty"], t["price"], t["disc"]]
+        return dag, [_dev_batch(cols, fts, jnp)]
+
+    def q1(n, seed=0):
+        t = _make_tables(n, seed)
+        fts = [V1, V1, D15, D15, D15, DT]
+        scan = TableScan(2, tuple(ColumnInfo(i + 1, ft) for i, ft in enumerate(fts)))
+        C = lambda i: col(i, fts[i])
+        sel = Selection((func("le", BOOL, C(5), lit("1998-09-02", DT)),))
+        disc_price = func("mul", new_decimal(31, 4), C(3), func("minus", new_decimal(16, 2), lit(1, new_longlong()), C(4)))
+        agg = Aggregation(
+            group_by=(C(0), C(1)),
+            aggs=(
+                AggDesc("sum", (C(2),)),
+                AggDesc("sum", (C(3),)),
+                AggDesc("sum", (disc_price,)),
+                AggDesc("avg", (C(2),)),
+                AggDesc("avg", (C(4),)),
+                AggDesc("count", ()),
+            ),
+        )
+        dag = DAGRequest((scan, sel, agg), output_offsets=tuple(range(8)))
+        cols = [_str_col(t["rflag"], b"ANR"), _str_col(t["lstat"], b"OF"),
+                t["qty"], t["price"], t["disc"], t["shipdate"]]
+        return dag, [_dev_batch(cols, fts, jnp)]
+
+    def topn(n, seed=0):
+        t = _make_tables(n, seed)
+        fts = [D15, DT]
+        scan = TableScan(1, (ColumnInfo(1, D15), ColumnInfo(2, DT)))
+        tn = TopN(order_by=((col(0, D15), True), (col(1, DT), False)), limit=100)
+        dag = DAGRequest((scan, tn), output_offsets=(0, 1))
+        return dag, [_dev_batch([t["price"], t["shipdate"]], fts, jnp)]
+
+    def q3(n, seed=0):
+        nl = n
+        no, nc = max(n // 8, 16), max(n // 32, 8)
+        t = _make_tables(nl, seed)
+        rng = np.random.default_rng(seed + 1)
+        LL = new_longlong()
+        lfts = [LL, D15, D15, DT]
+        ofts = [LL, LL, DT]
+        cfts = [LL, V1]
+        okey = rng.integers(0, no, nl).astype(np.int64)
+        ls = TableScan(1, tuple(ColumnInfo(i + 1, ft) for i, ft in enumerate(lfts)))
+        os_ = TableScan(2, tuple(ColumnInfo(i + 1, ft) for i, ft in enumerate(ofts)))
+        cs = TableScan(3, tuple(ColumnInfo(i + 1, ft) for i, ft in enumerate(cfts)))
+        cust_sel = Selection((func("eq", BOOL, col(1, cfts[1]), lit("B", V1)),))
+        inner = Join(build=(cs, cust_sel), probe_keys=(col(1, ofts[1]),), build_keys=(col(0, cfts[0]),), join_type="inner")
+        odate_sel = Selection((func("lt", BOOL, col(2, ofts[2]), lit("1995-03-15", DT)),))
+        outer = Join(build=(os_, odate_sel, inner), probe_keys=(col(0, lfts[0]),), build_keys=(col(0, ofts[0]),), join_type="inner")
+        lsel = Selection((func("gt", BOOL, col(3, lfts[3]), lit("1995-03-15", DT)),))
+        post = lfts + ofts + cfts
+        revenue = func("mul", new_decimal(31, 4), col(1, post[1]), func("minus", new_decimal(16, 2), lit(1, new_longlong()), col(2, post[2])))
+        agg = Aggregation(group_by=(col(0, post[0]),), aggs=(AggDesc("sum", (revenue,)),))
+        dag = DAGRequest((ls, lsel, outer, agg), output_offsets=(0, 1))
+        lb = _dev_batch([okey, t["price"], t["disc"], t["shipdate"]], lfts, jnp)
+        ob = _dev_batch(
+            [np.arange(no, dtype=np.int64), rng.integers(0, nc, no).astype(np.int64),
+             _make_tables(no, seed + 2)["shipdate"]], ofts, jnp)
+        cb = _dev_batch([np.arange(nc, dtype=np.int64), _str_col(rng.integers(0, 3, nc), b"BAS")], cfts, jnp)
+        return dag, [lb, ob, cb]
+
+    # headline first: a partial run (driver timeout) still yields Q6
+    return [
+        Config("q6", q6),
+        Config("scalar_agg", scalar_agg),
+        Config("q1", q1),
+        Config("topn", topn),
+        Config("q3", q3),
     ]
-    return dag, DeviceBatch(cols, jnp.ones(n, bool), jnp.int32(n))
 
 
-def bench_device(device, n: int, iters: int, warmup: int = 2) -> float:
-    """Rows/sec of the fused program on `device` (steady state)."""
+# --------------------------------------------------------------------------
+# measurement
+# --------------------------------------------------------------------------
+
+def _batch_bytes(batches) -> int:
+    total = 0
+    for b in batches:
+        for c in b.cols:
+            total += c.data.size * c.data.dtype.itemsize
+            total += c.null.size  # bool mask
+            if c.length is not None:
+                total += c.length.size * 4
+        total += b.row_valid.size
+    return total
+
+
+def _checksum(chunk) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for r in chunk.rows():
+        for d in r:
+            h.update(repr(None if d.is_null() else str(d.val)).encode())
+        h.update(b";")
+    return h.hexdigest()[:16]
+
+
+LOOP_K = 128  # kernel executions per timed dispatch (amortizes the
+# ~100ms tunnel dispatch latency into noise)
+
+
+def _make_loop(prog_fn, batches, K):
+    """K dependent executions of the fused program in one dispatch.
+
+    The loop body perturbs EVERY probe-batch column with a value derived
+    from the previous iteration's output (carry), a genuine data dependence:
+    XLA can neither hoist any per-column compute out of the loop nor elide
+    iterations. Numeric columns get +(carry%3); string columns get their
+    bytes shifted by carry%2 (sort keys change too). Workload cost per
+    iteration is identical to a single run. Join build sides stay
+    unperturbed — build-once/probe-per-batch is the realistic shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from tidb_tpu.chunk.device import DeviceBatch, DeviceColumn
+
+    def loop_fn(*bs):
+        b0 = bs[0]
+
+        def body(i, carry):
+            pert = carry % jnp.int64(3)
+            cols = []
+            for c in b0.cols:
+                if c.length is None:
+                    cols.append(DeviceColumn(c.data + pert.astype(c.data.dtype), c.null, None, c.ft))
+                else:
+                    cols.append(DeviceColumn(c.data + (pert % 2).astype(jnp.uint8), c.null, c.length, c.ft))
+            nb0 = DeviceBatch(cols, b0.row_valid, b0.n_rows)
+            packed, valid, n_out, ovf, exr = prog_fn(nb0, *bs[1:])
+            # fold the ACTUAL output values into the carry — without this
+            # the row count alone can be constant (scalar agg -> always 1)
+            # and XLA dead-code-eliminates the entire kernel
+            sig = n_out.astype(jnp.int64)
+            for out in packed:
+                v = out[0]
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    s = jnp.clip(jnp.nan_to_num(v).sum(), -1e18, 1e18)
+                else:
+                    s = v.sum()
+                sig = sig + s.astype(jnp.int64)
+            return carry + sig
+
+        return jax.lax.fori_loop(0, K, body, jnp.int64(0))
+
+    return jax.jit(loop_fn)
+
+
+def bench_config(cfg, device, n, iters):
+    """(rows/s median, GB/s, spread%, checksum): K-deep on-device loop per
+    timed call, block_until_ready around each call."""
     import jax
 
     from tidb_tpu.exec.builder import build_program
 
     with jax.default_device(device):
-        dag, batch = make_batch(n)
-        batch = jax.device_put(batch, device)
-        prog = build_program(dag, n, group_capacity=16)
-        fn = jax.jit(prog.fn)
+        dag, batches = cfg.build(n)
+        batches = [jax.device_put(b, device) for b in batches]
+        caps = tuple(b.capacity for b in batches)
+        prog = build_program(dag, caps, group_capacity=4096)
+        loop = _make_loop(prog.fn, batches, LOOP_K)
         t0 = time.perf_counter()
-        out = fn(batch)
-        jax.block_until_ready(out)
-        log(f"  [{device.platform}] first call (compile+run): {time.perf_counter()-t0:.2f}s")
-        for _ in range(warmup):
-            jax.block_until_ready(fn(batch))
-        t0 = time.perf_counter()
+        jax.block_until_ready(loop(*batches))
+        log(f"  [{cfg.name}/{device.platform}] compile+first: {time.perf_counter()-t0:.2f}s")
+        times = []
         for _ in range(iters):
-            out = fn(batch)
-        jax.block_until_ready(out)
-        dt = time.perf_counter() - t0
-        # sanity: count aggregate > 0
-        packed, valid, n_rows, (g_ovf, j_ovf), _ex_rows = out
-        cnt = int(np.asarray(packed[1][0])[0])
-        assert cnt > 0 and not bool(g_ovf) and not bool(j_ovf), (cnt,)
-        return n * iters / dt
+            t0 = time.perf_counter()
+            jax.block_until_ready(loop(*batches))
+            times.append(time.perf_counter() - t0)
+        med = statistics.median(times)
+        spread = (max(times) - min(times)) / med * 100
+        nbytes = _batch_bytes(batches)
+        rows = sum(int(b.n_rows) for b in batches)
+        rps = rows * LOOP_K / med
+        gbs = nbytes * LOOP_K / med / 1e9
+        assert gbs <= HBM_ROOFLINE_GBS, (
+            f"{cfg.name}: claimed {gbs:.0f} GB/s exceeds any plausible HBM roofline — measurement bug"
+        )
+        # checksum from one unperturbed run of the plain program
+        from tidb_tpu.exec.executor import decode_outputs
+
+        packed, valid, _, (g_ovf, j_ovf), _ = prog.fn(*batches)
+        assert not bool(g_ovf) and not bool(j_ovf), cfg.name
+        chunk = decode_outputs(packed, valid, prog.out_fts)
+        return rps, gbs, spread, _checksum(chunk)
+
+
+def parity_gate(cfg, n=PARITY_ROWS):
+    """Small-N device-vs-oracle diff (the bit-parity contract)."""
+    from tidb_tpu.chunk import Chunk
+    from tidb_tpu.exec import run_dag_on_chunks, run_dag_reference
+    from tidb_tpu.exec.executor import datum_group_key
+
+    dag, batches = cfg.build(n)
+    chunks = []
+    from tidb_tpu.exec.executor import decode_outputs
+
+    for b in batches:
+        packed = []
+        fts = [c.ft for c in b.cols]
+        for c in b.cols:
+            if c.length is not None:
+                packed.append((None, np.asarray(c.null), np.asarray(c.data), np.asarray(c.length)))
+            else:
+                packed.append((np.asarray(c.data), np.asarray(c.null)))
+        chunks.append(decode_outputs(packed, np.asarray(b.row_valid), fts))
+    dev = run_dag_on_chunks(dag, chunks)
+    ref = run_dag_reference(dag, chunks)
+    got = sorted(tuple(datum_group_key(d) for d in r) for r in dev.rows())
+    want = sorted(tuple(datum_group_key(d) for d in r) for r in ref)
+    # float/decimal canonicalization: compare to 10 significant digits
+    def canon(rows):
+        out = []
+        for r in rows:
+            row = []
+            for tag, v in r:
+                if isinstance(v, float):
+                    v = float(f"{v:.10g}")
+                if isinstance(v, str) and "." in v:
+                    try:
+                        v = float(f"{float(v):.10g}")
+                    except ValueError:
+                        pass
+                row.append((tag, v))
+            out.append(tuple(row))
+        return out
+
+    assert canon(got) == canon(want), f"{cfg.name}: parity gate FAILED"
+
+
+def bench_oracle(cfg, n=ORACLE_ROWS):
+    """Row-at-a-time interpreter rows/s — the mocktikv-analog baseline."""
+    from tidb_tpu.exec import run_dag_reference
+    from tidb_tpu.exec.executor import decode_outputs
+
+    dag, batches = cfg.build(n)
+    chunks = []
+    for b in batches:
+        packed = []
+        fts = [c.ft for c in b.cols]
+        for c in b.cols:
+            if c.length is not None:
+                packed.append((None, np.asarray(c.null), np.asarray(c.data), np.asarray(c.length)))
+            else:
+                packed.append((np.asarray(c.data), np.asarray(c.null)))
+        chunks.append(decode_outputs(packed, np.asarray(b.row_valid), fts))
+    t0 = time.perf_counter()
+    run_dag_reference(dag, chunks)
+    dt = time.perf_counter() - t0
+    return sum(c.num_rows() for c in chunks) / dt
+
+
+def _cpu_baseline_subprocess() -> float | None:
+    """q6 on the XLA-CPU backend in a CLEAN process (the axon TPU plugin
+    hijacks in-process 'cpu' devices — measured 29us 'runs' that never
+    executed). Returns rows/s or None."""
+    import os
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_CPU_ONLY="1")
+    try:
+        out = subprocess.run(
+            [sys.executable, __file__], env=env, capture_output=True, text=True, timeout=600
+        )
+        for line in out.stdout.strip().splitlines():
+            if line.startswith("{"):
+                return float(json.loads(line)["cpu_rows_per_sec"])
+    except Exception as exc:  # noqa: BLE001
+        log(f"  cpu baseline subprocess failed: {exc}")
+    return None
+
+
+def _cpu_only_main():
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+    cpu = jax.devices("cpu")[0]
+    cfg = next(c for c in _configs() if c.name == "q6")
+    rps, gbs, spread, _ = bench_config(cfg, cpu, CPU_ROWS, 3)
+    log(f"  [q6/cpu-subprocess] {rps/1e6:.2f} Mrows/s, {gbs:.1f} GB/s, spread {spread:.0f}%")
+    print(json.dumps({"cpu_rows_per_sec": rps}))
+
+
+def _config_rows(name: str) -> int:
+    # sort-heavy programs (group-by / topn / join) compile 10-100x slower on
+    # the tunneled backend; smaller resident batches keep first-run compile
+    # bounded while the K-deep loop preserves steady-state signal
+    return ROWS if name in ("q6", "scalar_agg") else ROWS // 16
+
+
+def _one_config_main(name: str):
+    """Child process: parity + accel measurement for one config."""
+    import jax
+
+    cfg = next(c for c in _configs() if c.name == name)
+    parity_gate(cfg)
+    log(f"  [{name}] parity gate vs oracle: OK")
+    rps, gbs, spread, csum = bench_config(cfg, jax.devices()[0], _config_rows(name), ITERS)
+    print(json.dumps({
+        "mrows_per_sec": round(rps / 1e6, 2),
+        "gb_per_sec": round(gbs, 1),
+        "spread_pct": round(spread, 1),
+        "checksum": csum,
+    }))
+
+
+def _run_config_subprocess(name: str, budget: int):
+    import os
+    import subprocess
+
+    env = dict(os.environ, BENCH_ONE=name)
+    try:
+        out = subprocess.run(
+            [sys.executable, __file__], env=env, capture_output=True, text=True, timeout=budget
+        )
+        sys.stderr.write(out.stderr)
+        for line in out.stdout.strip().splitlines():
+            if line.startswith("{"):
+                return json.loads(line)
+        return {"skipped": f"no result (rc={out.returncode})"}
+    except subprocess.TimeoutExpired:
+        return {"skipped": f"compile/run budget ({budget}s) exceeded — rerun with a warm .xla_cache"}
+    except Exception as exc:  # noqa: BLE001
+        return {"skipped": str(exc)}
 
 
 def main():
+    import os
+
+    if os.environ.get("BENCH_CPU_ONLY"):
+        _cpu_only_main()
+        return
+    if os.environ.get("BENCH_ONE"):
+        _one_config_main(os.environ["BENCH_ONE"])
+        return
+
     import jax
 
     devs = jax.devices()
     log(f"jax {jax.__version__}, devices: {devs}")
     accel = devs[0]
-    cpu = jax.devices("cpu")[0] if accel.platform != "cpu" else accel
+    budget = int(os.environ.get("BENCH_CONFIG_BUDGET", "420"))
 
-    accel_rps = bench_device(accel, ROWS, iters=20)
-    log(f"device ({accel.platform}) throughput: {accel_rps/1e6:.1f} M rows/s")
+    results = {}
+    for cfg in _configs():
+        # each config in its own process: a pathological compile (cold
+        # cache) skips that config instead of losing the whole bench run
+        results[cfg.name] = _run_config_subprocess(cfg.name, budget)
+        log(f"  [{cfg.name}] {json.dumps(results[cfg.name])}")
+        if cfg.name == "q6" and "mrows_per_sec" in results["q6"]:
+            rps = results["q6"]["mrows_per_sec"] * 1e6
+            cpu_rps = _cpu_baseline_subprocess()
+            if cpu_rps is None or accel.platform == "cpu":
+                cpu_rps = rps
+            oracle_rps = bench_oracle(cfg)
+            log(f"  [q6] XLA-CPU baseline {cpu_rps/1e6:.2f} Mrows/s; oracle {oracle_rps/1e3:.1f} Krows/s")
+            results["q6"]["vs_xla_cpu"] = round(rps / cpu_rps, 2)
+            results["q6"]["vs_oracle_rowwise"] = round(rps / oracle_rps, 0)
 
-    if cpu is not accel:
-        cpu_rps = bench_device(cpu, CPU_ROWS, iters=3)
-    else:
-        cpu_rps = accel_rps
-    log(f"cpu baseline throughput: {cpu_rps/1e6:.1f} M rows/s")
-
+    q6 = results.get("q6", {})
     print(json.dumps({
         "metric": "q6_fused_filter_agg_throughput",
-        "value": round(accel_rps / 1e6, 2),
+        "value": q6.get("mrows_per_sec", 0.0),
         "unit": "Mrows/s/chip",
-        "vs_baseline": round(accel_rps / cpu_rps, 2),
+        "vs_baseline": q6.get("vs_xla_cpu", 0.0),
+        "gb_per_sec": q6.get("gb_per_sec", 0.0),
+        "vs_oracle_rowwise": q6.get("vs_oracle_rowwise", 0.0),
+        "configs": results,
     }))
 
 

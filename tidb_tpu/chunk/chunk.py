@@ -25,6 +25,15 @@ class Chunk:
             cols.append(Column.from_datums(ft, [r[ci] for r in rows]))
         return cls(cols)
 
+    def nbytes(self) -> int:
+        """Host bytes held by this chunk (memory-tracker accounting)."""
+        total = 0
+        for c in self.columns:
+            for arr in (c.data, c.null, c.offsets, c.blob):
+                if arr is not None and hasattr(arr, "nbytes"):
+                    total += arr.nbytes
+        return total
+
     def num_rows(self) -> int:
         return len(self.columns[0]) if self.columns else 0
 

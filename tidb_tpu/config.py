@@ -1,0 +1,44 @@
+"""Instance configuration (ref: pkg/config — TOML file + flags, bridged to
+sysvars at boot; cmd/tidb-server/main.go:654 setGlobalVars)."""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass
+
+
+@dataclass
+class Config:
+    # store / execution
+    region_split_rows: int = 1 << 20  # rows per region before auto-split
+    group_capacity: int = 4096  # initial group table capacity
+    join_capacity: int | None = None  # default: probe batch capacity
+    distsql_scan_concurrency: int = 4
+    paging_size: int | None = None
+    # memory
+    mem_quota_query: int = 1 << 30
+    # observability
+    enable_metrics: bool = True
+    slow_query_threshold_ms: int = 300
+
+    @classmethod
+    def from_toml(cls, path: str) -> "Config":
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        return cls.from_dict(data)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Config":
+        known = {f_ for f_ in cls.__dataclass_fields__}
+        flat = {}
+        for k, v in data.items():
+            if isinstance(v, dict):  # one level of TOML tables
+                for k2, v2 in v.items():
+                    if k2 in known:
+                        flat[k2] = v2
+            elif k in known:
+                flat[k] = v
+        return cls(**flat)
+
+
+DEFAULT = Config()

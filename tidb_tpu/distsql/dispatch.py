@@ -105,9 +105,12 @@ def select(store: TPUStore, req: KVRequest) -> SelectResult:
         """One cop task; drives the paging loop when paging is on
         (ref: copr/coprocessor.go:1393 handleCopPagingResult — each page's
         lastRange seeds the next request until the task drains)."""
+        from ..util import metrics
+
         out_chunks: list = []
         ranges = task.ranges
         while True:
+            metrics.DISTSQL_TASKS.inc()
             creq = CopRequest(
                 req.dag, ranges, req.start_ts, task.region_id, task.epoch,
                 aux_chunks=req.aux_chunks, paging_size=req.paging_size,
@@ -121,6 +124,7 @@ def select(store: TPUStore, req: KVRequest) -> SelectResult:
             if resp.region_error is not None:
                 if retries <= 0:
                     raise RuntimeError(f"region retries exhausted: {resp.region_error}")
+                metrics.DISTSQL_RETRIES.inc()
                 # re-split the REMAINING ranges against the fresh region view
                 sub = _build_tasks(store, ranges)
                 for s in sub:

@@ -126,14 +126,26 @@ def execute_root(
     concurrency: int = 4,
     cache: ProgramCache | None = None,
     group_capacity: int = DEFAULT_GROUP_CAPACITY,
+    paging_size: int | None = None,
 ) -> Chunk:
     """Run a logical (Complete-mode) DAG over the store: split, dispatch the
     pushdown half per region, merge at root. The caller-visible result is
-    identical to running the whole DAG over all rows at once."""
+    identical to running the whole DAG over all rows at once.
+
+    paging_size applies only when the pushdown half is row-local (the store
+    rejects paged aggregation/TopN/Limit); otherwise it is ignored here."""
     plan = split_dag(dag)
+    if paging_size is not None:
+        from ..exec.dag import Aggregation as _A, Limit as _L, TopN as _T, executor_walk
+
+        if any(isinstance(e, (_A, _T, _L)) for e in executor_walk(plan.push_dag.executors)):
+            paging_size = None
     res: SelectResult = select(
         store,
-        KVRequest(plan.push_dag, ranges, start_ts, concurrency=concurrency, aux_chunks=aux_chunks or []),
+        KVRequest(
+            plan.push_dag, ranges, start_ts, concurrency=concurrency,
+            aux_chunks=aux_chunks or [], paging_size=paging_size,
+        ),
     )
     merged = res.merged()
     if merged is None:

@@ -242,6 +242,9 @@ class ProgramCache:
         key = (dag.fingerprint(), capacities, group_capacity, join_capacity)
         prog = self._cache.get(key)
         if prog is None:
+            from ..util import metrics
+
+            metrics.PROGRAM_COMPILES.inc()
             prog = build_program(dag, capacities, group_capacity, join_capacity)
             self._cache[key] = prog
         return prog

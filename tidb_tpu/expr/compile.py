@@ -826,10 +826,13 @@ class ExprCompiler:
             raise NotImplementedError("LIKE needs raw string column")
         data, length = a.raw
         if a.ft.is_ci() or pat.ft.is_ci():
-            # general_ci LIKE: fold both subject and pattern (ASCII)
+            # general_ci LIKE: ASCII fold on BOTH sides (matching the
+            # compare()/sort-key fold — full-Unicode upper would diverge)
+            from ..expr.eval_ref import _ascii_upper
+
             hit = (data >= 0x61) & (data <= 0x7A)
             data = jnp.where(hit, data - 0x20, data)
-            p = p.upper()
+            p = _ascii_upper(p)
         import numpy as np
 
         if p.endswith("%") and "%" not in p[:-1] and "_" not in p:
@@ -993,17 +996,16 @@ class ExprCompiler:
         y, m = ym // 13, ym % 13
         sec, minute, hour = hms & 63, (hms >> 6) & 63, hms >> 12
         nn = sign * n.value.astype(jnp.int64)
-        unit_secs = {"second": 1, "minute": 60, "hour": 3600, "day": 86400, "week": 7 * 86400}
-        if unit in unit_secs:
-            total = _days_from_ymd(y, m, day) * 86400 + hour * 3600 + minute * 60 + sec + nn * unit_secs[unit]
+        from ..types.mytime import _UNIT_SECONDS, add_months
+
+        if unit in _UNIT_SECONDS:
+            total = _days_from_ymd(y, m, day) * 86400 + hour * 3600 + minute * 60 + sec + nn * _UNIT_SECONDS[unit]
             days, secs = total // 86400, total % 86400
             y, m, day = _ymd_from_days(days)
             hour, minute, sec = secs // 3600, (secs // 60) % 60, secs % 60
         elif unit in ("month", "quarter", "year"):
             months = nn * {"month": 1, "quarter": 3, "year": 12}[unit]
-            t = y * 12 + (m - 1) + months
-            y, m = t // 12, t % 12 + 1
-            day = jnp.minimum(day, _days_in_month_vec(y, m))
+            y, m, day = add_months(y, m, day, months)
         else:
             raise NotImplementedError(f"interval unit {unit!r}")
         packed = (((y * 13 + m) << 5 | day) << 17 | (hour << 12 | minute << 6 | sec)) << 24 | micro

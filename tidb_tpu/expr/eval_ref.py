@@ -21,6 +21,11 @@ from .ir import ColumnRef, Const, Expr, ScalarFunc
 _NUM_PREFIX = re.compile(r"^\s*[+-]?(\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?")
 
 
+def _ascii_upper(s: str) -> str:
+    """ASCII-only case fold (the general_ci subset every engine path uses)."""
+    return "".join(chr(ord(c) - 32) if "a" <= c <= "z" else c for c in s)
+
+
 def str_prefix_f64(s) -> float:
     import math
     import sys as _sys
@@ -507,7 +512,9 @@ class RefEvaluator:
         s = a.val if isinstance(a.val, str) else a.val.decode("utf-8", "surrogateescape")
         pat = p.val if isinstance(p.val, str) else p.val.decode()
         if self._ci(e):
-            s, pat = s.upper(), pat.upper()
+            # ASCII fold only — the engine's documented general_ci subset
+            # (full-Unicode str.upper would disagree with compare()/keys)
+            s, pat = _ascii_upper(s), _ascii_upper(pat)
         rx = re.escape(pat).replace(re.escape("%"), ".*").replace(re.escape("_"), ".")
         return Datum.i64(1 if re.fullmatch(rx, s, re.S) else 0)
 

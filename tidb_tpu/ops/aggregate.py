@@ -70,7 +70,26 @@ class GatherState:
 
 
 def _seg_sum(vals, seg, n, dtype=None):
-    return jax.ops.segment_sum(vals if dtype is None else vals.astype(dtype), seg, num_segments=n)
+    """Segment sum tuned for TPU: a single segment is a plain reduction
+    (segment_* lowers to scatter, which serializes on TPU), and the general
+    case promises sorted ids — every caller sorts rows by group key first,
+    and XLA's sorted-scatter path is far cheaper than the generic one."""
+    v = vals if dtype is None else vals.astype(dtype)
+    if n == 1:
+        return jnp.sum(v, axis=0, keepdims=True)
+    return jax.ops.segment_sum(v, seg, num_segments=n, indices_are_sorted=True)
+
+
+def _seg_min(vals, seg, n):
+    if n == 1:
+        return jnp.min(vals, axis=0, keepdims=True)
+    return jax.ops.segment_min(vals, seg, num_segments=n, indices_are_sorted=True)
+
+
+def _seg_max(vals, seg, n):
+    if n == 1:
+        return jnp.max(vals, axis=0, keepdims=True)
+    return jax.ops.segment_max(vals, seg, num_segments=n, indices_are_sorted=True)
 
 
 def _masked(vals, mask, fill):
@@ -111,9 +130,9 @@ def _seg_bitreduce(red, vals, seg, nseg, fill):
 
     sv, _ = jax.lax.associative_scan(combine, (vals, seg))
     pos = jnp.arange(n, dtype=jnp.int32)
-    last = jax.ops.segment_max(pos, seg, num_segments=nseg)
+    last = _seg_max(pos, seg, nseg)
     out = sv[jnp.clip(last, 0, n - 1)]
-    cnt = jax.ops.segment_sum(jnp.ones_like(seg), seg, num_segments=nseg)
+    cnt = _seg_sum(jnp.ones_like(seg), seg, nseg)
     return jnp.where(cnt > 0, out, jnp.int64(fill))
 
 
@@ -138,21 +157,21 @@ def _agg_states_raw(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
             return [(s, empty)]
         return [(cnt, jnp.zeros(nseg, bool)), (s, empty)]
     if name in ("min", "max"):
-        op = jax.ops.segment_min if name == "min" else jax.ops.segment_max
+        op = _seg_min if name == "min" else _seg_max
         if a.eval_type == "real":
             fill = jnp.inf if name == "min" else -jnp.inf
-            v = op(_masked(a.value, mask, fill), seg, num_segments=nseg)
+            v = op(_masked(a.value, mask, fill), seg, nseg)
         elif a.value.ndim == 2:
             raise AssertionError("string min/max is routed via GatherState")
         elif a.ft.is_unsigned() and a.eval_type == "int":
             flip = jnp.int64(-0x8000000000000000)
             av = a.value.astype(jnp.int64) ^ flip
             fill = I64_MAX if name == "min" else I64_MIN_
-            v = op(_masked(av, mask, fill), seg, num_segments=nseg) ^ flip
+            v = op(_masked(av, mask, fill), seg, nseg) ^ flip
         else:
             av = a.value.astype(jnp.int64)
             fill = I64_MAX if name == "min" else I64_MIN_
-            v = op(_masked(av, mask, fill), seg, num_segments=nseg)
+            v = op(_masked(av, mask, fill), seg, nseg)
         return [(v, empty)]
     if name == "first_row":
         raise AssertionError("first_row is routed via GatherState")
@@ -180,7 +199,7 @@ def _first_match_idx(mask_s, orig_s, seg, nseg, n):
 
     mask_s/orig_s are in sorted order (orig_s = perm, the original index of
     each sorted position). Returns (idx[nseg] clipped, has[nseg])."""
-    fi = jax.ops.segment_min(jnp.where(mask_s, orig_s, jnp.int32(n)), seg, num_segments=nseg)
+    fi = _seg_min(jnp.where(mask_s, orig_s, jnp.int32(n)), seg, nseg)
     has = fi < n
     return jnp.clip(fi, 0, n - 1), has
 
@@ -193,9 +212,9 @@ def _arg_extreme_mask(words_s, cand, seg, nseg, maximize: bool):
     for k in range(words_s.shape[1]):
         w = words_s[:, k]
         if maximize:
-            best = jax.ops.segment_max(jnp.where(cand, w, I64_MIN_), seg, num_segments=nseg)
+            best = _seg_max(jnp.where(cand, w, I64_MIN_), seg, nseg)
         else:
-            best = jax.ops.segment_min(jnp.where(cand, w, I64_MAX), seg, num_segments=nseg)
+            best = _seg_min(jnp.where(cand, w, I64_MAX), seg, nseg)
         cand = cand & (w == best[seg])
     return cand
 

@@ -54,12 +54,24 @@ class Session:
     share a store+catalog (pass them in) — the testkit pattern
     (ref: pkg/testkit TestKit over a shared mockstore)."""
 
-    def __init__(self, store: TPUStore | None = None, catalog: Catalog | None = None):
+    def __init__(self, store: TPUStore | None = None, catalog: Catalog | None = None, config=None):
+        from ..config import Config
+        from .sysvar import SysVarStore
+
         self.store = store or TPUStore()
         self.catalog = catalog or Catalog()
         self._tso = itertools.count(100)
         self._tso_lock = threading.Lock()
-        self.sysvars: dict[str, str] = {"tidb_enable_tpu_coprocessor": "ON"}
+        self.sysvars = SysVarStore()
+        self.user_vars: dict[str, object] = {}
+        if config is not None:
+            # instance config seeds session sysvars (ref: setGlobalVars
+            # bridging config -> sysvar defaults, cmd/tidb-server/main.go:654)
+            self.sysvars.set("tidb_distsql_scan_concurrency", str(config.distsql_scan_concurrency))
+            self.sysvars.set("tidb_mem_quota_query", str(config.mem_quota_query))
+            if config.paging_size:
+                self.sysvars.set("tidb_enable_paging", "ON")
+                self.sysvars.set("tidb_max_chunk_size", str(config.paging_size))
 
     def _next_ts(self) -> int:
         with self._tso_lock:
@@ -71,6 +83,8 @@ class Session:
         return self.execute_stmt(stmt)
 
     def execute_stmt(self, stmt) -> Result:
+        if isinstance(stmt, (A.SelectStmt, A.UpdateStmt, A.DeleteStmt, A.InsertStmt)):
+            self._substitute_vars(stmt)
         if isinstance(stmt, A.SelectStmt):
             return self._select(stmt)
         if isinstance(stmt, A.CreateTableStmt):
@@ -91,9 +105,18 @@ class Session:
         if isinstance(stmt, (A.BeginStmt, A.CommitStmt, A.RollbackStmt)):
             return Result()  # autocommit: every statement commits
         if isinstance(stmt, A.SetStmt):
+            from .sysvar import SysVarError
+
             for scope, name, val in stmt.assignments:
-                if isinstance(val, A.Literal):
-                    self.sysvars[name.lower()] = str(val.value)
+                if not isinstance(val, A.Literal):
+                    continue
+                if scope == "user":
+                    self.user_vars[name.lower()] = str(val.value)
+                else:
+                    try:
+                        self.sysvars.set(name, str(val.value))
+                    except SysVarError as exc:
+                        raise SQLError(str(exc)) from exc
             return Result()
         if isinstance(stmt, (A.UseStmt, A.CreateDatabaseStmt)):
             return Result()  # single implicit database
@@ -107,6 +130,42 @@ class Session:
             return self._explain(stmt)
         raise SQLError(f"statement {type(stmt).__name__} not supported yet")
 
+    def _substitute_vars(self, node):
+        """Rewrite @x / @@sysvar references to literals in place
+        (ref: expression rewriter's variable substitution)."""
+
+        def to_literal(v: A.Variable) -> A.Literal:
+            if v.system:
+                val = self.sysvars.get(v.name)
+            else:
+                val = self.user_vars.get(v.name.lower())
+            if val is None:
+                return A.Literal(None, "null")
+            s = str(val)
+            if s.lstrip("-").isdigit():
+                return A.Literal(int(s), "int")
+            return A.Literal(s, "str")
+
+        for f_ in getattr(node, "__dataclass_fields__", {}):
+            v = getattr(node, f_)
+            if isinstance(v, A.Variable):
+                setattr(node, f_, to_literal(v))
+            elif isinstance(v, A.ExprNode) or hasattr(v, "__dataclass_fields__"):
+                self._substitute_vars(v)
+            elif isinstance(v, list):
+                for i, it in enumerate(v):
+                    if isinstance(it, A.Variable):
+                        v[i] = to_literal(it)
+                    elif isinstance(it, A.ExprNode) or hasattr(it, "__dataclass_fields__"):
+                        self._substitute_vars(it)
+                    elif isinstance(it, tuple):
+                        v[i] = tuple(
+                            to_literal(x) if isinstance(x, A.Variable) else x for x in it
+                        )
+                        for x in v[i]:
+                            if isinstance(x, A.ExprNode):
+                                self._substitute_vars(x)
+
     # ------------------------------------------------------------------
     def _select(self, stmt: A.SelectStmt) -> Result:
         if stmt.from_clause is None:
@@ -115,24 +174,57 @@ class Session:
             ev = RefEvaluator()
             row = [ev.eval(lw.lower_base(f.expr), []) for f in stmt.fields]
             return Result(columns=[f.alias or "expr" for f in stmt.fields], rows=[row])
+        from ..util.memory import MemTracker, QuotaExceeded
+
         plan = plan_select(stmt, self.catalog)
         ts = self._next_ts()
-        aux = [self._fetch_table_chunk(t, ts) for t in plan.build_tables]
-        # empty ranges (ranger proved the predicate unsatisfiable) flow
-        # through: execute_root dispatches zero tasks and the root merge
-        # still produces scalar-agg rows (count(*) of nothing = 0)
-        ranges = plan.ranges if plan.ranges is not None else full_table_ranges(plan.probe_table.table_id)
-        chunk = execute_root(
-            self.store,
-            plan.dag,
-            ranges,
-            start_ts=ts,
-            aux_chunks=aux,
-        )
+        tracker = MemTracker("query", quota=self.sysvars.get_int("tidb_mem_quota_query") or None)
+        aux = []
+        try:
+            for t in plan.build_tables:
+                c = self._fetch_table_chunk(t, ts)
+                tracker.consume(c.nbytes())
+                aux.append(c)
+            # empty ranges (ranger proved the predicate unsatisfiable) flow
+            # through: execute_root dispatches zero tasks and the root merge
+            # still produces scalar-agg rows (count(*) of nothing = 0)
+            ranges = plan.ranges if plan.ranges is not None else full_table_ranges(plan.probe_table.table_id)
+            if not self.sysvars.get_bool("tidb_enable_tpu_coprocessor"):
+                # feature gate OFF (ref: TiDBAllowMPPExecution pattern):
+                # evaluate the whole plan with the row-at-a-time oracle
+                chunk = self._select_via_oracle(plan, ranges, aux, ts)
+            else:
+                chunk = execute_root(
+                    self.store,
+                    plan.dag,
+                    ranges,
+                    start_ts=ts,
+                    aux_chunks=aux,
+                    concurrency=self.sysvars.get_int("tidb_distsql_scan_concurrency"),
+                    paging_size=(
+                        self.sysvars.get_int("tidb_max_chunk_size")
+                        if self.sysvars.get_bool("tidb_enable_paging")
+                        else None
+                    ),
+                )
+            tracker.consume(chunk.nbytes())
+        except QuotaExceeded as exc:
+            raise SQLError(str(exc)) from exc
+        finally:
+            tracker.release_all()
         rows = chunk.rows()
         if plan.offset:
             rows = rows[plan.offset :]
         return Result(columns=plan.column_names, rows=rows)
+
+    def _select_via_oracle(self, plan, ranges, aux, ts) -> Chunk:
+        from ..exec import run_dag_reference
+
+        scan = plan.dag.executors[0]
+        probe_dag = DAGRequest((scan,), output_offsets=tuple(range(len(scan.columns))))
+        res = execute_root(self.store, probe_dag, ranges, start_ts=ts)
+        rows = run_dag_reference(plan.dag, [res] + list(aux))
+        return Chunk.from_rows(plan.dag.output_fts(), rows)
 
     def _fetch_table_chunk(self, meta: TableMeta, ts: int) -> Chunk:
         scan = TableScan(meta.table_id, tuple(ColumnInfo(c.col_id, c.ft) for c in meta.columns))
@@ -182,9 +274,12 @@ class Session:
             self.store.put_index(key, None, wts)
         return Result()
 
-    def _check_unique(self, meta: TableMeta, datums: list, handle: int, ts: int):
+    def _check_unique(self, meta: TableMeta, datums: list, handle: int, ts: int, old_handle: int | None = None):
         """Unique-index duplicate check (ref: ER_DUP_ENTRY; MySQL allows
-        multiple NULLs in a unique index)."""
+        multiple NULLs in a unique index). `old_handle` is the row's
+        previous handle during a PK-changing UPDATE — its still-live entries
+        are the row's own, not duplicates."""
+        own = {handle, old_handle if old_handle is not None else handle}
         pos = {c.name: i for i, c in enumerate(meta.columns)}
         for idx in meta.indices:
             if not idx.unique:
@@ -195,7 +290,7 @@ class Session:
             prefix = tablecodec.encode_index_key(meta.table_id, idx.index_id, vals)
             for key, _ in self.store.kv.scan(prefix, prefix + b"\xff", ts):
                 other = self._index_keys_handle(key)
-                if other is not None and other != handle:
+                if other is not None and other not in own:
                     raise SQLError(
                         f"duplicate entry for unique key {idx.name!r}"
                     )
@@ -371,7 +466,7 @@ class Session:
                 nkey = tablecodec.encode_row_key(meta.table_id, new_handle)
                 if self.store.kv.get(nkey, wts) is not None:
                     raise SQLError(f"duplicate entry {new_handle} for key PRIMARY")
-            self._check_unique(meta, new_row, new_handle, wts)
+            self._check_unique(meta, new_row, new_handle, wts, old_handle=handle)
             if new_handle != handle:
                 # PK change moves the row to a new key (ref: updateRecord's
                 # remove+add when the handle changes)
@@ -413,7 +508,7 @@ class Session:
         if kind == "variables":
             return Result(
                 columns=["Variable_name", "Value"],
-                rows=[[Datum.string(k), Datum.string(v)] for k, v in sorted(self.sysvars.items())],
+                rows=[[Datum.string(k), Datum.string(v)] for k, v in self.sysvars.items()],
             )
         return Result()
 

@@ -1,0 +1,98 @@
+"""System variables (ref: pkg/sessionctx/variable/sysvar.go — 456 vars with
+scopes and validators; this registry carries the subset the engine consults,
+including the TPU backend's feature gate, which follows the
+TiDBAllowMPPExecution pattern at sysvar.go:1910)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class SysVarError(ValueError):
+    pass
+
+
+def _bool_validator(v: str) -> str:
+    t = v.strip().upper()
+    if t in ("ON", "1", "TRUE"):
+        return "ON"
+    if t in ("OFF", "0", "FALSE"):
+        return "OFF"
+    raise SysVarError(f"expected ON/OFF, got {v!r}")
+
+
+def _int_validator(lo: int, hi: int):
+    def check(v: str) -> str:
+        try:
+            n = int(v)
+        except ValueError as exc:
+            raise SysVarError(f"expected integer, got {v!r}") from exc
+        if not (lo <= n <= hi):
+            raise SysVarError(f"value {n} out of range [{lo}, {hi}]")
+        return str(n)
+
+    return check
+
+
+@dataclass
+class SysVar:
+    name: str
+    default: str
+    scope: str = "session"  # session | global | both
+    validator: object = None
+
+    def validate(self, v: str) -> str:
+        return self.validator(v) if self.validator else v
+
+
+DEFINITIONS = {
+    v.name: v
+    for v in [
+        # the TPU coprocessor gate (ref: TiDBAllowMPPExecution pattern)
+        SysVar("tidb_enable_tpu_coprocessor", "ON", "both", _bool_validator),
+        # ref: sysvar.go:1956 TiDBDistSQLScanConcurrency
+        SysVar("tidb_distsql_scan_concurrency", "4", "both", _int_validator(1, 256)),
+        # ref: sysvar.go:2080 TiDBMaxChunkSize
+        SysVar("tidb_max_chunk_size", "1024", "both", _int_validator(32, 1 << 20)),
+        SysVar("tidb_mem_quota_query", str(1 << 30), "both", _int_validator(0, 1 << 60)),
+        SysVar("tidb_enable_paging", "OFF", "both", _bool_validator),
+        SysVar("tidb_opt_agg_push_down", "ON", "both", _bool_validator),
+        SysVar("autocommit", "ON", "both", _bool_validator),
+        SysVar("sql_mode", "STRICT_TRANS_TABLES", "both"),
+        SysVar("time_zone", "UTC", "both"),
+    ]
+}
+
+
+class SysVarStore:
+    """Per-session values over the shared definitions."""
+
+    def __init__(self):
+        self._values: dict[str, str] = {}
+
+    def get(self, name: str) -> str:
+        name = name.lower()
+        if name in self._values:
+            return self._values[name]
+        d = DEFINITIONS.get(name)
+        if d is None:
+            raise SysVarError(f"unknown system variable {name!r}")
+        return d.default
+
+    def get_bool(self, name: str) -> bool:
+        return self.get(name) == "ON"
+
+    def get_int(self, name: str) -> int:
+        return int(self.get(name))
+
+    def set(self, name: str, value: str):
+        name = name.lower()
+        d = DEFINITIONS.get(name)
+        if d is None:
+            raise SysVarError(f"unknown system variable {name!r}")
+        self._values[name] = d.validate(str(value))
+
+    def items(self):
+        out = {name: d.default for name, d in DEFINITIONS.items()}
+        out.update(self._values)
+        return sorted(out.items())

@@ -264,6 +264,24 @@ class TPUStore:
 
     # -- the coprocessor endpoint -------------------------------------------
     def coprocessor(self, req: CopRequest, group_capacity: int = DEFAULT_GROUP_CAPACITY) -> CopResponse:
+        from ..util import failpoint, metrics
+
+        metrics.COP_REQUESTS.inc()
+        t_start = time.monotonic()
+        resp = self._coprocessor(req, group_capacity)
+        metrics.COP_DURATION.observe(time.monotonic() - t_start)
+        if resp.region_error is not None or resp.other_error is not None:
+            metrics.COP_ERRORS.inc()
+        return resp
+
+    def _coprocessor(self, req: CopRequest, group_capacity: int) -> CopResponse:
+        from ..util import failpoint, metrics
+
+        if failpoint.eval("cop-region-error"):
+            # fault injection at the RPC seam (ref: unistore/rpc.go:265-271)
+            return CopResponse(region_error="injected epoch_not_match")
+        if failpoint.eval("cop-other-error"):
+            return CopResponse(other_error="injected coprocessor error")
         region = self.cluster.region_by_id(req.region_id)
         if region is None:
             return CopResponse(region_error=f"region {req.region_id} not found")
@@ -293,6 +311,9 @@ class TPUStore:
         except OverflowRetryError:
             # degenerate fan-out: fall back to the row-at-a-time oracle
             # (the host fallback SURVEY §7 / exec/builder.py promise)
+            from ..util import metrics as _m
+
+            _m.COP_FALLBACKS.inc()
             try:
                 from ..exec.dag import executor_walk
 

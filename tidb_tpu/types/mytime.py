@@ -63,6 +63,16 @@ def days_in_month(y, m):
     return 31 - is30 * 1 - (m == 2) * (3 - leap * 1)
 
 
+def add_months(y, m, d, months):
+    """Month arithmetic with month-end clamping, branchless (scalar or
+    array) — the one copy both the oracle and the device kernel use."""
+    t = y * 12 + (m - 1) + months
+    y2, m2 = t // 12, t % 12 + 1
+    dim = days_in_month(y2, m2)
+    d2 = d - (d - dim) * (d > dim)  # min(d, dim)
+    return y2, m2, d2
+
+
 _UNIT_SECONDS = {"second": 1, "minute": 60, "hour": 3600, "day": 86400, "week": 7 * 86400}
 
 
@@ -77,10 +87,8 @@ def datetime_add(packed: int, n: int, unit: str) -> int:
         y, m, d = civil_from_days(days)
         hh, mm, ss = secs // 3600, (secs // 60) % 60, secs % 60
     else:
-        months = {"month": n, "quarter": 3 * n, "year": 12 * n}[unit]
-        t = y * 12 + (m - 1) + months
-        y, m = t // 12, t % 12 + 1
-        d = min(d, days_in_month(y, m))
+        months = {"month": 1, "quarter": 3, "year": 12}[unit] * n
+        y, m, d = add_months(y, m, d, months)
     return pack_datetime(y, m, d, hh, mm, ss, micro)
 
 

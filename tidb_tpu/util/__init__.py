@@ -1,0 +1,5 @@
+from . import failpoint
+from .memory import MemTracker, QuotaExceeded
+from .metrics import REGISTRY
+
+__all__ = ["failpoint", "MemTracker", "QuotaExceeded", "REGISTRY"]

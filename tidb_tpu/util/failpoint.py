@@ -1,0 +1,58 @@
+"""Failpoints — compile-time-free fault injection (ref:
+github.com/pingcap/failpoint; 673 sites in the reference, activated
+per-test via testkit/testfailpoint).
+
+A failpoint is a named hook; tests enable it with a value (bool, count, or
+callable). Production code calls `eval("name")` at the site; disabled sites
+cost one dict lookup."""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_active: dict[str, object] = {}
+
+
+def enable(name: str, value: object = True):
+    with _lock:
+        _active[name] = value
+
+
+def disable(name: str):
+    with _lock:
+        _active.pop(name, None)
+
+
+def eval(name: str):  # noqa: A001 (mirrors the reference API)
+    """Returns the failpoint's value if enabled, else None. A callable
+    value is invoked (and may raise, the usual injection shape); an int
+    value decrements per hit and auto-disables at 0 (fire-N-times)."""
+    v = _active.get(name)
+    if v is None:
+        return None
+    if callable(v):
+        return v()
+    if isinstance(v, int) and not isinstance(v, bool):
+        with _lock:
+            left = _active.get(name)
+            if isinstance(left, int) and left <= 1:
+                _active.pop(name, None)
+            elif isinstance(left, int):
+                _active[name] = left - 1
+        return True
+    return v
+
+
+class enabled:  # noqa: N801 — context manager, test-side sugar
+    def __init__(self, name: str, value: object = True):
+        self.name = name
+        self.value = value
+
+    def __enter__(self):
+        enable(self.name, self.value)
+        return self
+
+    def __exit__(self, *exc):
+        disable(self.name)
+        return False
