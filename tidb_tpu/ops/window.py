@@ -1,0 +1,238 @@
+"""Window function kernel (ref: pkg/executor/window.go + pipelined_window.go;
+tipb.Window executor; per-function semantics pkg/executor/aggfuncs/
+func_{rank,row_number,lead_lag,first_value,...}.go).
+
+The reference slides a frame over partition-sorted rows with per-function
+PartialResult updates. On TPU the whole batch is resident, so one stable
+lexsort by (partition keys, order keys) turns every supported window into a
+segmented scan / gather in sorted space, scattered back to input order:
+
+  row_number / rank / dense_rank    index arithmetic on segment starts
+  percent_rank / cume_dist / ntile  + partition sizes (gathered ends)
+  sum / count / avg                 segmented inclusive cumsum, read at the
+                                    current row's PEER-GROUP END — exactly
+                                    MySQL's default frame (RANGE UNBOUNDED
+                                    PRECEDING..CURRENT ROW includes peers);
+                                    without ORDER BY the frame is the whole
+                                    partition (read at partition end)
+  min / max                         segmented scan (associative_scan with a
+                                    segment-reset combiner)
+  first_value / last_value /        gathers at partition start / peer end /
+  nth_value / lead / lag            fixed offsets with partition bounds
+
+Explicit ROWS/RANGE frames are not supported here (the planner routes those
+to the row-at-a-time oracle). String-valued MIN/MAX likewise fall back.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..expr.compile import CompVal
+from .aggregate import _round_div
+from .keys import lexsort, sort_key_arrays
+
+RANK_FUNCS = frozenset({"row_number", "rank", "dense_rank", "percent_rank", "cume_dist", "ntile"})
+GATHER_FUNCS = frozenset({"first_value", "last_value", "nth_value", "lead", "lag"})
+AGG_FUNCS = frozenset({"sum", "avg", "count", "min", "max"})
+WINDOW_FUNCS = RANK_FUNCS | GATHER_FUNCS | AGG_FUNCS
+
+
+def _seg_running_sum(x, start, arange):
+    """Inclusive running sum within segments; `start` = per-row segment
+    start index (monotone)."""
+    c = jnp.cumsum(x, axis=0)
+    excl = c - x  # exclusive prefix
+    return c - jnp.take(excl, start)
+
+
+def _seg_scan_extreme(x, new_part, is_max: bool):
+    """Segmented inclusive cummax/cummin via associative_scan with a
+    reset-at-boundary combiner (standard segmented-scan construction)."""
+
+    def comb(a, b):
+        av, af = a
+        bv, bf = b
+        m = jnp.maximum(av, bv) if is_max else jnp.minimum(av, bv)
+        return jnp.where(bf, bv, m), af | bf
+
+    v, _ = jax.lax.associative_scan(comb, (x, new_part))
+    return v
+
+
+def _gather_cv(cv: CompVal, idx, extra_null) -> CompVal:
+    raw = None
+    if cv.raw is not None:
+        raw = (cv.raw[0][idx], cv.raw[1][idx])
+    return CompVal(cv.value[idx], cv.null[idx] | extra_null, cv.ft, raw=raw)
+
+
+def window_cols(part_vals: list, order_pairs: list, funcs: list, valid) -> list[CompVal]:
+    """Compute window columns in original row order.
+
+    part_vals: [CompVal] partition keys; order_pairs: [(CompVal, desc)];
+    funcs: [(WinDesc, [CompVal arg columns])]; valid: row mask.
+    Returns one CompVal per WinDesc.
+    """
+    n = valid.shape[0]
+    arange = jnp.arange(n)
+    keys = [jnp.where(valid, jnp.int64(0), jnp.int64(1))]
+    for v in part_vals:
+        keys.extend(sort_key_arrays(v))
+    for v, desc in order_pairs:
+        keys.extend(sort_key_arrays(v, desc=desc))
+    perm = lexsort(keys, extra_key=arange)
+
+    def diff_of(vals_keys):
+        d = jnp.zeros(n, bool).at[0].set(True)
+        for k in vals_keys:
+            ks = k[perm]
+            d = d | jnp.concatenate([jnp.ones(1, bool), ks[1:] != ks[:-1]])
+        return d
+
+    # validity is a leading partition key: padding rows (sorted last) must
+    # never merge into the final valid partition even when their zeroed key
+    # lanes equal its keys
+    pkeys = [keys[0]] + [k for v in part_vals for k in sort_key_arrays(v)]
+    okeys = [k for v, desc in order_pairs for k in sort_key_arrays(v, desc=desc)]
+    new_part = diff_of(pkeys)
+    new_peer = new_part | (diff_of(okeys) if okeys else jnp.zeros(n, bool))
+    has_order = bool(order_pairs)
+
+    part_id = jnp.cumsum(new_part.astype(jnp.int32))
+    start = jax.lax.cummax(jnp.where(new_part, arange, 0))
+    is_last_part = jnp.concatenate([new_part[1:], jnp.ones(1, bool)])
+    part_end = jnp.flip(jax.lax.cummin(jnp.flip(jnp.where(is_last_part, arange, n))))
+    is_last_peer = jnp.concatenate([new_peer[1:], jnp.ones(1, bool)])
+    peer_end = jnp.flip(jax.lax.cummin(jnp.flip(jnp.where(is_last_peer, arange, n))))
+    # the read point of the default frame: last peer with ORDER BY, else
+    # the whole partition
+    frame_end = peer_end if has_order else part_end
+    cnt = (part_end - start + 1).astype(jnp.int64)
+    pos0 = (arange - start).astype(jnp.int64)  # 0-based row index in partition
+
+    sv = valid[perm]
+
+    def scatter(v_sorted, null_sorted, ft) -> CompVal:
+        value = jnp.zeros(n, v_sorted.dtype).at[perm].set(v_sorted)
+        null = jnp.ones(n, bool).at[perm].set(null_sorted)
+        return CompVal(value, null, ft)
+
+    def gather_result(cv: CompVal, j_sorted, src_null_sorted) -> CompVal:
+        """Sorted-space source index -> original-order gathered CompVal."""
+        src_orig = jnp.zeros(n, jnp.int32).at[perm].set(perm[jnp.clip(j_sorted, 0, n - 1)].astype(jnp.int32))
+        xnull = jnp.ones(n, bool).at[perm].set(src_null_sorted)
+        return _gather_cv(cv, src_orig, xnull)
+
+    out: list[CompVal] = []
+    for desc, argvals in funcs:
+        name = desc.name
+        if name == "row_number":
+            out.append(scatter(pos0 + 1, ~sv, desc.ft))
+        elif name == "rank":
+            peer_start = jax.lax.cummax(jnp.where(new_peer, arange, 0))
+            out.append(scatter((peer_start - start + 1).astype(jnp.int64), ~sv, desc.ft))
+        elif name == "dense_rank":
+            d = jnp.cumsum(new_peer.astype(jnp.int64))
+            out.append(scatter(d - jnp.take(d, start) + 1, ~sv, desc.ft))
+        elif name == "percent_rank":
+            peer_start = jax.lax.cummax(jnp.where(new_peer, arange, 0))
+            rank = (peer_start - start).astype(jnp.float64)
+            denom = jnp.maximum(cnt - 1, 1).astype(jnp.float64)
+            out.append(scatter(jnp.where(cnt <= 1, 0.0, rank / denom), ~sv, desc.ft))
+        elif name == "cume_dist":
+            covered = (peer_end - start + 1).astype(jnp.float64)
+            out.append(scatter(covered / cnt.astype(jnp.float64), ~sv, desc.ft))
+        elif name == "ntile":
+            k = jnp.int64(desc.offset)
+            base, rem = cnt // k, cnt % k
+            cut = rem * (base + 1)
+            bucket = jnp.where(
+                pos0 < cut,
+                pos0 // jnp.maximum(base + 1, 1),
+                rem + (pos0 - cut) // jnp.maximum(base, 1),
+            )
+            out.append(scatter(bucket + 1, ~sv, desc.ft))
+        elif name == "count":
+            if argvals:
+                ones = jnp.where(sv & ~argvals[0].null[perm], jnp.int64(1), jnp.int64(0))
+            else:
+                ones = jnp.where(sv, jnp.int64(1), jnp.int64(0))
+            run = _seg_running_sum(ones, start, arange)
+            out.append(scatter(jnp.take(run, frame_end), ~sv, desc.ft))
+        elif name in ("sum", "avg"):
+            a = argvals[0]
+            if a.value.ndim == 2:
+                raise NotImplementedError("string SUM/AVG windows run on the oracle")
+            av, anull = a.value[perm], a.null[perm]
+            live = sv & ~anull
+            if a.eval_type == "real":
+                x = jnp.where(live, av.astype(jnp.float64), 0.0)
+            else:
+                x = jnp.where(live, av.astype(jnp.int64), jnp.int64(0))
+            rsum = jnp.take(_seg_running_sum(x, start, arange), frame_end)
+            rcnt = jnp.take(
+                _seg_running_sum(live.astype(jnp.int64), start, arange), frame_end
+            )
+            null = ~sv | (rcnt == 0)
+            if name == "sum":
+                out.append(scatter(rsum, null, desc.ft))
+            elif a.eval_type == "real":
+                out.append(scatter(rsum / jnp.maximum(rcnt, 1).astype(jnp.float64), null, desc.ft))
+            else:
+                # decimal avg: scale(out) = scale(arg) + 4 (div frac incr),
+                # round half away from zero — mirrors finalize_agg
+                src_scale = max(a.ft.decimal, 0) if a.eval_type == "decimal" else 0
+                tgt = max(desc.ft.decimal, 0)
+                num = rsum * jnp.int64(10 ** (tgt - src_scale))
+                out.append(scatter(_round_div(num, jnp.maximum(rcnt, 1)), null, desc.ft))
+        elif name in ("min", "max"):
+            a = argvals[0]
+            if a.value.ndim == 2:
+                raise NotImplementedError("string MIN/MAX windows run on the oracle")
+            av, anull = a.value[perm], a.null[perm]
+            live = sv & ~anull
+            if a.eval_type == "real":
+                ident = jnp.float64(-jnp.inf if name == "max" else jnp.inf)
+                x = jnp.where(live, av.astype(jnp.float64), ident)
+            else:
+                ident = jnp.int64(-(1 << 62) if name == "max" else (1 << 62))
+                x = jnp.where(live, av.astype(jnp.int64), ident)
+            run = _seg_scan_extreme(x, new_part, name == "max")
+            rcnt = jnp.take(_seg_running_sum(live.astype(jnp.int64), start, arange), frame_end)
+            v = jnp.take(run, frame_end)
+            if a.eval_type == "int" and a.ft.is_unsigned():
+                pass  # unsigned order == signed order for values < 2^62 keys;
+                # full-range unsigned handled by the oracle fallback upstream
+            out.append(scatter(v, ~sv | (rcnt == 0), desc.ft))
+        elif name == "first_value":
+            out.append(gather_result(argvals[0], start, ~sv))
+        elif name == "last_value":
+            out.append(gather_result(argvals[0], frame_end, ~sv))
+        elif name == "nth_value":
+            j = start + jnp.int64(desc.offset) - 1
+            miss = ~sv | (j > frame_end)
+            out.append(gather_result(argvals[0], j, miss))
+        elif name in ("lead", "lag"):
+            off = desc.offset if name == "lead" else -desc.offset
+            j = arange + off
+            inb = (j >= 0) & (j < n)
+            jc = jnp.clip(j, 0, n - 1)
+            same = inb & (jnp.take(part_id, jc) == part_id) & sv & jnp.take(sv, jc)
+            res = gather_result(argvals[0], jc, ~same)
+            if len(argvals) > 1:
+                d = argvals[1]
+                dnull = jnp.ones(n, bool).at[perm].set(~same)
+                value = jnp.where(dnull, d.value, res.value) if res.raw is None else res.value
+                if res.raw is None:
+                    out.append(CompVal(value, jnp.where(dnull, d.null, res.null), desc.ft))
+                else:
+                    # string default: keep gather result, patch nulls where
+                    # the default applies (defaults are Consts; raw ride-along)
+                    raise NotImplementedError("string LEAD/LAG defaults run on the oracle")
+            else:
+                out.append(res)
+        else:
+            raise NotImplementedError(f"window function {name!r}")
+    return out

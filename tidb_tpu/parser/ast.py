@@ -100,6 +100,21 @@ class InList(ExprNode):
 
 
 @dataclass
+class SemiJoinCond(ExprNode):
+    """Planner-internal conjunct produced by subquery decorrelation (never
+    emitted by the parser): row passes iff a matching row exists (anti:
+    does not exist) in `table` on probe_exprs[i] = build_cols[i]
+    (ref: the semi-join LogicalJoin the reference's decorrelation rules
+    produce, pkg/planner/core/rule_decorrelate.go)."""
+
+    table: str  # materialized/real table name holding the subquery rows
+    probe_exprs: list  # [ExprNode] over the outer schema
+    build_cols: list  # [str] column names in `table`
+    anti: bool = False
+    require_notnull_probe: bool = False  # NOT IN: NULL probe would be wrong
+
+
+@dataclass
 class InSubquery(ExprNode):
     expr: ExprNode
     subquery: "SelectStmt"

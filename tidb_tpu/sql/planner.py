@@ -25,7 +25,7 @@ from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, IndexScan, Join, Lim
 from ..expr.agg import AGG_FUNCS, AggDesc
 from ..expr.ir import Expr, col, func, lit
 from ..parser import ast as A
-from ..types import Datum, DatumKind, FieldType, MyDecimal, MyTime, TypeCode, new_datetime, new_decimal, new_double, new_longlong, new_varchar
+from ..types import Datum, DatumKind, FieldType, Flag, MyDecimal, MyTime, TypeCode, new_datetime, new_decimal, new_double, new_longlong, new_varchar
 from .catalog import Catalog, CatalogError, TableMeta, field_type_from_spec
 
 BOOL = new_longlong()
@@ -506,9 +506,34 @@ def _coerce_datum(d: Datum, ft: FieldType) -> Datum:
     return d
 
 
+def datum_ft(d: Datum) -> FieldType:
+    """Natural FieldType of a materialized datum (subquery results carry
+    Datums back into expression trees as `kind="datum"` literals)."""
+    if d.kind == DatumKind.Int64:
+        return new_longlong()
+    if d.kind == DatumKind.Uint64:
+        return new_longlong(unsigned=True)
+    if d.kind in (DatumKind.Float32, DatumKind.Float64):
+        return new_double()
+    if d.kind == DatumKind.MysqlDecimal:
+        return new_decimal(max(len(str(d.val)), 1), d.val.scale)
+    if d.kind == DatumKind.MysqlTime:
+        return new_datetime()
+    if d.kind in (DatumKind.String, DatumKind.Bytes):
+        return new_varchar(max(len(str(d.val)), 1))
+    return new_longlong()
+
+
 def _lower_literal(n: A.Literal) -> Expr:
     if n.kind == "null":
         return lit(None, new_longlong())
+    if n.kind == "datum":
+        from ..expr.ir import Const
+
+        d: Datum = n.value
+        if d.is_null():
+            return lit(None, new_longlong())
+        return Const(d, datum_ft(d))
     if n.kind in ("int", "bool"):
         v = int(n.value)
         if -(1 << 63) <= v < (1 << 63):
@@ -534,15 +559,24 @@ def _lower_literal(n: A.Literal) -> Expr:
 # FROM / join planning
 # --------------------------------------------------------------------------
 
-def _flatten_from(node, catalog: Catalog) -> list:
+def _resolve_table(name: str, catalog: Catalog, mat: dict | None) -> TableMeta:
+    """Materialized (CTE/derived) tables shadow catalog tables."""
+    if mat:
+        m = mat.get(name.lower())
+        if m is not None:
+            return m
+    return catalog.table(name)
+
+
+def _flatten_from(node, catalog: Catalog, mat: dict | None = None) -> list:
     """FROM tree -> [(TableMeta, alias, kind, on_expr)] left-deep order.
     JOIN ... USING(cols) desugars to ON equality conjuncts."""
     if isinstance(node, A.TableName):
-        meta = catalog.table(node.name)
+        meta = _resolve_table(node.name, catalog, mat)
         return [(meta, (node.alias or node.name).lower(), "inner", None)]
     if isinstance(node, A.Join):
-        left = _flatten_from(node.left, catalog)
-        right = _flatten_from(node.right, catalog)
+        left = _flatten_from(node.left, catalog, mat)
+        right = _flatten_from(node.right, catalog, mat)
         if len(right) != 1:
             raise PlanError("right-nested joins not supported")
         meta, alias, _, _ = right[0]
@@ -662,12 +696,12 @@ def _unify_join_key(pk: Expr, bk: Expr):
     return cast(pk), cast(bk)
 
 
-def plan_select(stmt: A.SelectStmt, catalog: Catalog) -> PlannedQuery:
+def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -> PlannedQuery:
     if stmt.from_clause is None:
         raise PlanError("SELECT without FROM is evaluated by the session")
     if stmt.ctes:
-        raise PlanError("CTEs not supported yet")
-    flat = _flatten_from(stmt.from_clause, catalog)
+        raise PlanError("CTEs are materialized by the session before planning")
+    flat = _flatten_from(stmt.from_clause, catalog, mat)
 
     # ---- join order: probe = largest table (row-count stat); LEFT JOIN
     # pins the textual order (outer semantics are order-sensitive)
@@ -689,6 +723,10 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog) -> PlannedQuery:
 
     # ---- conjunct classification (PPDSolver analog)
     where_conj = _split_conjuncts(stmt.where)
+    # decorrelated-subquery markers become semi/anti join steps after the
+    # regular joins (ref: rule_decorrelate.go producing semi LogicalJoins)
+    semi_conds = [c for c in where_conj if isinstance(c, A.SemiJoinCond)]
+    where_conj = [c for c in where_conj if not isinstance(c, A.SemiJoinCond)]
     on_conj_per_join: dict[int, list] = {}
     for i, (_, _, kind, on) in enumerate(flat):
         if on is None:
@@ -852,6 +890,34 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog) -> PlannedQuery:
         )
         placed.add(alias)
         build_tables.append(meta)
+
+    # ---- decorrelated semi/anti joins (schema unchanged: probe rows only)
+    for sc in semi_conds:
+        smeta = _resolve_table(sc.table, catalog, mat)
+        s_scope = _Scope([_TableRef(smeta, smeta.name, 0)])
+        s_low = _Lowerer(s_scope)
+        build_execs = (TableScan(smeta.table_id, tuple(ColumnInfo(c.col_id, c.ft) for c in smeta.columns)),)
+        probe_keys, build_keys = [], []
+        for pe, bc in zip(sc.probe_exprs, sc.build_cols):
+            pk = low.lower_base(pe)
+            if sc.anti and sc.require_notnull_probe and not (pk.ft.flag & Flag.NotNull):
+                raise PlanError(
+                    "NOT IN over a correlated subquery requires a NOT NULL left operand "
+                    "(NULL-valued operands would change the three-valued result)"
+                )
+            bk = s_low.lower_base(A.ColumnName(bc))
+            pk, bk = _unify_join_key(pk, bk)
+            probe_keys.append(pk)
+            build_keys.append(bk)
+        executors.append(
+            Join(
+                build=build_execs,
+                probe_keys=tuple(probe_keys),
+                build_keys=tuple(build_keys),
+                join_type="anti" if sc.anti else "semi",
+            )
+        )
+        build_tables.append(smeta)
     if equi:
         # equi preds that never matched a join step (e.g. cycles) filter post-join
         for tabs, l_ast, r_ast in equi:

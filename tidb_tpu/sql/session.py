@@ -83,10 +83,13 @@ class Session:
         return self.execute_stmt(stmt)
 
     def execute_stmt(self, stmt) -> Result:
-        if isinstance(stmt, (A.SelectStmt, A.UpdateStmt, A.DeleteStmt, A.InsertStmt)):
+        if isinstance(stmt, (A.SelectStmt, A.SetOprStmt, A.UpdateStmt, A.DeleteStmt, A.InsertStmt)):
             self._substitute_vars(stmt)
         if isinstance(stmt, A.SelectStmt):
             return self._select(stmt)
+        if isinstance(stmt, A.SetOprStmt):
+            names, _, rows = self._set_opr(stmt, None)
+            return Result(columns=names, rows=rows)
         if isinstance(stmt, A.CreateTableStmt):
             self.catalog.create_table(stmt)
             return Result()
@@ -168,45 +171,98 @@ class Session:
 
     # ------------------------------------------------------------------
     def _select(self, stmt: A.SelectStmt) -> Result:
-        if stmt.from_clause is None:
-            # SELECT <exprs>: evaluate constants with the reference evaluator
-            lw = _Lowerer(_Scope([]))
-            ev = RefEvaluator()
-            row = [ev.eval(lw.lower_base(f.expr), []) for f in stmt.fields]
-            return Result(columns=[f.alias or "expr" for f in stmt.fields], rows=[row])
+        names, _, rows = self._run_select(stmt, None)
+        return Result(columns=names, rows=rows)
+
+    def _new_rewriter(self, parent_rw):
+        from .subquery import SubqueryRewriter
+
+        rw = SubqueryRewriter(
+            self.catalog,
+            registry=parent_rw.registry if parent_rw is not None else None,
+            max_recursion=self.sysvars.get_int("cte_max_recursion_depth"),
+        )
+        rw.exec_query = lambda q: self._exec_query(q, rw)
+        return rw
+
+    def _exec_query(self, stmt, parent_rw):
+        """Nested-query entry: SelectStmt or SetOprStmt -> (names, fts, rows),
+        sharing the parent rewriter's materialized-table namespace."""
+        if isinstance(stmt, A.SetOprStmt):
+            return self._set_opr(stmt, parent_rw)
+        return self._run_select(stmt, parent_rw)
+
+    def _run_select(self, stmt: A.SelectStmt, parent_rw) -> tuple:
+        from .subquery import SubqueryError
+
+        rw = self._new_rewriter(parent_rw)
+        try:
+            rw.process_ctes(stmt.ctes)
+            stmt.ctes = []
+            if stmt.from_clause is None:
+                # SELECT <exprs>: subqueries materialize, constants evaluate
+                # with the reference evaluator
+                for f in stmt.fields:
+                    if isinstance(f, A.SelectField):
+                        f.expr = rw._rewrite_expr(f.expr, [], stmt)
+                lw = _Lowerer(_Scope([]))
+                ev = RefEvaluator()
+                exprs = [lw.lower_base(f.expr) for f in stmt.fields]
+                row = [ev.eval(e, []) for e in exprs]
+                names = [f.alias or "expr" for f in stmt.fields]
+                return names, [e.ft for e in exprs], [row]
+            rw.rewrite_select(stmt)
+        except SubqueryError as exc:
+            raise SQLError(str(exc)) from exc
         from ..util.memory import MemTracker, QuotaExceeded
 
-        plan = plan_select(stmt, self.catalog)
+        plan = plan_select(stmt, self.catalog, mat=rw.registry.metas)
         ts = self._next_ts()
         tracker = MemTracker("query", quota=self.sysvars.get_int("tidb_mem_quota_query") or None)
+        gate_on = self.sysvars.get_bool("tidb_enable_tpu_coprocessor")
         aux = []
         try:
             for t in plan.build_tables:
-                c = self._fetch_table_chunk(t, ts)
+                c = self._table_chunk(t, ts, rw)
                 tracker.consume(c.nbytes())
                 aux.append(c)
-            # empty ranges (ranger proved the predicate unsatisfiable) flow
-            # through: execute_root dispatches zero tasks and the root merge
-            # still produces scalar-agg rows (count(*) of nothing = 0)
-            ranges = plan.ranges if plan.ranges is not None else full_table_ranges(plan.probe_table.table_id)
-            if not self.sysvars.get_bool("tidb_enable_tpu_coprocessor"):
-                # feature gate OFF (ref: TiDBAllowMPPExecution pattern):
-                # evaluate the whole plan with the row-at-a-time oracle
-                chunk = self._select_via_oracle(plan, ranges, aux, ts)
+            if plan.probe_table.table_id < 0:
+                # materialized probe (CTE/derived table): the whole DAG runs
+                # over in-memory chunks — device path or oracle by the gate
+                probe = rw.registry.chunks[plan.probe_table.name]
+                tracker.consume(probe.nbytes())
+                if gate_on:
+                    from ..exec import run_dag_on_chunks
+
+                    chunk = run_dag_on_chunks(plan.dag, [probe] + aux)
+                else:
+                    from ..exec import run_dag_reference
+
+                    rows = run_dag_reference(plan.dag, [probe] + aux)
+                    chunk = Chunk.from_rows(plan.dag.output_fts(), rows)
             else:
-                chunk = execute_root(
-                    self.store,
-                    plan.dag,
-                    ranges,
-                    start_ts=ts,
-                    aux_chunks=aux,
-                    concurrency=self.sysvars.get_int("tidb_distsql_scan_concurrency"),
-                    paging_size=(
-                        self.sysvars.get_int("tidb_max_chunk_size")
-                        if self.sysvars.get_bool("tidb_enable_paging")
-                        else None
-                    ),
-                )
+                # empty ranges (ranger proved the predicate unsatisfiable)
+                # flow through: execute_root dispatches zero tasks and the
+                # root merge still produces scalar-agg rows
+                ranges = plan.ranges if plan.ranges is not None else full_table_ranges(plan.probe_table.table_id)
+                if not gate_on:
+                    # feature gate OFF (ref: TiDBAllowMPPExecution pattern):
+                    # evaluate the whole plan with the row-at-a-time oracle
+                    chunk = self._select_via_oracle(plan, ranges, aux, ts)
+                else:
+                    chunk = execute_root(
+                        self.store,
+                        plan.dag,
+                        ranges,
+                        start_ts=ts,
+                        aux_chunks=aux,
+                        concurrency=self.sysvars.get_int("tidb_distsql_scan_concurrency"),
+                        paging_size=(
+                            self.sysvars.get_int("tidb_max_chunk_size")
+                            if self.sysvars.get_bool("tidb_enable_paging")
+                            else None
+                        ),
+                    )
             tracker.consume(chunk.nbytes())
         except QuotaExceeded as exc:
             raise SQLError(str(exc)) from exc
@@ -215,7 +271,103 @@ class Session:
         rows = chunk.rows()
         if plan.offset:
             rows = rows[plan.offset :]
-        return Result(columns=plan.column_names, rows=rows)
+        return plan.column_names, plan.dag.output_fts(), rows
+
+    def _set_opr(self, stmt: A.SetOprStmt, parent_rw) -> tuple:
+        """UNION [ALL] chains: branch results merge at root; a DISTINCT
+        union dedups the entire accumulated set (MySQL semantics; ref:
+        pkg/executor/union iterator + planner buildSetOpr)."""
+        from ..expr.eval_ref import compare
+        from .subquery import SubqueryError
+
+        rw = self._new_rewriter(parent_rw)
+        try:
+            rw.process_ctes(stmt.ctes)
+            stmt.ctes = []
+        except SubqueryError as exc:
+            raise SQLError(str(exc)) from exc
+        # two passes: collect every branch, unify column types across them
+        # (MySQL coerces all branches to one result type before dedup), then
+        # fold with the per-boundary distinct flags
+        from ..exec.executor import datum_group_key
+        from .planner import _unify_fts
+
+        names = None
+        branches = []
+        for sel in stmt.selects:
+            n_, f_, r_ = self._exec_query(sel, rw)
+            if names is None:
+                names = n_
+            elif len(n_) != len(names):
+                raise SQLError("The used SELECT statements have a different number of columns")
+            branches.append((f_, r_))
+        fts = [
+            _unify_fts([b[0][i] for b in branches])
+            for i in range(len(names))
+        ]
+        acc: list = []
+        for i, (bf, rows) in enumerate(branches):
+            coerced = [
+                [d if d.is_null() else _coerce_datum(d, ft) for d, ft in zip(r, fts)]
+                for r in rows
+            ]
+            acc.extend(coerced)
+            if i > 0 and not stmt.all_flags[i - 1]:
+                seen: set = set()
+                dedup = []
+                for r in acc:
+                    k = tuple(datum_group_key(d) for d in r)
+                    if k not in seen:
+                        seen.add(k)
+                        dedup.append(r)
+                acc = dedup
+        if stmt.order_by:
+            import functools
+
+            idxs = []
+            for b in stmt.order_by:
+                e = b.expr
+                if isinstance(e, A.Literal) and e.kind == "int":
+                    pos = int(e.value)
+                    if not (1 <= pos <= len(names)):
+                        raise SQLError(f"ORDER BY position {pos} out of range")
+                    idxs.append((pos - 1, b.desc))
+                elif isinstance(e, A.ColumnName) and not e.table:
+                    low_names = [n.lower() for n in names]
+                    if e.name.lower() not in low_names:
+                        raise SQLError(f"unknown column {e.name!r} in UNION ORDER BY")
+                    idxs.append((low_names.index(e.name.lower()), b.desc))
+                else:
+                    raise SQLError("UNION ORDER BY supports output columns and positions only")
+
+            def cmp(a, b):
+                for i, desc in idxs:
+                    x, y = a[i], b[i]
+                    if x.is_null() and y.is_null():
+                        continue
+                    c = -1 if x.is_null() else (1 if y.is_null() else compare(x, y))
+                    if c:
+                        return -c if desc else c
+                return 0
+
+            acc.sort(key=functools.cmp_to_key(cmp))
+        if stmt.limit is not None:
+            def _n(e, dflt):
+                if e is None:
+                    return dflt
+                if isinstance(e, A.Literal):
+                    return int(e.value)
+                return int(e)
+
+            off = _n(stmt.limit.offset, 0)
+            cnt = _n(stmt.limit.count, len(acc))
+            acc = acc[off : off + cnt]
+        return names, fts, acc
+
+    def _table_chunk(self, meta: TableMeta, ts: int, rw) -> Chunk:
+        if meta.table_id < 0:
+            return rw.registry.chunks[meta.name]
+        return self._fetch_table_chunk(meta, ts)
 
     def _select_via_oracle(self, plan, ranges, aux, ts) -> Chunk:
         from ..exec import run_dag_reference
@@ -516,7 +668,18 @@ class Session:
         inner = stmt.target
         if not isinstance(inner, A.SelectStmt):
             return Result()
-        plan = plan_select(inner, self.catalog)
+        from .subquery import SubqueryError
+
+        rw = self._new_rewriter(None)
+        try:
+            rw.process_ctes(inner.ctes)
+            inner.ctes = []
+            if inner.from_clause is None:
+                return Result(columns=["plan"], rows=[[Datum.string("constant select")]])
+            rw.rewrite_select(inner)
+            plan = plan_select(inner, self.catalog, mat=rw.registry.metas)
+        except (SubqueryError, PlanError) as exc:
+            raise SQLError(str(exc)) from exc
         from ..distsql import split_dag
 
         rp = split_dag(plan.dag)

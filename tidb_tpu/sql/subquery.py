@@ -1,0 +1,699 @@
+"""Subquery materialization + decorrelation — the session-side rewrite pass
+that removes every subquery construct from a SELECT before planning.
+
+The reference splits this between the expression rewriter (uncorrelated
+subqueries evaluate during plan building, pkg/planner/core/expression_rewriter.go)
+and the decorrelation rule (correlated IN/EXISTS become semi/anti
+LogicalJoins, correlated scalar aggregates become outer joins over a
+re-grouped inner — pkg/planner/core/rule_decorrelate.go). Here both shapes
+land on the same mechanism: the inner query is *materialized* into an
+in-memory table (`MatRegistry`) that the planner sees through its `mat`
+namespace, and the outer AST is rewritten to reference it:
+
+  uncorrelated scalar          -> datum literal
+  uncorrelated EXISTS          -> 0/1 literal (inner runs with LIMIT 1)
+  uncorrelated IN, small       -> InList of datum literals (exact 3VL)
+  uncorrelated IN, large       -> SemiJoinCond against the materialized rows
+  cmp ANY/ALL (uncorrelated)   -> min/max comparison with empty/NULL guards
+  correlated [NOT] IN / EXISTS -> SemiJoinCond (semi/anti join in the DAG)
+  correlated scalar (agg)      -> LEFT JOIN of the inner re-grouped by its
+                                  correlation keys + column reference
+
+CTEs (including recursive ones) materialize here too and shadow catalog
+tables by name (ref: pkg/planner/core/logical_plan_builder.go buildWith).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+
+from ..chunk import Chunk
+from ..exec.executor import datum_group_key
+from ..expr.eval_ref import compare
+from ..parser import ast as A
+from ..types import Datum
+from .catalog import Catalog, ColumnMeta, TableMeta
+
+# IN-lists up to this size inline as literals (one fused compare chain on
+# device); larger sets become semi joins against the materialized rows
+MAX_IN_LITERALS = 64
+
+
+class SubqueryError(ValueError):
+    pass
+
+
+def _dlit(d: Datum) -> A.Literal:
+    return A.Literal(d, "datum")
+
+
+TRUE_LIT = lambda: A.Literal(1, "int")  # noqa: E731
+FALSE_LIT = lambda: A.Literal(0, "int")  # noqa: E731
+NULL_LIT = lambda: A.Literal(None, "null")  # noqa: E731
+
+
+def _split_conjuncts(e):
+    if e is None:
+        return []
+    if isinstance(e, A.BinaryOp) and e.op == "and":
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+def _and_all(conjs):
+    out = None
+    for c in conjs:
+        out = c if out is None else A.BinaryOp("and", out, c)
+    return out
+
+
+class MatRegistry:
+    """Materialized result sets the planner resolves as tables. Negative
+    table ids never collide with catalog tables and are assigned in
+    registration order, so two statements with the same shape share the
+    compiled-program cache (the DAG fingerprint includes the id)."""
+
+    def __init__(self):
+        self.metas: dict[str, TableMeta] = {}
+        self.chunks: dict[str, Chunk] = {}
+        self._ids = itertools.count(1)
+
+    def register(self, names, fts, rows, name: str | None = None) -> str:
+        if name is None:
+            name = f"#sub{next(self._ids)}"
+        name = name.lower()
+        used: set = set()
+        cols = []
+        for i, (n, ft) in enumerate(zip(names, fts)):
+            base = (n or f"c{i}").lower()
+            nm, k = base, 2
+            while nm in used:
+                nm, k = f"{base}_{k}", k + 1
+            used.add(nm)
+            cols.append(ColumnMeta(nm, i + 1, ft))
+        meta = TableMeta(name, -next(self._ids), cols, [], None)
+        meta.row_count = len(rows)
+        self.metas[name] = meta
+        self.chunks[name] = Chunk.from_rows(list(fts), rows)
+        return name
+
+    def update_rows(self, name: str, rows) -> None:
+        """Replace a registered table's rows (recursive-CTE iteration)."""
+        meta = self.metas[name]
+        meta.row_count = len(rows)
+        self.chunks[name] = Chunk.from_rows([c.ft for c in meta.columns], rows)
+
+
+class SubqueryRewriter:
+    """One statement's rewrite pass. `exec_query` runs a nested
+    SelectStmt/SetOprStmt to (names, fts, rows) — the session wires it to
+    its own executor with this rewriter as the parent so nested queries see
+    the same CTE namespace."""
+
+    def __init__(self, catalog: Catalog, registry: MatRegistry | None = None, max_recursion: int = 1000):
+        self.catalog = catalog
+        self.registry = registry or MatRegistry()
+        self.max_recursion = max_recursion
+        self.exec_query = None  # set by the session after construction
+
+    # ------------------------------------------------------------- schema
+    def _table_cols(self, name: str) -> list | None:
+        m = self.registry.metas.get(name.lower())
+        if m is None:
+            try:
+                m = self.catalog.table(name)
+            except Exception:
+                return None
+        return [c.name for c in m.columns]
+
+    def _from_schema(self, node) -> list:
+        """FROM tree -> [(alias, [colnames])]; None for unknown tables (the
+        planner reports those with a proper error later)."""
+        if node is None:
+            return []
+        if isinstance(node, A.TableName):
+            cols = self._table_cols(node.name) or []
+            return [((node.alias or node.name).lower(), cols)]
+        if isinstance(node, A.SubqueryTable):
+            sel = node.subquery
+            labels = []
+            fields = sel.selects[0].fields if isinstance(sel, A.SetOprStmt) else sel.fields
+            for f in fields:
+                e = f.expr if isinstance(f, A.SelectField) else f
+                if isinstance(e, A.Star):
+                    # star inside a not-yet-materialized derived table:
+                    # conservatively unknown — resolved after materialization
+                    continue
+                if isinstance(f, A.SelectField) and f.alias:
+                    labels.append(f.alias.lower())
+                elif isinstance(e, A.ColumnName):
+                    labels.append(e.name.lower())
+            return [(node.alias.lower(), labels)]
+        if isinstance(node, A.Join):
+            return self._from_schema(node.left) + self._from_schema(node.right)
+        return []
+
+    @staticmethod
+    def _resolves(c: A.ColumnName, schema: list) -> bool:
+        if c.table:
+            t = c.table.lower()
+            return any(alias == t for alias, _ in schema)
+        return any(c.name.lower() in cols for _, cols in schema)
+
+    def _refs_outer(self, node, inner_schema: list, outer_scopes: list) -> bool:
+        """Does any column under `node` resolve only in an enclosing scope?
+        Nested subqueries extend the scope stack with their own FROM."""
+        found = [False]
+
+        def walk(n, schemas):
+            if found[0] or not hasattr(n, "__dataclass_fields__"):
+                return
+            if isinstance(n, A.ColumnName):
+                if not self._resolves(n, schemas[-1]) and any(self._resolves(n, s) for s in schemas[:-1]):
+                    found[0] = True
+                return
+            sub = getattr(n, "subquery", None)
+            if sub is not None and not isinstance(n, A.SubqueryTable):
+                inner_sel = sub.selects[0] if isinstance(sub, A.SetOprStmt) else sub
+                walk_stmt(inner_sel, schemas + [self._from_schema(inner_sel.from_clause)])
+                return
+            for f_ in n.__dataclass_fields__:
+                v = getattr(n, f_)
+                for it in v if isinstance(v, (list, tuple)) else [v]:
+                    if isinstance(it, tuple):
+                        for x in it:
+                            walk(x, schemas)
+                    elif hasattr(it, "__dataclass_fields__"):
+                        walk(it, schemas)
+
+        def walk_stmt(sel, schemas):
+            for f in sel.fields:
+                walk(f, schemas)
+            for part in (sel.where, sel.having):
+                if part is not None:
+                    walk(part, schemas)
+            for b in list(sel.group_by) + list(sel.order_by):
+                walk(b.expr, schemas)
+
+        schemas = outer_scopes + [inner_schema]
+        if isinstance(node, A.SelectStmt):
+            walk_stmt(node, schemas)
+            # join ON conditions can carry correlation too
+            def walk_from(fr):
+                if isinstance(fr, A.Join):
+                    walk_from(fr.left)
+                    walk_from(fr.right)
+                    if fr.on is not None:
+                        walk(fr.on, schemas)
+            walk_from(node.from_clause)
+        else:
+            walk(node, schemas)
+        return found[0]
+
+    # ------------------------------------------------------- entry points
+    def process_ctes(self, ctes: list) -> None:
+        for cte in ctes:
+            if cte.recursive and isinstance(cte.subquery, A.SetOprStmt):
+                self._recursive_cte(cte)
+                continue
+            names, fts, rows = self.exec_query(cte.subquery)
+            if cte.columns:
+                names = list(cte.columns) + list(names[len(cte.columns):])
+            self.registry.register(names, fts, rows, name=cte.name)
+
+    def _recursive_cte(self, cte: A.CTE) -> None:
+        """Delta-based recursive CTE evaluation (ref: pkg/executor/cte.go —
+        seed part, then the recursive part iterates over the previous
+        iteration's rows until a fixpoint or the depth cap)."""
+        sets = cte.subquery
+
+        def refs_cte(sel) -> bool:
+            def in_from(fr):
+                if isinstance(fr, A.TableName):
+                    return fr.name.lower() == cte.name.lower()
+                if isinstance(fr, A.Join):
+                    return in_from(fr.left) or in_from(fr.right)
+                if isinstance(fr, A.SubqueryTable):
+                    inner = fr.subquery
+                    sels = inner.selects if isinstance(inner, A.SetOprStmt) else [inner]
+                    return any(refs_cte(s) for s in sels)
+                return False
+
+            return in_from(sel.from_clause)
+
+        seeds = [s for s in sets.selects if not refs_cte(s)]
+        recs = [s for s in sets.selects if refs_cte(s)]
+        if not seeds or not recs:
+            raise SubqueryError(f"recursive CTE {cte.name!r} needs seed and recursive parts")
+        distinct = not all(sets.all_flags)
+
+        names = fts = None
+        total: list = []
+        seen: set = set()
+        for s in seeds:
+            n_, f_, r_ = self.exec_query(s)
+            if names is None:
+                names, fts = n_, f_
+            total.extend(r_)
+        if distinct:
+            dedup = []
+            for r in total:
+                k = tuple(datum_group_key(d) for d in r)
+                if k not in seen:
+                    seen.add(k)
+                    dedup.append(r)
+            total = dedup
+        if cte.columns:
+            names = list(cte.columns) + list(names[len(cte.columns):])
+        self.registry.register(names, fts, total, name=cte.name)
+        delta = total
+        for _ in range(self.max_recursion + 1):
+            if not delta:
+                break
+            # the recursive part reads the previous iteration's delta
+            self.registry.update_rows(cte.name, delta)
+            new: list = []
+            for s in recs:
+                _, _, r_ = self.exec_query(copy.deepcopy(s))
+                new.extend(r_)
+            if distinct:
+                fresh = []
+                for r in new:
+                    k = tuple(datum_group_key(d) for d in r)
+                    if k not in seen:
+                        seen.add(k)
+                        fresh.append(r)
+                new = fresh
+            total = total + new
+            delta = new
+        else:
+            raise SubqueryError(
+                f"recursive CTE {cte.name!r} exceeded cte_max_recursion_depth={self.max_recursion}"
+            )
+        self.registry.update_rows(cte.name, total)
+
+    def rewrite_select(self, stmt: A.SelectStmt) -> None:
+        """In-place: after this returns, `stmt` contains no subquery nodes
+        (SemiJoinCond markers and materialized table references instead)."""
+        stmt.from_clause = self._rewrite_from(stmt.from_clause)
+        schema = self._from_schema(stmt.from_clause)
+        # WHERE conjuncts get the full treatment (semi/anti markers allowed)
+        conjs = [self._rewrite_conjunct(c, schema, stmt) for c in _split_conjuncts(stmt.where)]
+        conjs = [c for c in conjs if c is not None]
+        stmt.where = _and_all(conjs)
+        # everywhere else only value-producing rewrites are legal
+        for f in stmt.fields:
+            if isinstance(f, A.SelectField):
+                f.expr = self._rewrite_expr(f.expr, schema, stmt)
+        if stmt.having is not None:
+            stmt.having = self._rewrite_expr(stmt.having, schema, stmt)
+        for b in list(stmt.group_by) + list(stmt.order_by):
+            b.expr = self._rewrite_expr(b.expr, schema, stmt)
+
+    # ------------------------------------------------------------- pieces
+    def _rewrite_from(self, node):
+        if node is None or isinstance(node, A.TableName):
+            return node
+        if isinstance(node, A.SubqueryTable):
+            names, fts, rows = self.exec_query(node.subquery)
+            name = self.registry.register(names, fts, rows)
+            return A.TableName(name, alias=node.alias)
+        if isinstance(node, A.Join):
+            node.left = self._rewrite_from(node.left)
+            node.right = self._rewrite_from(node.right)
+            return node
+        return node
+
+    def _is_correlated(self, sub, schema) -> bool:
+        sel = sub.selects[0] if isinstance(sub, A.SetOprStmt) else sub
+        inner_schema = self._from_schema(sel.from_clause)
+        return self._refs_outer(sel, inner_schema, [schema])
+
+    def _rewrite_conjunct(self, c, schema, stmt):
+        """Top-level WHERE conjunct: IN/EXISTS may become join markers.
+        Returns None to drop the conjunct (proven always-true)."""
+        neg = False
+        node = c
+        while isinstance(node, A.UnaryOp) and node.op == "not" and isinstance(
+            node.operand, (A.Exists, A.InSubquery)
+        ):
+            neg = not neg
+            node = node.operand
+        if isinstance(node, A.Exists):
+            negated = node.negated ^ neg
+            if not self._is_correlated(node.subquery, schema):
+                return self._uncorrelated_exists(node.subquery, negated)
+            return self._correlated_semi(node.subquery, schema, None, negated)
+        if isinstance(node, A.InSubquery):
+            negated = node.negated ^ neg
+            if not self._is_correlated(node.subquery, schema):
+                return self._uncorrelated_in(node, schema, stmt, negated)
+            x = self._rewrite_expr(node.expr, schema, stmt)
+            return self._correlated_semi(node.subquery, schema, x, negated)
+        return self._rewrite_expr(c, schema, stmt)
+
+    def _rewrite_expr(self, n, schema, stmt):
+        """Generic walk replacing value-position subqueries."""
+        if not hasattr(n, "__dataclass_fields__"):
+            return n
+        if isinstance(n, A.SubqueryExpr):
+            return self._scalar(n.subquery, schema, stmt)
+        if isinstance(n, A.Exists):
+            if self._is_correlated(n.subquery, schema):
+                raise SubqueryError(
+                    "correlated EXISTS is only supported as a top-level WHERE conjunct"
+                )
+            return self._uncorrelated_exists(n.subquery, n.negated)
+        if isinstance(n, A.InSubquery):
+            if self._is_correlated(n.subquery, schema):
+                raise SubqueryError(
+                    "correlated IN is only supported as a top-level WHERE conjunct"
+                )
+            return self._uncorrelated_in(n, schema, stmt, n.negated, conjunct=False)
+        if isinstance(n, A.CompareSubquery):
+            return self._compare_subquery(n, schema, stmt)
+        for f_ in n.__dataclass_fields__:
+            v = getattr(n, f_)
+            if isinstance(v, list):
+                for i, it in enumerate(v):
+                    if isinstance(it, tuple):
+                        v[i] = tuple(
+                            self._rewrite_expr(x, schema, stmt) if isinstance(x, A.ExprNode) else x
+                            for x in it
+                        )
+                    elif isinstance(it, A.ExprNode):
+                        v[i] = self._rewrite_expr(it, schema, stmt)
+            elif isinstance(v, A.ExprNode):
+                setattr(n, f_, self._rewrite_expr(v, schema, stmt))
+        return n
+
+    # -------------------------------------------------- uncorrelated forms
+    def _exec_values(self, sub):
+        """Run an uncorrelated subquery; returns (fts, rows)."""
+        names, fts, rows = self.exec_query(sub)
+        return fts, rows
+
+    def _uncorrelated_exists(self, sub, negated):
+        limited = copy.deepcopy(sub)
+        tgt = limited.selects[0] if isinstance(limited, A.SetOprStmt) else limited
+        if tgt.limit is None and not isinstance(limited, A.SetOprStmt):
+            tgt.limit = A.Limit(A.Literal(1, "int"))
+        _, rows = self._exec_values(limited)
+        exists = bool(rows)
+        return TRUE_LIT() if exists ^ negated else FALSE_LIT()
+
+    def _uncorrelated_in(self, node, schema, stmt, negated, conjunct=True):
+        sub = node.subquery
+        fields = (sub.selects[0] if isinstance(sub, A.SetOprStmt) else sub).fields
+        if len(fields) != 1 or isinstance(fields[0].expr if isinstance(fields[0], A.SelectField) else fields[0], A.Star):
+            raise SubqueryError("IN subquery must select exactly one column")
+        fts, rows = self._exec_values(sub)
+        x = self._rewrite_expr(node.expr, schema, stmt)
+        values = [r[0] for r in rows]
+        # dedup (IN is a set membership test)
+        seen: set = set()
+        uniq = []
+        for d in values:
+            k = datum_group_key(d)
+            if k not in seen:
+                seen.add(k)
+                uniq.append(d)
+        if len(uniq) <= MAX_IN_LITERALS:
+            if not uniq:
+                # x IN () is never TRUE; x NOT IN () is always TRUE
+                return None if (negated and conjunct) else (TRUE_LIT() if negated else FALSE_LIT())
+            return A.InList(x, [_dlit(d) for d in uniq], negated=negated)
+        if not conjunct:
+            raise SubqueryError(
+                f"IN subquery with >{MAX_IN_LITERALS} values is only supported as a WHERE conjunct"
+            )
+        has_null = any(d.is_null() for d in uniq)
+        if negated and has_null:
+            # x NOT IN (S ∪ {NULL}) is never TRUE (three-valued logic)
+            return FALSE_LIT()
+        nonnull = [d for d in uniq if not d.is_null()]
+        name = self.registry.register(["v"], [fts[0]], [[d] for d in nonnull])
+        marker = A.SemiJoinCond(name, [x], ["v"], anti=negated)
+        if negated:
+            # NULL probe against non-empty S is NULL -> row filtered; the
+            # anti join alone would keep it
+            return A.BinaryOp("and", marker, A.IsNull(copy.deepcopy(x), negated=True))
+        return marker
+
+    def _compare_subquery(self, n: A.CompareSubquery, schema, stmt):
+        """cmp ANY/ALL folding over the materialized value set
+        (ref: expression_rewriter.go handleCompareSubquery min/max rewrite)."""
+        if self._is_correlated(n.subquery, schema):
+            raise SubqueryError("correlated ANY/ALL subqueries not supported")
+        fts, rows = self._exec_values(n.subquery)
+        x = self._rewrite_expr(n.expr, schema, stmt)
+        values = [r[0] for r in rows]
+        has_null = any(d.is_null() for d in values)
+        nonnull = [d for d in values if not d.is_null()]
+        if n.op == "eq" and not n.all:  # = ANY == IN
+            return self._fold_in(x, values, negated=False)
+        if n.op == "ne" and n.all:  # <> ALL == NOT IN
+            return self._fold_in(x, values, negated=True)
+        if not values:
+            return TRUE_LIT() if n.all else FALSE_LIT()
+        if not nonnull:
+            return NULL_LIT()
+        mn = min(nonnull, key=lambda d: _cmp_key(d, nonnull[0]))
+        mx = max(nonnull, key=lambda d: _cmp_key(d, nonnull[0]))
+        if n.op in ("lt", "le", "gt", "ge"):
+            bound = {
+                ("lt", True): mn, ("le", True): mn, ("gt", True): mx, ("ge", True): mx,
+                ("lt", False): mx, ("le", False): mx, ("gt", False): mn, ("ge", False): mn,
+            }[(n.op, n.all)]
+            cond = A.BinaryOp(n.op, x, _dlit(bound))
+            if has_null:
+                # AND NULL: TRUE->NULL, FALSE->FALSE (ALL); OR NULL:
+                # TRUE->TRUE, FALSE->NULL (ANY) — exact three-valued fold
+                cond = A.BinaryOp("and" if n.all else "or", cond, NULL_LIT())
+            return cond
+        if n.op == "eq" and n.all:
+            # x = ALL(S): all values equal x
+            cond = A.BinaryOp("and", A.BinaryOp("eq", x, _dlit(mn)), A.BinaryOp("eq", copy.deepcopy(x), _dlit(mx)))
+            if has_null:
+                cond = A.BinaryOp("and", cond, NULL_LIT())
+            return cond
+        if n.op == "ne" and not n.all:
+            # x <> ANY(S): some value differs from x
+            cond = A.BinaryOp("or", A.BinaryOp("ne", x, _dlit(mn)), A.BinaryOp("ne", copy.deepcopy(x), _dlit(mx)))
+            if has_null:
+                cond = A.BinaryOp("or", cond, NULL_LIT())
+            return cond
+        raise SubqueryError(f"comparison {n.op!r} ANY/ALL not supported")
+
+    def _fold_in(self, x, values, negated):
+        seen: set = set()
+        uniq = []
+        for d in values:
+            k = datum_group_key(d)
+            if k not in seen:
+                seen.add(k)
+                uniq.append(d)
+        if not uniq:
+            return TRUE_LIT() if negated else FALSE_LIT()
+        if len(uniq) > MAX_IN_LITERALS:
+            raise SubqueryError("ANY/ALL over large value sets not supported in value position")
+        return A.InList(x, [_dlit(d) for d in uniq], negated=negated)
+
+    # --------------------------------------------------- correlated forms
+    def _extract_corr(self, sub: A.SelectStmt, schema):
+        """Split the inner WHERE into local conjuncts and correlation pairs
+        (inner_expr, outer_expr). Raises unless every correlated conjunct
+        is an equality with one pure-inner and one pure-outer side."""
+        if isinstance(sub, A.SetOprStmt):
+            raise SubqueryError("correlated UNION subqueries not supported")
+        if sub.limit is not None or sub.order_by:
+            raise SubqueryError("correlated subqueries with ORDER BY/LIMIT not supported")
+        if sub.having is not None:
+            raise SubqueryError("correlated subqueries with HAVING not supported")
+        inner_schema = self._from_schema(sub.from_clause)
+        local, pairs = [], []
+        for c in _split_conjuncts(sub.where):
+            if not self._refs_outer(c, inner_schema, [schema]):
+                local.append(c)
+                continue
+            if not (isinstance(c, A.BinaryOp) and c.op == "eq"):
+                raise SubqueryError(
+                    "correlated subqueries support equality correlation only "
+                    f"(got {type(c).__name__})"
+                )
+
+            def side_kind(e):
+                refs_i = [False]
+                refs_o = [False]
+
+                def walk(x):
+                    if isinstance(x, A.ColumnName):
+                        if self._resolves(x, inner_schema):
+                            refs_i[0] = True
+                        elif self._resolves(x, schema):
+                            refs_o[0] = True
+                        return
+                    if hasattr(x, "__dataclass_fields__"):
+                        for f_ in x.__dataclass_fields__:
+                            v = getattr(x, f_)
+                            for it in v if isinstance(v, (list, tuple)) else [v]:
+                                if hasattr(it, "__dataclass_fields__"):
+                                    walk(it)
+
+                walk(e)
+                if refs_i[0] and refs_o[0]:
+                    return "mixed"
+                return "outer" if refs_o[0] else "inner"
+
+            lk, rk = side_kind(c.left), side_kind(c.right)
+            if lk == "inner" and rk == "outer":
+                pairs.append((c.left, c.right))
+            elif lk == "outer" and rk == "inner":
+                pairs.append((c.right, c.left))
+            else:
+                raise SubqueryError(
+                    "correlated equality must have one inner-only and one outer-only side"
+                )
+        if not pairs:
+            raise SubqueryError("correlated subquery has no usable equality correlation")
+        return local, pairs
+
+    def _correlated_semi(self, sub, schema, in_expr, negated):
+        """Correlated [NOT] IN / [NOT] EXISTS conjunct -> SemiJoinCond."""
+        if isinstance(sub, A.SetOprStmt):
+            raise SubqueryError("correlated UNION subqueries not supported")
+        if sub.group_by or any(_has_agg_field(f) for f in sub.fields):
+            raise SubqueryError("correlated IN/EXISTS with aggregation not supported")
+        local, pairs = self._extract_corr(sub, schema)
+        fields = []
+        if in_expr is not None:
+            inner_fields = sub.fields
+            if len(inner_fields) != 1:
+                raise SubqueryError("IN subquery must select exactly one column")
+            ve = inner_fields[0].expr if isinstance(inner_fields[0], A.SelectField) else inner_fields[0]
+            if isinstance(ve, A.Star):
+                raise SubqueryError("IN subquery must select exactly one column")
+            fields.append(A.SelectField(ve, "v"))
+        for i, (ie, _) in enumerate(pairs):
+            fields.append(A.SelectField(ie, f"k{i}"))
+        mat_sel = A.SelectStmt(fields=fields, from_clause=sub.from_clause, where=_and_all(local))
+        names, fts, rows = self.exec_query(mat_sel)
+        probe = ([in_expr] if in_expr is not None else []) + [oe for _, oe in pairs]
+        build = list(names)
+        if in_expr is not None and negated:
+            # rows whose value is NULL poison their whole correlation group
+            # (x NOT IN {... NULL} is never TRUE): a second anti join on the
+            # correlation keys alone removes probes of poisoned groups
+            null_rows = [r[1:] for r in rows if r[0].is_null()]
+            rows = [r for r in rows if not r[0].is_null()]
+            name = self.registry.register(build, fts, rows)
+            marker = A.SemiJoinCond(name, probe, build, anti=True, require_notnull_probe=True)
+            if null_rows and pairs:
+                nname = self.registry.register(build[1:], fts[1:], null_rows)
+                poison = A.SemiJoinCond(nname, [copy.deepcopy(oe) for _, oe in pairs], build[1:], anti=True)
+                return A.BinaryOp("and", marker, poison)
+            if null_rows and not pairs:
+                return FALSE_LIT()
+            return marker
+        name = self.registry.register(build, fts, rows)
+        return A.SemiJoinCond(name, probe, build, anti=negated)
+
+    def _scalar(self, sub, schema, stmt):
+        """Scalar subquery in value position."""
+        if isinstance(sub, A.SetOprStmt):
+            sel = sub.selects[0]
+        else:
+            sel = sub
+        n_fields = len(sel.fields)
+        if n_fields != 1:
+            raise SubqueryError("scalar subquery must select exactly one column")
+        if not self._is_correlated(sub, schema):
+            _, rows = self._exec_values(sub)
+            if len(rows) > 1:
+                raise SubqueryError("Subquery returns more than 1 row")
+            return _dlit(rows[0][0]) if rows else NULL_LIT()
+        if isinstance(sub, A.SetOprStmt):
+            raise SubqueryError("correlated UNION subqueries not supported")
+        return self._scalar_corr(sub, schema, stmt)
+
+    def _scalar_corr(self, sub: A.SelectStmt, schema, stmt):
+        """Correlated scalar subquery -> LEFT JOIN against the inner
+        re-grouped by its correlation keys (ref: rule_decorrelate.go's
+        aggregate pull-up producing an outer join)."""
+        if sub.group_by:
+            raise SubqueryError("correlated scalar subqueries with GROUP BY not supported")
+        local, pairs = self._extract_corr(sub, schema)
+        f0 = sub.fields[0]
+        ve = f0.expr if isinstance(f0, A.SelectField) else f0
+        if isinstance(ve, A.Star):
+            raise SubqueryError("scalar subquery must select exactly one column")
+        inner_schema = self._from_schema(sub.from_clause)
+        if self._refs_outer(ve, inner_schema, [schema]):
+            raise SubqueryError("outer references in a scalar subquery's select list not supported")
+        has_agg = _has_agg_expr(ve)
+        fields = [A.SelectField(ie, f"k{i}") for i, (ie, _) in enumerate(pairs)]
+        fields.append(A.SelectField(ve, "v"))
+        mat_sel = A.SelectStmt(fields=fields, from_clause=sub.from_clause, where=_and_all(local))
+        if has_agg:
+            mat_sel.group_by = [A.ByItem(copy.deepcopy(ie)) for ie, _ in pairs]
+        names, fts, rows = self.exec_query(mat_sel)
+        if not has_agg:
+            keys = set()
+            for r in rows:
+                k = tuple(datum_group_key(d) for d in r[:-1])
+                if k in keys:
+                    raise SubqueryError("Subquery returns more than 1 row")
+                keys.add(k)
+        name = self.registry.register(names, fts, rows)
+        alias = name.lstrip("#").replace("#", "_")
+        on = _and_all([
+            A.BinaryOp("eq", copy.deepcopy(oe), A.ColumnName(f"k{i}", alias))
+            for i, (_, oe) in enumerate(pairs)
+        ])
+        stmt.from_clause = A.Join(stmt.from_clause, A.TableName(name, alias=alias), "left", on)
+        ref = A.ColumnName("v", alias)
+        if isinstance(ve, A.AggFunc) and ve.name.lower() == "count":
+            # COUNT over an empty correlation group is 0, not NULL — the
+            # left join's null extension must be patched back
+            return A.FuncCall("ifnull", [ref, A.Literal(0, "int")])
+        return ref
+
+
+def _has_agg_expr(n) -> bool:
+    if isinstance(n, A.AggFunc):
+        return True
+    if not hasattr(n, "__dataclass_fields__"):
+        return False
+    for f_ in n.__dataclass_fields__:
+        v = getattr(n, f_)
+        for it in v if isinstance(v, (list, tuple)) else [v]:
+            if isinstance(it, tuple):
+                if any(_has_agg_expr(x) for x in it):
+                    return True
+            elif _has_agg_expr(it):
+                return True
+    return False
+
+
+def _has_agg_field(f) -> bool:
+    return _has_agg_expr(f.expr if isinstance(f, A.SelectField) else f)
+
+
+class _CmpWrap:
+    """Total-order wrapper for min/max over homogeneous datums."""
+
+    __slots__ = ("d",)
+
+    def __init__(self, d):
+        self.d = d
+
+    def __lt__(self, other):
+        return compare(self.d, other.d) < 0
+
+    def __eq__(self, other):
+        return compare(self.d, other.d) == 0
+
+
+def _cmp_key(d: Datum, ref: Datum) -> _CmpWrap:
+    return _CmpWrap(d)

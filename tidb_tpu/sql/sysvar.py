@@ -58,6 +58,8 @@ DEFINITIONS = {
         SysVar("tidb_enable_paging", "OFF", "both", _bool_validator),
         SysVar("tidb_opt_agg_push_down", "ON", "both", _bool_validator),
         SysVar("autocommit", "ON", "both", _bool_validator),
+        # ref: sysvar.go CTEMaxRecursionDepth
+        SysVar("cte_max_recursion_depth", "1000", "both", _int_validator(0, 1 << 20)),
         SysVar("sql_mode", "STRICT_TRANS_TABLES", "both"),
         SysVar("time_zone", "UTC", "both"),
     ]
