@@ -26,7 +26,7 @@ from dataclasses import dataclass, replace
 
 from ..chunk import Chunk
 from ..exec.builder import DEFAULT_GROUP_CAPACITY, ProgramCache
-from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, IndexScan, Join, Limit, Projection, Selection, TableScan, TopN, current_schema_fts
+from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, IndexScan, Join, Limit, Projection, Selection, TableScan, TopN, Window, current_schema_fts
 from ..exec.executor import run_dag_on_chunks
 from ..expr.agg import AggDesc, AggMode
 from ..expr.ir import col
@@ -105,6 +105,12 @@ def split_dag(dag: DAGRequest) -> RootPlan:
         if isinstance(ex, (TopN, Limit)):
             push.append(ex)  # per-region pre-prune
             root = list(executors[i:])  # re-apply globally, then the rest
+            break
+        if isinstance(ex, Window):
+            # window functions need the full partition: never per-region
+            # (the reference runs Window at root or over whole-data TiFlash,
+            # plan_to_pb.go:663 / exhaust_physical_plans window enforcement)
+            root = list(executors[i:])
             break
         raise TypeError(f"unknown executor {ex}")
     push_fts = current_schema_fts(push)

@@ -29,7 +29,7 @@ from ..expr.compile import CompVal, ExprCompiler, normalize_device_column
 from ..ops import apply_selection, group_aggregate, hash_join, scalar_aggregate, topn
 from ..ops.aggregate import GatherState, finalize_agg
 from ..types import FieldType
-from .dag import Aggregation, DAGRequest, IndexScan, Join, Limit, Projection, Selection, TableScan, TopN, collect_scans, current_schema_fts
+from .dag import Aggregation, DAGRequest, IndexScan, Join, Limit, Projection, Selection, TableScan, TopN, Window, collect_scans, current_schema_fts
 
 DEFAULT_GROUP_CAPACITY = 4096
 
@@ -120,6 +120,20 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
                 if ex.join_type == "left_outer":
                     bfts = [f.clone_nullable() for f in bfts]
                 fts = fts + bfts
+        elif isinstance(ex, Window):
+            from ..ops.window import window_cols
+
+            part_vals = comp.run(list(ex.partition_by), cols) if ex.partition_by else []
+            order_vals = comp.run([e for e, _ in ex.order_by], cols) if ex.order_by else []
+            order_pairs = list(zip(order_vals, [d for _, d in ex.order_by]))
+            funcs = []
+            for w in ex.funcs:
+                argv = comp.run(list(w.args), cols) if w.args else []
+                if w.default is not None:
+                    argv = argv + comp.run([w.default], cols)
+                funcs.append((w, argv))
+            cols = cols + window_cols(part_vals, order_pairs, funcs, valid)
+            fts = fts + [w.ft for w in ex.funcs]
         elif isinstance(ex, Aggregation):
             garg_exprs = []
             for a in ex.aggs:

@@ -152,6 +152,45 @@ class Join:
 
 
 @dataclass(frozen=True)
+class WinDesc:
+    """One window function (ref: tipb.WindowFunc within tipb.Window;
+    semantics pkg/executor/aggfuncs/func_{rank,row_number,lead_lag,...}.go).
+
+    `offset` carries the static integer parameter: LEAD/LAG offset,
+    NTILE bucket count, NTH_VALUE position. `default` is the lowered
+    LEAD/LAG default expression (a Const) or None (NULL)."""
+
+    name: str
+    args: tuple  # tuple[Expr, ...] — value argument(s)
+    ft: FieldType
+    offset: int = 1
+    default: object = None  # Expr | None
+
+    def fingerprint(self):
+        d = self.default.fingerprint() if self.default is not None else None
+        return ("win", self.name, self.offset, d) + tuple(a.fingerprint() for a in self.args)
+
+
+@dataclass(frozen=True)
+class Window:
+    """(ref: tipb.Window; pkg/executor/window.go WindowExec). Output schema:
+    input columns ++ one result column per function — matching the
+    reference's appended window result columns (plan_to_pb.go:663)."""
+
+    partition_by: tuple  # tuple[Expr, ...]
+    order_by: tuple  # tuple[(Expr, desc: bool), ...]
+    funcs: tuple  # tuple[WinDesc, ...]
+
+    def fingerprint(self):
+        return (
+            ("window",)
+            + tuple(e.fingerprint() for e in self.partition_by)
+            + ("ord",) + tuple((e.fingerprint(), d) for e, d in self.order_by)
+            + ("fn",) + tuple(f.fingerprint() for f in self.funcs)
+        )
+
+
+@dataclass(frozen=True)
 class TopN:
     """(ref: tipb.TopN; mpp_exec.go:526 topNExec)."""
 
@@ -209,6 +248,8 @@ def current_schema_fts(executors) -> list[FieldType]:
             fts = [e.ft for e in ex.exprs]
         elif isinstance(ex, Aggregation):
             fts = ex.output_fts()
+        elif isinstance(ex, Window):
+            fts = fts + [f.ft for f in ex.funcs]
         elif isinstance(ex, Join):
             if ex.join_type in ("semi", "anti"):
                 pass  # probe schema unchanged

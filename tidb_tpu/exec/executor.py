@@ -18,7 +18,7 @@ from ..expr.agg import AggDesc
 from ..expr.eval_ref import RefEvaluator, compare, _truth
 from ..types import Datum, DatumKind, FieldType, MyDecimal, MyTime
 from .builder import DEFAULT_GROUP_CAPACITY, CompiledDAG, ProgramCache, build_program
-from .dag import Aggregation, DAGRequest, Join, Limit, Projection, Selection, TableScan, TopN, current_schema_fts
+from .dag import Aggregation, DAGRequest, Join, Limit, Projection, Selection, TableScan, TopN, Window, current_schema_fts
 
 
 def _pow2(n: int) -> int:
@@ -410,6 +410,8 @@ def _ref_pipeline(executors, chunks, cursor, ev) -> list[list[Datum]]:
                 return 0
 
             rows = sorted(rows, key=functools.cmp_to_key(cmp_rows))[: ex.limit]
+        elif isinstance(ex, Window):
+            rows = _ref_window(ex, rows, ev)
         elif isinstance(ex, Join):
             rows = _ref_join(ex, rows, chunks, cursor, ev)
         elif isinstance(ex, Aggregation):
@@ -444,6 +446,133 @@ def _ref_pipeline(executors, chunks, cursor, ev) -> list[list[Datum]]:
         else:
             raise TypeError(f"unsupported executor {ex}")
     return rows
+
+
+def _ref_window(ex, rows, ev) -> list[list[Datum]]:
+    """Window oracle: partition dict -> stable sort by order keys -> per-row
+    frame evaluation with MySQL default frames (RANGE UNBOUNDED
+    PRECEDING..CURRENT ROW including peers with ORDER BY; whole partition
+    without). Semantics ref: pkg/executor/aggfuncs/func_*.go per function."""
+    import functools
+
+    from ..types import MyDecimal
+
+    def okey_cmp(r1, r2):
+        for e, desc in ex.order_by:
+            a, b = ev.eval(e, r1), ev.eval(e, r2)
+            if a.is_null() and b.is_null():
+                continue
+            c = -1 if a.is_null() else (1 if b.is_null() else compare(a, b))
+            if c:
+                return -c if desc else c
+        return 0
+
+    parts: dict = {}
+    order: list = []
+    for i, r in enumerate(rows):
+        key = tuple(datum_group_key(ev.eval(g, r), g.ft) for g in ex.partition_by)
+        if key not in parts:
+            parts[key] = []
+            order.append(key)
+        parts[key].append(i)
+
+    results: dict = {i: [] for i in range(len(rows))}
+    for key in order:
+        idxs = parts[key]
+        idxs.sort(key=functools.cmp_to_key(lambda a, b: okey_cmp(rows[a], rows[b]) or (a - b)))
+        n = len(idxs)
+        # peer groups (equal order keys)
+        peer_id = [0] * n
+        for j in range(1, n):
+            peer_id[j] = peer_id[j - 1] + (1 if okey_cmp(rows[idxs[j - 1]], rows[idxs[j]]) else 0)
+        peer_end = [0] * n
+        end = n - 1
+        for j in range(n - 1, -1, -1):
+            if j < n - 1 and peer_id[j] != peer_id[j + 1]:
+                end = j
+            peer_end[j] = end
+        has_order = bool(ex.order_by)
+        for w in ex.funcs:
+            for j, ri in enumerate(idxs):
+                frame_hi = (peer_end[j] if has_order else n - 1)
+                results[ri].append(_ref_window_value(w, ex, rows, idxs, j, n, frame_hi, peer_id, ev))
+    return [r + results[i] for i, r in enumerate(rows)]
+
+
+def _ref_window_value(w, ex, rows, idxs, j, n, frame_hi, peer_id, ev) -> Datum:
+    from ..types import MyDecimal
+
+    name = w.name
+
+    def argval(ri, k=0):
+        return ev.eval(w.args[k], rows[ri])
+
+    if name == "row_number":
+        return Datum.i64(j + 1)
+    if name == "rank":
+        first = next(k for k in range(n) if peer_id[k] == peer_id[j])
+        return Datum.i64(first + 1)
+    if name == "dense_rank":
+        return Datum.i64(peer_id[j] + 1)
+    if name == "percent_rank":
+        if n <= 1:
+            return Datum.f64(0.0)
+        first = next(k for k in range(n) if peer_id[k] == peer_id[j])
+        return Datum.f64(first / (n - 1))
+    if name == "cume_dist":
+        return Datum.f64((frame_hi + 1) / n) if ex.order_by else Datum.f64(1.0)
+    if name == "ntile":
+        k = w.offset
+        base, rem = n // k, n % k
+        cut = rem * (base + 1)
+        if j < cut:
+            return Datum.i64(j // (base + 1) + 1)
+        return Datum.i64(rem + (j - cut) // max(base, 1) + 1)
+    if name in ("lead", "lag"):
+        off = w.offset if name == "lead" else -w.offset
+        t = j + off
+        if 0 <= t < n:
+            return argval(idxs[t])
+        if w.default is not None:
+            return ev.eval(w.default, rows[idxs[j]])
+        return Datum.NULL
+    if name == "first_value":
+        return argval(idxs[0])
+    if name == "last_value":
+        return argval(idxs[frame_hi])
+    if name == "nth_value":
+        t = w.offset - 1
+        if t <= frame_hi:
+            return argval(idxs[t])
+        return Datum.NULL
+    # frame aggregates over rows[0..frame_hi]
+    if name == "count" and not w.args:
+        return Datum.i64(frame_hi + 1)
+    vals = [argval(idxs[k]) for k in range(frame_hi + 1)]
+    live = [d for d in vals if not d.is_null()]
+    if name == "count":
+        return Datum.i64(len(live))
+    if not live:
+        return Datum.NULL
+    if name in ("min", "max"):
+        best = live[0]
+        for d in live[1:]:
+            c = compare(d, best)
+            if (name == "max" and c > 0) or (name == "min" and c < 0):
+                best = d
+        return best
+    # sum / avg with MySQL numeric promotion
+    et = w.ft.eval_type()
+    if et == "real":
+        s = sum(float(d.val.to_float() if isinstance(d.val, MyDecimal) else d.val) for d in live)
+        return Datum.f64(s if name == "sum" else s / len(live))
+    acc = None
+    for d in live:
+        dv = d.val if isinstance(d.val, MyDecimal) else MyDecimal(str(d.val))
+        acc = dv if acc is None else acc + dv
+    if name == "sum":
+        return Datum.dec(acc)
+    return Datum.dec(acc.div(MyDecimal(str(len(live)))))
 
 
 def _ref_join(ex: Join, probe_rows, chunks, cursor, ev) -> list[list[Datum]]:

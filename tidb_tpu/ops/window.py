@@ -29,7 +29,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..expr.compile import CompVal
+from ..expr.compile import CompVal, I64_MIN
 from .aggregate import _round_div
 from .keys import lexsort, sort_key_arrays
 
@@ -78,8 +78,12 @@ def window_cols(part_vals: list, order_pairs: list, funcs: list, valid) -> list[
     n = valid.shape[0]
     arange = jnp.arange(n)
     keys = [jnp.where(valid, jnp.int64(0), jnp.int64(1))]
+    n_pkey_arrays = 1  # the validity key counts as a partition key: padding
+    # rows (sorted last) must never merge into the final valid partition
+    # even when their zeroed key lanes equal its keys
     for v in part_vals:
         keys.extend(sort_key_arrays(v))
+    n_pkey_arrays = len(keys)
     for v, desc in order_pairs:
         keys.extend(sort_key_arrays(v, desc=desc))
     perm = lexsort(keys, extra_key=arange)
@@ -91,11 +95,8 @@ def window_cols(part_vals: list, order_pairs: list, funcs: list, valid) -> list[
             d = d | jnp.concatenate([jnp.ones(1, bool), ks[1:] != ks[:-1]])
         return d
 
-    # validity is a leading partition key: padding rows (sorted last) must
-    # never merge into the final valid partition even when their zeroed key
-    # lanes equal its keys
-    pkeys = [keys[0]] + [k for v in part_vals for k in sort_key_arrays(v)]
-    okeys = [k for v, desc in order_pairs for k in sort_key_arrays(v, desc=desc)]
+    pkeys = keys[:n_pkey_arrays]
+    okeys = keys[n_pkey_arrays:]
     new_part = diff_of(pkeys)
     new_peer = new_part | (diff_of(okeys) if okeys else jnp.zeros(n, bool))
     has_order = bool(order_pairs)
@@ -193,18 +194,26 @@ def window_cols(part_vals: list, order_pairs: list, funcs: list, valid) -> list[
                 raise NotImplementedError("string MIN/MAX windows run on the oracle")
             av, anull = a.value[perm], a.null[perm]
             live = sv & ~anull
+            unsigned = a.eval_type == "int" and a.ft.is_unsigned()
             if a.eval_type == "real":
                 ident = jnp.float64(-jnp.inf if name == "max" else jnp.inf)
                 x = jnp.where(live, av.astype(jnp.float64), ident)
             else:
-                ident = jnp.int64(-(1 << 62) if name == "max" else (1 << 62))
-                x = jnp.where(live, av.astype(jnp.int64), ident)
+                # full-range identities: extremes the scan cannot beat, and a
+                # value EQUAL to the identity is itself the correct answer.
+                # Unsigned values flip the sign bit (order-preserving u64 ->
+                # s64 bijection), flipped back after the scan.
+                xi = av.astype(jnp.int64)
+                if unsigned:
+                    xi = xi ^ I64_MIN
+                ii = jnp.iinfo(jnp.int64)
+                ident = jnp.int64(ii.min if name == "max" else ii.max)
+                x = jnp.where(live, xi, ident)
             run = _seg_scan_extreme(x, new_part, name == "max")
             rcnt = jnp.take(_seg_running_sum(live.astype(jnp.int64), start, arange), frame_end)
             v = jnp.take(run, frame_end)
-            if a.eval_type == "int" and a.ft.is_unsigned():
-                pass  # unsigned order == signed order for values < 2^62 keys;
-                # full-range unsigned handled by the oracle fallback upstream
+            if unsigned:
+                v = v ^ I64_MIN
             out.append(scatter(v, ~sv | (rcnt == 0), desc.ft))
         elif name == "first_value":
             out.append(gather_result(argvals[0], start, ~sv))

@@ -72,6 +72,17 @@ class AggFunc(ExprNode):
 
 
 @dataclass
+class WindowFunc(ExprNode):
+    """fn(args) OVER (PARTITION BY ... ORDER BY ...) (ref: ast.WindowFuncExpr;
+    frames are not represented — the parser rejects ROWS/RANGE clauses)."""
+
+    name: str
+    args: list  # [ExprNode]
+    partition_by: list = field(default_factory=list)  # [ExprNode]
+    order_by: list = field(default_factory=list)  # [ByItem]
+
+
+@dataclass
 class IsNull(ExprNode):
     expr: ExprNode
     negated: bool = False

@@ -842,8 +842,13 @@ class Parser:
                 if self.eat_kw("SEPARATOR"):
                     gc_sep = self.next().text
             self.expect_op(")")
+            if self.at_kw("OVER"):
+                self.next()
+                if distinct:
+                    raise ParseError(f"DISTINCT is not allowed in window function {lname!r}")
+                part, order = self.window_spec()
+                return A.WindowFunc(lname, args, part, order)
             if lname in _AGG_FUNCS:
-                # OVER (...) would make it a window func — not yet planned
                 return A.AggFunc(lname, args, distinct, gc_order, gc_sep)
             return A.FuncCall(lname, args)
         # qualified column
@@ -856,6 +861,25 @@ class Parser:
 
     def func_arg(self):
         return self.expr()
+
+    def window_spec(self):
+        """OVER ( [PARTITION BY exprs] [ORDER BY items] ) — explicit
+        ROWS/RANGE frames are rejected (default frames only)."""
+        self.expect_op("(")
+        part: list = []
+        order: list = []
+        if self.eat_kw("PARTITION"):
+            self.expect_kw("BY")
+            part.append(self.expr())
+            while self.eat_op(","):
+                part.append(self.expr())
+        if self.eat_kw("ORDER"):
+            self.expect_kw("BY")
+            order = self.by_list()
+        if self.at_kw("ROWS", "RANGE", "GROUPS"):
+            raise ParseError("explicit window frames (ROWS/RANGE) not supported yet")
+        self.expect_op(")")
+        return part, order
 
     # ---- type spec ----
     def type_spec(self) -> A.TypeSpec:

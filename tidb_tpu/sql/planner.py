@@ -204,6 +204,9 @@ class _Lowerer:
         self.agg_asts: list = []  # matching AST nodes
         self.n_agg_cols = 0
         self.in_agg_ctx = False
+        # window slots: id(A.WindowFunc node) -> ColumnRef into the Window
+        # executor's appended output columns (installed by plan_select)
+        self.window_slots: dict = {}
 
     def _expand_alias(self, name: str) -> Expr:
         """Lower an alias's defining expression with the alias itself masked
@@ -280,6 +283,14 @@ class _Lowerer:
     def _structural(self, n, rec):
         """Lower a compound node by dispatching on type with `rec` for
         children (shared between base and agg contexts)."""
+        if isinstance(n, A.WindowFunc):
+            slot = self.window_slots.get(id(n))
+            if slot is None:
+                raise PlanError(
+                    f"window function {n.name!r} is only supported in the select "
+                    "list and ORDER BY"
+                )
+            return slot
         if isinstance(n, A.BinaryOp):
             l, r = rec(n.left), rec(n.right)
             return self._binary(n.op, l, r)
@@ -616,6 +627,94 @@ def _has_agg(n) -> bool:
     return any(_has_agg(c) for c in _ast_children(n))
 
 
+def _has_window(n) -> bool:
+    if isinstance(n, A.WindowFunc):
+        return True
+    return any(_has_window(c) for c in _ast_children(n))
+
+
+_WIN_NO_ARG = frozenset({"row_number", "rank", "dense_rank", "percent_rank", "cume_dist"})
+
+
+def _plan_windows(win_nodes: list, low: "_Lowerer", executors: list) -> None:
+    """Group the collected A.WindowFunc nodes by (partition, order) spec,
+    append one Window executor per spec, and register column slots so the
+    select-list lowering sees plain ColumnRefs (ref: buildWindowFunctions
+    grouping same-spec functions into one Window operator)."""
+    from ..exec.dag import Window as WindowExec
+    from ..exec.dag import WinDesc, current_schema_fts
+    from ..ops.window import WINDOW_FUNCS
+
+    cursor = len(current_schema_fts(executors))
+    specs: dict = {}
+    order_keys: list = []
+    for n in win_nodes:
+        p_exprs = tuple(low.lower_base(e) for e in n.partition_by)
+        o_items = tuple((low.lower_base(b.expr), b.desc) for b in n.order_by)
+        key = tuple(p.fingerprint() for p in p_exprs) + ("|",) + tuple(
+            (e.fingerprint(), d) for e, d in o_items
+        )
+        if key not in specs:
+            specs[key] = (p_exprs, o_items, [])
+            order_keys.append(key)
+        specs[key][2].append(n)
+
+    for key in order_keys:
+        p_exprs, o_items, nodes = specs[key]
+        descs = []
+        for n in nodes:
+            name = n.name.lower()
+            if name not in WINDOW_FUNCS:
+                raise PlanError(f"window function {name!r} not supported")
+            args: tuple = ()
+            offset, default = 1, None
+            if name in _WIN_NO_ARG:
+                if n.args:
+                    raise PlanError(f"{name}() takes no arguments")
+            elif name == "ntile":
+                if len(n.args) != 1:
+                    raise PlanError("ntile(n) takes one argument")
+                offset = _const_int(low.lower_base(n.args[0]))
+                if offset < 1:
+                    raise PlanError("ntile argument must be >= 1")
+            elif name in ("lead", "lag"):
+                if not (1 <= len(n.args) <= 3):
+                    raise PlanError(f"{name}(expr[, offset[, default]])")
+                args = (low.lower_base(n.args[0]),)
+                if len(n.args) > 1:
+                    offset = _const_int(low.lower_base(n.args[1]))
+                if len(n.args) > 2:
+                    default = low.lower_base(n.args[2])
+            elif name == "nth_value":
+                if len(n.args) != 2:
+                    raise PlanError("nth_value(expr, n) takes two arguments")
+                args = (low.lower_base(n.args[0]),)
+                offset = _const_int(low.lower_base(n.args[1]))
+                if offset < 1:
+                    raise PlanError("nth_value position must be >= 1")
+            elif name == "count" and len(n.args) == 1 and isinstance(n.args[0], A.Star):
+                args = ()
+            else:
+                if len(n.args) != 1:
+                    raise PlanError(f"window {name}() takes one argument")
+                args = (low.lower_base(n.args[0]),)
+            descs.append(WinDesc(name, args, _win_ft(name, args), offset, default))
+            low.window_slots[id(n)] = col(cursor, descs[-1].ft)
+            cursor += 1
+        executors.append(WindowExec(p_exprs, o_items, tuple(descs)))
+
+
+def _win_ft(name: str, args: tuple) -> FieldType:
+    """Window result type (ref: aggfuncs type inference per function)."""
+    if name in ("row_number", "rank", "dense_rank", "ntile", "count"):
+        return new_longlong(notnull=True)
+    if name in ("percent_rank", "cume_dist"):
+        return new_double()
+    if name in ("sum", "avg"):
+        return AggDesc(name, args).ft
+    return args[0].ft.clone_nullable()
+
+
 def _referenced_columns(stmt: A.SelectStmt, meta: TableMeta) -> set:
     """All column names a single-table SELECT touches (star = every
     column) — the covering-index eligibility set."""
@@ -947,6 +1046,28 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
                 raise PlanError(f"ORDER/GROUP BY position {i} out of range")
             return fields[i - 1].expr
         return e
+
+    # ---- window functions (ref: logical_plan_builder buildWindowFunctions;
+    # exhaust_physical_plans window enforcement; plan_to_pb.go:663)
+    win_nodes: list = []
+
+    def collect_wins(x):
+        if isinstance(x, A.WindowFunc):
+            win_nodes.append(x)
+            return
+        for c in _ast_children(x):
+            collect_wins(c)
+
+    for f in fields:
+        collect_wins(f.expr)
+    for b in stmt.order_by:
+        collect_wins(b.expr)
+    if stmt.having is not None and _has_window(stmt.having):
+        raise PlanError("window functions are not allowed in HAVING")
+    if win_nodes:
+        if stmt.group_by or any(_has_agg(f.expr) for f in fields):
+            raise PlanError("mixing window functions with GROUP BY/aggregates not supported yet")
+        _plan_windows(win_nodes, low, executors)
 
     # ---- aggregation
     group_asts = [positional(b.expr) for b in stmt.group_by]

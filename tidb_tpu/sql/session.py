@@ -181,6 +181,7 @@ class Session:
             self.catalog,
             registry=parent_rw.registry if parent_rw is not None else None,
             max_recursion=self.sysvars.get_int("cte_max_recursion_depth"),
+            parent=parent_rw,
         )
         rw.exec_query = lambda q: self._exec_query(q, rw)
         return rw
@@ -216,7 +217,7 @@ class Session:
             raise SQLError(str(exc)) from exc
         from ..util.memory import MemTracker, QuotaExceeded
 
-        plan = plan_select(stmt, self.catalog, mat=rw.registry.metas)
+        plan = plan_select(stmt, self.catalog, mat=rw.mat_dict())
         ts = self._next_ts()
         tracker = MemTracker("query", quota=self.sysvars.get_int("tidb_mem_quota_query") or None)
         gate_on = self.sysvars.get_bool("tidb_enable_tpu_coprocessor")
@@ -677,7 +678,7 @@ class Session:
             if inner.from_clause is None:
                 return Result(columns=["plan"], rows=[[Datum.string("constant select")]])
             rw.rewrite_select(inner)
-            plan = plan_select(inner, self.catalog, mat=rw.registry.metas)
+            plan = plan_select(inner, self.catalog, mat=rw.mat_dict())
         except (SubqueryError, PlanError) as exc:
             raise SQLError(str(exc)) from exc
         from ..distsql import split_dag

@@ -53,12 +53,7 @@ FALSE_LIT = lambda: A.Literal(0, "int")  # noqa: E731
 NULL_LIT = lambda: A.Literal(None, "null")  # noqa: E731
 
 
-def _split_conjuncts(e):
-    if e is None:
-        return []
-    if isinstance(e, A.BinaryOp) and e.op == "and":
-        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
-    return [e]
+from .planner import _split_conjuncts  # shared conjunct splitting
 
 
 def _and_all(conjs):
@@ -69,20 +64,21 @@ def _and_all(conjs):
 
 
 class MatRegistry:
-    """Materialized result sets the planner resolves as tables. Negative
-    table ids never collide with catalog tables and are assigned in
-    registration order, so two statements with the same shape share the
-    compiled-program cache (the DAG fingerprint includes the id)."""
+    """Materialized result sets, keyed by generated storage names ("#m<n>",
+    never valid SQL identifiers). Negative table ids never collide with
+    catalog tables and are assigned in registration order, so two statements
+    with the same shape share the compiled-program cache (the DAG
+    fingerprint includes the id). User-visible CTE names bind per rewriter
+    scope (SubqueryRewriter.bindings), NOT here — a CTE inside a subquery
+    must not shadow tables in the outer query."""
 
     def __init__(self):
         self.metas: dict[str, TableMeta] = {}
         self.chunks: dict[str, Chunk] = {}
         self._ids = itertools.count(1)
 
-    def register(self, names, fts, rows, name: str | None = None) -> str:
-        if name is None:
-            name = f"#sub{next(self._ids)}"
-        name = name.lower()
+    def register(self, names, fts, rows) -> TableMeta:
+        storage = f"#m{next(self._ids)}"
         used: set = set()
         cols = []
         for i, (n, ft) in enumerate(zip(names, fts)):
@@ -92,34 +88,46 @@ class MatRegistry:
                 nm, k = f"{base}_{k}", k + 1
             used.add(nm)
             cols.append(ColumnMeta(nm, i + 1, ft))
-        meta = TableMeta(name, -next(self._ids), cols, [], None)
+        meta = TableMeta(storage, -next(self._ids), cols, [], None)
         meta.row_count = len(rows)
-        self.metas[name] = meta
-        self.chunks[name] = Chunk.from_rows(list(fts), rows)
-        return name
+        self.metas[storage] = meta
+        self.chunks[storage] = Chunk.from_rows(list(fts), rows)
+        return meta
 
-    def update_rows(self, name: str, rows) -> None:
+    def update_rows(self, meta: TableMeta, rows) -> None:
         """Replace a registered table's rows (recursive-CTE iteration)."""
-        meta = self.metas[name]
         meta.row_count = len(rows)
-        self.chunks[name] = Chunk.from_rows([c.ft for c in meta.columns], rows)
+        self.chunks[meta.name] = Chunk.from_rows([c.ft for c in meta.columns], rows)
 
 
 class SubqueryRewriter:
     """One statement's rewrite pass. `exec_query` runs a nested
     SelectStmt/SetOprStmt to (names, fts, rows) — the session wires it to
     its own executor with this rewriter as the parent so nested queries see
-    the same CTE namespace."""
+    enclosing CTE bindings (scoped, innermost wins) while materialized
+    storage is shared."""
 
-    def __init__(self, catalog: Catalog, registry: MatRegistry | None = None, max_recursion: int = 1000):
+    def __init__(self, catalog: Catalog, registry: MatRegistry | None = None, max_recursion: int = 1000,
+                 parent: "SubqueryRewriter | None" = None):
         self.catalog = catalog
         self.registry = registry or MatRegistry()
         self.max_recursion = max_recursion
+        self.parent = parent
+        self.bindings: dict[str, TableMeta] = {}  # CTE name -> meta (this scope)
         self.exec_query = None  # set by the session after construction
+
+    def mat_dict(self) -> dict:
+        """The planner's `mat` namespace for this scope: every storage
+        entry (referenced by generated '#m…' names) plus the CTE bindings
+        visible here (enclosing scopes first, this scope overriding)."""
+        out = dict(self.parent.mat_dict()) if self.parent is not None else {}
+        out.update(self.registry.metas)
+        out.update(self.bindings)
+        return out
 
     # ------------------------------------------------------------- schema
     def _table_cols(self, name: str) -> list | None:
-        m = self.registry.metas.get(name.lower())
+        m = self.mat_dict().get(name.lower())
         if m is None:
             try:
                 m = self.catalog.table(name)
@@ -138,12 +146,20 @@ class SubqueryRewriter:
         if isinstance(node, A.SubqueryTable):
             sel = node.subquery
             labels = []
-            fields = sel.selects[0].fields if isinstance(sel, A.SetOprStmt) else sel.fields
+            inner = sel.selects[0] if isinstance(sel, A.SetOprStmt) else sel
+            fields = inner.fields
+            inner_schema = None
             for f in fields:
                 e = f.expr if isinstance(f, A.SelectField) else f
                 if isinstance(e, A.Star):
-                    # star inside a not-yet-materialized derived table:
-                    # conservatively unknown — resolved after materialization
+                    # expand the star against the subquery's own FROM so the
+                    # derived table's schema is complete for correlation checks
+                    if inner_schema is None:
+                        inner_schema = self._from_schema(inner.from_clause)
+                    for alias, cols in inner_schema:
+                        if e.table and alias != e.table.lower():
+                            continue
+                        labels.extend(cols)
                     continue
                 if isinstance(f, A.SelectField) and f.alias:
                     labels.append(f.alias.lower())
@@ -220,7 +236,7 @@ class SubqueryRewriter:
             names, fts, rows = self.exec_query(cte.subquery)
             if cte.columns:
                 names = list(cte.columns) + list(names[len(cte.columns):])
-            self.registry.register(names, fts, rows, name=cte.name)
+            self.bindings[cte.name.lower()] = self.registry.register(names, fts, rows)
 
     def _recursive_cte(self, cte: A.CTE) -> None:
         """Delta-based recursive CTE evaluation (ref: pkg/executor/cte.go —
@@ -266,13 +282,14 @@ class SubqueryRewriter:
             total = dedup
         if cte.columns:
             names = list(cte.columns) + list(names[len(cte.columns):])
-        self.registry.register(names, fts, total, name=cte.name)
+        cmeta = self.registry.register(names, fts, total)
+        self.bindings[cte.name.lower()] = cmeta
         delta = total
         for _ in range(self.max_recursion + 1):
             if not delta:
                 break
             # the recursive part reads the previous iteration's delta
-            self.registry.update_rows(cte.name, delta)
+            self.registry.update_rows(cmeta, delta)
             new: list = []
             for s in recs:
                 _, _, r_ = self.exec_query(copy.deepcopy(s))
@@ -291,7 +308,7 @@ class SubqueryRewriter:
             raise SubqueryError(
                 f"recursive CTE {cte.name!r} exceeded cte_max_recursion_depth={self.max_recursion}"
             )
-        self.registry.update_rows(cte.name, total)
+        self.registry.update_rows(cmeta, total)
 
     def rewrite_select(self, stmt: A.SelectStmt) -> None:
         """In-place: after this returns, `stmt` contains no subquery nodes
@@ -317,8 +334,8 @@ class SubqueryRewriter:
             return node
         if isinstance(node, A.SubqueryTable):
             names, fts, rows = self.exec_query(node.subquery)
-            name = self.registry.register(names, fts, rows)
-            return A.TableName(name, alias=node.alias)
+            meta = self.registry.register(names, fts, rows)
+            return A.TableName(meta.name, alias=node.alias)
         if isinstance(node, A.Join):
             node.left = self._rewrite_from(node.left)
             node.right = self._rewrite_from(node.right)
@@ -326,9 +343,11 @@ class SubqueryRewriter:
         return node
 
     def _is_correlated(self, sub, schema) -> bool:
-        sel = sub.selects[0] if isinstance(sub, A.SetOprStmt) else sub
-        inner_schema = self._from_schema(sel.from_clause)
-        return self._refs_outer(sel, inner_schema, [schema])
+        sels = sub.selects if isinstance(sub, A.SetOprStmt) else [sub]
+        return any(
+            self._refs_outer(sel, self._from_schema(sel.from_clause), [schema])
+            for sel in sels
+        )
 
     def _rewrite_conjunct(self, c, schema, stmt):
         """Top-level WHERE conjunct: IN/EXISTS may become join markers.
@@ -433,8 +452,8 @@ class SubqueryRewriter:
             # x NOT IN (S ∪ {NULL}) is never TRUE (three-valued logic)
             return FALSE_LIT()
         nonnull = [d for d in uniq if not d.is_null()]
-        name = self.registry.register(["v"], [fts[0]], [[d] for d in nonnull])
-        marker = A.SemiJoinCond(name, [x], ["v"], anti=negated)
+        meta = self.registry.register(["v"], [fts[0]], [[d] for d in nonnull])
+        marker = A.SemiJoinCond(meta.name, [x], ["v"], anti=negated)
         if negated:
             # NULL probe against non-empty S is NULL -> row filtered; the
             # anti join alone would keep it
@@ -587,17 +606,17 @@ class SubqueryRewriter:
             # correlation keys alone removes probes of poisoned groups
             null_rows = [r[1:] for r in rows if r[0].is_null()]
             rows = [r for r in rows if not r[0].is_null()]
-            name = self.registry.register(build, fts, rows)
-            marker = A.SemiJoinCond(name, probe, build, anti=True, require_notnull_probe=True)
+            meta = self.registry.register(build, fts, rows)
+            marker = A.SemiJoinCond(meta.name, probe, build, anti=True, require_notnull_probe=True)
             if null_rows and pairs:
-                nname = self.registry.register(build[1:], fts[1:], null_rows)
-                poison = A.SemiJoinCond(nname, [copy.deepcopy(oe) for _, oe in pairs], build[1:], anti=True)
+                nmeta = self.registry.register(build[1:], fts[1:], null_rows)
+                poison = A.SemiJoinCond(nmeta.name, [copy.deepcopy(oe) for _, oe in pairs], build[1:], anti=True)
                 return A.BinaryOp("and", marker, poison)
             if null_rows and not pairs:
                 return FALSE_LIT()
             return marker
-        name = self.registry.register(build, fts, rows)
-        return A.SemiJoinCond(name, probe, build, anti=negated)
+        meta = self.registry.register(build, fts, rows)
+        return A.SemiJoinCond(meta.name, probe, build, anti=negated)
 
     def _scalar(self, sub, schema, stmt):
         """Scalar subquery in value position."""
@@ -645,13 +664,13 @@ class SubqueryRewriter:
                 if k in keys:
                     raise SubqueryError("Subquery returns more than 1 row")
                 keys.add(k)
-        name = self.registry.register(names, fts, rows)
-        alias = name.lstrip("#").replace("#", "_")
+        meta = self.registry.register(names, fts, rows)
+        alias = "_sq_" + meta.name.lstrip("#")
         on = _and_all([
             A.BinaryOp("eq", copy.deepcopy(oe), A.ColumnName(f"k{i}", alias))
             for i, (_, oe) in enumerate(pairs)
         ])
-        stmt.from_clause = A.Join(stmt.from_clause, A.TableName(name, alias=alias), "left", on)
+        stmt.from_clause = A.Join(stmt.from_clause, A.TableName(meta.name, alias=alias), "left", on)
         ref = A.ColumnName("v", alias)
         if isinstance(ve, A.AggFunc) and ve.name.lower() == "count":
             # COUNT over an empty correlation group is 0, not NULL — the
