@@ -1,0 +1,195 @@
+"""Transactions: Percolator 2PC engine (store/txn.py) + session txn layer
+(ref: unistore/tikv/mvcc.go prewrite/commit, lockstore; client-go 2PC;
+pkg/session LazyTxn; pkg/executor/union_scan.go read-your-writes)."""
+
+import pytest
+
+from tidb_tpu.sql.catalog import Catalog
+from tidb_tpu.sql.session import Session, SQLError
+from tidb_tpu.store import TPUStore
+from tidb_tpu.store.txn import KeyIsLocked, TxnEngine, WriteConflict
+
+
+@pytest.fixture()
+def pair():
+    store, cat = TPUStore(), Catalog()
+    s1, s2 = Session(store, cat), Session(store, cat)
+    s1.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+    s1.execute("INSERT INTO t VALUES (1,10),(2,20)")
+    return s1, s2
+
+
+# ---------------------------------------------------------------- engine
+
+
+def test_engine_prewrite_commit():
+    from tidb_tpu.store.kv import MemKV
+
+    kv = MemKV()
+    eng = TxnEngine(kv)
+    eng.commit_txn({b"a": b"1", b"b": b"2"}, start_ts=10, commit_ts=11)
+    assert kv.get(b"a", 11) == b"1" and kv.get(b"b", 11) == b"2"
+    assert kv.get(b"a", 10) is None  # snapshot before commit_ts
+
+
+def test_engine_write_conflict():
+    from tidb_tpu.store.kv import MemKV
+
+    kv = MemKV()
+    eng = TxnEngine(kv)
+    eng.commit_txn({b"a": b"1"}, 10, 15)
+    with pytest.raises(WriteConflict):
+        eng.commit_txn({b"a": b"2"}, 12, 16)  # started before the commit landed
+    assert kv.get(b"a", 100) == b"1"
+    assert not eng.locks  # failed prewrite leaves no locks behind
+
+
+def test_engine_key_is_locked():
+    from tidb_tpu.store.kv import MemKV
+
+    eng = TxnEngine(MemKV())
+    eng.prewrite({b"a": b"1"}, b"a", 10)
+    with pytest.raises(KeyIsLocked):
+        eng.prewrite({b"a": b"2"}, b"a", 12)
+    eng.rollback([b"a"], 10)
+    eng.commit_txn({b"a": b"2"}, 12, 13)
+
+
+def test_engine_pessimistic_converts():
+    from tidb_tpu.store.kv import MemKV
+
+    kv = MemKV()
+    eng = TxnEngine(kv)
+    eng.acquire_pessimistic([b"a"], b"a", 10, 10)
+    with pytest.raises(KeyIsLocked):
+        eng.acquire_pessimistic([b"a"], b"a", 20, 20)
+    eng.commit_txn({b"a": b"x"}, 10, 12)
+    assert kv.get(b"a", 12) == b"x"
+    assert not eng.locks
+
+
+# ---------------------------------------------------------------- session
+
+
+def test_read_your_writes_and_isolation(pair):
+    s1, s2 = pair
+    s1.execute("BEGIN")
+    s1.execute("UPDATE t SET v = 99 WHERE id = 1")
+    s1.execute("INSERT INTO t VALUES (3,30)")
+    s1.execute("DELETE FROM t WHERE id = 2")
+    assert s1.execute("SELECT * FROM t ORDER BY id").values() == [[1, 99], [3, 30]]
+    # other session sees the pre-txn snapshot
+    assert s2.execute("SELECT * FROM t ORDER BY id").values() == [[1, 10], [2, 20]]
+    s1.execute("COMMIT")
+    assert s2.execute("SELECT * FROM t ORDER BY id").values() == [[1, 99], [3, 30]]
+
+
+def test_rollback_discards(pair):
+    s1, s2 = pair
+    s1.execute("BEGIN")
+    s1.execute("UPDATE t SET v = 0")
+    s1.execute("ROLLBACK")
+    assert s1.execute("SELECT * FROM t ORDER BY id").values() == [[1, 10], [2, 20]]
+
+
+def test_repeatable_read_snapshot(pair):
+    s1, s2 = pair
+    s1.execute("BEGIN")
+    assert s1.execute("SELECT v FROM t WHERE id = 1").values() == [[10]]
+    s2.execute("UPDATE t SET v = 77 WHERE id = 1")
+    # repeatable read: s1 still sees its snapshot
+    assert s1.execute("SELECT v FROM t WHERE id = 1").values() == [[10]]
+    s1.execute("COMMIT")
+    assert s1.execute("SELECT v FROM t WHERE id = 1").values() == [[77]]
+
+
+def test_pessimistic_lock_conflict(pair):
+    s1, s2 = pair
+    s1.execute("BEGIN")
+    s1.execute("UPDATE t SET v = 1 WHERE id = 2")
+    with pytest.raises(SQLError, match="locked"):
+        s2.execute("UPDATE t SET v = 2 WHERE id = 2")
+    s1.execute("COMMIT")
+    s2.execute("UPDATE t SET v = 2 WHERE id = 2")
+    assert s2.execute("SELECT v FROM t WHERE id = 2").values() == [[2]]
+
+
+def test_optimistic_write_conflict(pair):
+    s1, s2 = pair
+    s1.execute("SET tidb_txn_mode = 'optimistic'")
+    s1.execute("BEGIN")
+    s1.execute("UPDATE t SET v = 5 WHERE id = 1")
+    s2.execute("UPDATE t SET v = 7 WHERE id = 1")
+    with pytest.raises(SQLError, match="conflict"):
+        s1.execute("COMMIT")
+    assert s2.execute("SELECT v FROM t WHERE id = 1").values() == [[7]]
+
+
+def test_select_for_update_locks(pair):
+    s1, s2 = pair
+    s1.execute("BEGIN")
+    s1.execute("SELECT * FROM t WHERE id = 2 FOR UPDATE")
+    with pytest.raises(SQLError):
+        s2.execute("DELETE FROM t WHERE id = 2")
+    s1.execute("ROLLBACK")
+    s2.execute("DELETE FROM t WHERE id = 2")
+    assert s2.execute("SELECT count(*) FROM t").values() == [[1]]
+
+
+def test_txn_aggregate_sees_own_writes(pair):
+    s1, _ = pair
+    s1.execute("BEGIN")
+    s1.execute("INSERT INTO t VALUES (10, 100), (11, 200)")
+    got = s1.execute("SELECT count(*), sum(v) FROM t").values()
+    assert [[got[0][0], int(str(got[0][1]))]] == [[4, 330]]
+    s1.execute("COMMIT")
+    assert s1.execute("SELECT count(*) FROM t").values() == [[4]]
+
+
+def test_txn_join_with_dirty_table(pair):
+    s1, _ = pair
+    s1.execute("CREATE TABLE u (id INT PRIMARY KEY, tv INT)")
+    s1.execute("INSERT INTO u VALUES (1, 10)")
+    s1.execute("BEGIN")
+    s1.execute("INSERT INTO u VALUES (2, 20)")
+    got = s1.execute("SELECT t.id, u.id FROM t JOIN u ON t.v = u.tv ORDER BY t.id").values()
+    assert got == [[1, 1], [2, 2]]
+    s1.execute("ROLLBACK")
+    got = s1.execute("SELECT t.id, u.id FROM t JOIN u ON t.v = u.tv ORDER BY t.id").values()
+    assert got == [[1, 1]]
+
+
+def test_ddl_implicitly_commits(pair):
+    s1, s2 = pair
+    s1.execute("BEGIN")
+    s1.execute("UPDATE t SET v = 1 WHERE id = 1")
+    s1.execute("CREATE TABLE z (a INT PRIMARY KEY)")  # implicit commit
+    assert s2.execute("SELECT v FROM t WHERE id = 1").values() == [[1]]
+    assert s1.txn is None
+
+
+def test_begin_commits_previous(pair):
+    s1, s2 = pair
+    s1.execute("BEGIN")
+    s1.execute("UPDATE t SET v = 42 WHERE id = 1")
+    s1.execute("BEGIN")  # implicitly commits the first txn
+    assert s2.execute("SELECT v FROM t WHERE id = 1").values() == [[42]]
+    s1.execute("ROLLBACK")
+
+
+def test_unique_check_sees_buffer(pair):
+    s1, _ = pair
+    s1.execute("CREATE UNIQUE INDEX uv ON t (v)")
+    s1.execute("BEGIN")
+    s1.execute("INSERT INTO t VALUES (5, 50)")
+    with pytest.raises(SQLError, match="duplicate"):
+        s1.execute("INSERT INTO t VALUES (6, 50)")  # dup within the buffer
+    s1.execute("ROLLBACK")
+
+
+def test_failed_statement_in_autocommit_leaves_no_trace(pair):
+    s1, _ = pair
+    with pytest.raises(SQLError):
+        s1.execute("INSERT INTO t VALUES (1, 999)")  # dup pk
+    assert s1.execute("SELECT count(*) FROM t").values() == [[2]]
+    assert not s1.store.txn.locks

@@ -184,6 +184,12 @@ class Catalog:
             del self._tables[name.lower()]
             self.version += 1
 
+    def table_by_id(self, table_id: int) -> TableMeta | None:
+        for t in self._tables.values():
+            if t.table_id == table_id:
+                return t
+        return None
+
     def table(self, name: str) -> TableMeta:
         t = self._tables.get(name.lower())
         if t is None:

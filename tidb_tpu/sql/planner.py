@@ -685,6 +685,13 @@ def _plan_windows(win_nodes: list, low: "_Lowerer", executors: list) -> None:
                     offset = _const_int(low.lower_base(n.args[1]))
                 if len(n.args) > 2:
                     default = low.lower_base(n.args[2])
+                    # value and default unify to one result type (MySQL
+                    # unifies them; the device kernel mixes their lanes)
+                    uft = _unify_fts([args[0].ft, default.ft])
+                    if args[0].ft.eval_type() != uft.eval_type() or _dec_scale(args[0].ft) != _dec_scale(uft):
+                        args = (func("cast", uft, args[0]),)
+                    if default.ft.eval_type() != uft.eval_type() or _dec_scale(default.ft) != _dec_scale(uft):
+                        default = func("cast", uft, default)
             elif name == "nth_value":
                 if len(n.args) != 2:
                     raise PlanError("nth_value(expr, n) takes two arguments")
@@ -1065,7 +1072,9 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
     if stmt.having is not None and _has_window(stmt.having):
         raise PlanError("window functions are not allowed in HAVING")
     if win_nodes:
-        if stmt.group_by or any(_has_agg(f.expr) for f in fields):
+        if stmt.group_by or any(_has_agg(f.expr) for f in fields) or (
+            stmt.having is not None and _has_agg(stmt.having)
+        ):
             raise PlanError("mixing window functions with GROUP BY/aggregates not supported yet")
         _plan_windows(win_nodes, low, executors)
 

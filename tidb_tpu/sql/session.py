@@ -10,8 +10,6 @@ TRUNCATE. Everything else raises loudly rather than silently no-op."""
 
 from __future__ import annotations
 
-import itertools
-import threading
 from dataclasses import dataclass, field
 
 from ..chunk import Chunk
@@ -28,6 +26,42 @@ from .catalog import Catalog, CatalogError, TableMeta
 from .planner import PlanError, _Lowerer, _Scope, _TableRef, _coerce_datum, plan_select
 
 HANDLE_FT = new_longlong(notnull=True)
+
+
+@dataclass
+class TxnState:
+    """One open transaction (ref: session's LazyTxn + the client-side
+    memdb buffer; pkg/store/driver/txn). Mutations buffer at the KV level
+    (what 2PC ships); row_ops keep the row-level overlay SELECTs need for
+    read-your-writes (the UnionScan analog, pkg/executor/union_scan.go)."""
+
+    start_ts: int
+    mode: str  # "optimistic" | "pessimistic"
+    explicit: bool
+    mutations: dict = field(default_factory=dict)  # key -> bytes | None
+    row_ops: dict = field(default_factory=dict)  # table_id -> {handle: [Datum] | None}
+    locked: set = field(default_factory=set)  # pessimistic-locked keys
+    row_delta: dict = field(default_factory=dict)  # table_id -> row-count delta
+    # (applied to catalog stats only on successful commit)
+
+    def savepoint(self):
+        """Statement-level snapshot: a failed statement inside an explicit
+        txn must leave no partial buffer (MySQL implicit statement
+        savepoint; ref: session.StmtRollback)."""
+        return (
+            dict(self.mutations),
+            {tid: dict(ops) for tid, ops in self.row_ops.items()},
+            set(self.locked),
+            dict(self.row_delta),
+        )
+
+    def restore(self, sp):
+        self.mutations, self.row_ops, self.locked, self.row_delta = (
+            dict(sp[0]),
+            {tid: dict(ops) for tid, ops in sp[1].items()},
+            set(sp[2]),
+            dict(sp[3]),
+        )
 
 
 @dataclass
@@ -60,8 +94,7 @@ class Session:
 
         self.store = store or TPUStore()
         self.catalog = catalog or Catalog()
-        self._tso = itertools.count(100)
-        self._tso_lock = threading.Lock()
+        self.txn: TxnState | None = None
         self.sysvars = SysVarStore()
         self.user_vars: dict[str, object] = {}
         if config is not None:
@@ -74,8 +107,102 @@ class Session:
                 self.sysvars.set("tidb_max_chunk_size", str(config.paging_size))
 
     def _next_ts(self) -> int:
-        with self._tso_lock:
-            return next(self._tso)
+        return self.store.next_ts()
+
+    def _read_ts(self) -> int:
+        """Snapshot ts: the open txn's start_ts (repeatable read), else a
+        fresh TSO tick (ref: sessiontxn isolation providers)."""
+        return self.txn.start_ts if self.txn is not None else self.store.next_ts()
+
+    # ---------------------------------------------------------------- txn
+    def _begin(self, explicit: bool = True):
+        self.txn = TxnState(
+            start_ts=self.store.next_ts(),
+            mode=self.sysvars.get("tidb_txn_mode") or "pessimistic",
+            explicit=explicit,
+        )
+
+    def _commit(self):
+        from ..store.txn import TxnError
+
+        txn, self.txn = self.txn, None
+        if txn is None:
+            return
+        if not txn.mutations:
+            self.store.txn.release_all(txn.start_ts)
+            return
+        commit_ts = self.store.next_ts()
+        try:
+            self.store.txn.commit_txn(txn.mutations, txn.start_ts, commit_ts)
+        except TxnError as exc:
+            self.store.txn.release_all(txn.start_ts)
+            raise SQLError(str(exc)) from exc
+        # non-mutated pessimistic locks (SELECT FOR UPDATE) release now
+        self.store.txn.release_all(txn.start_ts)
+        # planner row-count stats apply only once the txn is durable
+        for tid, delta in txn.row_delta.items():
+            meta = self.catalog.table_by_id(tid)
+            if meta is not None:
+                meta.row_count = max(meta.row_count + delta, 0)
+
+    def _rollback(self):
+        txn, self.txn = self.txn, None
+        if txn is not None:
+            self.store.txn.release_all(txn.start_ts)
+
+    def _autocommit_dml(self, fn):
+        """Run a DML statement inside the open txn (with a statement
+        savepoint: a failed statement buffers nothing), or wrap it in an
+        implicit single-statement txn (autocommit -> immediate 2PC)."""
+        if self.txn is not None:
+            sp = self.txn.savepoint()
+            try:
+                return fn()
+            except Exception:
+                self.txn.restore(sp)
+                raise
+        self._begin(explicit=False)
+        try:
+            res = fn()
+        except Exception:
+            self._rollback()
+            raise
+        self._commit()
+        return res
+
+    def _implicit_commit(self):
+        """DDL commits any open transaction first (MySQL semantics)."""
+        if self.txn is not None:
+            self._commit()
+
+    def _lock_rows(self, meta: TableMeta, handles):
+        """Pessimistic intention locks at DML/SELECT-FOR-UPDATE time
+        (explicit pessimistic txns only; autocommit statements commit
+        immediately so prewrite conflict checks suffice)."""
+        from ..store.txn import TxnError
+
+        if self.txn is None or not self.txn.explicit or self.txn.mode != "pessimistic":
+            return
+        keys = [tablecodec.encode_row_key(meta.table_id, h) for h in handles]
+        if not keys:
+            return
+        for_update_ts = self.store.next_ts()
+        try:
+            self.store.txn.acquire_pessimistic(keys, keys[0], self.txn.start_ts, for_update_ts)
+        except TxnError as exc:
+            raise SQLError(str(exc)) from exc
+        self.txn.locked |= set(keys)
+
+    # ------------------------------------------------- buffered write path
+    def _buf_put_row(self, meta: TableMeta, handle: int, datums: list):
+        key = tablecodec.encode_row_key(meta.table_id, handle)
+        self.txn.mutations[key] = self.store._row_encoder.encode(meta.col_ids(), datums)
+        self.txn.row_ops.setdefault(meta.table_id, {})[handle] = list(datums)
+
+    def _buf_delete_row(self, meta: TableMeta, handle: int):
+        key = tablecodec.encode_row_key(meta.table_id, handle)
+        self.txn.mutations[key] = None
+        self.txn.row_ops.setdefault(meta.table_id, {})[handle] = None
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> Result:
@@ -91,22 +218,34 @@ class Session:
             names, _, rows = self._set_opr(stmt, None)
             return Result(columns=names, rows=rows)
         if isinstance(stmt, A.CreateTableStmt):
+            self._implicit_commit()
             self.catalog.create_table(stmt)
             return Result()
         if isinstance(stmt, A.DropTableStmt):
+            self._implicit_commit()
             for t in stmt.tables:
                 self.catalog.drop_table(t.name, stmt.if_exists)
             return Result()
         if isinstance(stmt, A.TruncateTableStmt):
-            return self._truncate(stmt)
+            self._implicit_commit()
+            return self._autocommit_dml(lambda: self._truncate(stmt))
         if isinstance(stmt, A.InsertStmt):
-            return self._insert(stmt)
+            return self._autocommit_dml(lambda: self._insert(stmt))
         if isinstance(stmt, A.UpdateStmt):
-            return self._update(stmt)
+            return self._autocommit_dml(lambda: self._update(stmt))
         if isinstance(stmt, A.DeleteStmt):
-            return self._delete(stmt)
-        if isinstance(stmt, (A.BeginStmt, A.CommitStmt, A.RollbackStmt)):
-            return Result()  # autocommit: every statement commits
+            return self._autocommit_dml(lambda: self._delete(stmt))
+        if isinstance(stmt, A.BeginStmt):
+            # BEGIN implicitly commits any open txn (MySQL semantics)
+            self._implicit_commit()
+            self._begin(explicit=True)
+            return Result()
+        if isinstance(stmt, A.CommitStmt):
+            self._commit()
+            return Result()
+        if isinstance(stmt, A.RollbackStmt):
+            self._rollback()
+            return Result()
         if isinstance(stmt, A.SetStmt):
             from .sysvar import SysVarError
 
@@ -124,8 +263,10 @@ class Session:
         if isinstance(stmt, (A.UseStmt, A.CreateDatabaseStmt)):
             return Result()  # single implicit database
         if isinstance(stmt, A.CreateIndexStmt):
+            self._implicit_commit()
             return self._create_index(stmt)
         if isinstance(stmt, A.DropIndexStmt):
+            self._implicit_commit()
             return self._drop_index(stmt)
         if isinstance(stmt, A.ShowStmt):
             return self._show(stmt)
@@ -215,10 +356,14 @@ class Session:
             rw.rewrite_select(stmt)
         except SubqueryError as exc:
             raise SQLError(str(exc)) from exc
+        if self.txn is not None and self.txn.row_ops:
+            self._shadow_dirty_tables(stmt.from_clause, rw)
+        if stmt.for_update:
+            self._select_for_update(stmt)
         from ..util.memory import MemTracker, QuotaExceeded
 
         plan = plan_select(stmt, self.catalog, mat=rw.mat_dict())
-        ts = self._next_ts()
+        ts = self._read_ts()
         tracker = MemTracker("query", quota=self.sysvars.get_int("tidb_mem_quota_query") or None)
         gate_on = self.sysvars.get_bool("tidb_enable_tpu_coprocessor")
         aux = []
@@ -370,6 +515,48 @@ class Session:
             return rw.registry.chunks[meta.name]
         return self._fetch_table_chunk(meta, ts)
 
+    def _shadow_dirty_tables(self, node, rw) -> None:
+        """Bind every txn-dirty table referenced in FROM to a materialized
+        overlay (committed snapshot + this txn's buffered rows) — the
+        UnionScan analog (ref: pkg/executor/union_scan.go; the reference
+        likewise disables pushdown below a dirty table's reader)."""
+        if isinstance(node, A.TableName):
+            name = node.name.lower()
+            if name in rw.bindings:
+                return
+            try:
+                meta = self.catalog.table(name)
+            except CatalogError:
+                return
+            ops = self.txn.row_ops.get(meta.table_id)
+            if not ops:
+                return
+            rows = [row for _, row in self._scan_rows_with_handles(meta, None, self.txn.start_ts)]
+            m = rw.registry.register([c.name for c in meta.columns], meta.fts(), rows)
+            rw.bindings[name] = m
+        elif isinstance(node, A.Join):
+            self._shadow_dirty_tables(node.left, rw)
+            self._shadow_dirty_tables(node.right, rw)
+
+    def _select_for_update(self, stmt: A.SelectStmt) -> None:
+        """SELECT ... FOR UPDATE: pessimistic locks on the matched probe
+        rows (ref: PointGetExec / SelectLock executor lock-keys step)."""
+        if self.txn is None or not self.txn.explicit:
+            return  # autocommit SELECT FOR UPDATE locks nothing durable
+        if not isinstance(stmt.from_clause, A.TableName):
+            raise SQLError("SELECT ... FOR UPDATE supports single-table queries only")
+        try:
+            meta = self.catalog.table(stmt.from_clause.name)
+        except CatalogError:
+            return  # CTE/derived target: nothing lockable
+        try:
+            matched = self._scan_rows_with_handles(meta, stmt.where, self.txn.start_ts)
+        except (PlanError, SQLError):
+            # WHERE references rewrite markers the row scanner cannot
+            # evaluate: lock the whole table (conservative, never unsound)
+            matched = self._scan_rows_with_handles(meta, None, self.txn.start_ts)
+        self._lock_rows(meta, [h for h, _ in matched])
+
     def _select_via_oracle(self, plan, ranges, aux, ts) -> Chunk:
         from ..exec import run_dag_reference
 
@@ -427,6 +614,19 @@ class Session:
             self.store.put_index(key, None, wts)
         return Result()
 
+    def _scan_index_prefix(self, prefix: bytes, ts: int):
+        """Live index keys under `prefix`: committed entries overlaid with
+        this txn's buffered index mutations (tombstones hide, puts add)."""
+        muts = self.txn.mutations if self.txn is not None else {}
+        _MISS = object()
+        for key, _ in self.store.kv.scan(prefix, prefix + b"\xff", ts):
+            if muts.get(key, _MISS) is None:
+                continue  # tombstoned in this txn
+            yield key
+        for key, val in muts.items():
+            if val is not None and key.startswith(prefix) and self.store.kv.get(key, ts) is None:
+                yield key
+
     def _check_unique(self, meta: TableMeta, datums: list, handle: int, ts: int, old_handle: int | None = None):
         """Unique-index duplicate check (ref: ER_DUP_ENTRY; MySQL allows
         multiple NULLs in a unique index). `old_handle` is the row's
@@ -441,7 +641,7 @@ class Session:
             if any(d.is_null() for d in vals):
                 continue
             prefix = tablecodec.encode_index_key(meta.table_id, idx.index_id, vals)
-            for key, _ in self.store.kv.scan(prefix, prefix + b"\xff", ts):
+            for key in self._scan_index_prefix(prefix, ts):
                 other = self._index_keys_handle(key)
                 if other is not None and other not in own:
                     raise SQLError(
@@ -473,11 +673,11 @@ class Session:
 
     def _write_indexes(self, meta, datums, handle, ts, delete=False):
         for key in self._index_keys(meta, datums, handle):
-            self.store.put_index(key, None if delete else b"\x00", ts)
+            self.txn.mutations[key] = None if delete else b"\x00"
 
     def _insert(self, stmt: A.InsertStmt) -> Result:
         meta = self.catalog.table(stmt.table.name)
-        ts = self._next_ts()
+        ts = self.txn.start_ts
         if stmt.select is not None:
             src = self._select(stmt.select)
             cols = [c.lower() for c in (stmt.columns or [c.name for c in meta.columns])]
@@ -513,7 +713,7 @@ class Session:
                     i = [c.name for c in meta.columns].index(meta.handle_col)
                     datums[i] = Datum.i64(handle)
             key = tablecodec.encode_row_key(meta.table_id, handle)
-            exists = self.store.kv.get(key, ts) is not None
+            exists = self._read_row(meta, handle, ts) is not None
             if exists:
                 # duplicate primary key (ref: ER_DUP_ENTRY / REPLACE / IGNORE)
                 if stmt.ignore:
@@ -521,23 +721,30 @@ class Session:
                 if not stmt.replace:
                     raise SQLError(f"duplicate entry {handle} for key PRIMARY")
             self._check_unique(meta, datums, handle, ts)  # before any mutation
+            self._lock_rows(meta, [handle])
             if exists and stmt.replace and meta.indices:
                 # REPLACE drops the old row's index entries; the old row is
                 # fetched by its known key (no table scan)
                 old_row = self._read_row(meta, handle, ts)
                 if old_row is not None:
                     self._write_indexes(meta, old_row, handle, ts, delete=True)
-            self.store.put_row(meta.table_id, handle, meta.col_ids(), datums, ts)
+            self._buf_put_row(meta, handle, datums)
             self._write_indexes(meta, datums, handle, ts)
             if not exists:
                 n += 1
-                meta.row_count += 1
+                self.txn.row_delta[meta.table_id] = self.txn.row_delta.get(meta.table_id, 0) + 1
         return Result(affected=n)
 
     def _read_row(self, meta: TableMeta, handle: int, ts: int) -> list | None:
-        """Point read of one row by handle (ref: PointGet)."""
+        """Point read of one row by handle with txn-buffer overlay
+        (ref: PointGet reading through the memdb first)."""
         from ..codec.rowcodec import decode_row_to_datum_map
 
+        if self.txn is not None:
+            ops = self.txn.row_ops.get(meta.table_id, {})
+            if handle in ops:
+                row = ops[handle]
+                return list(row) if row is not None else None
         val = self.store.kv.get(tablecodec.encode_row_key(meta.table_id, handle), ts)
         if val is None:
             return None
@@ -556,10 +763,18 @@ class Session:
         scan = TableScan(meta.table_id, tuple(cols))
         dag = DAGRequest((scan,), output_offsets=tuple(range(len(cols))))
         chunk = execute_root(self.store, dag, full_table_ranges(meta.table_id), start_ts=ts)
+        by_handle = {int(r[0].val): r[1:] for r in chunk.rows()}
+        if self.txn is not None:
+            # read-your-writes overlay (the UnionScan analog)
+            for h, row in self.txn.row_ops.get(meta.table_id, {}).items():
+                if row is None:
+                    by_handle.pop(h, None)
+                else:
+                    by_handle[h] = list(row)
         ev = RefEvaluator()
         out = []
-        for r in chunk.rows():
-            handle, row = int(r[0].val), r[1:]
+        for handle in sorted(by_handle):
+            row = by_handle[handle]
             if cond is None or _truth(ev.eval(cond, row)):
                 out.append((handle, row))
         if order_by:
@@ -590,8 +805,9 @@ class Session:
         if not isinstance(stmt.table, A.TableName):
             raise SQLError("multi-table UPDATE not supported")
         meta = self.catalog.table(stmt.table.name)
-        ts = self._next_ts()
+        ts = self.txn.start_ts
         matched = self._scan_rows_with_handles(meta, stmt.where, ts, stmt.order_by, stmt.limit)
+        self._lock_rows(meta, [h for h, _ in matched])
         scope = _Scope([_TableRef(meta, meta.name, 0)])
         lw = _Lowerer(scope)
         col_pos = {c.name: i for i, c in enumerate(meta.columns)}
@@ -600,7 +816,6 @@ class Session:
             cm = meta.col(a.column.name if isinstance(a.column, A.ColumnName) else str(a.column))
             assigns.append((cm, lw.lower_base(a.expr)))
         ev = RefEvaluator()
-        wts = self._next_ts()
         moves_handle = meta.handle_col is not None and any(cm.name == meta.handle_col for cm, _ in assigns)
         for handle, row in matched:
             new_row = list(row)
@@ -615,40 +830,38 @@ class Session:
                 new_handle = int(d.val)
             # ALL constraint checks before ANY mutation — a failed UPDATE
             # must not leave tombstoned index entries behind
-            if new_handle != handle:
-                nkey = tablecodec.encode_row_key(meta.table_id, new_handle)
-                if self.store.kv.get(nkey, wts) is not None:
-                    raise SQLError(f"duplicate entry {new_handle} for key PRIMARY")
-            self._check_unique(meta, new_row, new_handle, wts, old_handle=handle)
+            if new_handle != handle and self._read_row(meta, new_handle, ts) is not None:
+                raise SQLError(f"duplicate entry {new_handle} for key PRIMARY")
+            self._check_unique(meta, new_row, new_handle, ts, old_handle=handle)
             if new_handle != handle:
                 # PK change moves the row to a new key (ref: updateRecord's
                 # remove+add when the handle changes)
-                self.store.delete_row(meta.table_id, handle, wts)
-            self._write_indexes(meta, row, handle, wts, delete=True)
-            self.store.put_row(meta.table_id, new_handle, meta.col_ids(), new_row, wts)
-            self._write_indexes(meta, new_row, new_handle, wts)
+                self._buf_delete_row(meta, handle)
+                self._lock_rows(meta, [new_handle])
+            self._write_indexes(meta, row, handle, ts, delete=True)
+            self._buf_put_row(meta, new_handle, new_row)
+            self._write_indexes(meta, new_row, new_handle, ts)
         return Result(affected=len(matched))
 
     def _delete(self, stmt: A.DeleteStmt) -> Result:
         meta = self.catalog.table(stmt.table.name)
-        ts = self._next_ts()
+        ts = self.txn.start_ts
         matched = self._scan_rows_with_handles(meta, stmt.where, ts, stmt.order_by, stmt.limit)
-        wts = self._next_ts()
+        self._lock_rows(meta, [h for h, _ in matched])
         for handle, row in matched:
-            self.store.delete_row(meta.table_id, handle, wts)
-            self._write_indexes(meta, row, handle, wts, delete=True)
-        meta.row_count -= len(matched)
+            self._buf_delete_row(meta, handle)
+            self._write_indexes(meta, row, handle, ts, delete=True)
+        self.txn.row_delta[meta.table_id] = self.txn.row_delta.get(meta.table_id, 0) - len(matched)
         return Result(affected=len(matched))
 
     def _truncate(self, stmt) -> Result:
         meta = self.catalog.table(stmt.table.name)
-        ts = self._next_ts()
+        ts = self.txn.start_ts
         matched = self._scan_rows_with_handles(meta, None, ts)
-        wts = self._next_ts()
         for handle, row in matched:
-            self.store.delete_row(meta.table_id, handle, wts)
-            self._write_indexes(meta, row, handle, wts, delete=True)
-        meta.row_count = 0
+            self._buf_delete_row(meta, handle)
+            self._write_indexes(meta, row, handle, ts, delete=True)
+        self.txn.row_delta[meta.table_id] = -meta.row_count
         return Result(affected=len(matched))
 
     # ------------------------------------------------------------------

@@ -21,6 +21,16 @@ def _bool_validator(v: str) -> str:
     raise SysVarError(f"expected ON/OFF, got {v!r}")
 
 
+def _enum_validator(*allowed: str):
+    def check(v: str) -> str:
+        t = v.strip().lower()
+        if t not in allowed:
+            raise SysVarError(f"expected one of {allowed}, got {v!r}")
+        return t
+
+    return check
+
+
 def _int_validator(lo: int, hi: int):
     def check(v: str) -> str:
         try:
@@ -58,6 +68,8 @@ DEFINITIONS = {
         SysVar("tidb_enable_paging", "OFF", "both", _bool_validator),
         SysVar("tidb_opt_agg_push_down", "ON", "both", _bool_validator),
         SysVar("autocommit", "ON", "both", _bool_validator),
+        # ref: sysvar.go TiDBTxnMode (pessimistic is TiDB's default)
+        SysVar("tidb_txn_mode", "pessimistic", "both", _enum_validator("pessimistic", "optimistic")),
         # ref: sysvar.go CTEMaxRecursionDepth
         SysVar("cte_max_recursion_depth", "1000", "both", _int_validator(0, 1 << 20)),
         SysVar("sql_mode", "STRICT_TRANS_TABLES", "both"),

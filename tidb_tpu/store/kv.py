@@ -64,6 +64,12 @@ class MemKV:
                     break
             i += 1
 
+    def latest_ts(self, key: bytes) -> int:
+        """Commit ts of the newest version of `key` (0 if none) — the
+        write-conflict check input (ref: mvcc.go checkConflict)."""
+        versions = self._data.get(key)
+        return versions[-1][0] if versions else 0
+
     def max_ts(self) -> int:
         ts = 0
         for versions in self._data.values():

@@ -15,6 +15,7 @@ the distsql layer exercises the same retry/re-split path as the reference
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from dataclasses import dataclass, field
@@ -86,15 +87,30 @@ class TPUStore:
     EmbedUnistore, mockstore.go:86)."""
 
     def __init__(self):
+        from .txn import TxnEngine
+
         self.kv = MemKV()
         self.cluster = Cluster()
         self.programs = ProgramCache()
+        self.txn = TxnEngine(self.kv, on_commit=self._bump_write_ver)
+        self._tso = itertools.count(100)
+        self._tso_lock = threading.Lock()
         self._write_ver = 0
         self._chunk_cache: dict = {}
         self._batch_cache: dict = {}
         self._aux_batch_cache: dict = {}  # id(chunk) -> DeviceBatch (broadcast reuse)
         self._aux_lock = threading.Lock()  # select() fans tasks over threads
         self._row_encoder = RowEncoder()
+
+    def next_ts(self) -> int:
+        """Store-global TSO (ref: PD timestamp oracle; mock unistore/pd.go).
+        Sessions sharing a store draw from one clock so snapshots and
+        commit timestamps totally order across sessions."""
+        with self._tso_lock:
+            return next(self._tso)
+
+    def _bump_write_ver(self):
+        self._write_ver += 1
 
     # -- write path (ref: table.AddRecord -> memdb -> prewrite/commit) ------
     def put_row(self, table_id: int, handle: int, col_ids: list[int], datums: list[Datum], ts: int):

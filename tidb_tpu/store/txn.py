@@ -1,0 +1,149 @@
+"""Percolator transaction engine over MemKV (ref: unistore/tikv/mvcc.go
+MVCCStore prewrite/commit + lockstore; client-go 2PC driver;
+pkg/store/driver/txn/txn_driver.go).
+
+The reference splits 2PC across the client (primary selection, parallel
+prewrite, commit point) and the store (lock CF, write CF, conflict checks).
+In one process both halves collapse into this engine:
+
+  prewrite   lock every mutated key after write-conflict + lock checks
+  commit     apply buffered values at commit_ts, release locks (atomic
+             under the engine mutex — readers never observe a partial
+             commit, which is why snapshot reads here do not need the
+             reference's lock-wait/resolve path)
+  rollback   drop this txn's locks
+  pessimistic lock
+             conflict-checked intention locks taken at DML time
+             (ref: acquire pessimistic lock, mvcc.go; lock converts to a
+             prewrite lock at commit)
+
+Failure semantics match Percolator where observable in-process:
+  KeyIsLocked    another live txn holds the key (no wait queue — the
+                 caller surfaces a lock-conflict error immediately)
+  WriteConflict  a commit landed after this txn's snapshot/for_update ts
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from .kv import MemKV
+
+
+class TxnError(Exception):
+    pass
+
+
+class KeyIsLocked(TxnError):
+    def __init__(self, key: bytes, holder_ts: int):
+        super().__init__(f"key is locked by txn {holder_ts}")
+        self.key, self.holder_ts = key, holder_ts
+
+
+class WriteConflict(TxnError):
+    def __init__(self, key: bytes, conflict_ts: int, start_ts: int):
+        super().__init__(
+            f"write conflict: key committed at {conflict_ts} > txn start {start_ts}"
+        )
+        self.key, self.conflict_ts, self.start_ts = key, conflict_ts, start_ts
+
+
+@dataclass
+class Lock:
+    """(ref: lockstore entry / kvrpcpb.LockInfo)."""
+
+    primary: bytes
+    start_ts: int
+    op: str  # "prewrite" | "pessimistic"
+    value: bytes | None = None  # buffered write (prewrite only)
+    is_delete: bool = False
+    for_update_ts: int = 0
+
+
+class TxnEngine:
+    def __init__(self, kv: MemKV, on_commit=None):
+        self.kv = kv
+        self.locks: dict[bytes, Lock] = {}
+        self._mu = threading.RLock()
+        self._on_commit = on_commit  # store cache-invalidation hook
+
+    # ------------------------------------------------------------------
+    def acquire_pessimistic(self, keys: list, primary: bytes, start_ts: int, for_update_ts: int):
+        """Intention locks for pessimistic DML (ref: mvcc.go pessimistic
+        lock path): conflict-checked against commits newer than
+        for_update_ts, held until commit/rollback."""
+        with self._mu:
+            for k in keys:
+                l = self.locks.get(k)
+                if l is not None and l.start_ts != start_ts:
+                    raise KeyIsLocked(k, l.start_ts)
+            for k in keys:
+                cts = self.kv.latest_ts(k)
+                if cts > for_update_ts:
+                    raise WriteConflict(k, cts, for_update_ts)
+            for k in keys:
+                if k not in self.locks:
+                    self.locks[k] = Lock(primary, start_ts, "pessimistic", for_update_ts=for_update_ts)
+
+    def prewrite(self, mutations: dict, primary: bytes, start_ts: int):
+        """mutations: key -> value bytes (None = delete tombstone)."""
+        with self._mu:
+            for k in mutations:
+                l = self.locks.get(k)
+                if l is not None and l.start_ts != start_ts:
+                    raise KeyIsLocked(k, l.start_ts)
+            for k in mutations:
+                l = self.locks.get(k)
+                if l is not None and l.op == "pessimistic":
+                    continue  # conflict already checked at for_update_ts
+                cts = self.kv.latest_ts(k)
+                if cts > start_ts:
+                    raise WriteConflict(k, cts, start_ts)
+            for k, v in mutations.items():
+                self.locks[k] = Lock(primary, start_ts, "prewrite", v, v is None)
+
+    def commit(self, keys: list, start_ts: int, commit_ts: int):
+        with self._mu:
+            staged = []
+            for k in keys:
+                l = self.locks.get(k)
+                if l is None or l.start_ts != start_ts:
+                    raise TxnError(f"lock not found for commit (txn {start_ts})")
+                if l.op != "prewrite":
+                    raise TxnError("commit before prewrite (pessimistic lock not converted)")
+                staged.append((k, l))
+            for k, l in staged:
+                self.kv.put(k, None if l.is_delete else l.value, commit_ts)
+                del self.locks[k]
+        if self._on_commit is not None and staged:
+            self._on_commit()
+
+    def rollback(self, keys: list, start_ts: int):
+        with self._mu:
+            for k in keys:
+                l = self.locks.get(k)
+                if l is not None and l.start_ts == start_ts:
+                    del self.locks[k]
+
+    def release_all(self, start_ts: int):
+        """Drop every lock a txn holds (rollback convenience)."""
+        with self._mu:
+            for k in [k for k, l in self.locks.items() if l.start_ts == start_ts]:
+                del self.locks[k]
+
+    # ------------------------------------------------------------------
+    def commit_txn(self, mutations: dict, start_ts: int, commit_ts: int):
+        """Full 2PC for an in-process txn: prewrite everything (primary =
+        first key), then commit. Raises without side effects on conflict;
+        pessimistic locks this txn already holds are converted."""
+        if not mutations:
+            return
+        keys = list(mutations)
+        primary = keys[0]
+        try:
+            self.prewrite(mutations, primary, start_ts)
+        except TxnError:
+            self.release_all(start_ts)
+            raise
+        self.commit(keys, start_ts, commit_ts)
