@@ -28,8 +28,13 @@ Package map (mirrors reference layers, SURVEY.md §1):
              (ref: MPP — pkg/planner/core/fragment.go, cophandler/mpp_exec.go)
   parser/    Standalone MySQL-dialect lexer + recursive-descent parser -> AST
              (ref: pkg/parser — a leaf package, like the reference's)
-  sql/       SQL front end: catalog, AST->DAG planner, session
-             (ref: pkg/infoschema+pkg/meta, pkg/planner, pkg/session)
+  sql/       SQL front end: catalog, AST->DAG planner, session, subquery
+             decorrelation, sysvars (ref: pkg/infoschema+pkg/meta,
+             pkg/planner, pkg/session, pkg/sessionctx)
+  server/    MySQL wire protocol server + minimal client
+             (ref: pkg/server)
+  util/      failpoints, metrics, memory tracking
+             (ref: pkg/util, pingcap/failpoint, pkg/metrics)
 """
 
 import jax as _jax

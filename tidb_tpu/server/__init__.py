@@ -1,0 +1,6 @@
+"""MySQL wire protocol server (ref: pkg/server)."""
+
+from .client import MiniClient
+from .server import MySQLServer, split_statements
+
+__all__ = ["MySQLServer", "MiniClient", "split_statements"]

@@ -43,6 +43,8 @@ class TxnState:
     locked: set = field(default_factory=set)  # pessimistic-locked keys
     row_delta: dict = field(default_factory=dict)  # table_id -> row-count delta
     # (applied to catalog stats only on successful commit)
+    index_muts: dict = field(default_factory=dict)  # index-key subset of mutations
+    schema_ver: int = -1  # catalog version at txn start (DDL fencing)
 
     def savepoint(self):
         """Statement-level snapshot: a failed statement inside an explicit
@@ -53,14 +55,16 @@ class TxnState:
             {tid: dict(ops) for tid, ops in self.row_ops.items()},
             set(self.locked),
             dict(self.row_delta),
+            dict(self.index_muts),
         )
 
     def restore(self, sp):
-        self.mutations, self.row_ops, self.locked, self.row_delta = (
+        self.mutations, self.row_ops, self.locked, self.row_delta, self.index_muts = (
             dict(sp[0]),
             {tid: dict(ops) for tid, ops in sp[1].items()},
             set(sp[2]),
             dict(sp[3]),
+            dict(sp[4]),
         )
 
 
@@ -71,6 +75,7 @@ class Result:
     columns: list = field(default_factory=list)
     rows: list = field(default_factory=list)
     affected: int = 0
+    fts: list | None = None  # column FieldTypes (wire column definitions)
 
     def scalar(self):
         return self.rows[0][0].val if self.rows else None
@@ -120,6 +125,7 @@ class Session:
             start_ts=self.store.next_ts(),
             mode=self.sysvars.get("tidb_txn_mode") or "pessimistic",
             explicit=explicit,
+            schema_ver=self.catalog.version,
         )
 
     def _commit(self):
@@ -131,6 +137,16 @@ class Session:
         if not txn.mutations:
             self.store.txn.release_all(txn.start_ts)
             return
+        if txn.schema_ver != self.catalog.version:
+            # concurrent DDL: buffered mutations were computed against an
+            # older schema (e.g. without a newly-built index) — committing
+            # would corrupt it (ref: TiDB "Information schema is changed")
+            self.store.txn.release_all(txn.start_ts)
+            raise SQLError(
+                "Information schema is changed during the execution of the statement "
+                "(schema version moved from "
+                f"{txn.schema_ver} to {self.catalog.version}) — transaction aborted"
+            )
         commit_ts = self.store.next_ts()
         try:
             self.store.txn.commit_txn(txn.mutations, txn.start_ts, commit_ts)
@@ -186,9 +202,12 @@ class Session:
         keys = [tablecodec.encode_row_key(meta.table_id, h) for h in handles]
         if not keys:
             return
-        for_update_ts = self.store.next_ts()
+        # conflict bound = the txn's snapshot ts: a commit that landed after
+        # our snapshot means this statement computed against stale rows —
+        # fail with a retryable conflict instead of losing the update.
+        # (TiDB instead re-reads at for_update_ts; stricter is still sound.)
         try:
-            self.store.txn.acquire_pessimistic(keys, keys[0], self.txn.start_ts, for_update_ts)
+            self.store.txn.acquire_pessimistic(keys, keys[0], self.txn.start_ts, self.txn.start_ts)
         except TxnError as exc:
             raise SQLError(str(exc)) from exc
         self.txn.locked |= set(keys)
@@ -215,8 +234,8 @@ class Session:
         if isinstance(stmt, A.SelectStmt):
             return self._select(stmt)
         if isinstance(stmt, A.SetOprStmt):
-            names, _, rows = self._set_opr(stmt, None)
-            return Result(columns=names, rows=rows)
+            names, fts, rows = self._set_opr(stmt, None)
+            return Result(columns=names, rows=rows, fts=fts)
         if isinstance(stmt, A.CreateTableStmt):
             self._implicit_commit()
             self.catalog.create_table(stmt)
@@ -312,8 +331,8 @@ class Session:
 
     # ------------------------------------------------------------------
     def _select(self, stmt: A.SelectStmt) -> Result:
-        names, _, rows = self._run_select(stmt, None)
-        return Result(columns=names, rows=rows)
+        names, fts, rows = self._run_select(stmt, None)
+        return Result(columns=names, rows=rows, fts=fts)
 
     def _new_rewriter(self, parent_rw):
         from .subquery import SubqueryRewriter
@@ -617,14 +636,16 @@ class Session:
     def _scan_index_prefix(self, prefix: bytes, ts: int):
         """Live index keys under `prefix`: committed entries overlaid with
         this txn's buffered index mutations (tombstones hide, puts add)."""
-        muts = self.txn.mutations if self.txn is not None else {}
+        muts = self.txn.index_muts if self.txn is not None else {}
         _MISS = object()
         for key, _ in self.store.kv.scan(prefix, prefix + b"\xff", ts):
             if muts.get(key, _MISS) is None:
                 continue  # tombstoned in this txn
             yield key
         for key, val in muts.items():
-            if val is not None and key.startswith(prefix) and self.store.kv.get(key, ts) is None:
+            # duplicate yields for keys also committed are harmless (the
+            # caller checks handle ownership, not multiplicity)
+            if val is not None and key.startswith(prefix):
                 yield key
 
     def _check_unique(self, meta: TableMeta, datums: list, handle: int, ts: int, old_handle: int | None = None):
@@ -671,9 +692,11 @@ class Session:
             out.append(tablecodec.encode_index_key(meta.table_id, idx.index_id, vals))
         return out
 
-    def _write_indexes(self, meta, datums, handle, ts, delete=False):
+    def _write_indexes(self, meta, datums, handle, delete=False):
         for key in self._index_keys(meta, datums, handle):
-            self.txn.mutations[key] = None if delete else b"\x00"
+            val = None if delete else b"\x00"
+            self.txn.mutations[key] = val
+            self.txn.index_muts[key] = val
 
     def _insert(self, stmt: A.InsertStmt) -> Result:
         meta = self.catalog.table(stmt.table.name)
@@ -727,9 +750,9 @@ class Session:
                 # fetched by its known key (no table scan)
                 old_row = self._read_row(meta, handle, ts)
                 if old_row is not None:
-                    self._write_indexes(meta, old_row, handle, ts, delete=True)
+                    self._write_indexes(meta, old_row, handle, delete=True)
             self._buf_put_row(meta, handle, datums)
-            self._write_indexes(meta, datums, handle, ts)
+            self._write_indexes(meta, datums, handle)
             if not exists:
                 n += 1
                 self.txn.row_delta[meta.table_id] = self.txn.row_delta.get(meta.table_id, 0) + 1
@@ -838,9 +861,9 @@ class Session:
                 # remove+add when the handle changes)
                 self._buf_delete_row(meta, handle)
                 self._lock_rows(meta, [new_handle])
-            self._write_indexes(meta, row, handle, ts, delete=True)
+            self._write_indexes(meta, row, handle, delete=True)
             self._buf_put_row(meta, new_handle, new_row)
-            self._write_indexes(meta, new_row, new_handle, ts)
+            self._write_indexes(meta, new_row, new_handle)
         return Result(affected=len(matched))
 
     def _delete(self, stmt: A.DeleteStmt) -> Result:
@@ -850,7 +873,7 @@ class Session:
         self._lock_rows(meta, [h for h, _ in matched])
         for handle, row in matched:
             self._buf_delete_row(meta, handle)
-            self._write_indexes(meta, row, handle, ts, delete=True)
+            self._write_indexes(meta, row, handle, delete=True)
         self.txn.row_delta[meta.table_id] = self.txn.row_delta.get(meta.table_id, 0) - len(matched)
         return Result(affected=len(matched))
 
@@ -860,7 +883,7 @@ class Session:
         matched = self._scan_rows_with_handles(meta, None, ts)
         for handle, row in matched:
             self._buf_delete_row(meta, handle)
-            self._write_indexes(meta, row, handle, ts, delete=True)
+            self._write_indexes(meta, row, handle, delete=True)
         self.txn.row_delta[meta.table_id] = -meta.row_count
         return Result(affected=len(matched))
 
