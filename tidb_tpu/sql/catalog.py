@@ -113,6 +113,7 @@ class Catalog:
         self._next_id = itertools.count(1001)
         self._lock = threading.Lock()
         self.version = 0  # schema version (ref: domain schema lease)
+        self.stats: dict[int, object] = {}  # table_id -> TableStats (ANALYZE)
 
     def create_table(self, stmt: A.CreateTableStmt) -> TableMeta:
         name = stmt.table.name.lower()

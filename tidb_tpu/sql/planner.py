@@ -802,6 +802,52 @@ def _unify_join_key(pk: Expr, bk: Expr):
     return cast(pk), cast(bk)
 
 
+def range_const_of(ft: FieldType):
+    """Literal -> Datum of the column's type for range building. When the
+    coercion is LOSSY (1.5 rounded to 2 for an int column) the original
+    bound semantics would prune matching rows — decline, the conjunct stays
+    as a plain filter (ref: ranger's points conversion refuses inexact
+    casts)."""
+    from ..expr.eval_ref import compare
+
+    numeric = (DatumKind.Int64, DatumKind.Uint64, DatumKind.Float32, DatumKind.Float64, DatumKind.MysqlDecimal)
+
+    def ev(lit_ast):
+        d = _lower_literal(lit_ast).datum
+        cd = _coerce_datum(d, ft)
+        if d.kind in numeric and cd.kind in numeric and compare(d, cd) != 0:
+            return None
+        return cd
+
+    return ev
+
+
+def estimate_table_rows(meta: TableMeta, conjuncts: list, catalog: Catalog) -> float:
+    """Filtered-cardinality estimate for one table: ANALYZE histograms when
+    available (ref: pkg/statistics Selectivity), else the raw row count.
+    Per-column interval selectivities multiply (independence assumption,
+    as the reference's default without column groups)."""
+    from .ranger import intervals_for_column
+    from .stats import est_selectivity
+
+    tstats = catalog.stats.get(meta.table_id)
+    base = float(tstats.row_count if tstats is not None else meta.row_count)
+    if tstats is None or not conjuncts:
+        return base
+    sel = 1.0
+    for cm in meta.columns:
+        cs = tstats.columns.get(cm.name)
+        if cs is None:
+            continue
+        ivs = intervals_for_column(conjuncts, cm.name, range_const_of(cm.ft))
+        if ivs is None:
+            continue
+        if not ivs:
+            return 0.0
+        sel *= est_selectivity(cs, ivs)
+    return base * sel
+
+
 def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -> PlannedQuery:
     if stmt.from_clause is None:
         raise PlanError("SELECT without FROM is evaluated by the session")
@@ -814,7 +860,29 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
     textual_order = [(meta, alias) for meta, alias, _, _ in flat]  # for SELECT *
     has_left = any(kind == "left" for _, _, kind, _ in flat)
     if not has_left and len(flat) > 1:
-        probe_i = max(range(len(flat)), key=lambda i: flat[i][0].row_count)
+        # probe = table with the LARGEST estimated post-filter cardinality
+        # (build sides broadcast; ref: physical optimizer's row-count-driven
+        # build/probe selection, exhaust_physical_plans.go)
+        tmp_refs, off0 = [], 0
+        for m_, a_, _, _ in flat:
+            tmp_refs.append(_TableRef(m_, a_, off0))
+            off0 += len(m_.columns)
+        tmp_scope = _Scope(tmp_refs)
+        per_alias: dict = {a_: [] for _, a_, _, _ in flat}
+        for c in _split_conjuncts(stmt.where):
+            if isinstance(c, A.SemiJoinCond):
+                continue
+            try:
+                tabs = tmp_scope.tables_of(c)
+            except PlanError:
+                continue
+            if len(tabs) == 1:
+                per_alias[next(iter(tabs))].append(c)
+        est = [
+            estimate_table_rows(m_, per_alias[a_], catalog)
+            for m_, a_, _, _ in flat
+        ]
+        probe_i = max(range(len(flat)), key=lambda i: est[i])
         flat = [flat[probe_i]] + flat[:probe_i] + flat[probe_i + 1 :]
 
     # ---- scope over the combined schema in placement order
@@ -873,25 +941,6 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
     access_path = "table"
     probe_scan = TableScan(probe_meta.table_id, tuple(ColumnInfo(c.col_id, c.ft) for c in probe_meta.columns))
 
-    def _const_of(ft):
-        """Literal -> Datum of the column's type for range building. When
-        the coercion is LOSSY (1.5 rounded to 2 for an int column) the
-        original bound semantics would prune matching rows — decline, the
-        conjunct stays as a plain filter (ref: ranger's points conversion
-        refuses inexact casts)."""
-        from ..expr.eval_ref import compare
-
-        numeric = (DatumKind.Int64, DatumKind.Uint64, DatumKind.Float32, DatumKind.Float64, DatumKind.MysqlDecimal)
-
-        def ev(lit_ast):
-            d = _lower_literal(lit_ast).datum
-            cd = _coerce_datum(d, ft)
-            if d.kind in numeric and cd.kind in numeric and compare(d, cd) != 0:
-                return None
-            return cd
-
-        return ev
-
     if len(trefs) == 1 and probe_meta.indices:
         # covering index: every referenced column lives in the index (or is
         # the handle) AND its first column is range-constrained
@@ -904,7 +953,7 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
             if not referenced <= covered:
                 continue
             first = probe_meta.col(idx.col_names[0])
-            ivs = intervals_for_column(local[probe_alias], first.name, _const_of(first.ft))
+            ivs = intervals_for_column(local[probe_alias], first.name, range_const_of(first.ft))
             if ivs is None:
                 continue
             # entry layout = [index cols..., handle]; the resolution schema
@@ -928,7 +977,7 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
             break
     if access_path == "table" and probe_meta.handle_col is not None:
         hcol = probe_meta.col(probe_meta.handle_col)
-        ivs = intervals_for_column(local[probe_alias], hcol.name, _const_of(hcol.ft))
+        ivs = intervals_for_column(local[probe_alias], hcol.name, range_const_of(hcol.ft))
         if ivs is not None:
             scan_ranges = handle_ranges_from_intervals(probe_meta.table_id, ivs)
             access_path = "table-range"

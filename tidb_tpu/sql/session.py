@@ -287,6 +287,8 @@ class Session:
         if isinstance(stmt, A.DropIndexStmt):
             self._implicit_commit()
             return self._drop_index(stmt)
+        if isinstance(stmt, A.AnalyzeTableStmt):
+            return self._analyze(stmt)
         if isinstance(stmt, A.ShowStmt):
             return self._show(stmt)
         if isinstance(stmt, A.ExplainStmt):
@@ -379,6 +381,9 @@ class Session:
             self._shadow_dirty_tables(stmt.from_clause, rw)
         if stmt.for_update:
             self._select_for_update(stmt)
+        fast = self._try_point_get(stmt, rw)
+        if fast is not None:
+            return fast
         from ..util.memory import MemTracker, QuotaExceeded
 
         plan = plan_select(stmt, self.catalog, mat=rw.mat_dict())
@@ -886,6 +891,163 @@ class Session:
             self._write_indexes(meta, row, handle, delete=True)
         self.txn.row_delta[meta.table_id] = -meta.row_count
         return Result(affected=len(matched))
+
+    def _analyze(self, stmt: A.AnalyzeTableStmt) -> Result:
+        """ANALYZE TABLE: full-scan histogram/TopN/NDV build into the
+        catalog's stats registry (ref: executor/analyze.go driving
+        cophandler/analyze.go collection; exact rather than sampled since
+        the whole column is in-process)."""
+        from .stats import TableStats, build_column_stats
+
+        self._implicit_commit()
+        for t in stmt.tables:
+            meta = self.catalog.table(t.name)
+            ts = self.store.next_ts()
+            rows = [row for _, row in self._scan_rows_with_handles(meta, None, ts)]
+            tstats = TableStats(row_count=len(rows), version=ts)
+            want = {c.lower() for c in stmt.columns} if stmt.columns else None
+            if want is not None:
+                unknown = want - {c.name for c in meta.columns}
+                if unknown:
+                    raise SQLError(f"unknown column {sorted(unknown)[0]!r} in ANALYZE of {meta.name!r}")
+            for i, cm in enumerate(meta.columns):
+                if want is not None and cm.name not in want:
+                    continue
+                tstats.columns[cm.name] = build_column_stats([r[i] for r in rows])
+            self.catalog.stats[meta.table_id] = tstats
+            meta.row_count = len(rows)  # ANALYZE also repairs the stat
+        return Result()
+
+    # ------------------------------------------------------------------
+    def _try_point_get(self, stmt: A.SelectStmt, rw) -> tuple | None:
+        """PointGet/BatchPointGet fast path (ref: pkg/executor/point_get.go,
+        batch_point_get.go; planner TryFastPlan): single real table, WHERE
+        pins the integer primary key to constants -> read rows by key,
+        bypassing distsql/coprocessor entirely."""
+        if (
+            not isinstance(stmt.from_clause, A.TableName)
+            or stmt.group_by or stmt.having is not None or stmt.distinct
+            or stmt.from_clause.name.lower() in rw.bindings
+        ):
+            return None
+        try:
+            meta = self.catalog.table(stmt.from_clause.name)
+        except CatalogError:
+            return None
+        if meta.handle_col is None:
+            return None
+        alias = (stmt.from_clause.alias or meta.name).lower()
+        from .planner import _lower_literal, _split_conjuncts
+
+        conjs = _split_conjuncts(stmt.where)
+        if any(isinstance(c, A.SemiJoinCond) for c in conjs):
+            return None  # decorrelated subquery markers need the full planner
+        handles: list | None = None
+        rest: list = []
+        for c in conjs:
+            got = None
+            if isinstance(c, A.BinaryOp) and c.op == "eq":
+                for lhs, rhs in ((c.left, c.right), (c.right, c.left)):
+                    if (
+                        isinstance(lhs, A.ColumnName)
+                        and lhs.name.lower() == meta.handle_col
+                        and (not lhs.table or lhs.table.lower() == alias)
+                        and isinstance(rhs, A.Literal) and rhs.kind in ("int", "datum")
+                    ):
+                        d = _lower_literal(rhs).datum
+                        if not d.is_null() and isinstance(d.val, int):
+                            got = [int(d.val)]
+                        break
+            elif (
+                isinstance(c, A.InList) and not c.negated
+                and isinstance(c.expr, A.ColumnName)
+                and c.expr.name.lower() == meta.handle_col
+                and (not c.expr.table or c.expr.table.lower() == alias)
+                and all(isinstance(i, A.Literal) and i.kind in ("int", "datum") for i in c.items)
+            ):
+                ds = [_lower_literal(i).datum for i in c.items]
+                if all(not d.is_null() and isinstance(d.val, int) for d in ds):
+                    got = sorted({int(d.val) for d in ds})
+            if got is not None:
+                handles = got if handles is None else [h for h in handles if h in set(got)]
+            else:
+                rest.append(c)
+        if handles is None:
+            return None
+        # any aggregate/window in the select list leaves the fast path
+        from .planner import _has_agg, _has_window
+
+        for f in stmt.fields:
+            e = f.expr if isinstance(f, A.SelectField) else f
+            if not isinstance(e, A.Star) and (_has_agg(e) or _has_window(e)):
+                return None
+        ts = self._read_ts()
+        rows = []
+        for h in handles:
+            row = self._read_row(meta, h, ts)
+            if row is not None:
+                rows.append(row)
+        scope = _Scope([_TableRef(meta, alias, 0)])
+        lw = _Lowerer(scope)
+        ev = RefEvaluator()
+        if rest:
+            conds = [lw.lower_base(c) for c in rest]
+            rows = [r for r in rows if all(_truth(ev.eval(c, r)) for c in conds)]
+        fields = []
+        for f in stmt.fields:
+            e = f.expr if isinstance(f, A.SelectField) else f
+            if isinstance(e, A.Star):
+                fields.extend(A.SelectField(A.ColumnName(cm.name, alias), cm.name) for cm in meta.columns)
+            else:
+                fields.append(f)
+        aliases = {f.alias.lower(): f.expr for f in fields if isinstance(f, A.SelectField) and f.alias}
+        lw = _Lowerer(scope, aliases)
+        exprs = [lw.lower_base(f.expr) for f in fields]
+        out = [[ev.eval(e, r) for e in exprs] for r in rows]
+        if stmt.order_by:
+            import functools
+
+            from ..expr.eval_ref import compare
+
+            def positional(e):
+                # ORDER BY 2 = select-list ordinal (matches the planner)
+                if isinstance(e, A.Literal) and e.kind == "int":
+                    i = int(e.value)
+                    if not (1 <= i <= len(fields)):
+                        raise SQLError(f"ORDER BY position {i} out of range")
+                    return fields[i - 1].expr
+                return e
+
+            items = [(lw.lower_base(positional(b.expr)), b.desc) for b in stmt.order_by]
+            # ORDER BY evaluates against the source row, so sort pairs
+            paired = list(zip(rows, out))
+
+            def cmp2(x, y):
+                for e, desc in items:
+                    a, b = ev.eval(e, x[0]), ev.eval(e, y[0])
+                    if a.is_null() and b.is_null():
+                        continue
+                    c = -1 if a.is_null() else (1 if b.is_null() else compare(a, b))
+                    if c:
+                        return -c if desc else c
+                return 0
+
+            paired.sort(key=functools.cmp_to_key(cmp2))
+            out = [o for _, o in paired]
+        if stmt.limit is not None:
+            def _n(e, dflt):
+                if e is None:
+                    return dflt
+                if isinstance(e, A.Literal):
+                    return int(e.value)
+                return int(e)
+
+            off = _n(stmt.limit.offset, 0)
+            out = out[off : off + _n(stmt.limit.count, len(out))]
+        from .planner import _field_label
+
+        names = [_field_label(f) for f in fields]
+        return names, [e.ft for e in exprs], out
 
     # ------------------------------------------------------------------
     def _show(self, stmt) -> Result:
