@@ -182,7 +182,8 @@ class Catalog:
                 if if_exists:
                     return
                 raise CatalogError(f"unknown table {name!r}")
-            del self._tables[name.lower()]
+            meta = self._tables.pop(name.lower())
+            self.stats.pop(meta.table_id, None)
             self.version += 1
 
     def table_by_id(self, table_id: int) -> TableMeta | None:

@@ -377,13 +377,16 @@ class Session:
             rw.rewrite_select(stmt)
         except SubqueryError as exc:
             raise SQLError(str(exc)) from exc
-        if self.txn is not None and self.txn.row_ops:
-            self._shadow_dirty_tables(stmt.from_clause, rw)
         if stmt.for_update:
             self._select_for_update(stmt)
+        # the fast path's _read_row already overlays the txn buffer, so it
+        # runs BEFORE dirty-table shadowing (which would materialize the
+        # whole table just to read one key)
         fast = self._try_point_get(stmt, rw)
         if fast is not None:
             return fast
+        if self.txn is not None and self.txn.row_ops:
+            self._shadow_dirty_tables(stmt.from_clause, rw)
         from ..util.memory import MemTracker, QuotaExceeded
 
         plan = plan_select(stmt, self.catalog, mat=rw.mat_dict())
@@ -927,7 +930,7 @@ class Session:
         if (
             not isinstance(stmt.from_clause, A.TableName)
             or stmt.group_by or stmt.having is not None or stmt.distinct
-            or stmt.from_clause.name.lower() in rw.bindings
+            or stmt.from_clause.name.lower() in rw.mat_dict()
         ):
             return None
         try:

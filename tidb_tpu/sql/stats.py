@@ -121,6 +121,8 @@ def est_interval_rows(cs: ColumnStats, iv: Interval) -> float:
         and iv.low_inc and iv.high_inc and compare(iv.low, iv.high) == 0
     )
     if is_point:
+        if any(compare(d, iv.low) == 0 for d, _ in cs.topn):
+            return hit  # TopN answers exactly; buckets exclude TopN values
         # equality not answered by TopN: avg rows-per-distinct of the
         # containing bucket (ref: histogram.go equalRowCount)
         for b in cs.buckets:

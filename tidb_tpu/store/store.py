@@ -148,19 +148,60 @@ class TPUStore:
             return cached
         fts = [c.ft for c in scan.columns]
         fts_by_id = {c.col_id: c.ft for c in scan.columns}
-        rows = []
+        ch = None
+        from ..exec.dag import IndexScan
+
+        if not isinstance(scan, IndexScan):
+            ch = self._native_region_chunk(region, ranges, scan, start_ts)
+        if ch is None:
+            rows = []
+            for key, val in self._scan_region_kvs(region, ranges, start_ts):
+                row = self._decode_row(key, val, scan, fts_by_id)
+                if row is not None:
+                    rows.append(row)
+            ch = Chunk.from_rows(fts, rows)
+        self._chunk_cache[rkey] = ch
+        return ch
+
+    def _scan_region_kvs(self, region: Region, ranges: list, start_ts: int):
+        """(key, value) pairs of region ∩ ranges at the snapshot — the one
+        range-clamping loop both decode paths consume."""
+        for rng in ranges:
+            start = max(rng.start, region.start_key)
+            end = min(rng.end, region.end_key)
+            if start >= end:
+                continue
+            yield from self.kv.scan(start, end, start_ts)
+
+    def _native_region_chunk(self, region: Region, ranges: list, scan, start_ts: int) -> Chunk | None:
+        """C++ scan decode (tidb_tpu/native): rowcodec values -> columns in
+        one call. None on any unsupported shape or decode error — the
+        caller runs the row-at-a-time Python decoder instead."""
+        from .. import native
+
+        if not native.available():
+            return None
+        values: list[bytes] = []
+        handles: list[int] = []
         for rng in ranges:
             start = max(rng.start, region.start_key)
             end = min(rng.end, region.end_key)
             if start >= end:
                 continue
             for key, val in self.kv.scan(start, end, start_ts):
-                row = self._decode_row(key, val, scan, fts_by_id)
-                if row is not None:
-                    rows.append(row)
-        ch = Chunk.from_rows(fts, rows)
-        self._chunk_cache[rkey] = ch
-        return ch
+                try:
+                    _, handle = tablecodec.decode_row_key(key)
+                except ValueError:
+                    continue
+                values.append(val)
+                handles.append(handle)
+        cols = native.decode_rows_columnar(values, handles, scan.columns)
+        if cols is None:
+            return None
+        from ..util import metrics
+
+        metrics.NATIVE_DECODES.inc()
+        return Chunk(cols)
 
     def _decode_row(self, key: bytes, val: bytes, scan, fts_by_id: dict):
         from ..exec.dag import IndexScan
