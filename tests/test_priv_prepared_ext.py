@@ -1,0 +1,139 @@
+"""Prepared statements, privileges, and the extension registry
+(ref: pkg/planner/core/plan_cache.go prepared statements,
+pkg/privilege/privileges, pkg/extension)."""
+
+import pytest
+
+from tidb_tpu.sql.catalog import Catalog
+from tidb_tpu.sql.session import Session, SQLError
+from tidb_tpu.store import TPUStore
+
+
+@pytest.fixture()
+def env():
+    store, cat = TPUStore(), Catalog()
+    root = Session(store, cat)
+    root.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+    root.execute("INSERT INTO t VALUES (1,10),(2,20)")
+    return store, cat, root
+
+
+# ------------------------------------------------------------- prepared
+
+
+def test_prepare_execute_deallocate(env):
+    _, _, s = env
+    s.execute("PREPARE q FROM 'SELECT v FROM t WHERE id = ?'")
+    s.execute("SET @a = 2")
+    assert s.execute("EXECUTE q USING @a").values() == [[20]]
+    s.execute("SET @a = 1")
+    assert s.execute("EXECUTE q USING @a").values() == [[10]]
+    s.execute("DEALLOCATE PREPARE q")
+    with pytest.raises(SQLError):
+        s.execute("EXECUTE q USING @a")
+
+
+def test_prepare_param_count_mismatch(env):
+    _, _, s = env
+    s.execute("PREPARE q FROM 'SELECT v FROM t WHERE id = ? AND v > ?'")
+    s.execute("SET @a = 1")
+    with pytest.raises(SQLError, match="parameters"):
+        s.execute("EXECUTE q USING @a")
+
+
+def test_prepare_dml(env):
+    _, _, s = env
+    s.execute("PREPARE ins FROM 'INSERT INTO t VALUES (?, ?)'")
+    s.execute("SET @i = 5")
+    s.execute("SET @v = 50")
+    s.execute("EXECUTE ins USING @i, @v")
+    assert s.execute("SELECT v FROM t WHERE id = 5").values() == [[50]]
+
+
+def test_prepare_template_reusable(env):
+    _, _, s = env
+    s.execute("PREPARE q FROM 'SELECT count(*) FROM t WHERE v >= ?'")
+    for val, want in ((10, 2), (15, 1), (99, 0)):
+        s.execute(f"SET @x = {val}")
+        assert s.execute("EXECUTE q USING @x").values() == [[want]]
+
+
+# ------------------------------------------------------------- privileges
+
+
+def test_user_lifecycle_and_grants(env):
+    store, cat, root = env
+    root.execute("CREATE USER 'alice' IDENTIFIED BY 'pw'")
+    root.execute("GRANT SELECT ON t TO 'alice'")
+    alice = Session(store, cat)
+    alice.user = "alice"
+    assert alice.execute("SELECT count(*) FROM t").values() == [[2]]
+    with pytest.raises(SQLError, match="INSERT"):
+        alice.execute("INSERT INTO t VALUES (9,90)")
+    root.execute("GRANT INSERT ON t TO 'alice'")
+    alice.execute("INSERT INTO t VALUES (9,90)")
+    root.execute("REVOKE SELECT ON t FROM 'alice'")
+    with pytest.raises(SQLError, match="SELECT"):
+        alice.execute("SELECT 1 FROM t")
+    with pytest.raises(SQLError, match="SUPER"):
+        alice.execute("CREATE USER 'bob'")
+    root.execute("DROP USER 'alice'")
+    with pytest.raises(SQLError):
+        root.execute("DROP USER 'alice'")
+    root.execute("DROP USER IF EXISTS 'alice'")
+
+
+def test_global_and_db_grants(env):
+    store, cat, root = env
+    root.execute("CREATE USER 'carol'")
+    root.execute("GRANT SELECT ON *.* TO 'carol'")
+    carol = Session(store, cat)
+    carol.user = "carol"
+    assert carol.execute("SELECT count(*) FROM t").values() == [[2]]
+    with pytest.raises(SQLError):
+        carol.execute("DROP TABLE t")
+
+
+def test_select_without_from_needs_no_priv(env):
+    store, cat, root = env
+    root.execute("CREATE USER 'dave'")
+    dave = Session(store, cat)
+    dave.user = "dave"
+    assert dave.execute("SELECT 1 + 1").values() == [[2]]
+
+
+# ------------------------------------------------------------- extension
+
+
+def test_extension_function(env):
+    from tidb_tpu.sql.extension import EXTENSIONS
+    from tidb_tpu.types import new_longlong
+
+    _, _, s = env
+    EXTENSIONS.register_function("tri_ple", lambda x: None if x is None else x * 3, new_longlong())
+    try:
+        got = s.execute("SELECT tri_ple(v) FROM t ORDER BY id").values()
+        assert got == [[30], [60]]
+        # inside WHERE too (host-only, root-side evaluation)
+        assert s.execute("SELECT id FROM t WHERE tri_ple(v) = 60").values() == [[2]]
+    finally:
+        EXTENSIONS.unregister_function("tri_ple")
+
+
+def test_extension_function_cannot_shadow_builtin():
+    from tidb_tpu.sql.extension import EXTENSIONS
+
+    with pytest.raises(ValueError):
+        EXTENSIONS.register_function("concat", lambda *a: "")
+
+
+def test_extension_sysvar(env):
+    from tidb_tpu.sql.extension import EXTENSIONS
+    from tidb_tpu.sql.sysvar import DEFINITIONS
+
+    _, _, s = env
+    if "x_custom_flag" not in DEFINITIONS:
+        EXTENSIONS.register_sysvar("x_custom_flag", "default_val")
+    assert s.sysvars.get("x_custom_flag") == "default_val"
+    s.execute("SET x_custom_flag = 'on2'")
+    assert s.sysvars.get("x_custom_flag") == "on2"

@@ -61,13 +61,13 @@ def _has_host_only_op(ex) -> bool:
     """Expressions the device whitelist excludes (the runtime-blocklist
     analog of infer_pushdown.go IsPushDownEnabled): keep them at root where
     the oracle fallback can evaluate them."""
-    from ..expr.ir import ScalarFunc
+    from ..expr.ir import EXTENSION_OPS, ScalarFunc
 
     HOST_ONLY = {"replace"}
 
     def walk(e):
         if isinstance(e, ScalarFunc):
-            if e.op in HOST_ONLY:
+            if e.op in HOST_ONLY or e.op in EXTENSION_OPS:
                 return True
             return any(walk(a) for a in e.args)
         return False

@@ -117,7 +117,16 @@ class RefEvaluator:
         if isinstance(e, Const):
             return e.datum
         assert isinstance(e, ScalarFunc)
-        return getattr(self, f"_op_{e.op}")(e, row)
+        method = getattr(self, f"_op_{e.op}", None)
+        if method is None:
+            from ..expr.ir import EXTENSION_OPS
+
+            if e.op in EXTENSION_OPS:
+                from ..sql.extension import EXTENSIONS
+
+                return EXTENSIONS.call(e.op, self._args(e, row))
+            raise NotImplementedError(f"no reference evaluator for {e.op!r}")
+        return method(e, row)
 
     # -- helpers -------------------------------------------------------------
     def _args(self, e, row):

@@ -48,6 +48,11 @@ SCALAR_OPS = frozenset(
     }
 )
 
+# host-only custom functions added at runtime by the extension registry
+# (ref: pkg/extension custom functions); never device-compiled — the DAG
+# splitter pins expressions containing them to the root side
+EXTENSION_OPS: set = set()
+
 
 class Expr:
     """Base expression node. All nodes expose `.ft` and are hashable."""
@@ -96,7 +101,7 @@ class ScalarFunc(Expr):
     ft: FieldType
 
     def __post_init__(self):
-        if self.op not in SCALAR_OPS:
+        if self.op not in SCALAR_OPS and self.op not in EXTENSION_OPS:
             raise ValueError(f"unknown scalar op {self.op!r}")
 
     def children(self) -> tuple[Expr, ...]:

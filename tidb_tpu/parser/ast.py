@@ -495,6 +495,34 @@ class AnalyzeTableStmt:
 
 
 @dataclass
+class CreateUserStmt:
+    users: list  # [(name, host, password)]
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropUserStmt:
+    users: list  # [(name, host)]
+    if_exists: bool = False
+
+
+@dataclass
+class GrantStmt:
+    privs: list  # ["select", ...] or ["all"]
+    db: str  # "*" = all
+    table: str  # "*" = all
+    users: list  # [(name, host)]
+
+
+@dataclass
+class RevokeStmt:
+    privs: list
+    db: str
+    table: str
+    users: list
+
+
+@dataclass
 class BeginStmt:
     pass
 

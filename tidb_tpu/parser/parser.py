@@ -157,6 +157,10 @@ class Parser:
             return self.update_stmt()
         if kw == "DELETE":
             return self.delete_stmt()
+        if kw == "GRANT":
+            return self.grant_stmt(revoke=False)
+        if kw == "REVOKE":
+            return self.grant_stmt(revoke=True)
         if kw == "CREATE":
             return self.create_stmt()
         if kw == "DROP":
@@ -1078,6 +1082,16 @@ class Parser:
     # ---- DDL ----
     def create_stmt(self):
         self.next()
+        if self.eat_kw("USER"):
+            ine = False
+            if self.eat_kw("IF"):
+                self.expect_kw("NOT")
+                self.expect_kw("EXISTS")
+                ine = True
+            users = [self.user_spec(with_password=True)]
+            while self.eat_op(","):
+                users.append(self.user_spec(with_password=True))
+            return A.CreateUserStmt(users, ine)
         if self.eat_kw("DATABASE", "SCHEMA"):
             ine = False
             if self.eat_kw("IF"):
@@ -1272,6 +1286,15 @@ class Parser:
 
     def drop_stmt(self):
         self.next()
+        if self.eat_kw("USER"):
+            ie = False
+            if self.eat_kw("IF"):
+                self.expect_kw("EXISTS")
+                ie = True
+            users = [self.user_spec()[:2]]
+            while self.eat_op(","):
+                users.append(self.user_spec()[:2])
+            return A.DropUserStmt(users, ie)
         if self.eat_kw("DATABASE", "SCHEMA"):
             ie = False
             if self.eat_kw("IF"):
@@ -1492,6 +1515,58 @@ class Parser:
             t = self.table_name()
             return A.ShowStmt("columns", table=t)
         return A.ExplainStmt(self.statement(), analyze, fmt)
+
+    def user_spec(self, with_password: bool = False):
+        """'name'[@'host'] [IDENTIFIED BY 'pw'] -> (name, host[, password])."""
+        t = self.next()
+        name = t.text
+        host = "%"
+        if self.eat_op("@"):
+            host = self.next().text
+        if not with_password:
+            return (name, host, None)
+        pw = ""
+        if self.eat_kw("IDENTIFIED"):
+            self.expect_kw("BY")
+            pw = self.next().text
+        return (name, host, pw)
+
+    def grant_stmt(self, revoke: bool):
+        """GRANT/REVOKE priv[, priv] ON [db.]tbl TO/FROM user[, user]
+        (ref: parser.y GrantStmt — the subset privilege checks use)."""
+        self.next()
+        privs = []
+        while True:
+            if self.eat_kw("ALL"):
+                self.eat_kw("PRIVILEGES")
+                privs.append("all")
+            else:
+                kw = self.next().text.lower()
+                privs.append(kw)
+            if not self.eat_op(","):
+                break
+        self.expect_kw("ON")
+        db = table = "*"
+        if self.at_op("*"):
+            self.next()
+            if self.eat_op("."):
+                self.expect_op("*")
+        else:
+            first = self.ident()
+            if self.eat_op("."):
+                db = first
+                if self.at_op("*"):
+                    self.next()
+                else:
+                    table = self.ident()
+            else:
+                table = first
+        self.expect_kw("FROM" if revoke else "TO")
+        users = [self.user_spec()[:2]]
+        while self.eat_op(","):
+            users.append(self.user_spec()[:2])
+        node = A.RevokeStmt if revoke else A.GrantStmt
+        return node(privs, db, table, users)
 
     def analyze_stmt(self) -> A.AnalyzeTableStmt:
         self.next()

@@ -58,13 +58,18 @@ class Connection:
         self.io.write(P.handshake_v10(self.conn_id, salt))
         resp = P.parse_handshake_response(self.io.read())
         user = resp["user"]
-        stored = self.server.users.get(user)
-        if stored is None and self.server.users:
+        if self.server.users:
+            stored = self.server.users.get(user)  # explicit override map
+        else:
+            # CREATE USER records (ref: privilege cache feeding auth)
+            stored = self.server.catalog.privileges.password_of(user)
+        if stored is None:
             self.io.write(P.err_packet(1045, f"Access denied for user '{user}'", "28000"))
             return False
-        if not P.check_auth(stored or b"", salt, resp["auth"]):
+        if not P.check_auth(stored, salt, resp["auth"]):
             self.io.write(P.err_packet(1045, f"Access denied for user '{user}'", "28000"))
             return False
+        self.session.user = user.lower()
         self.io.write(P.ok_packet(status=self._status()))
         return True
 

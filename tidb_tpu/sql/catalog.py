@@ -114,6 +114,9 @@ class Catalog:
         self._lock = threading.Lock()
         self.version = 0  # schema version (ref: domain schema lease)
         self.stats: dict[int, object] = {}  # table_id -> TableStats (ANALYZE)
+        from .privilege import PrivilegeStore
+
+        self.privileges = PrivilegeStore()  # domain-level user/priv cache
 
     def create_table(self, stmt: A.CreateTableStmt) -> TableMeta:
         name = stmt.table.name.lower()

@@ -1,0 +1,88 @@
+"""Extension registry: custom scalar functions and system variables without
+touching core (ref: pkg/extension — WithCustomSysVariables manifest.go:38,
+WithCustomFunctions manifest.go:52; SURVEY §2.1 names this as the hook the
+TPU feature gate itself would use in the reference).
+
+Custom functions run host-side: the planner lowers them to IR ops, the
+row-at-a-time evaluator dispatches to the registered Python callable, and
+the DAG splitter keeps any expression containing one on the root side
+(where the oracle fallback executes), exactly like a non-pushdown-able
+builtin behind the pushdown blocklist (infer_pushdown.go IsPushDownEnabled).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..expr import ir
+from ..types import Datum, DatumKind, FieldType, MyDecimal, new_double, new_longlong, new_varchar
+
+
+@dataclass
+class CustomFunction:
+    name: str
+    fn: object  # (*python values | None) -> python value | None
+    ft: FieldType
+
+
+class ExtensionRegistry:
+    def __init__(self):
+        self.functions: dict[str, CustomFunction] = {}
+
+    def register_function(self, name: str, fn, result_ft: FieldType | None = None):
+        """Register a host-evaluated scalar function usable from SQL.
+        `fn` receives plain Python values (None for NULL) and returns one;
+        the result type defaults to VARCHAR unless given."""
+        name = name.lower()
+        if name in ir.SCALAR_OPS:
+            raise ValueError(f"{name!r} is a builtin and cannot be overridden")
+        cf = CustomFunction(name, fn, result_ft or new_varchar(255))
+        self.functions[name] = cf
+        ir.EXTENSION_OPS.add(name)
+        return cf
+
+    def register_sysvar(self, name: str, default: str, validator=None, scope: str = "both"):
+        """Register a custom system variable (ref: WithCustomSysVariables)."""
+        from .sysvar import DEFINITIONS, SysVar
+
+        name = name.lower()
+        if name in DEFINITIONS:
+            raise ValueError(f"sysvar {name!r} already defined")
+        sv = SysVar(name, default, scope, validator)
+        DEFINITIONS[name] = sv
+        return sv
+
+    def unregister_function(self, name: str):
+        self.functions.pop(name.lower(), None)
+        ir.EXTENSION_OPS.discard(name.lower())
+
+    def call(self, name: str, datums: list) -> Datum:
+        cf = self.functions[name.lower()]
+        args = [None if d.is_null() else _plain(d) for d in datums]
+        out = cf.fn(*args)
+        return _to_datum(out, cf.ft)
+
+
+def _plain(d: Datum):
+    if d.kind == DatumKind.MysqlDecimal:
+        return d.val  # MyDecimal is a fine Python value
+    return d.val
+
+
+def _to_datum(v, ft: FieldType) -> Datum:
+    if v is None:
+        return Datum.NULL
+    if isinstance(v, bool):
+        return Datum.i64(int(v))
+    if isinstance(v, int):
+        return Datum.u64(v) if ft.is_unsigned() else Datum.i64(v)
+    if isinstance(v, float):
+        return Datum.f64(v)
+    if isinstance(v, MyDecimal):
+        return Datum.dec(v)
+    if isinstance(v, bytes):
+        return Datum.bytes_(v)
+    return Datum.string(str(v))
+
+
+EXTENSIONS = ExtensionRegistry()

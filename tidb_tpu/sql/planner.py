@@ -405,6 +405,13 @@ class _Lowerer:
             else:
                 ft = new_longlong()
             return func(name, ft, *args)
+        from .extension import EXTENSIONS
+
+        cf = EXTENSIONS.functions.get(name)
+        if cf is not None:
+            # custom host function: lowered like a builtin, pinned to the
+            # root side by the DAG splitter (extension.py module doc)
+            return func(name, cf.ft, *args)
         raise PlanError(f"function {n.name!r} not supported yet")
 
     # -- base lowering --------------------------------------------------------

@@ -88,6 +88,33 @@ class SQLError(ValueError):
     pass
 
 
+def _referenced_tables(stmt) -> set:
+    """Table names referenced anywhere in a statement (conservative walk:
+    CTE names that shadow real catalog tables still show up and still get
+    checked — the CTE body may read the real table; names that match no
+    catalog table are skipped by the caller)."""
+    names: set = set()
+
+    def walk(n):
+        if isinstance(n, A.TableName):
+            names.add(n.name.lower())
+            return
+        if not hasattr(n, "__dataclass_fields__"):
+            return
+        for f_ in n.__dataclass_fields__:
+            v = getattr(n, f_)
+            for it in v if isinstance(v, (list, tuple)) else [v]:
+                if isinstance(it, tuple):
+                    for x in it:
+                        if hasattr(x, "__dataclass_fields__"):
+                            walk(x)
+                elif hasattr(it, "__dataclass_fields__"):
+                    walk(it)
+
+    walk(stmt)
+    return names
+
+
 class Session:
     """One client session over an embedded store. Multiple sessions may
     share a store+catalog (pass them in) — the testkit pattern
@@ -102,6 +129,9 @@ class Session:
         self.txn: TxnState | None = None
         self.sysvars = SysVarStore()
         self.user_vars: dict[str, object] = {}
+        self.user = "root"  # authenticated user (the server sets this)
+        self.db = "test"  # the single implicit database
+        self.prepared: dict[str, object] = {}  # PREPARE name -> AST template
         if config is not None:
             # instance config seeds session sysvars (ref: setGlobalVars
             # bridging config -> sysvar defaults, cmd/tidb-server/main.go:654)
@@ -229,8 +259,49 @@ class Session:
         return self.execute_stmt(stmt)
 
     def execute_stmt(self, stmt) -> Result:
+        self._check_privileges(stmt)
         if isinstance(stmt, (A.SelectStmt, A.SetOprStmt, A.UpdateStmt, A.DeleteStmt, A.InsertStmt)):
             self._substitute_vars(stmt)
+        if isinstance(stmt, A.PrepareStmt):
+            # validate now; EXECUTE deep-copies the template per run (the
+            # rewrite passes mutate ASTs; ref: plan_cache.go prepared-stmt
+            # cache — the XLA ProgramCache is the compiled-plan layer here)
+            self.prepared[stmt.name.lower()] = parse_one(stmt.sql)
+            return Result()
+        if isinstance(stmt, A.ExecuteStmt):
+            return self._execute_prepared(stmt)
+        if isinstance(stmt, A.DeallocateStmt):
+            if self.prepared.pop(stmt.name.lower(), None) is None:
+                raise SQLError(f"unknown prepared statement {stmt.name!r}")
+            return Result()
+        if isinstance(stmt, A.CreateUserStmt):
+            from .privilege import PrivilegeError
+
+            try:
+                for name, host, pw in stmt.users:
+                    self.catalog.privileges.create_user(name, host, pw, stmt.if_not_exists)
+            except PrivilegeError as exc:
+                raise SQLError(str(exc)) from exc
+            return Result()
+        if isinstance(stmt, A.DropUserStmt):
+            from .privilege import PrivilegeError
+
+            try:
+                for name, host in stmt.users:
+                    self.catalog.privileges.drop_user(name, host, stmt.if_exists)
+            except PrivilegeError as exc:
+                raise SQLError(str(exc)) from exc
+            return Result()
+        if isinstance(stmt, (A.GrantStmt, A.RevokeStmt)):
+            from .privilege import PrivilegeError
+
+            op = self.catalog.privileges.revoke if isinstance(stmt, A.RevokeStmt) else self.catalog.privileges.grant
+            try:
+                for name, host in stmt.users:
+                    op(stmt.privs, stmt.db, stmt.table, name, host)
+            except PrivilegeError as exc:
+                raise SQLError(str(exc)) from exc
+            return Result()
         if isinstance(stmt, A.SelectStmt):
             return self._select(stmt)
         if isinstance(stmt, A.SetOprStmt):
@@ -295,6 +366,124 @@ class Session:
             return self._explain(stmt)
         raise SQLError(f"statement {type(stmt).__name__} not supported yet")
 
+    @staticmethod
+    def _value_literal(val) -> A.Literal:
+        """Python value (user var / param) -> literal AST node."""
+        if val is None:
+            return A.Literal(None, "null")
+        s = str(val)
+        try:
+            return A.Literal(int(s), "int")
+        except ValueError:
+            return A.Literal(s, "str")
+
+    def _execute_prepared(self, stmt: A.ExecuteStmt) -> Result:
+        """EXECUTE name [USING @a, @b]: deep-copy the template, bind
+        parameter markers from user variables (ref: executor/prepared.go)."""
+        import copy
+
+        tpl = self.prepared.get(stmt.name.lower())
+        if tpl is None:
+            raise SQLError(f"unknown prepared statement {stmt.name!r}")
+        ast2 = copy.deepcopy(tpl)
+        params = [self._value_literal(self.user_vars.get(v.lower())) for v in stmt.using]
+        n_used = self._bind_params(ast2, params)
+        if n_used != len(params):
+            raise SQLError(
+                f"prepared statement {stmt.name!r} expects {n_used} parameters, got {len(params)}"
+            )
+        return self.execute_stmt(ast2)
+
+    def _bind_params(self, node, params: list) -> int:
+        """Replace ParamMarker nodes with the bound literals; returns the
+        number of markers seen."""
+        count = [0]
+
+        def sub(x):
+            if isinstance(x, A.ParamMarker):
+                i = count[0]
+                count[0] += 1
+                if i >= len(params):
+                    return A.Literal(None, "null")
+                return params[i]
+            return None
+
+        def walk_seq(v):
+            for i, it in enumerate(v):
+                if isinstance(it, A.ParamMarker):
+                    v[i] = sub(it)
+                elif isinstance(it, list):
+                    walk_seq(it)
+                elif isinstance(it, tuple):
+                    v[i] = tuple(sub(x) if isinstance(x, A.ParamMarker) else x for x in it)
+                    for x in v[i]:
+                        if hasattr(x, "__dataclass_fields__"):
+                            walk(x)
+                elif hasattr(it, "__dataclass_fields__"):
+                    walk(it)
+
+        def walk(n):
+            if not hasattr(n, "__dataclass_fields__"):
+                return
+            for f_ in n.__dataclass_fields__:
+                v = getattr(n, f_)
+                if isinstance(v, A.ParamMarker):
+                    setattr(n, f_, sub(v))
+                elif hasattr(v, "__dataclass_fields__"):
+                    walk(v)
+                elif isinstance(v, list):
+                    walk_seq(v)
+
+        walk(node)
+        return count[0]
+
+    _PRIV_OF = {
+        "InsertStmt": "insert", "UpdateStmt": "update", "DeleteStmt": "delete",
+        "CreateTableStmt": "create", "DropTableStmt": "drop",
+        "TruncateTableStmt": "drop", "CreateIndexStmt": "index",
+        "DropIndexStmt": "index", "AlterTableStmt": "alter",
+    }
+
+    def _check_privileges(self, stmt):
+        """(ref: privileges.RequestVerification called from the optimizer/
+        executor adapters). Superusers skip; table scope is the statement's
+        target (SELECT checks every referenced table)."""
+        privs = self.catalog.privileges
+        if privs.is_super(self.user):
+            return
+        kind = type(stmt).__name__
+        if kind in ("GrantStmt", "RevokeStmt", "CreateUserStmt", "DropUserStmt"):
+            raise SQLError(f"access denied: {self.user!r} needs SUPER")
+        def check_read(names, exclude=()):
+            for tname in names:
+                if tname in exclude:
+                    continue
+                try:
+                    self.catalog.table(tname)
+                except CatalogError:
+                    continue  # CTE/derived alias, not a real table
+                if not privs.check(self.user, "select", tname, db=self.db):
+                    raise SQLError(f"access denied: {self.user!r} needs SELECT on {tname!r}")
+
+        need = self._PRIV_OF.get(kind)
+        if need is not None:
+            t = getattr(stmt, "table", None)
+            tname = t.name.lower() if isinstance(t, A.TableName) else "*"
+            if kind == "DropTableStmt":
+                for t2 in stmt.tables:
+                    if not privs.check(self.user, "drop", t2.name, db=self.db):
+                        raise SQLError(f"access denied: {self.user!r} needs DROP on {t2.name!r}")
+                return
+            if not privs.check(self.user, need, tname, db=self.db):
+                raise SQLError(f"access denied: {self.user!r} needs {need.upper()} on {tname!r}")
+            # writes that read other tables (INSERT...SELECT, subqueries in
+            # UPDATE/DELETE predicates) also need SELECT on the sources
+            if kind in ("InsertStmt", "UpdateStmt", "DeleteStmt"):
+                check_read(_referenced_tables(stmt), exclude={tname})
+            return
+        if kind in ("SelectStmt", "SetOprStmt", "AnalyzeTableStmt"):
+            check_read(_referenced_tables(stmt))
+
     def _substitute_vars(self, node):
         """Rewrite @x / @@sysvar references to literals in place
         (ref: expression rewriter's variable substitution)."""
@@ -304,12 +493,7 @@ class Session:
                 val = self.sysvars.get(v.name)
             else:
                 val = self.user_vars.get(v.name.lower())
-            if val is None:
-                return A.Literal(None, "null")
-            s = str(val)
-            if s.lstrip("-").isdigit():
-                return A.Literal(int(s), "int")
-            return A.Literal(s, "str")
+            return self._value_literal(val)
 
         for f_ in getattr(node, "__dataclass_fields__", {}):
             v = getattr(node, f_)
