@@ -1,0 +1,156 @@
+"""Ecosystem tools: dump (Dumpling analog), LOAD DATA (Lightning analog
+with resumable checkpoints), BACKUP/RESTORE (BR analog with checksums)
+(ref: dumpling/export, pkg/lightning, br/pkg)."""
+
+import json
+import os
+
+import pytest
+
+from tidb_tpu.sql.catalog import Catalog
+from tidb_tpu.sql.session import Session, SQLError
+from tidb_tpu.store import TPUStore
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT, name VARCHAR(16))")
+    s.execute("CREATE UNIQUE INDEX uv ON t (v)")
+    s.execute("INSERT INTO t VALUES (1,10,'a'),(2,20,'b,c'),(3,NULL,NULL)")
+    return s
+
+
+# ---------------------------------------------------------------- dump
+
+
+def test_dump_csv(sess, tmp_path):
+    from tidb_tpu.tools import dump_table
+
+    out = dump_table(sess, "t", str(tmp_path), fmt="csv")
+    assert out["rows"] == 3
+    lines = open(out["data_path"]).read().splitlines()
+    assert lines[0] == "id,v,name"
+    assert lines[2] == '2,20,"b,c"'  # quoting
+    assert lines[3] == "3,\\N,\\N"  # nulls
+    schema = open(out["schema_path"]).read()
+    assert "PRIMARY KEY" in schema and "UNIQUE KEY `uv`" in schema
+
+
+def test_dump_sql_reimportable(sess, tmp_path):
+    from tidb_tpu.tools import dump_table
+
+    out = dump_table(sess, "t", str(tmp_path), fmt="sql")
+    s2 = Session()
+    s2.execute(open(out["schema_path"]).read().rstrip().rstrip(";"))
+    for stmt in open(out["data_path"]).read().split(";\n"):
+        if stmt.strip():
+            s2.execute(stmt)
+    assert s2.execute("SELECT count(*) FROM t").values() == [[3]]
+    assert s2.execute("SELECT name FROM t WHERE id = 2").values() == [["b,c"]]
+
+
+def test_dump_all_consistent_snapshot(sess, tmp_path):
+    from tidb_tpu.tools import dump_all
+
+    sess.execute("CREATE TABLE u (id INT PRIMARY KEY)")
+    sess.execute("INSERT INTO u VALUES (1)")
+    out = dump_all(sess, str(tmp_path))
+    assert set(out) == {"t", "u"}
+
+
+# ---------------------------------------------------------------- load data
+
+
+def test_load_data_basic(sess, tmp_path):
+    p = tmp_path / "rows.tsv"
+    p.write_text("4\t40\td\n5\t50\te\n6\t\\N\t\\N\n")
+    r = sess.execute(f"LOAD DATA INFILE '{p}' INTO TABLE t")
+    assert r.affected == 3
+    assert sess.execute("SELECT count(*) FROM t").values() == [[6]]
+    assert sess.execute("SELECT v, name FROM t WHERE id = 6").values() == [[None, None]]
+    assert not os.path.exists(str(p) + ".ckpt")
+
+
+def test_load_data_checkpoint_resume(sess, tmp_path):
+    p = tmp_path / "rows.tsv"
+    p.write_text("\n".join(f"{i}\t{i * 10}\tr{i}" for i in range(10, 20)) + "\n")
+    # simulate a prior partial run: checkpoint says 4 rows are durable
+    (tmp_path / "rows.tsv.ckpt").write_text("4")
+    # make those 4 rows actually exist (as the crashed run would have left)
+    sess.execute("INSERT INTO t VALUES (10,100,'r10'),(11,110,'r11'),(12,120,'r12'),(13,130,'r13')")
+    r = sess.execute(f"LOAD DATA INFILE '{p}' INTO TABLE t")
+    assert r.affected == 6  # only the tail imports
+    assert sess.execute("SELECT count(*) FROM t WHERE id >= 10").values() == [[10]]
+
+
+def test_load_data_duplicate_pk_fails(sess, tmp_path):
+    p = tmp_path / "dup.tsv"
+    p.write_text("1\t999\tx\n")
+    with pytest.raises(SQLError, match="duplicate"):
+        sess.execute(f"LOAD DATA INFILE '{p}' INTO TABLE t")
+
+
+def test_load_data_indexes_maintained(sess, tmp_path):
+    p = tmp_path / "rows.tsv"
+    p.write_text("7\t70\tg\n")
+    sess.execute(f"LOAD DATA INFILE '{p}' INTO TABLE t")
+    # unique index uv must now see 70
+    with pytest.raises(SQLError, match="duplicate"):
+        sess.execute("INSERT INTO t VALUES (99, 70, 'clash')")
+
+
+# ---------------------------------------------------------------- backup/restore
+
+
+def test_backup_restore_roundtrip(sess, tmp_path):
+    bdir = str(tmp_path / "bk")
+    r = sess.execute(f"BACKUP DATABASE * TO '{bdir}'")
+    assert r.columns == ["Destination", "Keys", "SnapshotTS"]
+    store2, cat2 = TPUStore(), Catalog()
+    s2 = Session(store2, cat2)
+    r2 = s2.execute(f"RESTORE DATABASE * FROM '{bdir}'")
+    assert r2.values()[0][2] == 1  # one table
+    assert s2.execute("SELECT id, v, name FROM t ORDER BY id").values() == \
+        sess.execute("SELECT id, v, name FROM t ORDER BY id").values()
+    # index + autoid survive
+    assert s2.execute("SELECT id FROM t WHERE v = 20").values() == [[2]]
+    s2.execute("INSERT INTO t (v, name) VALUES (77, 'new')")
+    assert s2.execute("SELECT max(id) FROM t").values() == [[4]]
+
+
+def test_restore_rejects_existing_table(sess, tmp_path):
+    bdir = str(tmp_path / "bk")
+    sess.execute(f"BACKUP DATABASE * TO '{bdir}'")
+    with pytest.raises(Exception, match="already exists"):
+        sess.execute(f"RESTORE DATABASE * FROM '{bdir}'")
+
+
+def test_restore_detects_corruption(sess, tmp_path):
+    bdir = tmp_path / "bk"
+    sess.execute(f"BACKUP DATABASE * TO '{bdir}'")
+    seg = json.load(open(bdir / "manifest.json"))["segments"][0]["file"]
+    data = bytearray((bdir / seg).read_bytes())
+    data[-1] ^= 0xFF
+    (bdir / seg).write_bytes(bytes(data))
+    s2 = Session(TPUStore(), Catalog())
+    with pytest.raises(Exception, match="checksum"):
+        s2.execute(f"RESTORE DATABASE * FROM '{bdir}'")
+
+
+def test_backup_resume_skips_valid_segments(sess, tmp_path):
+    from tidb_tpu.tools import backup
+
+    bdir = str(tmp_path / "bk")
+    m1 = backup(sess.store, sess.catalog, bdir)
+    m2 = backup(sess.store, sess.catalog, bdir)  # second run: resume path
+    assert [s["sha256"] for s in m1["segments"]] == [s["sha256"] for s in m2["segments"]]
+
+
+def test_brie_requires_super(sess, tmp_path):
+    sess.execute("CREATE USER 'u'")
+    store, cat = sess.store, sess.catalog
+    u = Session(store, cat)
+    u.user = "u"
+    with pytest.raises(SQLError, match="SUPER"):
+        u.execute(f"BACKUP DATABASE * TO '{tmp_path}/x'")

@@ -1618,7 +1618,9 @@ class Parser:
             while self.eat_op(","):
                 tables.append(self.table_name())
         elif self.eat_kw("DATABASE", "SCHEMA"):
-            if not self.at_kw("TO", "FROM"):
+            if self.eat_op("*"):
+                pass  # BACKUP DATABASE * = full backup
+            elif not self.at_kw("TO", "FROM"):
                 db = self.ident()
                 tables.append(A.TableName("*", db))
         if kind == "backup":

@@ -9,7 +9,6 @@ through it; the session allocates row handles from its per-table autoid
 
 from __future__ import annotations
 
-import itertools
 import threading
 from dataclasses import dataclass, field
 
@@ -81,12 +80,8 @@ class TableMeta:
     columns: list  # [ColumnMeta]
     indices: list = field(default_factory=list)  # [IndexMeta]
     handle_col: str | None = None  # integer PRIMARY KEY column used as row handle
-    _next_handle: itertools.count = None  # autoid allocator (ref: meta/autoid)
+    _next_handle: int = 1  # autoid allocator cursor (ref: meta/autoid)
     row_count: int = 0  # maintained by DML; the planner's only "statistic"
-
-    def __post_init__(self):
-        if self._next_handle is None:
-            self._next_handle = itertools.count(1)
 
     def col(self, name: str) -> ColumnMeta:
         for c in self.columns:
@@ -101,7 +96,18 @@ class TableMeta:
         return [c.ft for c in self.columns]
 
     def alloc_handle(self) -> int:
-        return next(self._next_handle)
+        h = self._next_handle
+        self._next_handle += 1
+        return h
+
+    def peek_handle(self) -> int:
+        return self._next_handle
+
+    def observe_handle(self, h: int):
+        """Explicit-PK inserts advance the allocator past the used value
+        (MySQL auto_increment semantics; ref: meta/autoid rebase)."""
+        if h >= self._next_handle:
+            self._next_handle = h + 1
 
 
 class Catalog:
@@ -110,13 +116,25 @@ class Catalog:
 
     def __init__(self):
         self._tables: dict[str, TableMeta] = {}
-        self._next_id = itertools.count(1001)
+        self._next_id = 1001
         self._lock = threading.Lock()
         self.version = 0  # schema version (ref: domain schema lease)
         self.stats: dict[int, object] = {}  # table_id -> TableStats (ANALYZE)
         from .privilege import PrivilegeStore
 
         self.privileges = PrivilegeStore()  # domain-level user/priv cache
+
+    def _alloc_id(self) -> int:
+        v = self._next_id
+        self._next_id += 1
+        return v
+
+    def ensure_id_above(self, n: int):
+        """Restore installs original table/index ids; the allocator must
+        never hand them out again (ref: meta global id rebase)."""
+        with self._lock:
+            if n >= self._next_id:
+                self._next_id = n + 1
 
     def create_table(self, stmt: A.CreateTableStmt) -> TableMeta:
         name = stmt.table.name.lower()
@@ -149,8 +167,8 @@ class Catalog:
                     raise CatalogError(
                         "non-integer/composite PRIMARY KEY not supported yet (integer handle columns only)"
                     )
-                indices.append(IndexMeta(iname, next(self._next_id), icols, getattr(idx, "unique", False)))
-            tbl = TableMeta(name, next(self._next_id), cols, indices, handle_col)
+                indices.append(IndexMeta(iname, self._alloc_id(), icols, getattr(idx, "unique", False)))
+            tbl = TableMeta(name, self._alloc_id(), cols, indices, handle_col)
             self._tables[name] = tbl
             self.version += 1
             return tbl
@@ -164,7 +182,7 @@ class Catalog:
                 raise CatalogError(f"index {index_name!r} already exists")
             for cn in col_names:
                 tbl.col(cn)  # validates
-            im = IndexMeta(index_name, next(self._next_id), [c.lower() for c in col_names], unique)
+            im = IndexMeta(index_name, self._alloc_id(), [c.lower() for c in col_names], unique)
             tbl.indices.append(im)
             self.version += 1
             return im

@@ -58,15 +58,9 @@ class ExtensionRegistry:
 
     def call(self, name: str, datums: list) -> Datum:
         cf = self.functions[name.lower()]
-        args = [None if d.is_null() else _plain(d) for d in datums]
+        args = [None if d.is_null() else d.val for d in datums]
         out = cf.fn(*args)
         return _to_datum(out, cf.ft)
-
-
-def _plain(d: Datum):
-    if d.kind == DatumKind.MysqlDecimal:
-        return d.val  # MyDecimal is a fine Python value
-    return d.val
 
 
 def _to_datum(v, ft: FieldType) -> Datum:

@@ -85,6 +85,9 @@ class PrivilegeStore:
         with self._lock:
             u = self._record(name, host)
             pset = {p.lower() for p in privs}
+            bad = pset - PRIVS
+            if bad:
+                raise PrivilegeError(f"unknown privilege {sorted(bad)[0]!r}")
             if db == "*" and table == "*":
                 u.global_privs -= pset
             elif table == "*":

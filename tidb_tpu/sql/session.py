@@ -358,6 +358,22 @@ class Session:
         if isinstance(stmt, A.DropIndexStmt):
             self._implicit_commit()
             return self._drop_index(stmt)
+        if isinstance(stmt, A.LoadDataStmt):
+            from ..tools.lightning import load_data
+
+            self._implicit_commit()
+            return Result(affected=load_data(self, stmt))
+        if isinstance(stmt, A.BRIEStmt):
+            from ..tools import backup, restore
+
+            self._implicit_commit()
+            if stmt.kind == "backup":
+                m = backup(self.store, self.catalog, stmt.storage)
+                row = [Datum.string(stmt.storage), Datum.i64(m["total_keys"]), Datum.i64(m["snapshot_ts"])]
+                return Result(columns=["Destination", "Keys", "SnapshotTS"], rows=[row])
+            info = restore(self.store, self.catalog, stmt.storage)
+            row = [Datum.string(stmt.storage), Datum.i64(info["keys"]), Datum.i64(info["tables"])]
+            return Result(columns=["Source", "Keys", "Tables"], rows=[row])
         if isinstance(stmt, A.AnalyzeTableStmt):
             return self._analyze(stmt)
         if isinstance(stmt, A.ShowStmt):
@@ -397,15 +413,18 @@ class Session:
     def _bind_params(self, node, params: list) -> int:
         """Replace ParamMarker nodes with the bound literals; returns the
         number of markers seen."""
-        count = [0]
+        seen = [0]
 
         def sub(x):
             if isinstance(x, A.ParamMarker):
-                i = count[0]
-                count[0] += 1
-                if i >= len(params):
+                # markers carry their LEXICAL position (parser assigns it),
+                # which is the binding order MySQL uses — field traversal
+                # order here may differ (e.g. Limit stores count before
+                # offset)
+                seen[0] = max(seen[0], x.index + 1)
+                if x.index >= len(params):
                     return A.Literal(None, "null")
-                return params[i]
+                return params[x.index]
             return None
 
         def walk_seq(v):
@@ -435,7 +454,7 @@ class Session:
                     walk_seq(v)
 
         walk(node)
-        return count[0]
+        return seen[0]
 
     _PRIV_OF = {
         "InsertStmt": "insert", "UpdateStmt": "update", "DeleteStmt": "delete",
@@ -452,8 +471,12 @@ class Session:
         if privs.is_super(self.user):
             return
         kind = type(stmt).__name__
-        if kind in ("GrantStmt", "RevokeStmt", "CreateUserStmt", "DropUserStmt"):
+        if kind in ("GrantStmt", "RevokeStmt", "CreateUserStmt", "DropUserStmt", "BRIEStmt"):
             raise SQLError(f"access denied: {self.user!r} needs SUPER")
+        if kind == "LoadDataStmt":
+            if not privs.check(self.user, "insert", stmt.table.name, db=self.db):
+                raise SQLError(f"access denied: {self.user!r} needs INSERT on {stmt.table.name!r}")
+            return
         def check_read(names, exclude=()):
             for tname in names:
                 if tname in exclude:
@@ -921,6 +944,7 @@ class Session:
                     d = self._eval_const(c.default, c.ft) if c.default is not None else Datum.NULL
                 if meta.handle_col == c.name and not d.is_null():
                     handle = int(d.val)
+                    meta.observe_handle(handle)
                 datums.append(d)
             if handle is None:
                 handle = meta.alloc_handle()

@@ -1,0 +1,151 @@
+"""Bulk import — the Lightning analog (ref: pkg/lightning local backend:
+parse -> encode KV -> ingest, bypassing the SQL executor; checkpoints
+pkg/lightning/checkpoints keep imports resumable).
+
+`load_data` serves `LOAD DATA INFILE` (session routes LoadDataStmt here):
+CSV-ish lines are parsed, coerced to column types, encoded with rowcodec,
+and written in batches directly to the store (rows + index entries) — each
+batch commits at its own TSO tick and advances a sidecar checkpoint file
+(`<path>.ckpt`), so a crashed import resumes at the last durable batch."""
+
+from __future__ import annotations
+
+import os
+
+from ..codec import tablecodec
+from ..sql.planner import _coerce_datum
+from ..types import Datum
+
+BATCH = 1024
+
+
+def _parse_line(line: str, sep: str, enclosed: str) -> list:
+    """Split one data line (supports the enclosure char and \\N nulls)."""
+    fields = []
+    cur = []
+    i, n = 0, len(line)
+    in_enc = False
+    while i < n:
+        ch = line[i]
+        if in_enc:
+            if ch == enclosed:
+                if i + 1 < n and line[i + 1] == enclosed:
+                    cur.append(enclosed)
+                    i += 1
+                else:
+                    in_enc = False
+            else:
+                cur.append(ch)
+        elif enclosed and ch == enclosed and not cur:
+            in_enc = True
+        elif line.startswith(sep, i):
+            fields.append("".join(cur))
+            cur = []
+            i += len(sep) - 1
+        elif ch == "\\" and i + 1 < n:
+            nxt = line[i + 1]
+            cur.append({"n": "\n", "t": "\t", "N": "\x00NULL"}.get(nxt, nxt))
+            i += 1
+        else:
+            cur.append(ch)
+        i += 1
+    fields.append("".join(cur))
+    return fields
+
+
+def load_data(session, stmt) -> int:
+    """Execute a LoadDataStmt; returns imported row count (resumed rows
+    excluded). Duplicate primary keys fail the batch loudly."""
+    from ..sql.session import SQLError
+
+    meta = session.catalog.table(stmt.table.name)
+    path = stmt.path
+    if not os.path.exists(path):
+        raise SQLError(f"LOAD DATA: file not found: {path!r}")
+    col_names = [c.lower() for c in stmt.columns] or [c.name for c in meta.columns]
+    positions = []
+    for cn in col_names:
+        positions.append(meta.col(cn))
+    ckpt_path = path + ".ckpt"
+    done = 0
+    if os.path.exists(ckpt_path):
+        try:
+            done = int(open(ckpt_path).read().strip() or 0)
+        except ValueError:
+            done = 0
+
+    sep = stmt.fields_terminated or "\t"
+    enc = stmt.fields_enclosed or ""
+    imported = 0
+    batch_rows: list = []
+
+    def flush():
+        nonlocal imported
+        if not batch_rows:
+            return
+        ts = session.store.next_ts()
+        read_ts = session.store.next_ts()
+        # ALL conflict checks before ANY write: a mid-batch duplicate must
+        # not leave half a batch durable below the checkpoint (re-running
+        # would then collide with the crashed run's own rows)
+        for handle, _ in batch_rows:
+            key = tablecodec.encode_row_key(meta.table_id, handle)
+            if session.store.kv.get(key, read_ts) is not None:
+                raise SQLError(f"LOAD DATA: duplicate primary key {handle}")
+        for handle, datums in batch_rows:
+            session.store.put_row(meta.table_id, handle, meta.col_ids(), datums, ts)
+            pos = {c.name: i for i, c in enumerate(meta.columns)}
+            for idx in meta.indices:
+                vals = [datums[pos[cn]] for cn in idx.col_names] + [Datum.i64(handle)]
+                session.store.put_index(
+                    tablecodec.encode_index_key(meta.table_id, idx.index_id, vals), b"\x00", ts
+                )
+        imported += len(batch_rows)
+        batch_rows.clear()
+        # durable progress marker AFTER the batch lands (resume skips it)
+        with open(ckpt_path, "w") as f:
+            f.write(str(done + imported))
+
+    with open(path) as f:
+        lineno = 0
+        data_lineno = 0
+        for raw in f:
+            lineno += 1
+            if lineno <= stmt.ignore_lines:
+                continue
+            line = raw.rstrip("\n").rstrip("\r")
+            if not line:
+                continue
+            data_lineno += 1
+            if data_lineno <= done:
+                continue  # resumed past the checkpoint
+            fields = _parse_line(line, sep, enc)
+            if len(fields) != len(positions):
+                raise SQLError(
+                    f"LOAD DATA: line {lineno} has {len(fields)} fields, expected {len(positions)}"
+                )
+            datums = [Datum.NULL] * len(meta.columns)
+            name_to_i = {c.name: i for i, c in enumerate(meta.columns)}
+            handle = None
+            for cm, text in zip(positions, fields):
+                if text == "\x00NULL" or text == "\\N":
+                    d = Datum.NULL
+                else:
+                    d = _coerce_datum(Datum.string(text), cm.ft)
+                datums[name_to_i[cm.name]] = d
+                if meta.handle_col == cm.name and not d.is_null():
+                    handle = int(d.val)
+                    meta.observe_handle(handle)
+            if handle is None:
+                handle = meta.alloc_handle()
+                if meta.handle_col is not None:
+                    i = name_to_i[meta.handle_col]
+                    datums[i] = Datum.i64(handle)
+            batch_rows.append((handle, datums))
+            if len(batch_rows) >= BATCH:
+                flush()
+    flush()
+    meta.row_count += imported
+    if os.path.exists(ckpt_path):
+        os.remove(ckpt_path)  # complete: clear the resume marker
+    return imported
