@@ -155,6 +155,17 @@ class RowReader:
         return False
 
 
+def fill_origin_default(row_bytes: bytes, col_id: int, default, decoded: Datum) -> Datum:
+    """Pre-ADD-COLUMN rows carry no bytes for the column: fill the origin
+    default unless the row explicitly stored NULL (ref: rowcodec
+    ChunkDecoder default fill; shared by the scan and point-read paths)."""
+    if default is None or not decoded.is_null():
+        return decoded
+    if RowReader(row_bytes).is_null(col_id):
+        return decoded
+    return default
+
+
 def decode_row_to_datum_map(b: bytes, fts_by_id: dict[int, FieldType]) -> dict[int, Datum]:
     r = RowReader(b)
     out = {}

@@ -19,13 +19,18 @@ from ..types import FieldType
 
 @dataclass(frozen=True)
 class ColumnInfo:
-    """(ref: tipb.ColumnInfo — column id + type as the scan emits it)."""
+    """(ref: tipb.ColumnInfo — column id + type as the scan emits it;
+    `default` mirrors tipb's default_val: rows written before an ADD
+    COLUMN have no bytes for the column, and the scan fills this origin
+    default instead of NULL)."""
 
     col_id: int
     ft: FieldType
+    default: object = None  # Datum | None
 
     def fingerprint(self):
-        return (self.col_id, self.ft.tp, int(self.ft.flag), self.ft.flen, self.ft.decimal)
+        d = None if self.default is None else repr(self.default)
+        return (self.col_id, self.ft.tp, int(self.ft.flag), self.ft.flen, self.ft.decimal, d)
 
 
 @dataclass(frozen=True)

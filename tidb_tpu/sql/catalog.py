@@ -12,6 +12,10 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
+# handle/col-id allocations are tiny critical sections; one module lock
+# keeps TableMeta a plain dataclass (ref: meta/autoid's own mutex)
+_ALLOC_LOCK = threading.Lock()
+
 from ..parser import ast as A
 from ..types import Collation, FieldType, Flag, TypeCode, new_datetime, new_decimal, new_double, new_longlong, new_varchar
 
@@ -61,6 +65,8 @@ class ColumnMeta:
     ft: FieldType
     default: object = None  # parsed AST default, evaluated at insert
     auto_increment: bool = False
+    origin_default: object = None  # Datum filled for rows older than an
+    # ADD COLUMN (ref: meta/model ColumnInfo.OriginDefaultValue)
 
 
 @dataclass
@@ -82,12 +88,24 @@ class TableMeta:
     handle_col: str | None = None  # integer PRIMARY KEY column used as row handle
     _next_handle: int = 1  # autoid allocator cursor (ref: meta/autoid)
     row_count: int = 0  # maintained by DML; the planner's only "statistic"
+    next_col_id: int = 0  # max-ever col id + 1: DROP COLUMN must never free
+    # its id for reuse (old rows still hold bytes under it)
+
+    def __post_init__(self):
+        if self.next_col_id <= 0:
+            self.next_col_id = max((c.col_id for c in self.columns), default=0) + 1
 
     def col(self, name: str) -> ColumnMeta:
         for c in self.columns:
             if c.name == name.lower():
                 return c
         raise CatalogError(f"unknown column {name!r} in table {self.name!r}")
+
+    def scan_columns(self) -> tuple:
+        """ColumnInfos for a full-row scan of this table."""
+        from ..exec.dag import ColumnInfo
+
+        return tuple(ColumnInfo(c.col_id, c.ft, c.origin_default) for c in self.columns)
 
     def col_ids(self) -> list:
         return [c.col_id for c in self.columns]
@@ -96,9 +114,10 @@ class TableMeta:
         return [c.ft for c in self.columns]
 
     def alloc_handle(self) -> int:
-        h = self._next_handle
-        self._next_handle += 1
-        return h
+        with _ALLOC_LOCK:
+            h = self._next_handle
+            self._next_handle += 1
+            return h
 
     def peek_handle(self) -> int:
         return self._next_handle
@@ -106,8 +125,15 @@ class TableMeta:
     def observe_handle(self, h: int):
         """Explicit-PK inserts advance the allocator past the used value
         (MySQL auto_increment semantics; ref: meta/autoid rebase)."""
-        if h >= self._next_handle:
-            self._next_handle = h + 1
+        with _ALLOC_LOCK:
+            if h >= self._next_handle:
+                self._next_handle = h + 1
+
+    def alloc_col_id(self) -> int:
+        with _ALLOC_LOCK:
+            v = self.next_col_id
+            self.next_col_id += 1
+            return v
 
 
 class Catalog:
@@ -123,6 +149,9 @@ class Catalog:
         from .privilege import PrivilegeStore
 
         self.privileges = PrivilegeStore()  # domain-level user/priv cache
+        from .ddl import DDLJobLog
+
+        self.ddl_jobs = DDLJobLog()  # schema-change job history
 
     def _alloc_id(self) -> int:
         v = self._next_id

@@ -946,7 +946,7 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
     probe_meta, probe_alias = trefs[0].meta, trefs[0].alias
     scan_ranges = None
     access_path = "table"
-    probe_scan = TableScan(probe_meta.table_id, tuple(ColumnInfo(c.col_id, c.ft) for c in probe_meta.columns))
+    probe_scan = TableScan(probe_meta.table_id, probe_meta.scan_columns())
 
     if len(trefs) == 1 and probe_meta.indices:
         # covering index: every referenced column lives in the index (or is
@@ -1002,7 +1002,7 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
         meta, alias, kind = flat[i][0], tr.alias, flat[i][2]
         local_scope = _Scope([_TableRef(meta, alias, 0)])
         local_low = _Lowerer(local_scope)
-        build_execs: list = [TableScan(meta.table_id, tuple(ColumnInfo(c.col_id, c.ft) for c in meta.columns))]
+        build_execs: list = [TableScan(meta.table_id, meta.scan_columns())]
 
         join_preds = []
         pool = equi
@@ -1058,7 +1058,7 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
         smeta = _resolve_table(sc.table, catalog, mat)
         s_scope = _Scope([_TableRef(smeta, smeta.name, 0)])
         s_low = _Lowerer(s_scope)
-        build_execs = (TableScan(smeta.table_id, tuple(ColumnInfo(c.col_id, c.ft) for c in smeta.columns)),)
+        build_execs = (TableScan(smeta.table_id, smeta.scan_columns()),)
         probe_keys, build_keys = [], []
         for pe, bc in zip(sc.probe_exprs, sc.build_cols):
             pk = low.lower_base(pe)

@@ -374,6 +374,31 @@ class Session:
             info = restore(self.store, self.catalog, stmt.storage)
             row = [Datum.string(stmt.storage), Datum.i64(info["keys"]), Datum.i64(info["tables"])]
             return Result(columns=["Source", "Keys", "Tables"], rows=[row])
+        if isinstance(stmt, A.AlterTableStmt):
+            from .ddl import DDLError, alter_table
+
+            self._implicit_commit()
+            try:
+                alter_table(self, stmt)
+            except DDLError as exc:
+                raise SQLError(str(exc)) from exc
+            return Result()
+        if isinstance(stmt, A.RenameTableStmt):
+            from .ddl import DDLError, _rename_table, run_job
+
+            self._implicit_commit()
+            try:
+                for old, new in stmt.pairs:
+                    meta = self.catalog.table(old.name)
+                    new_name = new.name if isinstance(new, A.TableName) else str(new)
+                    run_job(self.catalog, "rename table", meta.name,
+                            f"RENAME TABLE {old.name} TO {new_name}",
+                            lambda m=meta, n=new_name: _rename_table(self.catalog, m, n))
+            except DDLError as exc:
+                raise SQLError(str(exc)) from exc
+            return Result()
+        if isinstance(stmt, A.AdminStmt):
+            return self._admin(stmt)
         if isinstance(stmt, A.AnalyzeTableStmt):
             return self._analyze(stmt)
         if isinstance(stmt, A.ShowStmt):
@@ -801,7 +826,7 @@ class Session:
         return Chunk.from_rows(plan.dag.output_fts(), rows)
 
     def _fetch_table_chunk(self, meta: TableMeta, ts: int) -> Chunk:
-        scan = TableScan(meta.table_id, tuple(ColumnInfo(c.col_id, c.ft) for c in meta.columns))
+        scan = TableScan(meta.table_id, meta.scan_columns())
         dag = DAGRequest((scan,), output_offsets=tuple(range(len(meta.columns))))
         return execute_root(self.store, dag, full_table_ranges(meta.table_id), start_ts=ts)
 
@@ -813,12 +838,22 @@ class Session:
         return _coerce_datum(d, ft)
 
     def _create_index(self, stmt: A.CreateIndexStmt) -> Result:
-        """CREATE INDEX: catalog change + backfill of existing rows
-        (ref: ddl add-index write-reorg backfill, pkg/ddl/backfilling.go —
+        """CREATE INDEX: a DDL job stepping the online states, then the
+        write-reorg backfill (ref: pkg/ddl/index.go + backfilling.go —
         single process, so one synchronous pass)."""
+        from .ddl import run_job
+
         meta = self.catalog.table(stmt.table.name)
         cols = [c[0] if isinstance(c, tuple) else str(c) for c in stmt.columns]
-        im = self.catalog.add_index(stmt.table.name, stmt.index_name, cols, stmt.unique)
+        n = run_job(self.catalog, "add index", meta.name,
+                    f"CREATE INDEX {stmt.index_name} ON {meta.name}",
+                    lambda: self._build_index(meta, stmt.index_name, cols, stmt.unique),
+                    index_states=True)
+        return Result(affected=n)
+
+    def _build_index(self, meta: TableMeta, index_name: str, cols: list, unique: bool) -> int:
+        """Metadata + backfill (shared by CREATE INDEX and ALTER ADD INDEX)."""
+        im = self.catalog.add_index(meta.name, index_name, cols, unique)
         ts = self._next_ts()
         rows = self._scan_rows_with_handles(meta, None, ts)
         wts = self._next_ts()
@@ -829,24 +864,31 @@ class Session:
             if im.unique and not any(d.is_null() for d in vals):
                 k = tuple(str(d) for d in vals)
                 if k in seen:
-                    self.catalog.drop_index(stmt.table.name, im.name)  # roll back
+                    self.catalog.drop_index(meta.name, im.name)  # roll back
                     raise SQLError(f"duplicate entry for unique key {im.name!r} during backfill")
                 seen[k] = handle
             self.store.put_index(
                 tablecodec.encode_index_key(meta.table_id, im.index_id, vals + [Datum.i64(handle)]), b"\x00", wts
             )
-        return Result(affected=len(rows))
+        return len(rows)
 
     def _drop_index(self, stmt: A.DropIndexStmt) -> Result:
+        from .ddl import run_job
+
+        meta = self.catalog.table(stmt.table.name)
+        run_job(self.catalog, "drop index", meta.name,
+                f"DROP INDEX {stmt.index_name} ON {meta.name}",
+                lambda: self._drop_index_impl(meta, stmt.index_name))
+        return Result()
+
+    def _drop_index_impl(self, meta: TableMeta, index_name: str):
         """Catalog change through the locked/versioned path, then tombstone
         every entry of the dropped index (no KV leak)."""
-        meta = self.catalog.table(stmt.table.name)
-        im = self.catalog.drop_index(stmt.table.name, stmt.index_name)
+        im = self.catalog.drop_index(meta.name, index_name)
         wts = self._next_ts()
         prefix = tablecodec.encode_index_key(meta.table_id, im.index_id, [])
         for key, _ in list(self.store.kv.scan(prefix, prefix + b"\xff", wts)):
             self.store.put_index(key, None, wts)
-        return Result()
 
     def _scan_index_prefix(self, prefix: bytes, ts: int):
         """Live index keys under `prefix`: committed entries overlaid with
@@ -987,8 +1029,13 @@ class Session:
         val = self.store.kv.get(tablecodec.encode_row_key(meta.table_id, handle), ts)
         if val is None:
             return None
+        from ..codec.rowcodec import fill_origin_default
+
         dmap = decode_row_to_datum_map(val, {c.col_id: c.ft for c in meta.columns})
-        return [dmap[c.col_id] for c in meta.columns]
+        return [
+            fill_origin_default(val, c.col_id, c.origin_default, dmap[c.col_id])
+            for c in meta.columns
+        ]
 
     def _scan_rows_with_handles(self, meta: TableMeta, where: A.ExprNode | None, ts: int,
                                 order_by: list | None = None, limit=None):
@@ -998,7 +1045,7 @@ class Session:
         scope = _Scope([_TableRef(meta, meta.name, 0)])
         lw = _Lowerer(scope)
         cond = lw.lower_base(where) if where is not None else None
-        cols = [ColumnInfo(-1, HANDLE_FT)] + [ColumnInfo(c.col_id, c.ft) for c in meta.columns]
+        cols = [ColumnInfo(-1, HANDLE_FT)] + list(meta.scan_columns())
         scan = TableScan(meta.table_id, tuple(cols))
         dag = DAGRequest((scan,), output_offsets=tuple(range(len(cols))))
         chunk = execute_root(self.store, dag, full_table_ranges(meta.table_id), start_ts=ts)
@@ -1259,6 +1306,45 @@ class Session:
 
         names = [_field_label(f) for f in fields]
         return names, [e.ft for e in exprs], out
+
+    def _admin(self, stmt: A.AdminStmt) -> Result:
+        """ADMIN SHOW DDL JOBS / CHECK TABLE (ref: pkg/executor/admin.go)."""
+        if stmt.kind == "show_ddl_jobs":
+            rows = []
+            for j in reversed(self.catalog.ddl_jobs.jobs):
+                rows.append([
+                    Datum.i64(j.job_id), Datum.string(j.job_type), Datum.string(j.table),
+                    Datum.string(j.schema_state), Datum.string(j.state),
+                    Datum.string(j.error or ""),
+                ])
+            return Result(
+                columns=["JOB_ID", "JOB_TYPE", "TABLE", "SCHEMA_STATE", "STATE", "ERROR"],
+                rows=rows,
+            )
+        if stmt.kind == "check_table":
+            # index consistency check (ref: admin check table): every row's
+            # index entries exist and no dangling entries remain
+            for t in stmt.tables:
+                meta = self.catalog.table(t.name)
+                ts = self.store.next_ts()
+                rows = self._scan_rows_with_handles(meta, None, ts)
+                pos = {c.name: i for i, c in enumerate(meta.columns)}
+                for idx in meta.indices:
+                    live = set()
+                    for handle, row in rows:
+                        vals = [row[pos[cn]] for cn in idx.col_names] + [Datum.i64(handle)]
+                        key = tablecodec.encode_index_key(meta.table_id, idx.index_id, vals)
+                        live.add(key)
+                        if self.store.kv.get(key, ts) is None:
+                            raise SQLError(
+                                f"admin check: row {handle} missing from index {idx.name!r}"
+                            )
+                    prefix = tablecodec.encode_index_key(meta.table_id, idx.index_id, [])
+                    for key, _ in self.store.kv.scan(prefix, prefix + b"\xff", ts):
+                        if key not in live:
+                            raise SQLError(f"admin check: dangling entry in index {idx.name!r}")
+            return Result()
+        return Result()
 
     # ------------------------------------------------------------------
     def _show(self, stmt) -> Result:

@@ -181,6 +181,8 @@ class TPUStore:
 
         if not native.available():
             return None
+        if any(c.default is not None for c in scan.columns):
+            return None  # origin-default fill is python-side only
         values: list[bytes] = []
         handles: list[int] = []
         for rng in ranges:
@@ -217,8 +219,10 @@ class TPUStore:
         for c in scan.columns:
             if c.col_id == -1:  # handle column (_tidb_rowid)
                 row.append(Datum.i64(handle))
-            else:
-                row.append(dmap[c.col_id])
+                continue
+            from ..codec.rowcodec import fill_origin_default
+
+            row.append(fill_origin_default(val, c.col_id, c.default, dmap[c.col_id]))
         return row
 
     def _decode_index_entry(self, key: bytes, scan):

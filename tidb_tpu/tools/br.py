@@ -17,7 +17,7 @@ import os
 import struct
 
 from ..sql.catalog import ColumnMeta, IndexMeta, TableMeta
-from ..types import Collation, FieldType, Flag, TypeCode
+from ..types import Collation, Datum, DatumKind, FieldType, Flag, MyDecimal, MyTime, TypeCode
 
 SEGMENT_KEYS = 4096
 
@@ -36,6 +36,47 @@ def _ft_from_dict(d: dict) -> FieldType:
     )
 
 
+def _datum_to_dict(d) -> dict | None:
+    if d is None:
+        return None
+    if d.is_null():
+        return {"k": "null"}
+    if d.kind == DatumKind.MysqlDecimal:
+        return {"k": "dec", "v": str(d.val), "s": d.val.scale}
+    if d.kind == DatumKind.MysqlTime:
+        return {"k": "time", "v": d.val.packed, "fsp": d.val.fsp}
+    if d.kind == DatumKind.Bytes:
+        return {"k": "bytes", "v": d.val.decode("latin1")}
+    if d.kind == DatumKind.Uint64:
+        return {"k": "u64", "v": d.val}
+    if d.kind in (DatumKind.Float32, DatumKind.Float64):
+        return {"k": "f64", "v": float(d.val)}
+    if d.kind == DatumKind.String:
+        return {"k": "str", "v": d.val}
+    return {"k": "i64", "v": int(d.val)}
+
+
+def _datum_from_dict(d: dict | None):
+    if d is None:
+        return None
+    k = d["k"]
+    if k == "null":
+        return Datum.NULL
+    if k == "dec":
+        return Datum.dec(MyDecimal(d["v"], d["s"]))
+    if k == "time":
+        return Datum.time(MyTime(d["v"], d.get("fsp", 0)))
+    if k == "bytes":
+        return Datum.bytes_(d["v"].encode("latin1"))
+    if k == "u64":
+        return Datum.u64(d["v"])
+    if k == "f64":
+        return Datum.f64(d["v"])
+    if k == "str":
+        return Datum.string(d["v"])
+    return Datum.i64(d["v"])
+
+
 def _schema_dict(catalog) -> list:
     out = []
     for name in catalog.tables():
@@ -46,8 +87,11 @@ def _schema_dict(catalog) -> list:
             "handle_col": m.handle_col,
             "row_count": m.row_count,
             "next_handle": m.peek_handle(),  # cursor survives the round trip
+            "next_col_id": m.next_col_id,
             "columns": [
-                {"name": c.name, "col_id": c.col_id, "ft": _ft_to_dict(c.ft)}
+                {"name": c.name, "col_id": c.col_id, "ft": _ft_to_dict(c.ft),
+                 "origin_default": _datum_to_dict(c.origin_default),
+                 "auto_increment": c.auto_increment}
                 for c in m.columns
             ],
             "indices": [
@@ -127,11 +171,20 @@ def restore(store, catalog, src_dir: str) -> dict:
             raise ValueError(f"restore: table {t['name']!r} already exists")
     # schema first (original ids — the KV bytes embed them)
     for t in manifest["schema"]:
-        cols = [ColumnMeta(c["name"], c["col_id"], _ft_from_dict(c["ft"])) for c in t["columns"]]
+        cols = [
+            ColumnMeta(
+                c["name"], c["col_id"], _ft_from_dict(c["ft"]),
+                auto_increment=c.get("auto_increment", False),
+                origin_default=_datum_from_dict(c.get("origin_default")),
+            )
+            for c in t["columns"]
+        ]
         idxs = [IndexMeta(i["name"], i["index_id"], list(i["col_names"]), i["unique"]) for i in t["indices"]]
         meta = TableMeta(t["name"], t["table_id"], cols, idxs, t["handle_col"])
         meta.row_count = t["row_count"]
         meta._next_handle = t["next_handle"]
+        if t.get("next_col_id"):
+            meta.next_col_id = t["next_col_id"]
         with catalog._lock:
             catalog._tables[t["name"]] = meta
             catalog.version += 1

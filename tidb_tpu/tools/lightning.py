@@ -44,7 +44,12 @@ def _parse_line(line: str, sep: str, enclosed: str) -> list:
             i += len(sep) - 1
         elif ch == "\\" and i + 1 < n:
             nxt = line[i + 1]
-            cur.append({"n": "\n", "t": "\t", "N": "\x00NULL"}.get(nxt, nxt))
+            if (nxt == "N" and not cur
+                    and (i + 2 >= n or line.startswith(sep, i + 2))):
+                # \N is NULL only when it constitutes the whole field
+                cur.append("\x00NULL")
+            else:
+                cur.append({"n": "\n", "t": "\t"}.get(nxt, nxt))
             i += 1
         else:
             cur.append(ch)
@@ -79,6 +84,9 @@ def load_data(session, stmt) -> int:
     imported = 0
     batch_rows: list = []
 
+    pos = {c.name: i for i, c in enumerate(meta.columns)}
+    uniq_idxs = [i for i in meta.indices if i.unique]
+
     def flush():
         nonlocal imported
         if not batch_rows:
@@ -88,18 +96,35 @@ def load_data(session, stmt) -> int:
         # ALL conflict checks before ANY write: a mid-batch duplicate must
         # not leave half a batch durable below the checkpoint (re-running
         # would then collide with the crashed run's own rows)
-        for handle, _ in batch_rows:
+        seen_pk: set = set()
+        seen_uk: set = set()
+        for handle, datums in batch_rows:
+            if handle in seen_pk:
+                raise SQLError(f"LOAD DATA: duplicate primary key {handle} within the file")
+            seen_pk.add(handle)
             key = tablecodec.encode_row_key(meta.table_id, handle)
             if session.store.kv.get(key, read_ts) is not None:
                 raise SQLError(f"LOAD DATA: duplicate primary key {handle}")
+            for idx in uniq_idxs:
+                vals = [datums[pos[cn]] for cn in idx.col_names]
+                if any(d.is_null() for d in vals):
+                    continue
+                prefix = tablecodec.encode_index_key(meta.table_id, idx.index_id, vals)
+                if (idx.index_id, prefix) in seen_uk:
+                    raise SQLError(f"LOAD DATA: duplicate entry for unique key {idx.name!r} within the file")
+                seen_uk.add((idx.index_id, prefix))
+                if next(iter(session.store.kv.scan(prefix, prefix + b"\xff", read_ts)), None) is not None:
+                    raise SQLError(f"LOAD DATA: duplicate entry for unique key {idx.name!r}")
         for handle, datums in batch_rows:
             session.store.put_row(meta.table_id, handle, meta.col_ids(), datums, ts)
-            pos = {c.name: i for i, c in enumerate(meta.columns)}
             for idx in meta.indices:
                 vals = [datums[pos[cn]] for cn in idx.col_names] + [Datum.i64(handle)]
                 session.store.put_index(
                     tablecodec.encode_index_key(meta.table_id, idx.index_id, vals), b"\x00", ts
                 )
+        # stats track per durable batch (a later failed batch must not
+        # leave committed rows uncounted)
+        meta.row_count += len(batch_rows)
         imported += len(batch_rows)
         batch_rows.clear()
         # durable progress marker AFTER the batch lands (resume skips it)
@@ -145,7 +170,6 @@ def load_data(session, stmt) -> int:
             if len(batch_rows) >= BATCH:
                 flush()
     flush()
-    meta.row_count += imported
     if os.path.exists(ckpt_path):
         os.remove(ckpt_path)  # complete: clear the resume marker
     return imported
