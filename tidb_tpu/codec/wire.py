@@ -152,6 +152,19 @@ def w_datum(w: Writer, d: Datum):
         raise NotImplementedError(f"wire datum kind {k}")
 
 
+def w_opt_datum(w: Writer, d):
+    """Optional datum (ColumnInfo.default — tipb default_val analog)."""
+    if d is None:
+        w.u8(0)
+    else:
+        w.u8(1)
+        w_datum(w, d)
+
+
+def r_opt_datum(r: Reader):
+    return r_datum(r) if r.u8() else None
+
+
 def r_datum(r: Reader) -> Datum:
     k = DatumKind(r.u8())
     if k == DatumKind.Null:
@@ -259,6 +272,7 @@ def w_executor(w: Writer, ex):
         for c in ex.columns:
             w.i64(c.col_id)
             w_ft(w, c.ft)
+            w_opt_datum(w, c.default)
     elif isinstance(ex, TableScan):
         w.u8(_EX_SCAN)
         w.i64(ex.table_id)
@@ -267,6 +281,7 @@ def w_executor(w: Writer, ex):
         for c in ex.columns:
             w.i64(c.col_id)
             w_ft(w, c.ft)
+            w_opt_datum(w, c.default)
     elif isinstance(ex, Selection):
         w.u8(_EX_SEL)
         w.i32(len(ex.conditions))
@@ -321,12 +336,12 @@ def r_executor(r: Reader):
         tid = r.i64()
         iid = r.i64()
         desc = r.bool_()
-        cols = tuple(ColumnInfo(r.i64(), r_ft(r)) for _ in range(r.i32()))
+        cols = tuple(ColumnInfo(r.i64(), r_ft(r), r_opt_datum(r)) for _ in range(r.i32()))
         return IndexScan(tid, iid, cols, desc)
     if tag == _EX_SCAN:
         tid = r.i64()
         desc = r.bool_()
-        cols = tuple(ColumnInfo(r.i64(), r_ft(r)) for _ in range(r.i32()))
+        cols = tuple(ColumnInfo(r.i64(), r_ft(r), r_opt_datum(r)) for _ in range(r.i32()))
         return TableScan(tid, cols, desc)
     if tag == _EX_SEL:
         return Selection(tuple(r_expr(r) for _ in range(r.i32())))

@@ -55,6 +55,9 @@ class KVRequest:
     paging_size: int | None = None  # per-page row budget (ref: kv.Request Paging)
     use_wire: bool = False  # route every cop request through the serialized
     # bytes seam (coprocessor_bytes) instead of in-process objects
+    batch_cop: bool = False  # group region tasks per store/chip into one
+    # worker's batch (ref: copr/batch_coprocessor.go — all regions of a
+    # TiFlash store travel in one request)
 
 
 @dataclass
@@ -138,7 +141,24 @@ def select(store: TPUStore, req: KVRequest) -> SelectResult:
                 return out_chunks
             ranges = resp.last_range
 
-    if req.concurrency > 1 and len(tasks) > 1:
+    if req.batch_cop and len(tasks) > 1:
+        # batch coprocessor: one batch per STORE; a worker drives all of
+        # its store's region tasks back-to-back (one dispatch per store,
+        # not per region — ref: batch_coprocessor.go grouping regions per
+        # TiFlash store, balanced by the PD placement in cluster.scatter)
+        by_store: dict[int, list] = {}
+        for i, t in enumerate(tasks):
+            by_store.setdefault(store.cluster.store_of(t.region_id), []).append((i, t))
+
+        def run_batch(entries):
+            for i, t in entries:
+                results[i] = run_task(i, t)
+
+        with ThreadPoolExecutor(max_workers=max(len(by_store), 1)) as pool:
+            futs = [pool.submit(run_batch, entries) for entries in by_store.values()]
+            for f in futs:
+                f.result()
+    elif req.concurrency > 1 and len(tasks) > 1:
         with ThreadPoolExecutor(max_workers=req.concurrency) as pool:
             futs = [pool.submit(run_task, i, t) for i, t in enumerate(tasks)]
             for i, f in enumerate(futs):

@@ -133,6 +133,7 @@ def execute_root(
     cache: ProgramCache | None = None,
     group_capacity: int = DEFAULT_GROUP_CAPACITY,
     paging_size: int | None = None,
+    batch_cop: bool = False,
 ) -> Chunk:
     """Run a logical (Complete-mode) DAG over the store: split, dispatch the
     pushdown half per region, merge at root. The caller-visible result is
@@ -151,6 +152,7 @@ def execute_root(
         KVRequest(
             plan.push_dag, ranges, start_ts, concurrency=concurrency,
             aux_chunks=aux_chunks or [], paging_size=paging_size,
+            batch_cop=batch_cop,
         ),
     )
     merged = res.merged()

@@ -68,6 +68,30 @@ def scatter_to_buckets(cols: list[jax.Array], valid: jax.Array, part: jax.Array,
     return out_cols, out_valid.reshape(n_parts + 1, bucket_cap)[:n_parts], overflow
 
 
+def broadcast_exchange(mesh_axis: str, cols: list, valid):
+    """Broadcast mode (ref: mpp_exec.go:669 Broadcast partition type, the
+    TiFlash broadcast-join operand path): every device receives EVERY row.
+    Returns ([P*n]-shaped cols, valid) identical on all devices — one
+    all_gather over ICI per column."""
+    out_cols = []
+    for c in cols:
+        g = jax.lax.all_gather(c, mesh_axis, axis=0, tiled=False)  # [P, n, ...]
+        out_cols.append(g.reshape((-1,) + c.shape[1:]))
+    gv = jax.lax.all_gather(valid, mesh_axis, axis=0, tiled=False).reshape(-1)
+    return out_cols, gv
+
+
+def passthrough_exchange(mesh_axis: str, cols: list, valid, target: int = 0):
+    """PassThrough mode (ref: mpp_exec.go:669-719 PassThrough partition
+    type — the root-gather: every task streams all rows to the single
+    collector). All devices' rows land on `target`; other devices keep the
+    buffers (SPMD static shapes) with all-False validity."""
+    out_cols, gv = broadcast_exchange(mesh_axis, cols, valid)
+    me = jax.lax.axis_index(mesh_axis)
+    gv = gv & (me == target)
+    return out_cols, gv
+
+
 def exchange_group_aggregate(mesh_axis: str, key_vals, agg_fn, cols, valid, n_parts: int, bucket_cap: int):
     """Inside shard_map: hash-exchange rows so each device owns one hash
     partition, then run `agg_fn(owned_cols, owned_valid)` locally.

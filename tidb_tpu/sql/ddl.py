@@ -149,14 +149,17 @@ def _add_column(session, meta, spec: A.AlterTableSpec):
 
         zero = Datum.string("") if ft.is_string() else Datum.i64(0)
         origin = _coerce_datum(zero, ft) if not ft.is_string() else zero
-    new_id = meta.alloc_col_id()
-    cm = ColumnMeta(name, new_id, ft, cd.default, cd.auto_increment, origin_default=origin)
     pos = len(meta.columns)
     if spec.position == "first":
         pos = 0
     elif spec.position.startswith("after:"):
         target = spec.position[6:].lower()
-        pos = [c.name for c in meta.columns].index(target) + 1
+        names = [c.name for c in meta.columns]
+        if target not in names:
+            raise DDLError(f"unknown column {target!r} in AFTER")
+        pos = names.index(target) + 1
+    new_id = meta.alloc_col_id()
+    cm = ColumnMeta(name, new_id, ft, cd.default, cd.auto_increment, origin_default=origin)
     meta.columns.insert(pos, cm)
     session.catalog.version += 1
 
@@ -190,8 +193,13 @@ def _modify_column(session, meta, spec: A.AlterTableSpec):
         )
     if old_et == "int" and cm.ft.is_unsigned() != new_ft.is_unsigned():
         raise DDLError(f"MODIFY {old_name!r}: signedness change not supported")
+    renaming = spec.action == "change_column" and cd.name.lower() != old_name
+    if renaming and any(c.name == cd.name.lower() for c in meta.columns):
+        # validate BEFORE mutating anything — a failed DDL must not
+        # half-apply (the rename would reject after the type change)
+        raise DDLError(f"column {cd.name.lower()!r} already exists")
     cm.ft = new_ft
-    if spec.action == "change_column" and cd.name.lower() != old_name:
+    if renaming:
         _rename_column(session, meta, old_name, cd.name)
         return
     session.catalog.version += 1

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 
 from ..chunk import Chunk
 from ..codec import tablecodec
+from ..codec.rowcodec import fill_origin_default
 from ..distsql import execute_root, full_table_ranges
 from ..exec.dag import ColumnInfo, DAGRequest, Selection, TableScan
 from ..expr.eval_ref import RefEvaluator, _truth
@@ -667,6 +668,7 @@ class Session:
                             if self.sysvars.get_bool("tidb_enable_paging")
                             else None
                         ),
+                        batch_cop=self.sysvars.get_bool("tidb_allow_batch_cop"),
                     )
             tracker.consume(chunk.nbytes())
         except QuotaExceeded as exc:
@@ -858,7 +860,10 @@ class Session:
         rows = self._scan_rows_with_handles(meta, None, ts)
         wts = self._next_ts()
         pos = {c.name: i for i, c in enumerate(meta.columns)}
+        # validate the WHOLE backfill before writing anything: a duplicate
+        # found mid-write would leave dead index entries in the store
         seen: dict = {}
+        entries = []
         for handle, row in rows:
             vals = [row[pos[cn]] for cn in im.col_names]
             if im.unique and not any(d.is_null() for d in vals):
@@ -867,9 +872,9 @@ class Session:
                     self.catalog.drop_index(meta.name, im.name)  # roll back
                     raise SQLError(f"duplicate entry for unique key {im.name!r} during backfill")
                 seen[k] = handle
-            self.store.put_index(
-                tablecodec.encode_index_key(meta.table_id, im.index_id, vals + [Datum.i64(handle)]), b"\x00", wts
-            )
+            entries.append(tablecodec.encode_index_key(meta.table_id, im.index_id, vals + [Datum.i64(handle)]))
+        for key in entries:
+            self.store.put_index(key, b"\x00", wts)
         return len(rows)
 
     def _drop_index(self, stmt: A.DropIndexStmt) -> Result:
@@ -1029,8 +1034,6 @@ class Session:
         val = self.store.kv.get(tablecodec.encode_row_key(meta.table_id, handle), ts)
         if val is None:
             return None
-        from ..codec.rowcodec import fill_origin_default
-
         dmap = decode_row_to_datum_map(val, {c.col_id: c.ft for c in meta.columns})
         return [
             fill_origin_default(val, c.col_id, c.origin_default, dmap[c.col_id])

@@ -66,6 +66,8 @@ DEFINITIONS = {
         SysVar("tidb_max_chunk_size", "1024", "both", _int_validator(32, 1 << 20)),
         SysVar("tidb_mem_quota_query", str(1 << 30), "both", _int_validator(0, 1 << 60)),
         SysVar("tidb_enable_paging", "OFF", "both", _bool_validator),
+        # ref: sysvar.go TiDBAllowBatchCop (regions-per-store batching)
+        SysVar("tidb_allow_batch_cop", "OFF", "both", _bool_validator),
         SysVar("tidb_opt_agg_push_down", "ON", "both", _bool_validator),
         SysVar("autocommit", "ON", "both", _bool_validator),
         # ref: sysvar.go TiDBTxnMode (pessimistic is TiDB's default)

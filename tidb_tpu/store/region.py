@@ -29,11 +29,30 @@ class Region:
 
 
 class Cluster:
-    """All regions, sorted by start key, covering [b'', KEY_MAX)."""
+    """All regions, sorted by start key, covering [b'', KEY_MAX).
 
-    def __init__(self):
+    Also plays the mock PD: regions are assigned to stores (the TPU-chip
+    analog of TiKV/TiFlash stores), `scatter()` rebalances round-robin
+    (ref: PD scatter; unistore/pd.go + cluster.go), and the store-global
+    TSO lives on TPUStore."""
+
+    def __init__(self, n_stores: int = 1):
         self._regions: list[Region] = [Region(1, b"", KEY_MAX)]
         self._next_id = 2
+        self.n_stores = max(n_stores, 1)
+        self._store_of: dict[int, int] = {1: 0}
+
+    def set_stores(self, n: int):
+        self.n_stores = max(n, 1)
+        self.scatter()
+
+    def store_of(self, region_id: int) -> int:
+        return self._store_of.get(region_id, region_id % self.n_stores)
+
+    def scatter(self):
+        """Round-robin region->store placement (ref: PD scatter-region)."""
+        for i, r in enumerate(self._regions):
+            self._store_of[r.region_id] = i % self.n_stores
 
     def regions(self) -> list[Region]:
         return list(self._regions)
@@ -56,6 +75,7 @@ class Cluster:
         r.end_key = key
         r.epoch += 1
         self._regions.insert(i + 1, new)
+        self._store_of[new.region_id] = new.region_id % self.n_stores
         return new
 
     def split_n(self, start: bytes, end: bytes, n: int, keyfn):

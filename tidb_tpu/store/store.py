@@ -23,7 +23,7 @@ from dataclasses import dataclass, field
 from ..chunk import Chunk, to_device_batch
 from ..chunk.device import DeviceBatch
 from ..codec import tablecodec
-from ..codec.rowcodec import RowEncoder, decode_row_to_datum_map
+from ..codec.rowcodec import RowEncoder, decode_row_to_datum_map, fill_origin_default
 from ..exec.builder import DEFAULT_GROUP_CAPACITY, ProgramCache
 from ..exec.dag import DAGRequest
 from ..exec.executor import OverflowRetryError, drive_program, run_dag_reference, _pow2
@@ -220,8 +220,6 @@ class TPUStore:
             if c.col_id == -1:  # handle column (_tidb_rowid)
                 row.append(Datum.i64(handle))
                 continue
-            from ..codec.rowcodec import fill_origin_default
-
             row.append(fill_origin_default(val, c.col_id, c.default, dmap[c.col_id]))
         return row
 
