@@ -33,6 +33,12 @@ Package map (mirrors reference layers, SURVEY.md §1):
              pkg/planner, pkg/session, pkg/sessionctx)
   server/    MySQL wire protocol server + minimal client
              (ref: pkg/server)
+  native/    C++ runtime components (scan-decode kernel) via ctypes
+             (ref: TiKV's native decode; rowcodec ChunkDecoder)
+  tools/     dump / LOAD DATA bulk import / BACKUP-RESTORE
+             (ref: dumpling/, pkg/lightning, br/)
+  background/ timer, TTL, dist-task, auto-analyze workers
+             (ref: pkg/timer, pkg/ttl, pkg/disttask, statistics/handle)
   util/      failpoints, metrics, memory tracking
              (ref: pkg/util, pingcap/failpoint, pkg/metrics)
 """

@@ -1,12 +1,16 @@
 """Session: SQL strings in, rows out — the engine's
 `session.ExecuteStmt` (ref: pkg/session/session.go:2008) collapsed to the
-single-process shape: parse -> plan -> execute_root over the embedded TPU
-store, autocommit writes with a monotonic TSO analog.
+single-process shape: parse -> subquery rewrite -> plan -> execute_root
+over the embedded TPU store, with real Percolator transactions.
 
-Statement coverage: CREATE/DROP TABLE, INSERT (VALUES / SELECT), UPDATE,
-DELETE, SELECT (joins, aggregation, HAVING, ORDER/LIMIT, DISTINCT),
-BEGIN/COMMIT/ROLLBACK (autocommit no-ops), SET/SHOW basics, EXPLAIN,
-TRUNCATE. Everything else raises loudly rather than silently no-op."""
+Statement coverage: CREATE/DROP/ALTER/RENAME TABLE, CREATE/DROP INDEX,
+INSERT (VALUES / SELECT / REPLACE / IGNORE), UPDATE, DELETE, TRUNCATE,
+SELECT (joins, aggregation, window functions, subqueries, CTEs incl.
+recursive, UNION, HAVING, ORDER/LIMIT, DISTINCT, FOR UPDATE, point-get
+fast path), BEGIN/COMMIT/ROLLBACK (pessimistic + optimistic 2PC),
+PREPARE/EXECUTE/DEALLOCATE, CREATE/DROP USER, GRANT/REVOKE, ANALYZE,
+LOAD DATA, BACKUP/RESTORE, ADMIN SHOW DDL JOBS / CHECK TABLE, SET/SHOW,
+EXPLAIN. Everything else raises loudly rather than silently no-op."""
 
 from __future__ import annotations
 
