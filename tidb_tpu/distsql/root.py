@@ -134,6 +134,7 @@ def execute_root(
     group_capacity: int = DEFAULT_GROUP_CAPACITY,
     paging_size: int | None = None,
     batch_cop: bool = False,
+    summary_sink: list | None = None,
 ) -> Chunk:
     """Run a logical (Complete-mode) DAG over the store: split, dispatch the
     pushdown half per region, merge at root. The caller-visible result is
@@ -155,6 +156,10 @@ def execute_root(
             batch_cop=batch_cop,
         ),
     )
+    if summary_sink is not None:
+        # per-task ExecutorExecutionSummary lists (ref: tipb exec summaries
+        # consumed by EXPLAIN ANALYZE, select_result.go:499)
+        summary_sink.extend(res.exec_summaries)
     merged = res.merged()
     if merged is None:
         merged = Chunk.empty(plan.push_dag.output_fts())

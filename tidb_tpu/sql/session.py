@@ -137,6 +137,7 @@ class Session:
         self.user = "root"  # authenticated user (the server sets this)
         self.db = "test"  # the single implicit database
         self.prepared: dict[str, object] = {}  # PREPARE name -> AST template
+        self._explain_sink: list | None = None  # EXPLAIN ANALYZE summaries
         if config is not None:
             # instance config seeds session sysvars (ref: setGlobalVars
             # bridging config -> sysvar defaults, cmd/tidb-server/main.go:654)
@@ -673,6 +674,7 @@ class Session:
                             else None
                         ),
                         batch_cop=self.sysvars.get_bool("tidb_allow_batch_cop"),
+                        summary_sink=self._explain_sink,
                     )
             tracker.consume(chunk.nbytes())
         except QuotaExceeded as exc:
@@ -1356,6 +1358,56 @@ class Session:
     # ------------------------------------------------------------------
     def _show(self, stmt) -> Result:
         kind = getattr(stmt, "kind", "")
+        if kind == "create_table":
+            from ..tools.dump import schema_sql
+
+            meta = self.catalog.table(stmt.table.name)
+            return Result(
+                columns=["Table", "Create Table"],
+                rows=[[Datum.string(meta.name), Datum.string(schema_sql(meta).rstrip("\n"))]],
+            )
+        if kind == "columns":
+            meta = self.catalog.table(stmt.table.name)
+            from ..tools.dump import _type_sql
+
+            rows = []
+            for c in meta.columns:
+                dflt = ""
+                if c.default is not None:
+                    try:
+                        d = self._eval_const(c.default, c.ft)
+                        dflt = "" if d.is_null() else str(d.val)
+                    except Exception:  # noqa: BLE001 — display only
+                        dflt = ""
+                elif c.origin_default is not None and not c.origin_default.is_null():
+                    dflt = str(c.origin_default.val)
+                rows.append([
+                    Datum.string(c.name),
+                    Datum.string(_type_sql(c.ft).lower()),
+                    Datum.string("NO" if c.ft.not_null() else "YES"),
+                    Datum.string("PRI" if c.name == meta.handle_col else ""),
+                    Datum.string(dflt),
+                    Datum.string("auto_increment" if c.auto_increment else ""),
+                ])
+            return Result(columns=["Field", "Type", "Null", "Key", "Default", "Extra"], rows=rows)
+        if kind == "index":
+            meta = self.catalog.table(stmt.table.name)
+            rows = []
+            for idx in meta.indices:
+                for seq, cn in enumerate(idx.col_names, 1):
+                    rows.append([
+                        Datum.string(meta.name), Datum.i64(0 if idx.unique else 1),
+                        Datum.string(idx.name), Datum.i64(seq), Datum.string(cn),
+                    ])
+            return Result(columns=["Table", "Non_unique", "Key_name", "Seq_in_index", "Column_name"], rows=rows)
+        if kind == "status":
+            from ..util import metrics
+
+            rows = []
+            for line in metrics.REGISTRY.dump().splitlines():
+                name, _, value = line.rpartition(" ")
+                rows.append([Datum.string(name), Datum.string(value)])
+            return Result(columns=["Variable_name", "Value"], rows=rows)
         if kind == "tables":
             return Result(columns=["Tables"], rows=[[Datum.string(t)] for t in self.catalog.tables()])
         if kind == "databases":
@@ -1371,8 +1423,11 @@ class Session:
         inner = stmt.target
         if not isinstance(inner, A.SelectStmt):
             return Result()
+        import copy
+
         from .subquery import SubqueryError
 
+        analyze_ast = copy.deepcopy(inner) if getattr(stmt, "analyze", False) else None
         rw = self._new_rewriter(None)
         try:
             rw.process_ctes(inner.ctes)
@@ -1386,8 +1441,49 @@ class Session:
         from ..distsql import split_dag
 
         rp = split_dag(plan.dag)
+        if analyze_ast is not None:
+            return self._explain_analyze(analyze_ast, rp)
         lines = [f"access: {plan.access_path}"]
         lines += [f"push[{type(e).__name__}]" for e in rp.push_dag.executors]
         if rp.root_dag is not None:
             lines += [f"root[{type(e).__name__}]" for e in rp.root_dag.executors[1:]]
         return Result(columns=["plan"], rows=[[Datum.string(s)] for s in lines])
+
+    def _explain_analyze(self, analyze_ast, rp) -> Result:
+        """EXPLAIN ANALYZE: run the query through the NORMAL select path (so
+        the feature gate, txn dirty-table shadowing, and the memory quota
+        all apply exactly as they would to the statement itself) while a
+        sink collects the coprocessor exec summaries
+        (ref: tipb.ExecutorExecutionSummary consumed at
+        pkg/distsql/select_result.go:499; EXPLAIN ANALYZE columns in
+        pkg/executor/explain.go)."""
+        from ..exec.dag import executor_walk
+
+        sink: list = []
+        self._explain_sink = sink
+        try:
+            _, _, out_rows = self._run_select(analyze_ast, None)
+        finally:
+            self._explain_sink = None
+        names = [type(e).__name__ for e in executor_walk(rp.push_dag.executors)]
+        rows_sum = [0] * len(names)
+        time_ns = [0] * len(names)
+        for task_summaries in sink:
+            for i, s in enumerate(task_summaries[: len(names)]):
+                rows_sum[i] += s.num_produced_rows
+                time_ns[i] += s.time_processed_ns
+        out = []
+        if sink:
+            out += [[
+                Datum.string(f"push[{n}]"), Datum.i64(rows_sum[i]), Datum.i64(len(sink)),
+                Datum.string(f"{time_ns[i] / 1e6:.2f}ms"),
+            ] for i, n in enumerate(names)]
+        else:
+            # oracle/materialized path: no coprocessor tasks ran
+            out.append([Datum.string("(no coprocessor summaries: oracle or in-memory path)"),
+                        Datum.NULL, Datum.i64(0), Datum.NULL])
+        if rp.root_dag is not None:
+            for e in rp.root_dag.executors[1:]:
+                out.append([Datum.string(f"root[{type(e).__name__}]"), Datum.NULL, Datum.i64(1), Datum.NULL])
+        out.append([Datum.string("result"), Datum.i64(len(out_rows)), Datum.i64(1), Datum.NULL])
+        return Result(columns=["executor", "rows", "tasks", "time"], rows=out)
