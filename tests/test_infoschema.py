@@ -1,0 +1,60 @@
+"""information_schema memtables (ref: pkg/infoschema +
+pkg/executor/infoschema_reader.go — schema introspection served from the
+engine itself)."""
+
+import pytest
+
+from tidb_tpu.sql.session import Session, SQLError
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT, s VARCHAR(8))")
+    s.execute("CREATE TABLE u (id INT PRIMARY KEY)")
+    s.execute("CREATE UNIQUE INDEX uv ON t (v)")
+    s.execute("INSERT INTO t VALUES (1,1,'a'),(2,2,'b')")
+    return s
+
+
+def test_tables(sess):
+    got = sess.execute(
+        "SELECT table_name, table_rows FROM information_schema.tables ORDER BY table_name"
+    ).values()
+    assert got == [["t", 2], ["u", 0]]
+
+
+def test_columns(sess):
+    got = sess.execute(
+        "SELECT column_name, column_type, column_key FROM information_schema.columns "
+        "WHERE table_name = 't' ORDER BY ordinal_position"
+    ).values()
+    assert got == [["id", "bigint", "PRI"], ["v", "bigint", ""], ["s", "varchar(8)", ""]]
+
+
+def test_statistics(sess):
+    got = sess.execute(
+        "SELECT index_name, non_unique, column_name FROM information_schema.statistics"
+    ).values()
+    assert got == [["uv", 0, "v"]]
+
+
+def test_join_memtables(sess):
+    got = sess.execute(
+        "SELECT count(*) FROM information_schema.columns c "
+        "JOIN information_schema.tables tt ON c.table_name = tt.table_name"
+    ).values()
+    assert got == [[4]]
+
+
+def test_unknown_memtable(sess):
+    with pytest.raises(SQLError, match="not supported"):
+        sess.execute("SELECT * FROM information_schema.engines")
+
+
+def test_memtable_does_not_shadow_user_table(sess):
+    sess.execute("CREATE TABLE tables (id INT PRIMARY KEY)")
+    sess.execute("INSERT INTO tables VALUES (7)")
+    assert sess.execute("SELECT id FROM tables").values() == [[7]]
+    got = sess.execute("SELECT count(*) FROM information_schema.tables").values()
+    assert got == [[3]]

@@ -577,8 +577,17 @@ def _lower_literal(n: A.Literal) -> Expr:
 # FROM / join planning
 # --------------------------------------------------------------------------
 
-def _resolve_table(name: str, catalog: Catalog, mat: dict | None) -> TableMeta:
-    """Materialized (CTE/derived) tables shadow catalog tables."""
+def _resolve_table(name: str, catalog: Catalog, mat: dict | None, db: str = "") -> TableMeta:
+    """Materialized (CTE/derived) tables shadow catalog tables. A db
+    qualifier resolves ONLY the db-scoped binding (information_schema
+    memtables register under "information_schema.<name>", never shadowing
+    same-named user tables)."""
+    if db and db not in ("test",):
+        if mat:
+            m = mat.get(f"{db.lower()}.{name.lower()}")
+            if m is not None:
+                return m
+        raise PlanError(f"unknown table {db}.{name}")
     if mat:
         m = mat.get(name.lower())
         if m is not None:
@@ -590,7 +599,7 @@ def _flatten_from(node, catalog: Catalog, mat: dict | None = None) -> list:
     """FROM tree -> [(TableMeta, alias, kind, on_expr)] left-deep order.
     JOIN ... USING(cols) desugars to ON equality conjuncts."""
     if isinstance(node, A.TableName):
-        meta = _resolve_table(node.name, catalog, mat)
+        meta = _resolve_table(node.name, catalog, mat, getattr(node, "db", ""))
         return [(meta, (node.alias or node.name).lower(), "inner", None)]
     if isinstance(node, A.Join):
         left = _flatten_from(node.left, catalog, mat)

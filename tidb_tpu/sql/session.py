@@ -102,7 +102,8 @@ def _referenced_tables(stmt) -> set:
 
     def walk(n):
         if isinstance(n, A.TableName):
-            names.add(n.name.lower())
+            if n.db.lower() != "information_schema":
+                names.add(n.name.lower())
             return
         if not hasattr(n, "__dataclass_fields__"):
             return
@@ -615,6 +616,7 @@ class Session:
             rw.rewrite_select(stmt)
         except SubqueryError as exc:
             raise SQLError(str(exc)) from exc
+        self._bind_information_schema(stmt.from_clause, rw)
         if stmt.for_update:
             self._select_for_update(stmt)
         # the fast path's _read_row already overlays the txn buffer, so it
@@ -781,6 +783,99 @@ class Session:
         if meta.table_id < 0:
             return rw.registry.chunks[meta.name]
         return self._fetch_table_chunk(meta, ts)
+
+    def _column_descs(self, meta: TableMeta) -> list:
+        """(name, type, is_nullable, key, default, extra) per column —
+        shared by SHOW COLUMNS and information_schema.columns."""
+        from ..tools.dump import _type_sql
+
+        out = []
+        for c in meta.columns:
+            dflt = ""
+            if c.default is not None:
+                try:
+                    d = self._eval_const(c.default, c.ft)
+                    dflt = "" if d.is_null() else str(d.val)
+                except Exception:  # noqa: BLE001 — display only
+                    dflt = ""
+            elif c.origin_default is not None and not c.origin_default.is_null():
+                dflt = str(c.origin_default.val)
+            out.append((
+                c.name, _type_sql(c.ft).lower(),
+                "NO" if c.ft.not_null() else "YES",
+                "PRI" if c.name == meta.handle_col else "",
+                dflt,
+                "auto_increment" if c.auto_increment else "",
+            ))
+        return out
+
+    @staticmethod
+    def _index_descs(meta: TableMeta) -> list:
+        """(non_unique, index_name, seq_in_index, column_name) rows."""
+        out = []
+        for idx in meta.indices:
+            for seq, cn in enumerate(idx.col_names, 1):
+                out.append((0 if idx.unique else 1, idx.name, seq, cn))
+        return out
+
+    def _bind_information_schema(self, node, rw) -> None:
+        """information_schema memtables served from the catalog
+        (ref: pkg/infoschema memtables + pkg/executor/infoschema_reader.go —
+        the reference serves these from TiDB itself via kv.StoreType=TiDB;
+        here they materialize per statement). Covered: TABLES, COLUMNS,
+        STATISTICS, TIDB_INDEXES-shaped index rows ride in STATISTICS."""
+        if isinstance(node, A.Join):
+            self._bind_information_schema(node.left, rw)
+            self._bind_information_schema(node.right, rw)
+            return
+        if not isinstance(node, A.TableName) or node.db.lower() != "information_schema":
+            return
+        from ..tools.dump import _type_sql
+        from ..types import new_varchar
+
+        kind = node.name.lower()
+        S, I = new_varchar(64), new_longlong()
+        if kind == "tables":
+            names = ["table_schema", "table_name", "table_rows", "tidb_table_id"]
+            fts = [S, S, I, I]
+            rows = []
+            for name in self.catalog.tables():
+                m = self.catalog.table(name)
+                rows.append([Datum.string(self.db), Datum.string(m.name),
+                             Datum.i64(m.row_count), Datum.i64(m.table_id)])
+        elif kind == "columns":
+            names = ["table_schema", "table_name", "column_name", "ordinal_position",
+                     "column_type", "is_nullable", "column_key"]
+            fts = [S, S, S, I, S, S, S]
+            rows = []
+            for name in self.catalog.tables():
+                m = self.catalog.table(name)
+                for i, (cn, ctype, nullable, key, _, _) in enumerate(self._column_descs(m), 1):
+                    rows.append([
+                        Datum.string(self.db), Datum.string(m.name), Datum.string(cn),
+                        Datum.i64(i), Datum.string(ctype),
+                        Datum.string(nullable), Datum.string(key),
+                    ])
+        elif kind == "statistics":
+            names = ["table_schema", "table_name", "non_unique", "index_name",
+                     "seq_in_index", "column_name"]
+            fts = [S, S, I, S, I, S]
+            rows = []
+            for name in self.catalog.tables():
+                m = self.catalog.table(name)
+                for nu, iname, seq, cn in self._index_descs(m):
+                    rows.append([
+                        Datum.string(self.db), Datum.string(m.name),
+                        Datum.i64(nu), Datum.string(iname),
+                        Datum.i64(seq), Datum.string(cn),
+                    ])
+        else:
+            raise SQLError(f"information_schema.{kind} not supported yet")
+        meta = rw.registry.register(names, fts, rows)
+        # db-scoped binding: the planner resolves information_schema.<name>
+        # through this key only, so a user table named "tables" is untouched
+        # and the AST stays reusable (prepared statements re-bind per run)
+        rw.bindings[f"information_schema.{kind}"] = meta
 
     def _shadow_dirty_tables(self, node, rw) -> None:
         """Bind every txn-dirty table referenced in FROM to a materialized
@@ -1368,37 +1463,19 @@ class Session:
             )
         if kind == "columns":
             meta = self.catalog.table(stmt.table.name)
-            from ..tools.dump import _type_sql
-
-            rows = []
-            for c in meta.columns:
-                dflt = ""
-                if c.default is not None:
-                    try:
-                        d = self._eval_const(c.default, c.ft)
-                        dflt = "" if d.is_null() else str(d.val)
-                    except Exception:  # noqa: BLE001 — display only
-                        dflt = ""
-                elif c.origin_default is not None and not c.origin_default.is_null():
-                    dflt = str(c.origin_default.val)
-                rows.append([
-                    Datum.string(c.name),
-                    Datum.string(_type_sql(c.ft).lower()),
-                    Datum.string("NO" if c.ft.not_null() else "YES"),
-                    Datum.string("PRI" if c.name == meta.handle_col else ""),
-                    Datum.string(dflt),
-                    Datum.string("auto_increment" if c.auto_increment else ""),
-                ])
+            rows = [
+                [Datum.string(cn), Datum.string(ctype), Datum.string(nullable),
+                 Datum.string(key), Datum.string(dflt), Datum.string(extra)]
+                for cn, ctype, nullable, key, dflt, extra in self._column_descs(meta)
+            ]
             return Result(columns=["Field", "Type", "Null", "Key", "Default", "Extra"], rows=rows)
         if kind == "index":
             meta = self.catalog.table(stmt.table.name)
-            rows = []
-            for idx in meta.indices:
-                for seq, cn in enumerate(idx.col_names, 1):
-                    rows.append([
-                        Datum.string(meta.name), Datum.i64(0 if idx.unique else 1),
-                        Datum.string(idx.name), Datum.i64(seq), Datum.string(cn),
-                    ])
+            rows = [
+                [Datum.string(meta.name), Datum.i64(nu), Datum.string(iname),
+                 Datum.i64(seq), Datum.string(cn)]
+                for nu, iname, seq, cn in self._index_descs(meta)
+            ]
             return Result(columns=["Table", "Non_unique", "Key_name", "Seq_in_index", "Column_name"], rows=rows)
         if kind == "status":
             from ..util import metrics
@@ -1435,8 +1512,9 @@ class Session:
             if inner.from_clause is None:
                 return Result(columns=["plan"], rows=[[Datum.string("constant select")]])
             rw.rewrite_select(inner)
+            self._bind_information_schema(inner.from_clause, rw)
             plan = plan_select(inner, self.catalog, mat=rw.mat_dict())
-        except (SubqueryError, PlanError) as exc:
+        except (SubqueryError, PlanError, CatalogError) as exc:
             raise SQLError(str(exc)) from exc
         from ..distsql import split_dag
 
