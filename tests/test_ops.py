@@ -115,7 +115,7 @@ class TestGroupAgg:
         db, vals = eval_vals(fts, ch, [col(1, fts[1])])
         (d,) = vals
         none_valid = jnp.zeros_like(db.row_valid)
-        states = scalar_aggregate([(AggDesc("count", ()), []), (AggDesc("sum", (col(1, fts[1]),)), [d])], none_valid)
+        states, _ = scalar_aggregate([(AggDesc("count", ()), []), (AggDesc("sum", (col(1, fts[1]),)), [d])], none_valid)
         assert int(states[0][0][0][0]) == 0
         assert bool(states[1][0][1][0])  # sum over empty -> NULL
 
@@ -305,12 +305,12 @@ class TestBitAggs:
         a = CompVal(vals, nulls, FT)
         from tidb_tpu.expr import col as _col
         descs = [AggDesc("bit_and", (_col(0, FT),)), AggDesc("bit_or", (_col(0, FT),)), AggDesc("bit_xor", (_col(0, FT),))]
-        sts = scalar_aggregate([(d, [a]) for d in descs], valid)
+        sts, _ = scalar_aggregate([(d, [a]) for d in descs], valid)
         assert int(sts[0][0][0][0]) == 0b1000
         assert int(sts[1][0][0][0]) == 0b1110
         assert int(sts[2][0][0][0]) == 0b0110
         # empty set: and -> all ones, or/xor -> 0, never NULL
-        sts = scalar_aggregate([(d, [a]) for d in descs], jnp.zeros(3, bool))
+        sts, _ = scalar_aggregate([(d, [a]) for d in descs], jnp.zeros(3, bool))
         assert int(sts[0][0][0][0]) == -1 and not bool(sts[0][0][1][0])
         assert int(sts[1][0][0][0]) == 0
         assert int(sts[2][0][0][0]) == 0

@@ -154,7 +154,8 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
                 new_cols.extend(_gather(gvals, res.group_rep))
                 valid = res.group_valid
             else:
-                states = scalar_aggregate(aggs, valid, merge=ex.merge)
+                states, s_ovf = scalar_aggregate(aggs, valid, merge=ex.merge)
+                state.group_overflow = state.group_overflow | s_ovf
                 ones = jnp.ones(1, bool)
                 for (a, av), st in zip(aggs, states):
                     new_cols.extend(_agg_result_cols(a, av, st, ones, ex.partial))

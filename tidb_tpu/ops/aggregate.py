@@ -1,11 +1,16 @@
 """Aggregation kernels (ref: unistore/cophandler/mpp_exec.go:999 aggExec,
 pkg/executor/aggregate/agg_hash_executor.go, pkg/executor/aggfuncs).
 
-TPU-native shape: instead of a hash table (pointer chasing — hostile to the
-VPU), group-by is sort-based: normalize keys to int64 arrays, lexsort, detect
-segment boundaries, then scatter-reduce into a fixed `group_capacity` table
-with `jax.ops.segment_*`. Dynamic group counts live behind a static capacity
-plus an overflow flag (SURVEY.md §7 "hard parts": dynamic cardinality).
+TPU-native shape: the reference keys a hash table on encoded group datums
+and updates per-row (pointer chasing — hostile to the VPU). Here group-by
+is hash-cluster based: normalize keys to int64 words (ops/keys.py), mix
+them into ONE 63-bit hash word (ops/seg.py), sort by that single word, and
+reduce each contiguous hash cluster with scatter-free segment passes
+(cumsum / segmented scan + boundary gathers). Hash collisions are detected
+exactly (row-vs-segment-head word compare) and surface as the overflow
+flag; the retry driver's larger capacity re-salts the hash. Dynamic group
+counts live behind a static `group_capacity` plus that flag (SURVEY.md §7
+"hard parts": dynamic cardinality).
 
 Two phases mirror the reference's partial/final split
 (ref: pkg/expression/aggregation modes):
@@ -16,6 +21,9 @@ Two phases mirror the reference's partial/final split
 Partial states (expr/agg.py): count=[n], sum=[s], avg=[n,s], min/max=[v].
 The psum across regions of these states is exactly the ICI-mesh merge of the
 north star (BASELINE.json): count/sum/avg states add elementwise.
+
+Output groups are ordered by first encounter (earliest contributing input
+row), matching the row-at-a-time oracle's insertion order.
 """
 
 from __future__ import annotations
@@ -28,9 +36,22 @@ import jax.numpy as jnp
 from ..expr.agg import AggDesc
 from ..expr.compile import CompVal, _round_div, _scale
 from ..types import FieldType, TypeCode
-from .keys import lexsort, segments_from_sorted, sort_key_arrays
+from .keys import segments_from_sorted, sort_key_arrays
+from .seg import (
+    I64_MAX,
+    SegCtx,
+    group_hash,
+    hash_words,
+    make_segctx,
+    run_head_pos,
+    seg_bitreduce,
+    seg_head_pos,
+    seg_max,
+    seg_min,
+    seg_sum,
+    sort_by_word,
+)
 
-I64_MAX = jnp.int64(0x7FFFFFFFFFFFFFFF)
 I64_MIN_ = jnp.int64(-0x8000000000000000)
 
 
@@ -69,29 +90,6 @@ class GatherState:
     has: jax.Array  # bool [G] group produced a state
 
 
-def _seg_sum(vals, seg, n, dtype=None):
-    """Segment sum tuned for TPU: a single segment is a plain reduction
-    (segment_* lowers to scatter, which serializes on TPU), and the general
-    case promises sorted ids — every caller sorts rows by group key first,
-    and XLA's sorted-scatter path is far cheaper than the generic one."""
-    v = vals if dtype is None else vals.astype(dtype)
-    if n == 1:
-        return jnp.sum(v, axis=0, keepdims=True)
-    return jax.ops.segment_sum(v, seg, num_segments=n, indices_are_sorted=True)
-
-
-def _seg_min(vals, seg, n):
-    if n == 1:
-        return jnp.min(vals, axis=0, keepdims=True)
-    return jax.ops.segment_min(vals, seg, num_segments=n, indices_are_sorted=True)
-
-
-def _seg_max(vals, seg, n):
-    if n == 1:
-        return jnp.max(vals, axis=0, keepdims=True)
-    return jax.ops.segment_max(vals, seg, num_segments=n, indices_are_sorted=True)
-
-
 def _masked(vals, mask, fill):
     return jnp.where(mask, vals, fill)
 
@@ -115,63 +113,43 @@ _BIT_OPS = {
 }
 
 
-def _seg_bitreduce(red, vals, seg, nseg, fill):
-    """Segmented bitwise reduce via associative scan (rows sorted by seg —
-    group_aggregate sorts, scalar_aggregate has one segment). There is no
-    jax.ops.segment_{and,or,xor}; the standard segmented-scan combine is
-    associative over sorted segment ids, then the last row of each segment
-    holds the segment's reduction."""
-    n = vals.shape[0]
-
-    def combine(c1, c2):
-        v1, s1 = c1
-        v2, s2 = c2
-        return jnp.where(s1 == s2, red(v1, v2), v2), s2
-
-    sv, _ = jax.lax.associative_scan(combine, (vals, seg))
-    pos = jnp.arange(n, dtype=jnp.int32)
-    last = _seg_max(pos, seg, nseg)
-    out = sv[jnp.clip(last, 0, n - 1)]
-    cnt = _seg_sum(jnp.ones_like(seg), seg, nseg)
-    return jnp.where(cnt > 0, out, jnp.int64(fill))
-
-
-def _agg_states_raw(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
+def _agg_states_raw(desc: AggDesc, args: list[CompVal], valid, ctx: SegCtx):
     """Per-group partial states from raw rows."""
     name = desc.name
+    nseg = ctx.nseg
     if name == "count":
         mask = valid
         for a in args:
             mask = mask & ~a.null
-        return [(_seg_sum(mask.astype(jnp.int64), seg, nseg), jnp.zeros(nseg, bool))]
+        return [(seg_sum(ctx, mask.astype(jnp.int64)), jnp.zeros(nseg, bool))]
     a = args[0]
     mask = valid & ~a.null
-    cnt = _seg_sum(mask.astype(jnp.int64), seg, nseg)
+    cnt = seg_sum(ctx, mask.astype(jnp.int64))
     empty = cnt == 0
     if name in ("sum", "avg"):
         if a.eval_type == "real":
-            s = _seg_sum(_masked(a.value, mask, 0.0), seg, nseg)
+            s = seg_sum(ctx, _masked(a.value, mask, 0.0))
         else:
-            s = _seg_sum(_masked(a.value.astype(jnp.int64), mask, jnp.int64(0)), seg, nseg)
+            s = seg_sum(ctx, _masked(a.value.astype(jnp.int64), mask, jnp.int64(0)))
         if name == "sum":
             return [(s, empty)]
         return [(cnt, jnp.zeros(nseg, bool)), (s, empty)]
     if name in ("min", "max"):
-        op = _seg_min if name == "min" else _seg_max
+        op = seg_min if name == "min" else seg_max
         if a.eval_type == "real":
             fill = jnp.inf if name == "min" else -jnp.inf
-            v = op(_masked(a.value, mask, fill), seg, nseg)
+            v = op(ctx, _masked(a.value, mask, fill))
         elif a.value.ndim == 2:
             raise AssertionError("string min/max is routed via GatherState")
         elif a.ft.is_unsigned() and a.eval_type == "int":
             flip = jnp.int64(-0x8000000000000000)
             av = a.value.astype(jnp.int64) ^ flip
             fill = I64_MAX if name == "min" else I64_MIN_
-            v = op(_masked(av, mask, fill), seg, nseg) ^ flip
+            v = op(ctx, _masked(av, mask, fill)) ^ flip
         else:
             av = a.value.astype(jnp.int64)
             fill = I64_MAX if name == "min" else I64_MIN_
-            v = op(_masked(av, mask, fill), seg, nseg)
+            v = op(ctx, _masked(av, mask, fill))
         return [(v, empty)]
     if name == "first_row":
         raise AssertionError("first_row is routed via GatherState")
@@ -179,32 +157,31 @@ def _agg_states_raw(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
         # moment states [count, sum, sum_sq] — additive, mesh-mergeable
         # (ref: executor/aggfuncs/func_varpop.go partial results)
         v = _as_f64(a)
-        cnt = _seg_sum(mask.astype(jnp.int64), seg, nseg)
-        s = _seg_sum(_masked(v, mask, 0.0), seg, nseg)
-        q = _seg_sum(_masked(v * v, mask, 0.0), seg, nseg)
+        s = seg_sum(ctx, _masked(v, mask, 0.0))
+        q = seg_sum(ctx, _masked(v * v, mask, 0.0))
         nn = cnt == 0
         return [(cnt, jnp.zeros(nseg, bool)), (s, nn), (q, nn)]
     if name == "group_concat":
         raise NotImplementedError("group_concat on device (root-only, oracle-evaluated)")
     if name in _BIT_OPS:
         red, fill = _BIT_OPS[name]
-        v = _seg_bitreduce(red, _masked(a.value.astype(jnp.int64), mask, jnp.int64(fill)), seg, nseg, fill)
+        v = seg_bitreduce(ctx, red, _masked(a.value.astype(jnp.int64), mask, jnp.int64(fill)), fill)
         # MySQL BIT_* never return NULL: empty set yields the identity
         return [(v, jnp.zeros(nseg, bool))]
     raise NotImplementedError(f"aggregate {name} on device")
 
 
-def _first_match_idx(mask_s, orig_s, seg, nseg, n):
+def _first_match_idx(mask_s, orig_s, ctx: SegCtx, n):
     """Per-segment earliest ORIGINAL row index among mask rows.
 
     mask_s/orig_s are in sorted order (orig_s = perm, the original index of
     each sorted position). Returns (idx[nseg] clipped, has[nseg])."""
-    fi = _seg_min(jnp.where(mask_s, orig_s, jnp.int32(n)), seg, nseg)
+    fi = seg_min(ctx, jnp.where(mask_s, orig_s.astype(jnp.int32), jnp.int32(n)))
     has = fi < n
     return jnp.clip(fi, 0, n - 1), has
 
 
-def _arg_extreme_mask(words_s, cand, seg, nseg, maximize: bool):
+def _arg_extreme_mask(words_s, cand, ctx: SegCtx, maximize: bool):
     """Narrow `cand` (sorted order) to rows holding the per-segment
     lexicographic extreme of `words_s` ([n, K] int64, most significant word
     first — the packed-string key layout). Word-by-word radix arg-extreme:
@@ -212,98 +189,104 @@ def _arg_extreme_mask(words_s, cand, seg, nseg, maximize: bool):
     for k in range(words_s.shape[1]):
         w = words_s[:, k]
         if maximize:
-            best = _seg_max(jnp.where(cand, w, I64_MIN_), seg, nseg)
+            best = seg_max(ctx, jnp.where(cand, w, I64_MIN_))
         else:
-            best = _seg_min(jnp.where(cand, w, I64_MAX), seg, nseg)
-        cand = cand & (w == best[seg])
+            best = seg_min(ctx, jnp.where(cand, w, I64_MAX))
+        cand = cand & (w == best[ctx.seg])
     return cand
 
 
-def _distinct_states(desc: AggDesc, args: list, row_valid, gkeys: list, invalid_first, nseg):
+def _distinct_states(desc: AggDesc, args: list, row_valid, hp, nseg: int, salt: int):
     """COUNT/SUM/AVG(DISTINCT ...) states via a secondary sort by
-    (validity, group keys, arg keys): the first row of each distinct
-    (group, args) combination contributes exactly once (ref: aggfuncs
-    distinct set semantics, executor/aggfuncs/func_count_distinct.go —
-    the sort replaces the hash set).
+    (group hash, arg hash): the first row of each distinct (group, args)
+    combination contributes exactly once (ref: aggfuncs distinct set
+    semantics, executor/aggfuncs/func_count_distinct.go — the sort replaces
+    the hash set).
 
-    Group numbering matches the main sort's: both order valid-first by the
-    same group-key words, so segment ids depend only on distinct key ranks.
-    With no group keys (scalar agg) callers pass nseg=2 (slot 1 = invalid).
-    """
+    Group numbering matches the main sort's: both cluster by the same group
+    hash word, so segment ids depend only on hash ranks. Returns
+    (states, collision_flag) — arg-hash collisions are detected by the
+    run-head word compare and clear on the salted retry."""
     argkeys: list = []
     amask = row_valid
     for a in args:
         amask = amask & ~a.null
         argkeys.extend(sort_key_arrays(a))
-    perm2 = lexsort([invalid_first] + gkeys + argkeys)
+    ah = hash_words(argkeys, salt + 1)
+    n = row_valid.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    hp2, ah2, perm2 = jax.lax.sort((hp, ah, iota), num_keys=2)
     valid2 = row_valid[perm2]
-    gkeys2 = [k[perm2] for k in gkeys]
-    if gkeys:
-        seg2, _ = segments_from_sorted(gkeys2, valid2)
-        seg2 = jnp.minimum(seg2, nseg - 1)
-    else:
-        seg2 = jnp.where(valid2, 0, 1).astype(jnp.int32)
-    allkeys2 = gkeys2 + [k[perm2] for k in argkeys]
-    diff = jnp.zeros(valid2.shape[0], bool)
-    for k in allkeys2:
-        diff = diff | jnp.concatenate([jnp.ones(1, bool), k[1:] != k[:-1]])
-    uniq = diff & valid2 & amask[perm2]
-    cnt = _seg_sum(uniq.astype(jnp.int64), seg2, nseg)
+    seg2, _ = segments_from_sorted([hp2], valid2)
+    seg2 = jnp.minimum(seg2, nseg - 1)
+    ctx2 = make_segctx(seg2, nseg)
+    one = jnp.ones(1, bool)
+    diff = jnp.concatenate([one, (hp2[1:] != hp2[:-1]) | (ah2[1:] != ah2[:-1])])
+    head = run_head_pos(diff)
+    amask2 = amask[perm2]
+    coll = jnp.zeros(n, bool)
+    for k in argkeys:
+        k2 = k[perm2]
+        coll = coll | (k2 != k2[head])
+    collision = jnp.any(coll & valid2 & amask2)
+    uniq = diff & valid2 & amask2
+    cnt = seg_sum(ctx2, uniq.astype(jnp.int64))
     if desc.name == "count":
-        return [(cnt, jnp.zeros(nseg, bool))]
+        return [(cnt, jnp.zeros(nseg, bool))], collision
     a0 = args[0]
     empty = cnt == 0
     if desc.name in _VAR_FUNCS:
         v2 = _as_f64(a0)[perm2]
-        s = _seg_sum(jnp.where(uniq, v2, 0.0), seg2, nseg)
-        q = _seg_sum(jnp.where(uniq, v2 * v2, 0.0), seg2, nseg)
-        return [(cnt, jnp.zeros(nseg, bool)), (s, empty), (q, empty)]
+        s = seg_sum(ctx2, jnp.where(uniq, v2, 0.0))
+        q = seg_sum(ctx2, jnp.where(uniq, v2 * v2, 0.0))
+        return [(cnt, jnp.zeros(nseg, bool)), (s, empty), (q, empty)], collision
     a2 = a0.value[perm2]
     if a0.eval_type == "real":
-        s = _seg_sum(jnp.where(uniq, a2, 0.0), seg2, nseg)
+        s = seg_sum(ctx2, jnp.where(uniq, a2, 0.0))
     else:
-        s = _seg_sum(jnp.where(uniq, a2.astype(jnp.int64), jnp.int64(0)), seg2, nseg)
+        s = seg_sum(ctx2, jnp.where(uniq, a2.astype(jnp.int64), jnp.int64(0)))
     if desc.name == "sum":
-        return [(s, empty)]
-    return [(cnt, jnp.zeros(nseg, bool)), (s, empty)]
+        return [(s, empty)], collision
+    return [(cnt, jnp.zeros(nseg, bool)), (s, empty)], collision
 
 
-def _agg_states_merge(desc: AggDesc, args: list[CompVal], valid, seg, nseg):
+def _agg_states_merge(desc: AggDesc, args: list[CompVal], valid, ctx: SegCtx):
     """Merge partial-state columns (Partial2/Final): args are state cols."""
     name = desc.name
+    nseg = ctx.nseg
     if name == "count":
         a = args[0]
-        return [(_seg_sum(_masked(a.value, valid, 0), seg, nseg), jnp.zeros(nseg, bool))]
+        return [(seg_sum(ctx, _masked(a.value, valid, 0)), jnp.zeros(nseg, bool))]
     if name in ("sum", "avg"):
         out = []
         for a in args:  # count then sum for avg; sum only for sum
             mask = valid & ~a.null
-            present = _seg_sum(mask.astype(jnp.int64), seg, nseg) > 0
+            present = seg_sum(ctx, mask.astype(jnp.int64)) > 0
             if a.eval_type == "real":
-                s = _seg_sum(_masked(a.value, mask, 0.0), seg, nseg)
+                s = seg_sum(ctx, _masked(a.value, mask, 0.0))
             else:
-                s = _seg_sum(_masked(a.value.astype(jnp.int64), mask, jnp.int64(0)), seg, nseg)
+                s = seg_sum(ctx, _masked(a.value.astype(jnp.int64), mask, jnp.int64(0)))
             out.append((s, ~present))
         if name == "avg":
             # count state never null
             out[0] = (out[0][0], jnp.zeros(nseg, bool))
         return out
     if name in ("min", "max"):
-        return _agg_states_raw(desc, args, valid, seg, nseg)
+        return _agg_states_raw(desc, args, valid, ctx)
     if name in _VAR_FUNCS:
         # additive moment states: sum each of [count, sum, sum_sq]
         cnt_a, s_a, q_a = args
         mask = valid & ~s_a.null
-        cnt = _seg_sum(_masked(cnt_a.value.astype(jnp.int64), valid, jnp.int64(0)), seg, nseg)
-        s = _seg_sum(_masked(s_a.value, mask, 0.0), seg, nseg)
-        q = _seg_sum(_masked(q_a.value, mask, 0.0), seg, nseg)
+        cnt = seg_sum(ctx, _masked(cnt_a.value.astype(jnp.int64), valid, jnp.int64(0)))
+        s = seg_sum(ctx, _masked(s_a.value, mask, 0.0))
+        q = seg_sum(ctx, _masked(q_a.value, mask, 0.0))
         nn = cnt == 0
         return [(cnt, jnp.zeros(nseg, bool)), (s, nn), (q, nn)]
     if name == "first_row":
         raise AssertionError("first_row merge is routed via GatherState")
     if name in _BIT_OPS:
         # reduce of reduces — same segmented bitwise kernel over state cols
-        return _agg_states_raw(desc, args, valid, seg, nseg)
+        return _agg_states_raw(desc, args, valid, ctx)
     raise NotImplementedError(f"merge of {name} on device")
 
 
@@ -343,11 +326,12 @@ def finalize_agg(desc: AggDesc, states: list, group_valid) -> tuple:
     return v, nl
 
 
-def _gather_or_distinct_state(desc, arg_vals, row_valid, merge, gkeys, invalid_first, nseg, seg, perm, n):
-    """GatherState / distinct states for the aggs that need them, else None.
+def _gather_or_distinct_state(desc, arg_vals, row_valid, merge, hp, ctx: SegCtx, perm, n, salt):
+    """(GatherState | distinct states | None, collision_flag | None) for the
+    aggs that need special routing.
 
     first_row (all modes) and string min/max resolve to a per-group original
-    row index; DISTINCT count/sum/avg resolve via a secondary sort."""
+    row index; DISTINCT count/sum/avg resolve via a secondary hash sort."""
     name = desc.name
     orig_s = perm.astype(jnp.int32)
     if name == "first_row":
@@ -355,22 +339,23 @@ def _gather_or_distinct_state(desc, arg_vals, row_valid, merge, gkeys, invalid_f
         if merge:
             # merge input states are [has, value]: earliest state with has>0
             mask = mask & (arg_vals[0].value > 0)
-        idx, has = _first_match_idx(mask[perm], orig_s, seg, nseg, n)
-        return GatherState(idx, has)
+        idx, has = _first_match_idx(mask[perm], orig_s, ctx, n)
+        return GatherState(idx, has), None
     if name in ("min", "max") and arg_vals and arg_vals[-1].value.ndim == 2:
         a = arg_vals[-1]  # merge-mode state col == value col, same kernel
         mask = (row_valid & ~a.null)[perm]
-        cand = _arg_extreme_mask(a.value[perm, :], mask, seg, nseg, name == "max")
-        idx, has = _first_match_idx(cand, orig_s, seg, nseg, n)
-        return GatherState(idx, has)
+        cand = _arg_extreme_mask(a.value[perm, :], mask, ctx, name == "max")
+        idx, has = _first_match_idx(cand, orig_s, ctx, n)
+        return GatherState(idx, has), None
     if desc.distinct and name in ({"count", "sum", "avg"} | _VAR_FUNCS) and arg_vals:
         if merge:
             raise NotImplementedError(
                 "DISTINCT aggregates are not decomposable into mergeable partials; "
                 "plan them in Complete mode (ref: AggregationPushDownSolver skips distinct)"
             )
-        return _distinct_states(desc, arg_vals, row_valid, gkeys, invalid_first, nseg)
-    return None
+        nseg = max(ctx.nseg, 2)  # scalar path: one group + the invalid slot
+        return _distinct_states(desc, arg_vals, row_valid, hp, nseg, salt)
+    return None, None
 
 
 def group_aggregate(
@@ -380,47 +365,71 @@ def group_aggregate(
     group_capacity: int,
     merge: bool = False,
 ):
-    """Sort-based group aggregation.
+    """Hash-cluster group aggregation.
 
     aggs: list of (AggDesc, [arg CompVals]). Returns GroupAggResult with one
-    extra hidden overflow segment dropped.
+    extra hidden overflow segment dropped; groups in first-encounter order.
     """
     n = row_valid.shape[0]
     keys: list[jax.Array] = []
     for g in group_bys:
         keys.extend(sort_key_arrays(g))
-    invalid_first_key = jnp.where(row_valid, jnp.int64(0), jnp.int64(1))
-    perm = lexsort([invalid_first_key] + keys)
+    # ONE sortable word: 63-bit salted hash, invalid rows pinned to the tail
+    hp = group_hash(keys, row_valid, salt=group_capacity)
+    h_s, perm = sort_by_word(hp)
     valid_s = row_valid[perm]
-    keys_s = [k[perm] for k in keys]
-    seg, n_groups = segments_from_sorted(keys_s, valid_s)
+    seg, n_groups = segments_from_sorted([h_s], valid_s)
     overflow = n_groups > group_capacity
     nseg = group_capacity + 1
     seg = jnp.minimum(seg, nseg - 1)
+    ctx = make_segctx(seg, nseg)
+
+    # exact-grouping check: a cluster mixing two distinct keys (hash
+    # collision, or the clamped overflow cluster) trips the overflow flag;
+    # the retry's larger capacity re-salts the hash and clears it
+    head = seg_head_pos(ctx)
+    coll = jnp.zeros(n, bool)
+    for k in keys:
+        k_s = k[perm]
+        coll = coll | (k_s != k_s[head])
+    overflow = overflow | jnp.any(coll & valid_s)
 
     # earliest original row per group (deterministic oracle parity)
-    group_rep_full, _ = _first_match_idx(valid_s, perm.astype(jnp.int32), seg, nseg, n)
+    group_rep_full, _ = _first_match_idx(valid_s, perm, ctx, n)
     group_rep = group_rep_full[:group_capacity]
     gids = jnp.arange(group_capacity, dtype=jnp.int32)
     group_valid = gids < n_groups
 
     states = []
     for desc, arg_vals in aggs:
-        st = _gather_or_distinct_state(
-            desc, arg_vals, row_valid, merge, keys, invalid_first_key, nseg, seg, perm, n
+        st, coll_flag = _gather_or_distinct_state(
+            desc, arg_vals, row_valid, merge, hp, ctx, perm, n, group_capacity
         )
+        if coll_flag is not None:
+            overflow = overflow | coll_flag
         if isinstance(st, GatherState):
             states.append(GatherState(st.idx[:group_capacity], st.has[:group_capacity] & group_valid))
             continue
         if st is None:
             av_s = [CompVal(a.value[perm] if a.value.ndim == 1 else a.value[perm, :], a.null[perm], a.ft, raw=None) for a in arg_vals]
             fn = _agg_states_merge if merge else _agg_states_raw
-            st = fn(desc, av_s, valid_s, seg, nseg)
+            st = fn(desc, av_s, valid_s, ctx)
         st = [(v[:group_capacity], nl[:group_capacity]) for v, nl in st]
         st = [(v, nl | ~group_valid) for v, nl in st]
         states.append(st)
 
-    return GroupAggResult(group_rep, group_valid, jnp.minimum(n_groups, group_capacity), overflow, states)
+    # groups come out hash-ordered; reorder by earliest contributing row so
+    # the output order matches the oracle's first-encounter insertion order
+    order = jnp.argsort(jnp.where(group_valid, group_rep, jnp.int32(n)))
+    group_rep = group_rep[order]
+    out_states: list = []
+    for st in states:
+        if isinstance(st, GatherState):
+            out_states.append(GatherState(st.idx[order], st.has[order]))
+        else:
+            out_states.append([(v[order], nl[order]) for v, nl in st])
+
+    return GroupAggResult(group_rep, group_valid, jnp.minimum(n_groups, group_capacity), overflow, out_states)
 
 
 def scalar_aggregate(aggs: list, row_valid: jax.Array, merge: bool = False):
@@ -428,21 +437,32 @@ def scalar_aggregate(aggs: list, row_valid: jax.Array, merge: bool = False):
     (ref: SELECT count(*) over empty set returns 0).
 
     States come back [1]-shaped; first_row / string min/max come back as a
-    GatherState ([1]-shaped idx/has) for the caller to gather."""
+    GatherState ([1]-shaped idx/has) for the caller to gather. Returns
+    (states, overflow) — overflow only from DISTINCT hash collisions,
+    cleared by the salted retry."""
     n = row_valid.shape[0]
-    seg = jnp.zeros(n, jnp.int32)
+    ctx = SegCtx(
+        seg=jnp.zeros(n, jnp.int32),
+        nseg=1,
+        starts=jnp.zeros(1, jnp.int32),
+        ends=jnp.full(1, n - 1, jnp.int32),
+        counts=jnp.full(1, n, jnp.int64),
+    )
     perm = jnp.arange(n, dtype=jnp.int32)
-    invalid_first = jnp.where(row_valid, jnp.int64(0), jnp.int64(1))
+    hp = jnp.where(row_valid, jnp.int64(0), I64_MAX)
+    overflow = jnp.bool_(False)
     states = []
     for desc, arg_vals in aggs:
-        st = _gather_or_distinct_state(
-            desc, arg_vals, row_valid, merge, [], invalid_first, 2, seg, perm, n
+        st, coll_flag = _gather_or_distinct_state(
+            desc, arg_vals, row_valid, merge, hp, ctx, perm, n, 1
         )
+        if coll_flag is not None:
+            overflow = overflow | coll_flag
         if isinstance(st, GatherState):
             states.append(GatherState(st.idx[:1], st.has[:1]))
         elif st is not None:  # distinct states came back [2]-shaped
             states.append([(v[:1], nl[:1]) for v, nl in st])
         else:
             fn = _agg_states_merge if merge else _agg_states_raw
-            states.append(fn(desc, arg_vals, row_valid, seg, 1))
-    return states
+            states.append(fn(desc, arg_vals, row_valid, ctx))
+    return states, overflow

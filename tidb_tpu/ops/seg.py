@@ -1,0 +1,171 @@
+"""Scatter-free segment machinery — the TPU-shaped core of group-by.
+
+XLA scatter (what `jax.ops.segment_*` lowers to) serializes on TPU and
+compiles explosively on some backends; multi-operand variadic sorts are the
+other compile sink. This module replaces both:
+
+  * group keys hash into ONE int64 word (splitmix64 mix over the normalized
+    key words from ops/keys.py), so grouping costs a single single-operand
+    sort no matter how many GROUP BY columns there are;
+  * segment reductions over the hash-sorted rows are cumsum / segmented
+    associative-scan passes plus gathers at segment boundaries — zero
+    scatter ops, all bandwidth-bound elementwise work;
+  * hash collisions (different keys, equal hash) are DETECTED exactly by
+    comparing every row's key words against its segment head, and surface
+    as the group-overflow flag; the retry driver grows capacity, and the
+    capacity salts the hash, so a retry re-seeds and the collision clears.
+
+Semantics parity target is unchanged: unistore/cophandler/mpp_exec.go:999
+aggExec's map-based group-by (a hash table keyed on encoded group datums —
+this is the same idea, shaped for the VPU).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+I64_MAX = jnp.int64(0x7FFFFFFFFFFFFFFF)
+MAX63 = jnp.int64(0x7FFFFFFFFFFFFFFF)  # top bit clear: valid-hash space
+
+# splitmix64 finalizer constants (public domain; two's-complement int64)
+_C1 = jnp.int64(0xBF58476D1CE4E5B9 - (1 << 64))
+_C2 = jnp.int64(0x94D049BB133111EB - (1 << 64))
+_GOLDEN = jnp.int64(0x9E3779B97F4A7C15 - (1 << 64))
+
+
+def _lsr(x, k: int):
+    """Logical shift right on int64 (arithmetic shift + mask)."""
+    return (x >> k) & jnp.int64((1 << (64 - k)) - 1)
+
+
+def _mix64(x):
+    x = (x ^ _lsr(x, 30)) * _C1
+    x = (x ^ _lsr(x, 27)) * _C2
+    return x ^ _lsr(x, 31)
+
+
+def _word_as_i64(w: jax.Array) -> jax.Array:
+    """Key word -> int64 bit material. Float words (real sort keys stay
+    float, see ops/keys.py) are bitcast via int32 halves — a direct 64-bit
+    bitcast would break the TPU x64-emulation rewrite."""
+    if jnp.issubdtype(w.dtype, jnp.floating):
+        iw = jax.lax.bitcast_convert_type(w.astype(jnp.float64), jnp.int32)
+        hi = iw[..., 0].astype(jnp.int64)
+        lo = iw[..., 1].astype(jnp.int64) & jnp.int64(0xFFFFFFFF)
+        return (hi << 32) | lo
+    return w.astype(jnp.int64)
+
+
+def hash_words(words: list[jax.Array], salt: int) -> jax.Array:
+    """Mix a list of [N] key words into one well-distributed int64 [N]."""
+    h = _mix64(jnp.int64(salt) * _GOLDEN + jnp.int64(1))
+    h = jnp.broadcast_to(h, words[0].shape) if words else h
+    for w in words:
+        h = _mix64(h ^ _word_as_i64(w))
+    return h
+
+
+def group_hash(words: list[jax.Array], valid: jax.Array, salt: int) -> jax.Array:
+    """Single sortable grouping word: valid rows get their 63-bit hash
+    (top bit clear), invalid rows get I64_MAX — one argsort then clusters
+    equal keys and pushes invalid rows to the tail."""
+    h = hash_words(words, salt) & MAX63
+    return jnp.where(valid, h, I64_MAX)
+
+
+def sort_by_word(word: jax.Array):
+    """(sorted_word, perm int32) via one single-key sort."""
+    iota = jnp.arange(word.shape[0], dtype=jnp.int32)
+    sw, perm = jax.lax.sort((word, iota), num_keys=1)
+    return sw, perm
+
+
+@dataclass
+class SegCtx:
+    """Boundary view of sorted segment ids.
+
+    seg: int32 [N] ascending; nseg static; starts/ends int32 [nseg]
+    (ends inclusive; empty segment has ends < starts); counts int64 [nseg].
+    """
+
+    seg: jax.Array
+    nseg: int
+    starts: jax.Array
+    ends: jax.Array
+    counts: jax.Array
+
+
+def make_segctx(seg: jax.Array, nseg: int) -> SegCtx:
+    g = jnp.arange(nseg, dtype=seg.dtype)
+    starts = jnp.searchsorted(seg, g, side="left").astype(jnp.int32)
+    ends = jnp.searchsorted(seg, g, side="right").astype(jnp.int32) - 1
+    counts = jnp.maximum((ends - starts + 1).astype(jnp.int64), 0)
+    return SegCtx(seg, nseg, starts, ends, counts)
+
+
+def seg_head_pos(ctx: SegCtx) -> jax.Array:
+    """Per-row sorted position of the row's segment head (int32 [N])."""
+    n = ctx.seg.shape[0]
+    return jnp.clip(ctx.starts, 0, n - 1)[ctx.seg]
+
+
+def run_head_pos(diff: jax.Array) -> jax.Array:
+    """Per-row position of the start of its equal-key run, given the
+    boundary mask (diff[0] must be True). cummax, no gathers."""
+    n = diff.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    return jax.lax.cummax(jnp.where(diff, pos, jnp.int32(0)))
+
+
+def seg_sum(ctx: SegCtx, vals: jax.Array, dtype=None) -> jax.Array:
+    """Per-segment sum via cumsum + boundary gathers (empty segments -> 0).
+    Callers pre-mask invalid lanes to 0, exactly as with segment_sum."""
+    v = vals if dtype is None else vals.astype(dtype)
+    if ctx.nseg == 1:
+        return jnp.sum(v, axis=0, keepdims=True)
+    n = v.shape[0]
+    c = jnp.cumsum(v, axis=0)
+    lo = jnp.clip(ctx.starts, 0, n - 1)
+    hi = jnp.clip(ctx.ends, 0, n - 1)
+    out = c[hi] - c[lo] + v[lo]
+    zero = jnp.zeros((), v.dtype)
+    return jnp.where(ctx.counts > 0, out, zero)
+
+
+def _seg_scan_reduce(ctx: SegCtx, vals: jax.Array, combine, empty_fill):
+    """Per-segment reduce of an arbitrary associative `combine` via a
+    segmented associative scan + gather at segment ends."""
+    n = vals.shape[0]
+
+    def comb(a, b):
+        v1, s1 = a
+        v2, s2 = b
+        return jnp.where(s1 == s2, combine(v1, v2), v2), s2
+
+    sv, _ = jax.lax.associative_scan(comb, (vals, ctx.seg))
+    out = sv[jnp.clip(ctx.ends, 0, n - 1)]
+    return jnp.where(ctx.counts > 0, out, empty_fill)
+
+
+def seg_min(ctx: SegCtx, vals: jax.Array) -> jax.Array:
+    if ctx.nseg == 1:
+        return jnp.min(vals, axis=0, keepdims=True)
+    fill = jnp.inf if jnp.issubdtype(vals.dtype, jnp.floating) else jnp.iinfo(vals.dtype).max
+    return _seg_scan_reduce(ctx, vals, jnp.minimum, jnp.asarray(fill, vals.dtype))
+
+
+def seg_max(ctx: SegCtx, vals: jax.Array) -> jax.Array:
+    if ctx.nseg == 1:
+        return jnp.max(vals, axis=0, keepdims=True)
+    fill = -jnp.inf if jnp.issubdtype(vals.dtype, jnp.floating) else jnp.iinfo(vals.dtype).min
+    return _seg_scan_reduce(ctx, vals, jnp.maximum, jnp.asarray(fill, vals.dtype))
+
+
+def seg_bitreduce(ctx: SegCtx, red, vals: jax.Array, fill) -> jax.Array:
+    """Segmented bitwise and/or/xor (no jax.ops.segment_* exists for these;
+    callers pre-mask invalid lanes to the identity). The segmented scan
+    handles nseg==1 too (one segment == plain scan, last element = total)."""
+    return _seg_scan_reduce(ctx, vals, red, jnp.int64(fill))

@@ -99,7 +99,9 @@ def run_sharded_partial_agg(dag: DAGRequest, stacked: DeviceBatch, mesh: Mesh):
         for desc in agg.aggs:
             aggs.append((desc, avals[k : k + len(desc.args)]))
             k += len(desc.args)
-        states = scalar_aggregate(aggs, valid, merge=agg.merge)
+        states, _ovf = scalar_aggregate(aggs, valid, merge=agg.merge)
+        # (scalar-path overflow only arises from DISTINCT hash collisions,
+        # which the mesh path rejects upstream — _ovf stays False here)
         # flatten to arrays: per agg, per state col: (value[1], null[1]);
         # first_row comes back as a GatherState — materialize its [has,
         # value] wire state here (numeric only on the mesh path)
