@@ -180,7 +180,7 @@ class TestTopN:
         fts, ch = make_data(n=80)
         db, vals = eval_vals(fts, ch, [col(1, fts[1]), col(2, fts[2])])
         d, r = vals
-        idx, valid = topn([(d, False), (r, True)], db.row_valid, 10)
+        idx, valid, _ovf = topn([(d, False), (r, True)], db.row_valid, 10)
         idx, valid = np.asarray(idx), np.asarray(valid)
         assert valid.all()
         # oracle: stable sort by (d asc nulls-first, r desc nulls-last)
@@ -200,7 +200,7 @@ class TestTopN:
         fts, ch = make_data(n=5)
         db, vals = eval_vals(fts, ch, [col(0, fts[0])])
         (g,) = vals
-        idx, valid = topn([(g, False)], db.row_valid, 100)
+        idx, valid, _ovf = topn([(g, False)], db.row_valid, 100)
         assert valid.sum() == 5
 
 

@@ -63,10 +63,11 @@ class _TraceState:
     def __init__(self):
         self.group_overflow = jnp.bool_(False)
         self.join_overflow = jnp.bool_(False)
+        self.topn_overflow = jnp.bool_(False)
         self.ex_rows: list = []
 
 
-def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, state: _TraceState):
+def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, state: _TraceState, topn_full: bool = False):
     """Trace one executor pipeline; recursion handles Join build sides.
 
     batches are consumed in canonical scan order (dag.collect_scans);
@@ -96,11 +97,12 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
         elif isinstance(ex, TopN):
             order_vals = comp.run([e for e, _ in ex.order_by], cols)
             by = list(zip(order_vals, [d for _, d in ex.order_by]))
-            idx, out_valid = topn(by, valid, ex.limit)
+            idx, out_valid, t_ovf = topn(by, valid, ex.limit, full_sort=topn_full)
+            state.topn_overflow = state.topn_overflow | t_ovf
             cols = _gather(cols, idx)
             valid = out_valid
         elif isinstance(ex, Join):
-            bcols, bvalid, bfts = _run_pipeline(ex.build, batches, cursor, group_capacity, join_capacity, state)
+            bcols, bvalid, bfts = _run_pipeline(ex.build, batches, cursor, group_capacity, join_capacity, state, topn_full)
             bcomp = ExprCompiler(bfts)
             bkeys = bcomp.run(list(ex.build_keys), bcols)
             pkeys = comp.run(list(ex.probe_keys), cols)
@@ -189,6 +191,7 @@ def build_program(
     capacities,
     group_capacity: int = DEFAULT_GROUP_CAPACITY,
     join_capacity: int | None = None,
+    topn_full: bool = False,
 ) -> CompiledDAG:
     """Compile the whole DAG tree (probe pipeline + all join build
     pipelines) into one fused XLA program over a tuple of device batches."""
@@ -202,7 +205,7 @@ def build_program(
     def program(*batches):
         state = _TraceState()
         cursor = [0]
-        cols, valid, _ = _run_pipeline(dag.executors, batches, cursor, group_capacity, join_capacity, state)
+        cols, valid, _ = _run_pipeline(dag.executors, batches, cursor, group_capacity, join_capacity, state, topn_full)
         outs = [cols[i] for i in dag.output_offsets]
         packed = []
         for c in outs:
@@ -210,7 +213,7 @@ def build_program(
                 packed.append((c.value, c.null, c.raw[0], c.raw[1]))
             else:
                 packed.append((c.value, c.null))
-        return packed, valid, valid.sum(), (state.group_overflow, state.join_overflow), jnp.stack(state.ex_rows)
+        return packed, valid, valid.sum(), (state.group_overflow, state.join_overflow, state.topn_overflow), jnp.stack(state.ex_rows)
 
     jit_fn = jax.jit(program)
     return CompiledDAG(jit_fn, dag.output_fts(), capacities, group_capacity, join_capacity)
@@ -250,17 +253,18 @@ class ProgramCache:
         capacities,
         group_capacity: int = DEFAULT_GROUP_CAPACITY,
         join_capacity: int | None = None,
+        topn_full: bool = False,
     ) -> CompiledDAG:
         if isinstance(capacities, int):
             capacities = (capacities,)
         capacities = tuple(capacities)
-        key = (dag.fingerprint(), capacities, group_capacity, join_capacity)
+        key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full)
         prog = self._cache.get(key)
         if prog is None:
             from ..util import metrics
 
             metrics.PROGRAM_COMPILES.inc()
-            prog = build_program(dag, capacities, group_capacity, join_capacity)
+            prog = build_program(dag, capacities, group_capacity, join_capacity, topn_full)
             self._cache[key] = prog
         return prog
 

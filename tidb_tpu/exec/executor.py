@@ -92,17 +92,20 @@ def drive_program(cache: ProgramCache, dag: DAGRequest, batches, group_capacity:
     caps = tuple(b.capacity for b in batches)
     gc = group_capacity
     jc = join_capacity or max(caps)
+    tf = False
     for _ in range(max_retries + 1):
-        prog = cache.get(dag, caps, gc, jc)
-        packed, valid, n, (g_ovf, j_ovf), ex_rows = prog.fn(*batches)
-        g_ovf, j_ovf = bool(g_ovf), bool(j_ovf)
-        if not g_ovf and not j_ovf:
+        prog = cache.get(dag, caps, gc, jc, tf)
+        packed, valid, n, (g_ovf, j_ovf, t_ovf), ex_rows = prog.fn(*batches)
+        g_ovf, j_ovf, t_ovf = bool(g_ovf), bool(j_ovf), bool(t_ovf)
+        if not g_ovf and not j_ovf and not t_ovf:
             counts = [int(x) for x in np.asarray(ex_rows)]
             return decode_outputs(packed, valid, prog.out_fts), counts
         if g_ovf:
             gc *= 4  # grow only the capacity that overflowed
         if j_ovf:
             jc *= 4
+        if t_ovf:
+            tf = True  # TopN candidate overflow: exact full-sort variant
     raise OverflowRetryError("DAG overflow not resolved after retries")
 
 

@@ -6,11 +6,17 @@ and updates per-row (pointer chasing — hostile to the VPU). Here group-by
 is hash-cluster based: normalize keys to int64 words (ops/keys.py), mix
 them into ONE 63-bit hash word (ops/seg.py), sort by that single word, and
 reduce each contiguous hash cluster with scatter-free segment passes
-(cumsum / segmented scan + boundary gathers). Hash collisions are detected
-exactly (row-vs-segment-head word compare) and surface as the overflow
-flag; the retry driver's larger capacity re-salts the hash. Dynamic group
-counts live behind a static `group_capacity` plus that flag (SURVEY.md §7
-"hard parts": dynamic cardinality).
+(cumsum + boundary gathers). Every per-row array needed after the sort
+(agg args, null masks, a second verification hash) rides the SAME sort as
+extra variadic-sort operands: random [N] gathers cost ~20ns/row on TPU
+(half the whole kernel budget per column), while an extra sort operand is
+~1ms/2M rows. Row validity folds into the hash word itself (invalid rows
+pin to I64_MAX), so even the validity mask needs no gather. Collisions
+(different keys, equal 62-bit hash) are caught by a neighbor compare on
+the independently-salted second hash (miss probability ~2^-124 per pair)
+and surface as the overflow flag; the retry driver's larger capacity
+re-salts both hashes. Dynamic group counts live behind a static
+`group_capacity` plus that flag (SURVEY.md §7 "hard parts").
 
 Two phases mirror the reference's partial/final split
 (ref: pkg/expression/aggregation modes):
@@ -40,12 +46,12 @@ from .keys import segments_from_sorted, sort_key_arrays
 from .seg import (
     I64_MAX,
     SegCtx,
+    SumBatch,
     group_hash,
     hash_words,
     make_segctx,
-    run_head_pos,
     seg_bitreduce,
-    seg_head_pos,
+    seg_first_match,
     seg_max,
     seg_min,
     seg_sum,
@@ -175,10 +181,12 @@ def _first_match_idx(mask_s, orig_s, ctx: SegCtx, n):
     """Per-segment earliest ORIGINAL row index among mask rows.
 
     mask_s/orig_s are in sorted order (orig_s = perm, the original index of
-    each sorted position). Returns (idx[nseg] clipped, has[nseg])."""
-    fi = seg_min(ctx, jnp.where(mask_s, orig_s.astype(jnp.int32), jnp.int32(n)))
-    has = fi < n
-    return jnp.clip(fi, 0, n - 1), has
+    each sorted position). sort_by_word is stable, so the first masked
+    sorted position IS the earliest original row — one cumsum+searchsorted
+    (seg_first_match), no segmented scan. Returns (idx[nseg], has[nseg])."""
+    pos, has = seg_first_match(ctx, mask_s)
+    idx = orig_s[pos].astype(jnp.int32)
+    return jnp.clip(idx, 0, n - 1), has
 
 
 def _arg_extreme_mask(words_s, cand, ctx: SegCtx, maximize: bool):
@@ -204,43 +212,46 @@ def _distinct_states(desc: AggDesc, args: list, row_valid, hp, nseg: int, salt: 
     the hash set).
 
     Group numbering matches the main sort's: both cluster by the same group
-    hash word, so segment ids depend only on hash ranks. Returns
-    (states, collision_flag) — arg-hash collisions are detected by the
-    run-head word compare and clear on the salted retry."""
+    hash word, so segment ids depend only on hash ranks. The value lane and
+    NULL-arg mask ride the sort as payload operands (no [N] gathers).
+    Returns (states, collision_flag) — arg-hash collisions are detected by
+    a neighbor compare on a second arg hash and clear on the salted retry."""
     argkeys: list = []
     amask = row_valid
     for a in args:
         amask = amask & ~a.null
         argkeys.extend(sort_key_arrays(a))
     ah = hash_words(argkeys, salt + 1)
-    n = row_valid.shape[0]
-    iota = jnp.arange(n, dtype=jnp.int32)
-    hp2, ah2, perm2 = jax.lax.sort((hp, ah, iota), num_keys=2)
-    valid2 = row_valid[perm2]
-    seg2, _ = segments_from_sorted([hp2], valid2)
+    ah2 = hash_words(argkeys, salt + 2)
+    need_val = desc.name != "count"
+    a0 = args[0]
+    if need_val and a0.value.ndim != 1:
+        raise NotImplementedError(f"DISTINCT {desc.name} over string values")
+    operands = [hp, ah, ah2, amask] + ([a0.value] if need_val else [])
+    sorted_ops = jax.lax.sort(tuple(operands), num_keys=2)
+    hps, ahs, ah2s = sorted_ops[0], sorted_ops[1], sorted_ops[2]
+    amask_s = sorted_ops[3]
+    valid2 = hps != I64_MAX
+    seg2, _ = segments_from_sorted([hps], valid2)
     seg2 = jnp.minimum(seg2, nseg - 1)
     ctx2 = make_segctx(seg2, nseg)
-    one = jnp.ones(1, bool)
-    diff = jnp.concatenate([one, (hp2[1:] != hp2[:-1]) | (ah2[1:] != ah2[:-1])])
-    head = run_head_pos(diff)
-    amask2 = amask[perm2]
-    coll = jnp.zeros(n, bool)
-    for k in argkeys:
-        k2 = k[perm2]
-        coll = coll | (k2 != k2[head])
-    collision = jnp.any(coll & valid2 & amask2)
-    uniq = diff & valid2 & amask2
+    fal = jnp.zeros(1, bool)
+    same_run = jnp.concatenate([fal, (hps[1:] == hps[:-1]) & (ahs[1:] == ahs[:-1])])
+    mism = jnp.concatenate([fal, ah2s[1:] != ah2s[:-1]])
+    pair_valid = valid2 & jnp.concatenate([fal, valid2[:-1]])
+    collision = jnp.any(same_run & mism & pair_valid)
+    diff = ~same_run
+    uniq = diff & valid2 & amask_s
     cnt = seg_sum(ctx2, uniq.astype(jnp.int64))
     if desc.name == "count":
         return [(cnt, jnp.zeros(nseg, bool))], collision
-    a0 = args[0]
+    a2 = sorted_ops[4]
     empty = cnt == 0
     if desc.name in _VAR_FUNCS:
-        v2 = _as_f64(a0)[perm2]
+        v2 = _as_f64(CompVal(a2, jnp.zeros_like(amask_s), a0.ft))
         s = seg_sum(ctx2, jnp.where(uniq, v2, 0.0))
         q = seg_sum(ctx2, jnp.where(uniq, v2 * v2, 0.0))
         return [(cnt, jnp.zeros(nseg, bool)), (s, empty), (q, empty)], collision
-    a2 = a0.value[perm2]
     if a0.eval_type == "real":
         s = seg_sum(ctx2, jnp.where(uniq, a2, 0.0))
     else:
@@ -326,36 +337,38 @@ def finalize_agg(desc: AggDesc, states: list, group_valid) -> tuple:
     return v, nl
 
 
-def _gather_or_distinct_state(desc, arg_vals, row_valid, merge, hp, ctx: SegCtx, perm, n, salt):
-    """(GatherState | distinct states | None, collision_flag | None) for the
-    aggs that need special routing.
-
-    first_row (all modes) and string min/max resolve to a per-group original
-    row index; DISTINCT count/sum/avg resolve via a secondary hash sort."""
+def _gather_state_sorted(desc, sorted_avs, valid_s, ctx: SegCtx, perm, n, merge):
+    """GatherState for first_row / string min-max, from SORTED args."""
     name = desc.name
-    orig_s = perm.astype(jnp.int32)
     if name == "first_row":
-        mask = row_valid
+        mask = valid_s
         if merge:
             # merge input states are [has, value]: earliest state with has>0
-            mask = mask & (arg_vals[0].value > 0)
-        idx, has = _first_match_idx(mask[perm], orig_s, ctx, n)
-        return GatherState(idx, has), None
-    if name in ("min", "max") and arg_vals and arg_vals[-1].value.ndim == 2:
-        a = arg_vals[-1]  # merge-mode state col == value col, same kernel
-        mask = (row_valid & ~a.null)[perm]
-        cand = _arg_extreme_mask(a.value[perm, :], mask, ctx, name == "max")
-        idx, has = _first_match_idx(cand, orig_s, ctx, n)
-        return GatherState(idx, has), None
-    if desc.distinct and name in ({"count", "sum", "avg"} | _VAR_FUNCS) and arg_vals:
+            mask = mask & (sorted_avs[0].value > 0)
+        idx, has = _first_match_idx(mask, perm, ctx, n)
+        return GatherState(idx, has)
+    a = sorted_avs[-1]  # merge-mode state col == value col, same kernel
+    mask = valid_s & ~a.null
+    cand = _arg_extreme_mask(a.value, mask, ctx, name == "max")
+    idx, has = _first_match_idx(cand, perm, ctx, n)
+    return GatherState(idx, has)
+
+
+def _needs_gather_state(desc, arg_vals) -> bool:
+    if desc.name == "first_row":
+        return True
+    return desc.name in ("min", "max") and bool(arg_vals) and arg_vals[-1].value.ndim == 2
+
+
+def _is_distinct_special(desc, arg_vals, merge) -> bool:
+    if desc.distinct and desc.name in ({"count", "sum", "avg"} | _VAR_FUNCS) and arg_vals:
         if merge:
             raise NotImplementedError(
                 "DISTINCT aggregates are not decomposable into mergeable partials; "
                 "plan them in Complete mode (ref: AggregationPushDownSolver skips distinct)"
             )
-        nseg = max(ctx.nseg, 2)  # scalar path: one group + the invalid slot
-        return _distinct_states(desc, arg_vals, row_valid, hp, nseg, salt)
-    return None, None
+        return True
+    return False
 
 
 def group_aggregate(
@@ -374,25 +387,76 @@ def group_aggregate(
     keys: list[jax.Array] = []
     for g in group_bys:
         keys.extend(sort_key_arrays(g))
-    # ONE sortable word: 63-bit salted hash, invalid rows pinned to the tail
+    # ONE sortable word: salted 62-bit hash, invalid rows pinned to the tail;
+    # a second independently-salted hash rides along purely for collision
+    # detection (neighbor compare — no gathers)
     hp = group_hash(keys, row_valid, salt=group_capacity)
-    h_s, perm = sort_by_word(hp)
-    valid_s = row_valid[perm]
+    hv = hash_words(keys, group_capacity + 0x9E3779B9)
+
+    # payload plan: every array needed after the sort rides the sort itself
+    # (variadic operands) — a random [N] gather costs more than an extra
+    # sort operand by an order of magnitude on TPU. Null masks bit-pack
+    # eight-to-a-byte into shared uint8 operands.
+    payload: list = []
+    slot_of: dict = {}
+    bool_arrs: list = []
+    bool_ix: dict = {}
+
+    def carry(arr) -> int:
+        key = id(arr)
+        if key not in slot_of:
+            slot_of[key] = len(payload)
+            payload.append(arr)
+        return slot_of[key]
+
+    def carry_bool(arr) -> int:
+        key = id(arr)
+        if key not in bool_ix:
+            bool_ix[key] = len(bool_arrs)
+            bool_arrs.append(arr)
+        return bool_ix[key]
+
+    plans = []  # per agg: "distinct" | list[(vslots, null_bit)] per arg
+    for desc, avs in aggs:
+        if _is_distinct_special(desc, avs, merge):
+            plans.append("distinct")
+            continue
+        slots = []
+        for a in avs:
+            if a.value.ndim == 2:
+                vslots = [carry(a.value[:, i]) for i in range(a.value.shape[1])]
+            else:
+                vslots = carry(a.value)
+            slots.append((vslots, carry_bool(a.null)))
+        plans.append(slots)
+
+    nwords = []
+    for w0 in range(0, len(bool_arrs), 8):
+        grp = bool_arrs[w0 : w0 + 8]
+        word = grp[0].astype(jnp.uint8)
+        for k, a in enumerate(grp[1:], start=1):
+            word = word | (a.astype(jnp.uint8) << k)
+        nwords.append(word)
+
+    iota = jnp.arange(n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(tuple([hp, iota, hv] + payload + nwords), num_keys=2)
+    h_s, perm, hv_s = sorted_ops[0], sorted_ops[1], sorted_ops[2]
+    pay_s = list(sorted_ops[3 : 3 + len(payload)])
+    nw_s = list(sorted_ops[3 + len(payload) :])
+    valid_s = h_s != I64_MAX  # validity is IN the sort word — no gather
     seg, n_groups = segments_from_sorted([h_s], valid_s)
     overflow = n_groups > group_capacity
     nseg = group_capacity + 1
     seg = jnp.minimum(seg, nseg - 1)
     ctx = make_segctx(seg, nseg)
 
-    # exact-grouping check: a cluster mixing two distinct keys (hash
-    # collision, or the clamped overflow cluster) trips the overflow flag;
-    # the retry's larger capacity re-salts the hash and clears it
-    head = seg_head_pos(ctx)
-    coll = jnp.zeros(n, bool)
-    for k in keys:
-        k_s = k[perm]
-        coll = coll | (k_s != k_s[head])
-    overflow = overflow | jnp.any(coll & valid_s)
+    # exact-grouping check: equal primary hash but different secondary hash
+    # anywhere inside a cluster => collision => overflow (salted retry)
+    fal = jnp.zeros(1, bool)
+    same_prev = jnp.concatenate([fal, h_s[1:] == h_s[:-1]])
+    mism = jnp.concatenate([fal, hv_s[1:] != hv_s[:-1]])
+    pair_valid = valid_s & jnp.concatenate([fal, valid_s[:-1]])
+    overflow = overflow | jnp.any(same_prev & mism & pair_valid)
 
     # earliest original row per group (deterministic oracle parity)
     group_rep_full, _ = _first_match_idx(valid_s, perm, ctx, n)
@@ -400,23 +464,47 @@ def group_aggregate(
     gids = jnp.arange(group_capacity, dtype=jnp.int32)
     group_valid = gids < n_groups
 
+    def resort(a: CompVal, slots) -> CompVal:
+        vslots, nbit = slots
+        if isinstance(vslots, list):
+            v = jnp.stack([pay_s[i] for i in vslots], axis=1)
+        else:
+            v = pay_s[vslots]
+        null = ((nw_s[nbit // 8] >> (nbit % 8)) & 1).astype(bool)
+        return CompVal(v, null, a.ft, raw=None)
+
+    # dry pass records every seg_sum request; resolve() batches them into
+    # one [A, N] cumsum; the replay pass below gets the real results
+    ctx.sums = SumBatch(ctx)
+    for (desc, arg_vals), plan in zip(aggs, plans):
+        if plan == "distinct" or _needs_gather_state(desc, arg_vals):
+            continue
+        av_s = [resort(a, sl) for a, sl in zip(arg_vals, plan)]
+        fn = _agg_states_merge if merge else _agg_states_raw
+        fn(desc, av_s, valid_s, ctx)
+    ctx.sums.resolve()
+
     states = []
-    for desc, arg_vals in aggs:
-        st, coll_flag = _gather_or_distinct_state(
-            desc, arg_vals, row_valid, merge, hp, ctx, perm, n, group_capacity
-        )
-        if coll_flag is not None:
+    for (desc, arg_vals), plan in zip(aggs, plans):
+        if plan == "distinct":
+            st, coll_flag = _distinct_states(
+                desc, arg_vals, row_valid, hp, nseg, group_capacity
+            )
             overflow = overflow | coll_flag
+        else:
+            av_s = [resort(a, sl) for a, sl in zip(arg_vals, plan)]
+            if _needs_gather_state(desc, arg_vals):
+                st = _gather_state_sorted(desc, av_s, valid_s, ctx, perm, n, merge)
+            else:
+                fn = _agg_states_merge if merge else _agg_states_raw
+                st = fn(desc, av_s, valid_s, ctx)
         if isinstance(st, GatherState):
             states.append(GatherState(st.idx[:group_capacity], st.has[:group_capacity] & group_valid))
             continue
-        if st is None:
-            av_s = [CompVal(a.value[perm] if a.value.ndim == 1 else a.value[perm, :], a.null[perm], a.ft, raw=None) for a in arg_vals]
-            fn = _agg_states_merge if merge else _agg_states_raw
-            st = fn(desc, av_s, valid_s, ctx)
         st = [(v[:group_capacity], nl[:group_capacity]) for v, nl in st]
         st = [(v, nl | ~group_valid) for v, nl in st]
         states.append(st)
+    ctx.sums = None
 
     # groups come out hash-ordered; reorder by earliest contributing row so
     # the output order matches the oracle's first-encounter insertion order
@@ -436,10 +524,11 @@ def scalar_aggregate(aggs: list, row_valid: jax.Array, merge: bool = False):
     """Aggregation without GROUP BY: always exactly one output row
     (ref: SELECT count(*) over empty set returns 0).
 
-    States come back [1]-shaped; first_row / string min/max come back as a
-    GatherState ([1]-shaped idx/has) for the caller to gather. Returns
-    (states, overflow) — overflow only from DISTINCT hash collisions,
-    cleared by the salted retry."""
+    No sort at all — one segment spanning the batch. States come back
+    [1]-shaped; first_row / string min/max come back as a GatherState
+    ([1]-shaped idx/has) for the caller to gather. Returns (states,
+    overflow) — overflow only from DISTINCT hash collisions, cleared by
+    the salted retry."""
     n = row_valid.shape[0]
     ctx = SegCtx(
         seg=jnp.zeros(n, jnp.int32),
@@ -453,15 +542,13 @@ def scalar_aggregate(aggs: list, row_valid: jax.Array, merge: bool = False):
     overflow = jnp.bool_(False)
     states = []
     for desc, arg_vals in aggs:
-        st, coll_flag = _gather_or_distinct_state(
-            desc, arg_vals, row_valid, merge, hp, ctx, perm, n, 1
-        )
-        if coll_flag is not None:
+        if _is_distinct_special(desc, arg_vals, merge):
+            st, coll_flag = _distinct_states(desc, arg_vals, row_valid, hp, 2, 1)
             overflow = overflow | coll_flag
-        if isinstance(st, GatherState):
-            states.append(GatherState(st.idx[:1], st.has[:1]))
-        elif st is not None:  # distinct states came back [2]-shaped
             states.append([(v[:1], nl[:1]) for v, nl in st])
+        elif _needs_gather_state(desc, arg_vals):
+            st = _gather_state_sorted(desc, arg_vals, row_valid, ctx, perm, n, merge)
+            states.append(GatherState(st.idx[:1], st.has[:1]))
         else:
             fn = _agg_states_merge if merge else _agg_states_raw
             states.append(fn(desc, arg_vals, row_valid, ctx))

@@ -2,13 +2,21 @@
 pkg/executor/join/hash_join_v2.go).
 
 The reference builds a string-keyed hash map then probes row by row. On TPU
-that becomes sort + binary search: sort the build side by normalized join
-keys; for each probe row, lower/upper-bound searchsorted gives the matching
-run [lo, hi). Output expansion (dynamic fan-out) lands in a static
-`out_capacity` table: a prefix sum over match counts assigns each output
-slot to a (probe, nth-match) pair, recovered with one more searchsorted —
-fully vectorized, no data-dependent shapes, overflow flagged for host
-fallback (SURVEY.md §7 hard parts: join fan-out).
+that becomes sort + binary search: sort the build side by join key; for each
+probe row, lower/upper-bound searchsorted gives the matching run [lo, hi).
+Single-word keys (ints, dates, decimals) sort on the key itself — exact.
+Multi-word keys (strings, composites) mix into ONE salted 63-bit hash word
+(ops/seg.py), so the build sort stays a cheap single-operand sort no matter
+the key arity; exactness is restored by two word-level checks — every build
+run must be internally uniform, and every hash-hit probe must word-match its
+run head — whose failure (hash collision) raises the overflow flag. The
+retry driver's capacity growth re-salts the hash, clearing the collision.
+
+Output expansion (dynamic fan-out) lands in a static `out_capacity` table:
+a prefix sum over match counts assigns each output slot to a (probe,
+nth-match) pair, recovered with one more searchsorted — fully vectorized,
+no data-dependent shapes, overflow flagged for host fallback (SURVEY.md §7
+hard parts: join fan-out).
 
 NULL join keys never match (SQL equi-join), mirroring the reference's
 skip-on-null (mpp_exec.go joinExec null key handling).
@@ -23,6 +31,9 @@ import jax.numpy as jnp
 
 from ..expr.compile import CompVal
 from .keys import lexsort, sort_key_arrays
+from .seg import MAX63, hash_words, run_head_pos, sort_by_word
+
+I64_MAX = jnp.int64(0x7FFFFFFFFFFFFFFF)
 
 
 @dataclass
@@ -65,49 +76,54 @@ def hash_join(
     bkeys, b_usable = _key_matrix(build_keys, build_valid)
     pkeys, p_usable = _key_matrix(probe_keys, probe_valid)
     nb = build_valid.shape[0]
-    I64_MAX = jnp.int64(0x7FFFFFFFFFFFFFFF)
+    overflow = jnp.bool_(False)
 
-    # Mask unusable (invalid / NULL-key) build rows to +max so the sorted
-    # array is globally ordered by key words alone — searchsorted needs
-    # that. A LEGITIMATE +max key (BIGINT max, +inf) collides with the mask
-    # value, so an unusable-last tiebreak key forces every masked row behind
-    # the usable rows of the max-key run; all unusable rows then occupy
-    # exactly the tail positions [nb_usable, nb), which the hi clip below
-    # removes.
-    def _maskmax(k):
-        top = jnp.inf if jnp.issubdtype(k.dtype, jnp.floating) else I64_MAX
-        return jnp.where(b_usable, k, top)
-
-    bkeys = [_maskmax(k) for k in bkeys]
-    bperm = lexsort(bkeys, extra_key=(~b_usable).astype(jnp.int64))
-    bkeys_s = [k[bperm] for k in bkeys]
-    nb_usable = b_usable.sum()
-
-    # Single-word keys (ints, dates, decimals, short strings): direct
-    # searchsorted. Multi-word keys: densify (build ∪ probe) tuples to ranks
-    # with one shared lexsort, then searchsorted on ranks.
-    if len(bkeys_s) == 1:
-        bk, pk = bkeys_s[0], pkeys[0]
-        lo = jnp.searchsorted(bk, pk, side="left").astype(jnp.int32)
-        hi = jnp.searchsorted(bk, pk, side="right").astype(jnp.int32)
+    if len(bkeys) == 1:
+        # exact single-word path: sort on the key itself. Mask unusable
+        # (invalid / NULL-key) build rows to +max so the sorted array is
+        # globally ordered by the key word alone — searchsorted needs that.
+        # A LEGITIMATE +max key (BIGINT max, +inf) collides with the mask
+        # value, so an unusable-last tiebreak key forces every masked row
+        # behind the usable rows of the max-key run; all unusable rows then
+        # occupy exactly the tail positions [nb_usable, nb), which the hi
+        # clip below removes.
+        bk, pk = bkeys[0], pkeys[0]
+        top = jnp.inf if jnp.issubdtype(bk.dtype, jnp.floating) else I64_MAX
+        bk_m = jnp.where(b_usable, bk, top)
+        bperm = lexsort([bk_m], extra_key=(~b_usable).astype(jnp.int64))
+        bk_s = bk_m[bperm]
+        nb_usable = b_usable.sum()
+        lo = jnp.searchsorted(bk_s, pk, side="left").astype(jnp.int32)
+        hi = jnp.searchsorted(bk_s, pk, side="right").astype(jnp.int32)
+        hi = jnp.minimum(hi, nb_usable.astype(jnp.int32))
+        lo = jnp.minimum(lo, hi)
     else:
-        # multi-word: map each word tuple to a dense rank via sorting the
-        # union (build + probe) once, then single searchsorted on ranks.
-        nb_, np_ = bkeys_s[0].shape[0], pkeys[0].shape[0]
-        allk = [jnp.concatenate([b, p]) for b, p in zip(bkeys_s, pkeys)]
-        operm = lexsort(allk)
-        ok = [k[operm] for k in allk]
-        diff = jnp.zeros(nb_ + np_, bool)
-        for k in ok:
-            diff = diff | jnp.concatenate([jnp.ones(1, bool), k[1:] != k[:-1]])
-        rank_sorted = jnp.cumsum(diff.astype(jnp.int64)) - 1
-        rank = jnp.zeros(nb_ + np_, jnp.int64).at[operm].set(rank_sorted)
-        brank, prank = rank[:nb_], rank[nb_:]
-        lo = jnp.searchsorted(brank, prank, side="left").astype(jnp.int32)
-        hi = jnp.searchsorted(brank, prank, side="right").astype(jnp.int32)
+        # multi-word keys: one salted hash word per side; unusable rows pin
+        # to the (odd, never-hashable) I64_MAX sentinel and sort last
+        salt = out_capacity
+        bh = jnp.where(b_usable, hash_words(bkeys, salt) & MAX63, I64_MAX)
+        ph = jnp.where(p_usable, hash_words(pkeys, salt) & MAX63, I64_MAX)
+        bh_s, bperm = sort_by_word(bh)
+        lo = jnp.searchsorted(bh_s, ph, side="left").astype(jnp.int32)
+        hi = jnp.searchsorted(bh_s, ph, side="right").astype(jnp.int32)
+        lo = jnp.minimum(lo, hi)
+        # exactness check 1: every build hash run is internally uniform
+        one = jnp.ones(1, bool)
+        diffb = jnp.concatenate([one, bh_s[1:] != bh_s[:-1]])
+        headb = run_head_pos(diffb)
+        bcoll = jnp.zeros(nb, bool)
+        for w in bkeys:
+            ws = w[bperm]
+            bcoll = bcoll | (ws != ws[headb])
+        overflow = overflow | jnp.any(bcoll & b_usable[bperm])
+        # exactness check 2: every hash-hit probe word-matches its run head
+        head_idx = bperm[jnp.clip(lo, 0, nb - 1)]
+        pmism = jnp.zeros(p_usable.shape[0], bool)
+        for bw, pw in zip(bkeys, pkeys):
+            pmism = pmism | (bw[head_idx] != pw)
+        hash_hit = p_usable & (hi > lo)
+        overflow = overflow | jnp.any(pmism & hash_hit)
 
-    hi = jnp.minimum(hi, nb_usable.astype(jnp.int32))
-    lo = jnp.minimum(lo, hi)
     counts = jnp.where(p_usable, hi - lo, 0)
     matched = counts > 0
 
@@ -118,7 +134,7 @@ def hash_join(
             build_null=jnp.ones(probe_valid.shape[0], bool),
             out_valid=probe_valid & matched,
             n_out=(probe_valid & matched).sum(),
-            overflow=jnp.bool_(False),
+            overflow=overflow,
         )
     if join_type == "anti":
         keep = probe_valid & ~matched
@@ -128,7 +144,7 @@ def hash_join(
             build_null=jnp.ones(probe_valid.shape[0], bool),
             out_valid=keep,
             n_out=keep.sum(),
-            overflow=jnp.bool_(False),
+            overflow=overflow,
         )
 
     if join_type == "left_outer":
@@ -136,7 +152,7 @@ def hash_join(
 
     offsets = jnp.cumsum(counts) - counts  # start slot per probe row
     total = counts.sum()
-    overflow = total > out_capacity
+    overflow = overflow | (total > out_capacity)
 
     slot = jnp.arange(out_capacity)
     # which probe row does each output slot belong to

@@ -28,7 +28,10 @@ import jax
 import jax.numpy as jnp
 
 I64_MAX = jnp.int64(0x7FFFFFFFFFFFFFFF)
-MAX63 = jnp.int64(0x7FFFFFFFFFFFFFFF)  # top bit clear: valid-hash space
+# valid-hash space: top bit clear AND low bit clear — a masked hash is even,
+# so it can never equal the (odd) I64_MAX invalid sentinel, keeping the
+# sorted seg ids monotone even in the astronomically-unlikely near-miss
+MAX63 = jnp.int64(0x7FFFFFFFFFFFFFFE)
 
 # splitmix64 finalizer constants (public domain; two's-complement int64)
 _C1 = jnp.int64(0xBF58476D1CE4E5B9 - (1 << 64))
@@ -77,9 +80,11 @@ def group_hash(words: list[jax.Array], valid: jax.Array, salt: int) -> jax.Array
 
 
 def sort_by_word(word: jax.Array):
-    """(sorted_word, perm int32) via one single-key sort."""
+    """(sorted_word, perm int32) via one single-key STABLE sort (position is
+    the second sort key, so equal words keep input order — segment heads are
+    then the earliest original rows, which group_rep reads off for free)."""
     iota = jnp.arange(word.shape[0], dtype=jnp.int32)
-    sw, perm = jax.lax.sort((word, iota), num_keys=1)
+    sw, perm = jax.lax.sort((word, iota), num_keys=2)
     return sw, perm
 
 
@@ -89,6 +94,8 @@ class SegCtx:
 
     seg: int32 [N] ascending; nseg static; starts/ends int32 [nseg]
     (ends inclusive; empty segment has ends < starts); counts int64 [nseg].
+    sums: optional SumBatch — when set, seg_sum calls are recorded and later
+    resolved as ONE batched [A, N] cumsum instead of A separate ones.
     """
 
     seg: jax.Array
@@ -96,6 +103,54 @@ class SegCtx:
     starts: jax.Array
     ends: jax.Array
     counts: jax.Array
+    sums: object = None
+
+
+class SumBatch:
+    """Record/replay batcher for seg_sum.
+
+    An aggregation typically needs many per-segment sums (counts, sums,
+    moment sums). Each one as its own int64 cumsum costs a separate
+    multi-pass op; stacked [A, N] they ride ONE cumsum whose lane dimension
+    vectorizes. Protocol: a dry trace pass records every requested array
+    (returning dummy zeros), resolve() computes the batched result, then an
+    identical replay pass receives the real arrays in the same order (the
+    states functions are pure, so the call sequence repeats exactly; any
+    non-sum ops traced twice are structurally identical and XLA CSE merges
+    them)."""
+
+    def __init__(self, ctx: "SegCtx"):
+        self.ctx = ctx
+        self.reqs: list = []
+        self.results: list | None = None
+        self.replay_i = 0
+
+    def add(self, v: jax.Array) -> jax.Array:
+        if self.results is None:
+            self.reqs.append(v)
+            return jnp.zeros((self.ctx.nseg,), v.dtype)
+        r = self.results[self.replay_i]
+        self.replay_i += 1
+        return r
+
+    def resolve(self):
+        ctx = self.ctx
+        n = ctx.seg.shape[0]
+        lo = jnp.clip(ctx.starts, 0, n - 1)
+        hi = jnp.clip(ctx.ends, 0, n - 1)
+        by_dtype: dict = {}
+        for i, v in enumerate(self.reqs):
+            by_dtype.setdefault(jnp.dtype(v.dtype), []).append((i, v))
+        results: list = [None] * len(self.reqs)
+        for dt, items in by_dtype.items():
+            s = jnp.stack([v for _, v in items], 0)  # [A, N]
+            c = jnp.cumsum(s, axis=1)
+            out = c[:, hi] - c[:, lo] + s[:, lo]
+            out = jnp.where(ctx.counts[None, :] > 0, out, jnp.zeros((), dt))
+            for j, (i, _) in enumerate(items):
+                results[i] = out[j]
+        self.results = results
+        self.replay_i = 0
 
 
 def make_segctx(seg: jax.Array, nseg: int) -> SegCtx:
@@ -104,12 +159,6 @@ def make_segctx(seg: jax.Array, nseg: int) -> SegCtx:
     ends = jnp.searchsorted(seg, g, side="right").astype(jnp.int32) - 1
     counts = jnp.maximum((ends - starts + 1).astype(jnp.int64), 0)
     return SegCtx(seg, nseg, starts, ends, counts)
-
-
-def seg_head_pos(ctx: SegCtx) -> jax.Array:
-    """Per-row sorted position of the row's segment head (int32 [N])."""
-    n = ctx.seg.shape[0]
-    return jnp.clip(ctx.starts, 0, n - 1)[ctx.seg]
 
 
 def run_head_pos(diff: jax.Array) -> jax.Array:
@@ -122,10 +171,13 @@ def run_head_pos(diff: jax.Array) -> jax.Array:
 
 def seg_sum(ctx: SegCtx, vals: jax.Array, dtype=None) -> jax.Array:
     """Per-segment sum via cumsum + boundary gathers (empty segments -> 0).
-    Callers pre-mask invalid lanes to 0, exactly as with segment_sum."""
+    Callers pre-mask invalid lanes to 0, exactly as with segment_sum.
+    Routed through ctx.sums (one batched cumsum) when a SumBatch is armed."""
     v = vals if dtype is None else vals.astype(dtype)
     if ctx.nseg == 1:
         return jnp.sum(v, axis=0, keepdims=True)
+    if ctx.sums is not None:
+        return ctx.sums.add(v)
     n = v.shape[0]
     c = jnp.cumsum(v, axis=0)
     lo = jnp.clip(ctx.starts, 0, n - 1)
@@ -135,37 +187,64 @@ def seg_sum(ctx: SegCtx, vals: jax.Array, dtype=None) -> jax.Array:
     return jnp.where(ctx.counts > 0, out, zero)
 
 
-def _seg_scan_reduce(ctx: SegCtx, vals: jax.Array, combine, empty_fill):
-    """Per-segment reduce of an arbitrary associative `combine` via a
-    segmented associative scan + gather at segment ends."""
+def _seg_scan_reduce(ctx: SegCtx, vals: jax.Array, combine, neutral, empty_fill):
+    """Per-segment reduce of an arbitrary associative `combine` via a manual
+    Hillis-Steele doubling scan (shift + where, log2(N) unrolled steps).
+
+    NOT lax.associative_scan: its tuple-carry form lowers to variadic
+    reduce-window, which on the TPU backend both hangs compilation at
+    multi-M row counts and trips a scoped-vmem XLA bug. Plain shifts and
+    selects compile as elementwise ops."""
     n = vals.shape[0]
-
-    def comb(a, b):
-        v1, s1 = a
-        v2, s2 = b
-        return jnp.where(s1 == s2, combine(v1, v2), v2), s2
-
-    sv, _ = jax.lax.associative_scan(comb, (vals, ctx.seg))
-    out = sv[jnp.clip(ctx.ends, 0, n - 1)]
+    v = vals
+    s = ctx.seg
+    neutral_arr = jnp.full((1,), neutral, vals.dtype)
+    d = 1
+    while d < n:
+        pv = jnp.concatenate([jnp.broadcast_to(neutral_arr, (d,)), v[:-d]])
+        ps = jnp.concatenate([jnp.full((d,), -1, s.dtype), s[:-d]])
+        v = jnp.where(s == ps, combine(v, pv), v)
+        d *= 2
+    out = v[jnp.clip(ctx.ends, 0, n - 1)]
     return jnp.where(ctx.counts > 0, out, empty_fill)
+
+
+def seg_first_match(ctx: SegCtx, mask_s: jax.Array):
+    """Per-segment sorted position of the FIRST mask row (int32 [nseg]),
+    plus a has-any flag. One cumsum + one searchsorted — no scan tricks.
+
+    With the stable sort_by_word order, the first masked sorted position in
+    a segment is also the masked row with the smallest original index."""
+    n = mask_s.shape[0]
+    c = jnp.cumsum(mask_s.astype(jnp.int32))
+    lo = jnp.clip(ctx.starts, 0, n - 1)
+    hi = jnp.clip(ctx.ends, 0, n - 1)
+    base = c[lo] - mask_s[lo].astype(jnp.int32)  # masked rows strictly before
+    first = jnp.searchsorted(c, base + 1, side="left").astype(jnp.int32)
+    incount = c[hi] - base
+    has = (ctx.counts > 0) & (incount > 0)
+    return jnp.where(has, jnp.clip(first, 0, n - 1), 0), has
 
 
 def seg_min(ctx: SegCtx, vals: jax.Array) -> jax.Array:
     if ctx.nseg == 1:
         return jnp.min(vals, axis=0, keepdims=True)
     fill = jnp.inf if jnp.issubdtype(vals.dtype, jnp.floating) else jnp.iinfo(vals.dtype).max
-    return _seg_scan_reduce(ctx, vals, jnp.minimum, jnp.asarray(fill, vals.dtype))
+    f = jnp.asarray(fill, vals.dtype)
+    return _seg_scan_reduce(ctx, vals, jnp.minimum, f, f)
 
 
 def seg_max(ctx: SegCtx, vals: jax.Array) -> jax.Array:
     if ctx.nseg == 1:
         return jnp.max(vals, axis=0, keepdims=True)
     fill = -jnp.inf if jnp.issubdtype(vals.dtype, jnp.floating) else jnp.iinfo(vals.dtype).min
-    return _seg_scan_reduce(ctx, vals, jnp.maximum, jnp.asarray(fill, vals.dtype))
+    f = jnp.asarray(fill, vals.dtype)
+    return _seg_scan_reduce(ctx, vals, jnp.maximum, f, f)
 
 
 def seg_bitreduce(ctx: SegCtx, red, vals: jax.Array, fill) -> jax.Array:
     """Segmented bitwise and/or/xor (no jax.ops.segment_* exists for these;
-    callers pre-mask invalid lanes to the identity). The segmented scan
+    callers pre-mask invalid lanes to the identity). The doubling scan
     handles nseg==1 too (one segment == plain scan, last element = total)."""
-    return _seg_scan_reduce(ctx, vals, red, jnp.int64(fill))
+    f = jnp.int64(fill)
+    return _seg_scan_reduce(ctx, vals, red, f, f)
