@@ -330,3 +330,60 @@ class TestBitAggs:
         res = group_aggregate([g], [(AggDesc("bit_or", (_col(1, FT),)), [a])], jnp.ones(4, bool), 8)
         got = sorted(int(v) for v in res.states[0][0][0][: int(res.n_groups)])
         assert got == sorted([0b11, 0b101])
+
+
+class TestDenseSmallG:
+    def test_dense_matches_sort_kernel(self):
+        """The stats-hinted dense small-G kernel must be bit-identical to
+        the sort kernel (same states, same first-encounter order)."""
+        import jax.numpy as jnp
+
+        from tidb_tpu.expr import col
+        from tidb_tpu.expr.agg import AggDesc
+        from tidb_tpu.ops.aggregate import group_aggregate
+
+        fts, ch = make_data(n=200, k_card=5)
+        db, vals = eval_vals(fts, ch, [col(0, fts[0]), col(1, fts[1]), col(2, fts[2])])
+        g, d, r = vals
+        aggs = [
+            (AggDesc("count", ()), []),
+            (AggDesc("sum", (col(1, fts[1]),)), [d]),
+            (AggDesc("avg", (col(2, fts[2]),)), [r]),
+            (AggDesc("min", (col(1, fts[1]),)), [d]),
+            (AggDesc("first_row", (col(0, fts[0]),)), [g]),
+        ]
+        import numpy as np
+
+        rng = np.random.default_rng(3)
+        valid = db.row_valid & jnp.asarray(rng.random(200) < 0.8)  # filtered rows
+        ref = group_aggregate([g], aggs, valid, 64)
+        dense = group_aggregate([g], aggs, valid, 64, small_groups=8)
+        assert not bool(dense.overflow)
+        ng = int(ref.n_groups)
+        assert int(dense.n_groups) == ng
+        assert jnp.array_equal(ref.group_rep[:ng], dense.group_rep[:ng])
+        for rs, ds in zip(ref.states, dense.states):
+            if hasattr(rs, "idx"):
+                assert jnp.array_equal(rs.idx[:ng], ds.idx[:ng])
+                assert jnp.array_equal(rs.has[:ng], ds.has[:ng])
+            else:
+                for (rv, rn), (dv, dn) in zip(rs, ds):
+                    if jnp.issubdtype(rv.dtype, jnp.floating):
+                        # float sums accumulate in different orders
+                        # (cumsum-sorted vs masked-original) — last-ulp only
+                        assert jnp.allclose(rv[:ng], dv[:ng], rtol=1e-12)
+                    else:
+                        assert jnp.array_equal(rv[:ng], dv[:ng])
+                    assert jnp.array_equal(rn[:ng], dn[:ng])
+
+    def test_dense_overflow_when_hint_wrong(self):
+        """More groups than the hint -> overflow flag (driver falls back)."""
+        from tidb_tpu.expr import col
+        from tidb_tpu.expr.agg import AggDesc
+        from tidb_tpu.ops.aggregate import group_aggregate
+
+        fts, ch = make_data(n=200, k_card=50)
+        db, vals = eval_vals(fts, ch, [col(0, fts[0])])
+        (g,) = vals
+        res = group_aggregate([g], [(AggDesc("count", ()), [])], db.row_valid, 64, small_groups=4)
+        assert bool(res.overflow)

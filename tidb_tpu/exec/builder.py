@@ -67,7 +67,7 @@ class _TraceState:
         self.ex_rows: list = []
 
 
-def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, state: _TraceState, topn_full: bool = False):
+def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, state: _TraceState, topn_full: bool = False, small_groups: int | None = None):
     """Trace one executor pipeline; recursion handles Join build sides.
 
     batches are consumed in canonical scan order (dag.collect_scans);
@@ -102,7 +102,7 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
             cols = _gather(cols, idx)
             valid = out_valid
         elif isinstance(ex, Join):
-            bcols, bvalid, bfts = _run_pipeline(ex.build, batches, cursor, group_capacity, join_capacity, state, topn_full)
+            bcols, bvalid, bfts = _run_pipeline(ex.build, batches, cursor, group_capacity, join_capacity, state, topn_full, small_groups)
             bcomp = ExprCompiler(bfts)
             bkeys = bcomp.run(list(ex.build_keys), bcols)
             pkeys = comp.run(list(ex.probe_keys), cols)
@@ -149,14 +149,14 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
                 k += len(a.args)
             new_cols: list[CompVal] = []
             if ex.group_by:
-                res = group_aggregate(gvals, aggs, valid, group_capacity, merge=ex.merge)
+                res = group_aggregate(gvals, aggs, valid, group_capacity, merge=ex.merge, small_groups=small_groups)
                 state.group_overflow = state.group_overflow | res.overflow
                 for (a, av), st in zip(aggs, res.states):
                     new_cols.extend(_agg_result_cols(a, av, st, res.group_valid, ex.partial))
                 new_cols.extend(_gather(gvals, res.group_rep))
                 valid = res.group_valid
             else:
-                states, s_ovf = scalar_aggregate(aggs, valid, merge=ex.merge)
+                states, s_ovf = scalar_aggregate(aggs, valid, merge=ex.merge, salt=group_capacity)
                 state.group_overflow = state.group_overflow | s_ovf
                 ones = jnp.ones(1, bool)
                 for (a, av), st in zip(aggs, states):
@@ -192,6 +192,7 @@ def build_program(
     group_capacity: int = DEFAULT_GROUP_CAPACITY,
     join_capacity: int | None = None,
     topn_full: bool = False,
+    small_groups: int | None = None,
 ) -> CompiledDAG:
     """Compile the whole DAG tree (probe pipeline + all join build
     pipelines) into one fused XLA program over a tuple of device batches."""
@@ -205,7 +206,7 @@ def build_program(
     def program(*batches):
         state = _TraceState()
         cursor = [0]
-        cols, valid, _ = _run_pipeline(dag.executors, batches, cursor, group_capacity, join_capacity, state, topn_full)
+        cols, valid, _ = _run_pipeline(dag.executors, batches, cursor, group_capacity, join_capacity, state, topn_full, small_groups)
         outs = [cols[i] for i in dag.output_offsets]
         packed = []
         for c in outs:
@@ -254,17 +255,18 @@ class ProgramCache:
         group_capacity: int = DEFAULT_GROUP_CAPACITY,
         join_capacity: int | None = None,
         topn_full: bool = False,
+        small_groups: int | None = None,
     ) -> CompiledDAG:
         if isinstance(capacities, int):
             capacities = (capacities,)
         capacities = tuple(capacities)
-        key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full)
+        key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full, small_groups)
         prog = self._cache.get(key)
         if prog is None:
             from ..util import metrics
 
             metrics.PROGRAM_COMPILES.inc()
-            prog = build_program(dag, capacities, group_capacity, join_capacity, topn_full)
+            prog = build_program(dag, capacities, group_capacity, join_capacity, topn_full, small_groups)
             self._cache[key] = prog
         return prog
 

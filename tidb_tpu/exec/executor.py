@@ -80,7 +80,7 @@ def decode_outputs(packed, valid, out_fts) -> Chunk:
 DEFAULT_PROGRAM_CACHE = ProgramCache()
 
 
-def drive_program(cache: ProgramCache, dag: DAGRequest, batches, group_capacity: int, max_retries: int = 3, join_capacity: int | None = None):
+def drive_program(cache: ProgramCache, dag: DAGRequest, batches, group_capacity: int, max_retries: int = 3, join_capacity: int | None = None, small_groups: int | None = None):
     """Run the fused program, growing group/join capacity on overflow
     (the single overflow-retry contract — store and host driver share it).
 
@@ -93,15 +93,19 @@ def drive_program(cache: ProgramCache, dag: DAGRequest, batches, group_capacity:
     gc = group_capacity
     jc = join_capacity or max(caps)
     tf = False
+    smg = small_groups
     for _ in range(max_retries + 1):
-        prog = cache.get(dag, caps, gc, jc, tf)
+        prog = cache.get(dag, caps, gc, jc, tf, smg)
         packed, valid, n, (g_ovf, j_ovf, t_ovf), ex_rows = prog.fn(*batches)
         g_ovf, j_ovf, t_ovf = bool(g_ovf), bool(j_ovf), bool(t_ovf)
         if not g_ovf and not j_ovf and not t_ovf:
             counts = [int(x) for x in np.asarray(ex_rows)]
             return decode_outputs(packed, valid, prog.out_fts), counts
         if g_ovf:
-            gc *= 4  # grow only the capacity that overflowed
+            if smg is not None:
+                smg = None  # stats hint was wrong: fall back to sort kernel
+            else:
+                gc *= 4  # grow only the capacity that overflowed
         if j_ovf:
             jc *= 4
         if t_ovf:
@@ -121,13 +125,14 @@ def run_dag_on_chunks(
     group_capacity: int = DEFAULT_GROUP_CAPACITY,
     max_retries: int = 3,
     oracle_fallback: bool = True,
+    small_groups: int | None = None,
 ) -> Chunk:
     """Device path over one chunk per scan; falls back to the reference
     evaluator when capacity retries are exhausted (degenerate fan-out)."""
     cache = cache or DEFAULT_PROGRAM_CACHE
     batches = [to_device_batch(c, capacity=_pow2(max(c.num_rows(), 1))) for c in chunks]
     try:
-        return drive_program(cache, dag, batches, group_capacity, max_retries)[0]
+        return drive_program(cache, dag, batches, group_capacity, max_retries, small_groups=small_groups)[0]
     except (OverflowRetryError, NotImplementedError):
         # capacity exhaustion OR a host-only operator (replace,
         # group_concat): the row-at-a-time oracle is the documented fallback

@@ -45,6 +45,7 @@ from ..types import FieldType, TypeCode
 from .keys import segments_from_sorted, sort_key_arrays
 from .seg import (
     I64_MAX,
+    DenseCtx,
     SegCtx,
     SumBatch,
     group_hash,
@@ -55,7 +56,6 @@ from .seg import (
     seg_max,
     seg_min,
     seg_sum,
-    sort_by_word,
 )
 
 I64_MIN_ = jnp.int64(-0x8000000000000000)
@@ -371,18 +371,111 @@ def _is_distinct_special(desc, arg_vals, merge) -> bool:
     return False
 
 
+def _dense_eligible(aggs, merge) -> bool:
+    """The dense small-G kernel handles everything except DISTINCT and
+    string-valued gather aggregates (their word-matrix machinery assumes
+    the sorted layout)."""
+    for desc, avs in aggs:
+        if desc.distinct:
+            return False
+        if desc.name in ("min", "max") and avs and avs[-1].value.ndim == 2:
+            return False
+        if desc.name == "group_concat":
+            return False
+    return True
+
+
+def _group_aggregate_dense(group_bys, aggs, row_valid, g_cap: int, merge: bool):
+    """Sort-free small-G aggregation (see seg.DenseCtx): g_cap min-reduction
+    rounds extract the distinct group hashes, g_cap compares assign dense
+    ids, and states are masked full-array reductions. Overflow (more groups
+    than g_cap, or a hash collision caught by the second-hash consistency
+    check) sends the driver to the sort kernel."""
+    n = row_valid.shape[0]
+    keys: list[jax.Array] = []
+    for g in group_bys:
+        keys.extend(sort_key_arrays(g))
+    hp = group_hash(keys, row_valid, salt=g_cap)
+    hv = hash_words(keys, g_cap + 0x9E3779B9)
+
+    cur = hp
+    tbl = []
+    for _ in range(g_cap):
+        m = jnp.min(cur)
+        tbl.append(m)
+        cur = jnp.where(cur == m, I64_MAX, cur)
+    overflow = jnp.min(cur) != I64_MAX
+    tbl_arr = jnp.stack(tbl)
+    n_groups = (tbl_arr != I64_MAX).sum().astype(jnp.int32)
+
+    gid = jnp.zeros(n, jnp.int32)
+    for t in tbl:
+        gid = gid + (hp > t).astype(jnp.int32)
+    nseg = g_cap + 1
+    masks = [gid == i for i in range(nseg)]
+    ctx = DenseCtx(gid=gid, nseg=nseg, masks=masks)
+
+    # collision check: the secondary hash must be constant within a group.
+    # Invalid (filtered) rows share the slot right after the last real
+    # group — mask them out, their hv is unrelated.
+    coll = jnp.bool_(False)
+    for i in range(g_cap):
+        vm = masks[i] & row_valid
+        mx = jnp.max(jnp.where(vm, hv, I64_MIN_))
+        mn = jnp.min(jnp.where(vm, hv, I64_MAX))
+        coll = coll | ((vm.sum() > 0) & (mx != mn))
+    overflow = overflow | coll
+
+    group_rep_full, _ = seg_first_match(ctx, row_valid)
+    group_rep = group_rep_full[:g_cap]
+    gids = jnp.arange(g_cap, dtype=jnp.int32)
+    group_valid = gids < n_groups
+
+    states = []
+    for desc, arg_vals in aggs:
+        if _needs_gather_state(desc, arg_vals):
+            st = _gather_state_sorted(
+                desc, arg_vals, row_valid, ctx, jnp.arange(n, dtype=jnp.int32), n, merge
+            )
+        else:
+            fn = _agg_states_merge if merge else _agg_states_raw
+            st = fn(desc, arg_vals, row_valid, ctx)
+        if isinstance(st, GatherState):
+            states.append(GatherState(st.idx[:g_cap], st.has[:g_cap] & group_valid))
+            continue
+        st = [(v[:g_cap], nl[:g_cap]) for v, nl in st]
+        st = [(v, nl | ~group_valid) for v, nl in st]
+        states.append(st)
+
+    order = jnp.argsort(jnp.where(group_valid, group_rep, jnp.int32(n)))
+    group_rep = group_rep[order]
+    out_states: list = []
+    for st in states:
+        if isinstance(st, GatherState):
+            out_states.append(GatherState(st.idx[order], st.has[order]))
+        else:
+            out_states.append([(v[order], nl[order]) for v, nl in st])
+    return GroupAggResult(group_rep, group_valid, jnp.minimum(n_groups, g_cap), overflow, out_states)
+
+
 def group_aggregate(
     group_bys: list[CompVal],
     aggs: list,
     row_valid: jax.Array,
     group_capacity: int,
     merge: bool = False,
+    small_groups: int | None = None,
 ):
     """Hash-cluster group aggregation.
 
     aggs: list of (AggDesc, [arg CompVals]). Returns GroupAggResult with one
     extra hidden overflow segment dropped; groups in first-encounter order.
+    small_groups: statistics-driven hint (planner NDV product) — when set
+    and the agg mix allows it, the sort-free dense kernel runs instead; its
+    overflow flag routes the driver back here.
     """
+    if small_groups and group_bys and _dense_eligible(aggs, merge):
+        return _group_aggregate_dense(group_bys, aggs, row_valid, small_groups, merge)
     n = row_valid.shape[0]
     keys: list[jax.Array] = []
     for g in group_bys:
@@ -520,7 +613,7 @@ def group_aggregate(
     return GroupAggResult(group_rep, group_valid, jnp.minimum(n_groups, group_capacity), overflow, out_states)
 
 
-def scalar_aggregate(aggs: list, row_valid: jax.Array, merge: bool = False):
+def scalar_aggregate(aggs: list, row_valid: jax.Array, merge: bool = False, salt: int = 1):
     """Aggregation without GROUP BY: always exactly one output row
     (ref: SELECT count(*) over empty set returns 0).
 
@@ -543,7 +636,7 @@ def scalar_aggregate(aggs: list, row_valid: jax.Array, merge: bool = False):
     states = []
     for desc, arg_vals in aggs:
         if _is_distinct_special(desc, arg_vals, merge):
-            st, coll_flag = _distinct_states(desc, arg_vals, row_valid, hp, 2, 1)
+            st, coll_flag = _distinct_states(desc, arg_vals, row_valid, hp, 2, salt)
             overflow = overflow | coll_flag
             states.append([(v[:1], nl[:1]) for v, nl in st])
         elif _needs_gather_state(desc, arg_vals):

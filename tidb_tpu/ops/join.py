@@ -31,9 +31,7 @@ import jax.numpy as jnp
 
 from ..expr.compile import CompVal
 from .keys import lexsort, sort_key_arrays
-from .seg import MAX63, hash_words, run_head_pos, sort_by_word
-
-I64_MAX = jnp.int64(0x7FFFFFFFFFFFFFFF)
+from .seg import I64_MAX, MAX63, hash_words, run_head_pos, sort_by_word
 
 
 @dataclass
@@ -93,8 +91,12 @@ def hash_join(
         bperm = lexsort([bk_m], extra_key=(~b_usable).astype(jnp.int64))
         bk_s = bk_m[bperm]
         nb_usable = b_usable.sum()
-        lo = jnp.searchsorted(bk_s, pk, side="left").astype(jnp.int32)
-        hi = jnp.searchsorted(bk_s, pk, side="right").astype(jnp.int32)
+        # method='sort': the merge formulation (sort queries with the
+        # haystack + cumsum) — the default binary search is ~17 serial
+        # gather rounds, ~18ms per 64K queries on TPU; the merge is one
+        # cheap variadic sort
+        lo = jnp.searchsorted(bk_s, pk, side="left", method="sort").astype(jnp.int32)
+        hi = jnp.searchsorted(bk_s, pk, side="right", method="sort").astype(jnp.int32)
         hi = jnp.minimum(hi, nb_usable.astype(jnp.int32))
         lo = jnp.minimum(lo, hi)
     else:
@@ -104,8 +106,8 @@ def hash_join(
         bh = jnp.where(b_usable, hash_words(bkeys, salt) & MAX63, I64_MAX)
         ph = jnp.where(p_usable, hash_words(pkeys, salt) & MAX63, I64_MAX)
         bh_s, bperm = sort_by_word(bh)
-        lo = jnp.searchsorted(bh_s, ph, side="left").astype(jnp.int32)
-        hi = jnp.searchsorted(bh_s, ph, side="right").astype(jnp.int32)
+        lo = jnp.searchsorted(bh_s, ph, side="left", method="sort").astype(jnp.int32)
+        hi = jnp.searchsorted(bh_s, ph, side="right", method="sort").astype(jnp.int32)
         lo = jnp.minimum(lo, hi)
         # exactness check 1: every build hash run is internally uniform
         one = jnp.ones(1, bool)
@@ -156,7 +158,7 @@ def hash_join(
 
     slot = jnp.arange(out_capacity)
     # which probe row does each output slot belong to
-    probe_of = jnp.searchsorted(offsets + counts, slot, side="right").astype(jnp.int32)
+    probe_of = jnp.searchsorted(offsets + counts, slot, side="right", method="sort").astype(jnp.int32)
     probe_of = jnp.minimum(probe_of, probe_valid.shape[0] - 1)
     nth = slot - offsets[probe_of]
     b_sorted_pos = lo[probe_of] + nth.astype(jnp.int32)

@@ -153,6 +153,36 @@ class SumBatch:
         self.replay_i = 0
 
 
+@dataclass
+class DenseCtx:
+    """Small-G group context over rows in ORIGINAL order (no sort at all).
+
+    For the classic OLAP shape — huge scan, handful of groups (TPC-H Q1 has
+    six) — the grouping sort is pure overhead. Distinct group hashes are
+    extracted with g_cap min-reductions, per-row dense ids come from g_cap
+    compares, and every segment reduction is a masked full-array reduction
+    per group. All VPU-friendly passes; cost scales with g_cap, so the
+    planner only picks this when statistics promise few groups (NDV), and
+    the overflow flag falls back to the sort kernel when the promise was
+    wrong. masks is a trace-time list of [N] bool arrays, one per slot
+    (slot nseg-1 = invalid/overflow rows)."""
+
+    gid: jax.Array
+    nseg: int
+    masks: list
+
+
+def dense_first_match(ctx: DenseCtx, mask: jax.Array):
+    """Per-group ORIGINAL position of the first mask row + has-any flag.
+    (Dense rows are unsorted, so 'first' = min original index directly.)"""
+    n = mask.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    fis = [jnp.min(jnp.where(m & mask, iota, jnp.int32(n))) for m in ctx.masks]
+    fi = jnp.stack(fis)
+    has = fi < n
+    return jnp.where(has, fi, 0), has
+
+
 def make_segctx(seg: jax.Array, nseg: int) -> SegCtx:
     g = jnp.arange(nseg, dtype=seg.dtype)
     starts = jnp.searchsorted(seg, g, side="left").astype(jnp.int32)
@@ -169,11 +199,15 @@ def run_head_pos(diff: jax.Array) -> jax.Array:
     return jax.lax.cummax(jnp.where(diff, pos, jnp.int32(0)))
 
 
-def seg_sum(ctx: SegCtx, vals: jax.Array, dtype=None) -> jax.Array:
+def seg_sum(ctx, vals: jax.Array, dtype=None) -> jax.Array:
     """Per-segment sum via cumsum + boundary gathers (empty segments -> 0).
     Callers pre-mask invalid lanes to 0, exactly as with segment_sum.
-    Routed through ctx.sums (one batched cumsum) when a SumBatch is armed."""
+    Routed through ctx.sums (one batched cumsum) when a SumBatch is armed;
+    DenseCtx does one masked full reduction per group."""
     v = vals if dtype is None else vals.astype(dtype)
+    if isinstance(ctx, DenseCtx):
+        zero = jnp.zeros((), v.dtype)
+        return jnp.stack([jnp.sum(jnp.where(m, v, zero)) for m in ctx.masks])
     if ctx.nseg == 1:
         return jnp.sum(v, axis=0, keepdims=True)
     if ctx.sums is not None:
@@ -209,12 +243,15 @@ def _seg_scan_reduce(ctx: SegCtx, vals: jax.Array, combine, neutral, empty_fill)
     return jnp.where(ctx.counts > 0, out, empty_fill)
 
 
-def seg_first_match(ctx: SegCtx, mask_s: jax.Array):
+def seg_first_match(ctx, mask_s: jax.Array):
     """Per-segment sorted position of the FIRST mask row (int32 [nseg]),
     plus a has-any flag. One cumsum + one searchsorted — no scan tricks.
 
     With the stable sort_by_word order, the first masked sorted position in
-    a segment is also the masked row with the smallest original index."""
+    a segment is also the masked row with the smallest original index.
+    (DenseCtx rows are unsorted; positions are original indices.)"""
+    if isinstance(ctx, DenseCtx):
+        return dense_first_match(ctx, mask_s)
     n = mask_s.shape[0]
     c = jnp.cumsum(mask_s.astype(jnp.int32))
     lo = jnp.clip(ctx.starts, 0, n - 1)
@@ -226,25 +263,35 @@ def seg_first_match(ctx: SegCtx, mask_s: jax.Array):
     return jnp.where(has, jnp.clip(first, 0, n - 1), 0), has
 
 
-def seg_min(ctx: SegCtx, vals: jax.Array) -> jax.Array:
-    if ctx.nseg == 1:
-        return jnp.min(vals, axis=0, keepdims=True)
+def seg_min(ctx, vals: jax.Array) -> jax.Array:
     fill = jnp.inf if jnp.issubdtype(vals.dtype, jnp.floating) else jnp.iinfo(vals.dtype).max
     f = jnp.asarray(fill, vals.dtype)
+    if isinstance(ctx, DenseCtx):
+        return jnp.stack([jnp.min(jnp.where(m, vals, f)) for m in ctx.masks])
+    if ctx.nseg == 1:
+        return jnp.min(vals, axis=0, keepdims=True)
     return _seg_scan_reduce(ctx, vals, jnp.minimum, f, f)
 
 
-def seg_max(ctx: SegCtx, vals: jax.Array) -> jax.Array:
-    if ctx.nseg == 1:
-        return jnp.max(vals, axis=0, keepdims=True)
+def seg_max(ctx, vals: jax.Array) -> jax.Array:
     fill = -jnp.inf if jnp.issubdtype(vals.dtype, jnp.floating) else jnp.iinfo(vals.dtype).min
     f = jnp.asarray(fill, vals.dtype)
+    if isinstance(ctx, DenseCtx):
+        return jnp.stack([jnp.max(jnp.where(m, vals, f)) for m in ctx.masks])
+    if ctx.nseg == 1:
+        return jnp.max(vals, axis=0, keepdims=True)
     return _seg_scan_reduce(ctx, vals, jnp.maximum, f, f)
 
 
-def seg_bitreduce(ctx: SegCtx, red, vals: jax.Array, fill) -> jax.Array:
+def seg_bitreduce(ctx, red, vals: jax.Array, fill) -> jax.Array:
     """Segmented bitwise and/or/xor (no jax.ops.segment_* exists for these;
     callers pre-mask invalid lanes to the identity). The doubling scan
     handles nseg==1 too (one segment == plain scan, last element = total)."""
     f = jnp.int64(fill)
+    if isinstance(ctx, DenseCtx):
+        outs = []
+        for m in ctx.masks:
+            mv = jnp.where(m, vals, f)
+            outs.append(jax.lax.reduce(mv, f, lambda a, b: red(a, b), (0,)))
+        return jnp.stack(outs)
     return _seg_scan_reduce(ctx, vals, red, f, f)

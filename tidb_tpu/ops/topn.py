@@ -2,24 +2,28 @@
 pkg/executor/sortexec/topn.go:38).
 
 The reference keeps a heap over evaluated sort keys. A full lexsort of the
-batch is correct but wastes ~40x: sorting N rows to keep k=100. TPU shape:
-`lax.top_k` threshold refinement —
+batch is correct but wastes ~40x (sorting N rows to keep k=100), and on TPU
+even `lax.top_k` lowers to a sort. TPU shape — no large sort at all:
 
-  1. fold (row validity, first-key null flag) into one word s0, find the
-     k-th smallest s0 (top_k over the bit-inverted word);
-  2. among rows at that s0, find the k-th smallest first value word w1;
-  3. candidates = rows strictly better than (s0kth) plus rows at s0kth with
-     w1 <= w1kth — a guaranteed superset of the true top k;
-  4. compact the first CAP candidate positions with one more top_k, then a
-     CAP-sized stable lexsort over ALL key words breaks the remaining ties.
+  1. fold (row validity, first-key null flag) into one word s0; strided-
+     sample S pairs (s0, w1) and sort just the SAMPLE (tiny);
+  2. pick the j-th sample pair as a threshold, j sized so the expected
+     candidate count lands in [k, CAP];
+  3. candidates = rows lexicographically <= threshold on (s0, w1). VERIFY:
+     if count >= min(k, n_valid) the candidate set provably contains the
+     true top k (any non-candidate is beaten by >= k candidates); if the
+     count is also <= CAP the fast path is EXACT;
+  4. compact the candidate positions with cumsum + searchsorted (CAP
+     queries — no scatter, no sort), then a CAP-sized stable lexsort over
+     ALL key words breaks the remaining ties.
 
-If candidates overflow CAP (massive ties on the first value word), the
-overflow flag fires and the retry driver recompiles with full_sort=True —
-the exact full lexsort, same stable result, just slower. Compiling the full
-sort INSIDE a lax.cond would pay its (size-proportional) compile cost on
-every TopN plan, so the slow variant is a separate cached program. Large k
-(>2048) goes straight to the full sort (TopN at that size is a sort
-anyway)."""
+If verification fails (tie-heavy first word, adversarial distribution, or
+fewer valid rows than the sample can see), the overflow flag fires and the
+retry driver recompiles with full_sort=True — the exact full lexsort, same
+stable result, just slower. Compiling the full sort INSIDE a lax.cond would
+pay its (size-proportional) compile cost on every TopN plan, so the slow
+variant is a separate cached program. Large k (>2048) goes straight to the
+full sort (TopN at that size is a sort anyway)."""
 
 from __future__ import annotations
 
@@ -28,11 +32,10 @@ import jax.numpy as jnp
 
 from ..expr.compile import CompVal
 from .keys import lexsort, sort_key_arrays
-
-I64_MAX = jnp.int64(0x7FFFFFFFFFFFFFFF)
+from .seg import I64_MAX
 
 FAST_K_LIMIT = 2048  # beyond this, full sort is the right kernel
-CAND_FACTOR = 4  # candidate capacity = next pow2 of CAND_FACTOR*k
+SAMPLE = 16384  # threshold sample size
 
 
 def _pow2(x: int) -> int:
@@ -40,15 +43,6 @@ def _pow2(x: int) -> int:
     while c < x:
         c *= 2
     return c
-
-
-def _kth_smallest(x, mask, k: int):
-    """k-th smallest value of x over mask rows (dtype max if fewer)."""
-    if jnp.issubdtype(x.dtype, jnp.floating):
-        v = jnp.where(mask, x, jnp.inf)
-        return -jax.lax.top_k(-v, k)[0][k - 1]
-    v = jnp.where(mask, x, jnp.asarray(jnp.iinfo(x.dtype).max, x.dtype))
-    return ~jax.lax.top_k(~v, k)[0][k - 1]
 
 
 def topn(by: list, row_valid, k: int, full_sort: bool = False):
@@ -72,7 +66,15 @@ def topn(by: list, row_valid, k: int, full_sort: bool = False):
         perm = lexsort([invalid_last] + keys)
         return perm[:k].astype(jnp.int32)
 
-    cap = _pow2(CAND_FACTOR * k)
+    stride = max(1, n // SAMPLE)
+    s_count = n // stride  # sampled pairs
+    # expected candidates per sample rank is n/s_count; margin past the
+    # k-quantile scales with the Poisson deviation of the sample count so
+    # candidate underflow (a spurious full-sort recompile) stays a tail
+    # event for every k, not just small ones
+    base = (k * s_count) // n
+    j = min(base + 4 + 2 * int(base ** 0.5), s_count - 1)
+    cap = _pow2(max(4 * k, 8 * (n // s_count), 256))
     if full_sort or k < 1 or k > FAST_K_LIMIT or cap >= n or len(keys) < 2:
         return full_sort_idx(), out_valid, jnp.bool_(False)
 
@@ -80,18 +82,23 @@ def topn(by: list, row_valid, k: int, full_sort: bool = False):
     # <=3 distinct values, so the real selection happens on w1
     s0 = jnp.where(row_valid, keys[0], I64_MAX)
     w1 = keys[1]
-    s0kth = _kth_smallest(s0, row_valid, k)
-    at_kth = row_valid & (s0 == s0kth)
-    w1kth = _kth_smallest(w1, at_kth, k)
-    cand = row_valid & ((s0 < s0kth) | (at_kth & (w1 <= w1kth)))
-    cnt = cand.sum()
+    w1f = jnp.issubdtype(w1.dtype, jnp.floating)
+    w1_top = jnp.asarray(jnp.inf if w1f else jnp.iinfo(w1.dtype).max, w1.dtype)
+    w1m = jnp.where(row_valid, w1, w1_top)
 
-    # first `cap` candidate positions, ascending (top_k of inverted pos)
-    pos = jnp.arange(n, dtype=jnp.int32)
-    cpos = ~jax.lax.top_k(~jnp.where(cand, pos, jnp.int32(n)), cap)[0]
-    cvalid = cpos < n
+    s0_s, w1_s = jax.lax.sort((s0[::stride][:s_count], w1m[::stride][:s_count]), num_keys=2)
+    ts0, tw1 = s0_s[j], w1_s[j]
+    cand = row_valid & ((s0 < ts0) | ((s0 == ts0) & (w1m <= tw1)))
+    cnt = cand.sum().astype(jnp.int32)
+    overflow = (cnt < jnp.minimum(jnp.int32(k), n_valid.astype(jnp.int32))) | (cnt > cap)
+
+    # compact first `cap` candidate positions: cumsum + searchsorted
+    # (ascending by construction — stability preserved)
+    c = jnp.cumsum(cand.astype(jnp.int32))
+    cpos = jnp.searchsorted(c, jnp.arange(1, cap + 1, dtype=jnp.int32), side="left").astype(jnp.int32)
+    cvalid = jnp.arange(cap, dtype=jnp.int32) < cnt
     cpos_c = jnp.clip(cpos, 0, n - 1)
     small_keys = [jnp.where(cvalid, jnp.int64(0), jnp.int64(1))] + [kk[cpos_c] for kk in keys]
     perm_s = lexsort(small_keys, extra_key=cpos_c.astype(jnp.int64))
     fast_idx = cpos_c[perm_s[:k]].astype(jnp.int32)
-    return fast_idx, out_valid, cnt > cap
+    return fast_idx, out_valid, overflow
