@@ -193,3 +193,39 @@ def test_failed_statement_in_autocommit_leaves_no_trace(pair):
         s1.execute("INSERT INTO t VALUES (1, 999)")  # dup pk
     assert s1.execute("SELECT count(*) FROM t").values() == [[2]]
     assert not s1.store.txn.locks
+
+
+class TestReplaceIgnoreUnique:
+    """ADVICE r2: REPLACE INTO / INSERT IGNORE on a SECONDARY unique-index
+    conflict must follow MySQL semantics (ref: executor/replace.go
+    removeRow; insert IGNORE duplicate-as-warning), not raise."""
+
+    def _mk(self):
+        from tidb_tpu.sql import Session
+
+        s = Session()
+        s.execute("create table t (id bigint primary key, u bigint, v varchar(10), unique key uk (u))")
+        s.execute("insert into t values (1, 10, 'a'), (2, 20, 'b')")
+        return s
+
+    def test_replace_deletes_conflicting_row(self):
+        s = self._mk()
+        r = s.execute("replace into t values (3, 10, 'c')")  # conflicts with id=1 on uk
+        assert r.affected == 2  # one delete + one insert
+        rows = sorted((int(x[0].val), int(x[1].val), str(x[2].val)) for x in s.execute("select * from t").rows)
+        assert rows == [(2, 20, "b"), (3, 10, "c")]
+
+    def test_replace_conflicting_pk_and_unique(self):
+        s = self._mk()
+        # conflicts with id=2 on PK AND id=1 on uk: both rows die
+        r = s.execute("replace into t values (2, 10, 'z')")
+        assert r.affected == 3  # MySQL: uk-row delete + in-place delete+insert
+        rows = sorted((int(x[0].val), int(x[1].val)) for x in s.execute("select * from t").rows)
+        assert rows == [(2, 10)]
+
+    def test_insert_ignore_skips_unique_conflict(self):
+        s = self._mk()
+        r = s.execute("insert ignore into t values (3, 10, 'c'), (4, 40, 'd')")
+        assert r.affected == 1  # only (4,40,'d') lands
+        rows = sorted(int(x[0].val) for x in s.execute("select * from t").rows)
+        assert rows == [1, 2, 4]

@@ -102,10 +102,12 @@ def drive_program(cache: ProgramCache, dag: DAGRequest, batches, group_capacity:
             counts = [int(x) for x in np.asarray(ex_rows)]
             return decode_outputs(packed, valid, prog.out_fts), counts
         if g_ovf:
-            if smg is not None:
-                smg = None  # stats hint was wrong: fall back to sort kernel
-            else:
-                gc *= 4  # grow only the capacity that overflowed
+            # drop a wrong stats hint AND grow capacity in the same retry:
+            # the driver cannot tell whether the dense kernel ran (the agg
+            # mix may have been ineligible), so doing both never wastes a
+            # retry on a byte-identical program
+            smg = None
+            gc *= 4
         if j_ovf:
             jc *= 4
         if t_ovf:

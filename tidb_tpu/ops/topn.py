@@ -74,7 +74,10 @@ def topn(by: list, row_valid, k: int, full_sort: bool = False):
     # event for every k, not just small ones
     base = (k * s_count) // n
     j = min(base + 4 + 2 * int(base ** 0.5), s_count - 1)
-    cap = _pow2(max(4 * k, 8 * (n // s_count), 256))
+    # cap needs slack ABOVE the expected candidate count (~(j+1) sample
+    # gaps) or benign uniform data overflows into the full-sort recompile
+    expected = (j + 1) * max(1, n // s_count)
+    cap = _pow2(max(2 * k + 2 * expected, 256))
     if full_sort or k < 1 or k > FAST_K_LIMIT or cap >= n or len(keys) < 2:
         return full_sort_idx(), out_valid, jnp.bool_(False)
 

@@ -184,9 +184,11 @@ class Session:
                 "(schema version moved from "
                 f"{txn.schema_ver} to {self.catalog.version}) — transaction aborted"
             )
-        commit_ts = self.store.next_ts()
         try:
-            self.store.txn.commit_txn(txn.mutations, txn.start_ts, commit_ts)
+            # commit_ts is allocated INSIDE the engine's critical section:
+            # TSO monotonicity then guarantees no reader can hold a
+            # read_ts >= commit_ts before the apply completes
+            self.store.txn.commit_txn(txn.mutations, txn.start_ts, self.store.next_ts)
         except TxnError as exc:
             self.store.txn.release_all(txn.start_ts)
             raise SQLError(str(exc)) from exc
@@ -731,7 +733,8 @@ class Session:
                 seen: set = set()
                 dedup = []
                 for r in acc:
-                    k = tuple(datum_group_key(d) for d in r)
+                    # collation-aware keys: ci strings dedup case-folded
+                    k = tuple(datum_group_key(d, ft) for d, ft in zip(r, fts))
                     if k not in seen:
                         seen.add(k)
                         dedup.append(r)
@@ -1011,11 +1014,12 @@ class Session:
             if val is not None and key.startswith(prefix):
                 yield key
 
-    def _check_unique(self, meta: TableMeta, datums: list, handle: int, ts: int, old_handle: int | None = None):
-        """Unique-index duplicate check (ref: ER_DUP_ENTRY; MySQL allows
-        multiple NULLs in a unique index). `old_handle` is the row's
-        previous handle during a PK-changing UPDATE — its still-live entries
-        are the row's own, not duplicates."""
+    def _find_unique_conflict(self, meta: TableMeta, datums: list, handle: int, ts: int, old_handle: int | None = None):
+        """First (conflicting_handle, index) whose unique entry collides
+        with this row, or None (ref: ER_DUP_ENTRY; MySQL allows multiple
+        NULLs in a unique index). `old_handle` is the row's previous handle
+        during a PK-changing UPDATE — its still-live entries are the row's
+        own, not duplicates."""
         own = {handle, old_handle if old_handle is not None else handle}
         pos = {c.name: i for i, c in enumerate(meta.columns)}
         for idx in meta.indices:
@@ -1028,9 +1032,13 @@ class Session:
             for key in self._scan_index_prefix(prefix, ts):
                 other = self._index_keys_handle(key)
                 if other is not None and other not in own:
-                    raise SQLError(
-                        f"duplicate entry for unique key {idx.name!r}"
-                    )
+                    return other, idx
+        return None
+
+    def _check_unique(self, meta: TableMeta, datums: list, handle: int, ts: int, old_handle: int | None = None):
+        conflict = self._find_unique_conflict(meta, datums, handle, ts, old_handle)
+        if conflict is not None:
+            raise SQLError(f"duplicate entry for unique key {conflict[1].name!r}")
 
     @staticmethod
     def _index_keys_handle(key: bytes) -> int | None:
@@ -1107,7 +1115,24 @@ class Session:
                     continue
                 if not stmt.replace:
                     raise SQLError(f"duplicate entry {handle} for key PRIMARY")
-            self._check_unique(meta, datums, handle, ts)  # before any mutation
+            # secondary-unique conflicts: REPLACE deletes every conflicting
+            # row; IGNORE skips the new row (ref: executor/replace.go
+            # removeRow loop, insert IGNORE ER_DUP_ENTRY-as-warning)
+            conflict = self._find_unique_conflict(meta, datums, handle, ts)
+            if conflict is not None and stmt.ignore:
+                continue
+            if conflict is not None and not stmt.replace:
+                raise SQLError(f"duplicate entry for unique key {conflict[1].name!r}")
+            while conflict is not None:
+                c_handle, _c_idx = conflict
+                self._lock_rows(meta, [c_handle])
+                old_row = self._read_row(meta, c_handle, ts)
+                if old_row is not None:
+                    self._write_indexes(meta, old_row, c_handle, delete=True)
+                    self._buf_delete_row(meta, c_handle)
+                    self.txn.row_delta[meta.table_id] = self.txn.row_delta.get(meta.table_id, 0) - 1
+                    n += 1  # MySQL counts each replaced row
+                conflict = self._find_unique_conflict(meta, datums, handle, ts)
             self._lock_rows(meta, [handle])
             if exists and stmt.replace and meta.indices:
                 # REPLACE drops the old row's index entries; the old row is
@@ -1120,6 +1145,8 @@ class Session:
             if not exists:
                 n += 1
                 self.txn.row_delta[meta.table_id] = self.txn.row_delta.get(meta.table_id, 0) + 1
+            elif stmt.replace:
+                n += 2  # replaced in place: MySQL counts delete AND insert
         return Result(affected=n)
 
     def _read_row(self, meta: TableMeta, handle: int, ts: int) -> list | None:

@@ -193,8 +193,13 @@ class SubqueryRewriter:
             if sub is not None and not isinstance(n, A.SubqueryTable):
                 inner_sel = sub.selects[0] if isinstance(sub, A.SetOprStmt) else sub
                 walk_stmt(inner_sel, schemas + [self._from_schema(inner_sel.from_clause)])
-                return
+                # DON'T return: sibling fields (InSubquery.expr,
+                # CompareSubquery.expr) can carry outer references of their
+                # own (ADVICE r2: early return misclassified the enclosing
+                # subquery as uncorrelated)
             for f_ in n.__dataclass_fields__:
+                if f_ == "subquery":
+                    continue  # handled above with the extended scope
                 v = getattr(n, f_)
                 for it in v if isinstance(v, (list, tuple)) else [v]:
                     if isinstance(it, tuple):
@@ -275,7 +280,7 @@ class SubqueryRewriter:
         if distinct:
             dedup = []
             for r in total:
-                k = tuple(datum_group_key(d) for d in r)
+                k = tuple(datum_group_key(d, ft) for d, ft in zip(r, fts))
                 if k not in seen:
                     seen.add(k)
                     dedup.append(r)
@@ -297,7 +302,7 @@ class SubqueryRewriter:
             if distinct:
                 fresh = []
                 for r in new:
-                    k = tuple(datum_group_key(d) for d in r)
+                    k = tuple(datum_group_key(d, ft) for d, ft in zip(r, fts))
                     if k not in seen:
                         seen.add(k)
                         fresh.append(r)
@@ -430,11 +435,11 @@ class SubqueryRewriter:
         fts, rows = self._exec_values(sub)
         x = self._rewrite_expr(node.expr, schema, stmt)
         values = [r[0] for r in rows]
-        # dedup (IN is a set membership test)
+        # dedup (IN is a set membership test; collation-aware key)
         seen: set = set()
         uniq = []
         for d in values:
-            k = datum_group_key(d)
+            k = datum_group_key(d, fts[0] if fts else None)
             if k not in seen:
                 seen.add(k)
                 uniq.append(d)
@@ -660,7 +665,7 @@ class SubqueryRewriter:
         if not has_agg:
             keys = set()
             for r in rows:
-                k = tuple(datum_group_key(d) for d in r[:-1])
+                k = tuple(datum_group_key(d, ft) for d, ft in zip(r[:-1], fts))
                 if k in keys:
                     raise SubqueryError("Subquery returns more than 1 row")
                 keys.add(k)

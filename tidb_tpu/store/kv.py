@@ -10,27 +10,34 @@ serialized by the session layer for now (2PC lands with the txn layer).
 from __future__ import annotations
 
 import bisect
+import threading
 from dataclasses import dataclass, field
 
 
 class MemKV:
-    __slots__ = ("_data", "_keys", "_dirty")
+    __slots__ = ("_data", "_keys", "_dirty", "lock")
 
     def __init__(self):
         self._data: dict[bytes, list[tuple[int, bytes | None]]] = {}
         self._keys: list[bytes] = []
         self._dirty = False
+        # structural lock: every read/write takes it, and TxnEngine.commit
+        # holds it across the WHOLE apply loop, so a concurrent snapshot
+        # read can never observe half a commit (the docstring invariant of
+        # store/txn.py); RLock so the engine can nest puts under it
+        self.lock = threading.RLock()
 
     def put(self, key: bytes, value: bytes | None, ts: int):
         """value None = tombstone."""
-        versions = self._data.get(key)
-        if versions is None:
-            self._data[key] = [(ts, value)]
-            self._dirty = True
-        else:
-            versions.append((ts, value))
-            if len(versions) > 1 and versions[-2][0] > ts:
-                versions.sort(key=lambda v: v[0])
+        with self.lock:
+            versions = self._data.get(key)
+            if versions is None:
+                self._data[key] = [(ts, value)]
+                self._dirty = True
+            else:
+                versions.append((ts, value))
+                if len(versions) > 1 and versions[-2][0] > ts:
+                    versions.sort(key=lambda v: v[0])
 
     def _ensure_sorted(self):
         if self._dirty:
@@ -38,37 +45,41 @@ class MemKV:
             self._dirty = False
 
     def get(self, key: bytes, ts: int) -> bytes | None:
-        versions = self._data.get(key)
-        if not versions:
+        with self.lock:
+            versions = self._data.get(key)
+            if not versions:
+                return None
+            # newest version with commit_ts <= ts
+            for vts, val in reversed(versions):
+                if vts <= ts:
+                    return val
             return None
-        # newest version with commit_ts <= ts
-        for vts, val in reversed(versions):
-            if vts <= ts:
-                return val
-        return None
 
     def scan(self, start: bytes, end: bytes, ts: int, limit: int | None = None):
-        """Yield (key, value) with start <= key < end visible at ts."""
-        self._ensure_sorted()
-        i = bisect.bisect_left(self._keys, start)
-        n = 0
-        while i < len(self._keys):
-            k = self._keys[i]
-            if k >= end:
-                break
-            v = self.get(k, ts)
-            if v is not None:
-                yield k, v
-                n += 1
-                if limit is not None and n >= limit:
+        """Yield (key, value) with start <= key < end visible at ts.
+        The result set is materialized under the lock — one consistent cut."""
+        with self.lock:
+            self._ensure_sorted()
+            i = bisect.bisect_left(self._keys, start)
+            out = []
+            while i < len(self._keys):
+                k = self._keys[i]
+                if k >= end:
                     break
-            i += 1
+                v = self.get(k, ts)
+                if v is not None:
+                    out.append((k, v))
+                    if limit is not None and len(out) >= limit:
+                        break
+                i += 1
+        return iter(out)
 
     def latest_ts(self, key: bytes) -> int:
         """Commit ts of the newest version of `key` (0 if none) — the
         write-conflict check input (ref: mvcc.go checkConflict)."""
-        versions = self._data.get(key)
-        return versions[-1][0] if versions else 0
+        with self.lock:
+            versions = self._data.get(key)
+            return versions[-1][0] if versions else 0
 
     def max_ts(self) -> int:
         ts = 0

@@ -103,7 +103,12 @@ class TxnEngine:
             for k, v in mutations.items():
                 self.locks[k] = Lock(primary, start_ts, "prewrite", v, v is None)
 
-    def commit(self, keys: list, start_ts: int, commit_ts: int):
+    def commit(self, keys: list, start_ts: int, commit_ts):
+        """commit_ts: an int, or a callable TSO source. When callable, the
+        timestamp is drawn INSIDE the kv critical section: with a monotone
+        TSO, no reader can have obtained read_ts >= commit_ts before the
+        whole apply is visible — snapshot isolation without the reference's
+        lock-wait/resolve read path. Returns the commit_ts used."""
         with self._mu:
             staged = []
             for k in keys:
@@ -113,11 +118,15 @@ class TxnEngine:
                 if l.op != "prewrite":
                     raise TxnError("commit before prewrite (pessimistic lock not converted)")
                 staged.append((k, l))
-            for k, l in staged:
-                self.kv.put(k, None if l.is_delete else l.value, commit_ts)
-                del self.locks[k]
+            with self.kv.lock:  # readers see all of the commit or none
+                if callable(commit_ts):
+                    commit_ts = commit_ts()
+                for k, l in staged:
+                    self.kv.put(k, None if l.is_delete else l.value, commit_ts)
+                    del self.locks[k]
         if self._on_commit is not None and staged:
             self._on_commit()
+        return commit_ts
 
     def rollback(self, keys: list, start_ts: int):
         with self._mu:
@@ -133,12 +142,13 @@ class TxnEngine:
                 del self.locks[k]
 
     # ------------------------------------------------------------------
-    def commit_txn(self, mutations: dict, start_ts: int, commit_ts: int):
+    def commit_txn(self, mutations: dict, start_ts: int, commit_ts):
         """Full 2PC for an in-process txn: prewrite everything (primary =
         first key), then commit. Raises without side effects on conflict;
-        pessimistic locks this txn already holds are converted."""
+        pessimistic locks this txn already holds are converted.
+        commit_ts may be a callable TSO source (see commit)."""
         if not mutations:
-            return
+            return None
         keys = list(mutations)
         primary = keys[0]
         try:
@@ -146,4 +156,26 @@ class TxnEngine:
         except TxnError:
             self.release_all(start_ts)
             raise
-        self.commit(keys, start_ts, commit_ts)
+        return self.commit(keys, start_ts, commit_ts)
+
+    def check_unlocked(self, keys, start_ts: int = 0):
+        """Raise KeyIsLocked if any key is held by another transaction —
+        the guard bulk ingest (LOAD DATA, BR restore) runs before writing
+        around the lock table (ref: Lightning conflict with live txns)."""
+        with self._mu:
+            for k in keys:
+                l = self.locks.get(k)
+                if l is not None and l.start_ts != start_ts:
+                    raise KeyIsLocked(k, l.start_ts)
+
+    def bulk_ingest(self, items, ts: int):
+        """Atomically verify-and-apply (key, value) pairs for bulk import
+        (LOAD DATA / BR restore): the lock check and the writes happen
+        under ONE engine critical section, so a concurrent prewrite cannot
+        slip between them; readers see the whole batch or none of it
+        (lock order engine _mu -> kv.lock matches commit())."""
+        with self._mu:
+            self.check_unlocked([k for k, _ in items])
+            with self.kv.lock:
+                for k, v in items:
+                    self.kv.put(k, v, ts)

@@ -199,6 +199,7 @@ def restore(store, catalog, src_dir: str) -> dict:
         if hashlib.sha256(data).hexdigest() != seg["sha256"]:
             raise ValueError(f"restore: checksum mismatch in {seg['file']}")
         pos = 0
+        batch = []
         for _ in range(seg["keys"]):
             (klen,) = struct.unpack_from("<I", data, pos)
             pos += 4
@@ -208,7 +209,10 @@ def restore(store, catalog, src_dir: str) -> dict:
             pos += 4
             val = data[pos : pos + vlen]
             pos += vlen
-            store.kv.put(bytes(key), bytes(val), ts)
-            n += 1
+            batch.append((bytes(key), bytes(val)))
+        # restore must not overwrite keys locked by an in-flight 2PC:
+        # lock-check + apply in one engine critical section (ADVICE r2)
+        store.txn.bulk_ingest(batch, ts)
+        n += len(batch)
     store._bump_write_ver()
     return {"tables": len(manifest["schema"]), "keys": n, "snapshot_ts": manifest["snapshot_ts"]}

@@ -115,13 +115,20 @@ def load_data(session, stmt) -> int:
                 seen_uk.add((idx.index_id, prefix))
                 if next(iter(session.store.kv.scan(prefix, prefix + b"\xff", read_ts)), None) is not None:
                     raise SQLError(f"LOAD DATA: duplicate entry for unique key {idx.name!r}")
+        # the import must not clobber keys under an in-flight 2PC:
+        # lock-check + apply happen in ONE engine critical section
+        # (ADVICE r2: bulk writes vs lock table)
+        items = []
         for handle, datums in batch_rows:
-            session.store.put_row(meta.table_id, handle, meta.col_ids(), datums, ts)
+            items.append((
+                tablecodec.encode_row_key(meta.table_id, handle),
+                session.store._row_encoder.encode(meta.col_ids(), datums),
+            ))
             for idx in meta.indices:
                 vals = [datums[pos[cn]] for cn in idx.col_names] + [Datum.i64(handle)]
-                session.store.put_index(
-                    tablecodec.encode_index_key(meta.table_id, idx.index_id, vals), b"\x00", ts
-                )
+                items.append((tablecodec.encode_index_key(meta.table_id, idx.index_id, vals), b"\x00"))
+        session.store.txn.bulk_ingest(items, ts)
+        session.store._bump_write_ver()
         # stats track per durable batch (a later failed batch must not
         # leave committed rows uncounted)
         meta.row_count += len(batch_rows)
