@@ -15,9 +15,9 @@ Per device, in a single fused XLA computation:
      the owned groups. No host round-trip between phases.
 
 The host wrapper gathers the per-device final tables and decodes one result
-Chunk. Group keys may be strings (packed-word keys, first 32 bytes); string
-AGGREGATE VALUES (min/max/first_row over varchar) are not exchangeable yet
-and raise."""
+Chunk. Group keys AND string aggregate values (min/max/first_row over
+varchar) travel as packed compare words (first 32 bytes — the SQL gate
+rejects wider string columns)."""
 
 from __future__ import annotations
 
@@ -49,16 +49,17 @@ def _flatten_local(local: DeviceBatch):
 
 
 def _materialize_gather(desc, arg_vals, st: GatherState, final: bool = False):
-    """GatherState -> concrete state columns (numeric only — string gather
-    values cannot ride the exchange buffers yet). Partial form keeps the
+    """GatherState -> concrete state columns. Partial form keeps the
     [has, value] wire schema for first_row; `final` collapses to the single
-    result column."""
+    result column. String values (first_row/min/max over varchar) ride the
+    exchange as their packed compare words [G, W+1] — decode_outputs
+    reconstructs the bytes, so strings up to STRING_WORDS*8 bytes survive
+    (the SQL gate parallel/sql.py rejects wider columns)."""
     vcol = arg_vals[-1]
-    if vcol.value.ndim != 1:
-        raise NotImplementedError(
-            f"string-valued gather aggregate {desc.name!r} (first_row/min/max) over the mesh"
-        )
-    val = jnp.where(st.has, vcol.value[st.idx], jnp.zeros((), vcol.value.dtype))
+    if vcol.value.ndim == 2:
+        val = jnp.where(st.has[:, None], vcol.value[st.idx, :], jnp.zeros((), vcol.value.dtype))
+    else:
+        val = jnp.where(st.has, vcol.value[st.idx], jnp.zeros((), vcol.value.dtype))
     null = jnp.where(st.has, vcol.null[st.idx], True)
     if desc.name == "first_row" and not final:
         return [(st.has.astype(jnp.int64), jnp.zeros(st.has.shape, bool)), (val, null)]

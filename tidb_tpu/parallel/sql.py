@@ -1,0 +1,111 @@
+"""SQL -> mesh fragmentation: route eligible pushdown plans onto the
+device mesh (ref: pkg/planner/core/fragment.go:116 GenerateRootMPPTasks —
+the reference cuts physical plans at exchange boundaries into per-node MPP
+tasks; here the cut is scan+selection below, grouped aggregation above,
+with the hash exchange inside run_sharded_grouped_agg).
+
+The decision mirrors the reference's `useMPPExecution` gate
+(pkg/executor/mpp_gather.go:40, sysvar TiDBAllowMPPExecution): the session
+asks `try_mesh_select` first; a None return (ineligible shape, too few
+devices, group overflow) falls back to the per-region thread-pool path, the
+same way the reference falls back from TiFlash MPP to cop tasks.
+"""
+
+from __future__ import annotations
+
+from ..chunk import Chunk
+from ..distsql.dispatch import KVRequest, select
+from ..exec.dag import Aggregation, DAGRequest, Selection, TableScan
+
+MESH_SYSVAR = "tidb_enable_tpu_mesh"
+# packed compare words carry the first STRING_WORDS*8 bytes across the
+# exchange; longer strings would silently truncate, so they stay off-mesh.
+# flen counts CHARACTERS (utf8mb4: up to 4 bytes each) and inserts do not
+# enforce it, so the static gate is advisory only — the authoritative check
+# measures actual bytes in the scanned chunks (_chunks_exchange_safe).
+_MAX_EXCH_STR = 32
+
+
+def _chunks_exchange_safe(chunks) -> bool:
+    """No string value in any scanned column exceeds the packed-word width
+    the exchange can carry byte-exactly."""
+    for c in chunks:
+        for col in c.columns:
+            if col.is_varlen() and len(col):
+                if int((col.offsets[1:] - col.offsets[:-1]).max()) > _MAX_EXCH_STR:
+                    return False
+    return True
+
+
+def mesh_eligible(dag: DAGRequest) -> bool:
+    """Shape gate: TableScan [Selection]* Aggregation(GROUP BY) with
+    exchange-safe aggregates and key types (ref: the reference's
+    per-operator CanPushToTiFlash checks in exhaust_physical_plans)."""
+    exs = dag.executors
+    if len(exs) < 2 or not isinstance(exs[0], TableScan):
+        return False
+    if not all(isinstance(e, Selection) for e in exs[1:-1]):
+        return False
+    agg = exs[-1]
+    if not isinstance(agg, Aggregation) or not agg.group_by or agg.merge:
+        return False
+    for d in agg.aggs:
+        if d.distinct or d.name == "group_concat":
+            return False
+    return True
+
+
+def try_mesh_select(
+    store,
+    dag: DAGRequest,
+    ranges: list,
+    start_ts: int,
+    group_capacity: int = 1024,
+    min_devices: int = 2,
+) -> Chunk | None:
+    """Execute an eligible plan over the region mesh; None = not taken.
+
+    Region rows reach the devices through the same scan pushdown
+    (paging/retry preserved) as the thread-pool path; the grouped
+    aggregation then runs as ONE shard_map program: per-device Partial1 ->
+    all_to_all hash exchange -> Final merge (parallel/grouped.py)."""
+    if not mesh_eligible(dag):
+        return None
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < min_devices:
+        return None
+    from .grouped import run_sharded_grouped_agg
+    from .mesh import region_mesh, stack_region_batches
+
+    scan = dag.executors[0]
+    scan_dag = DAGRequest((scan,), output_offsets=tuple(range(len(scan.columns))))
+    res = select(store, KVRequest(scan_dag, ranges, start_ts))
+    chunks = [c for c in res.chunks if c is not None and c.num_rows() > 0]
+    agg = dag.executors[-1]
+    out_fts = agg.output_fts()
+    if not chunks:
+        # zero rows scanned: grouped aggregation of nothing is no groups
+        return Chunk.empty([out_fts[i] for i in dag.output_offsets])
+    if not _chunks_exchange_safe(chunks):
+        return None  # wide strings cannot ride the exchange byte-exactly
+
+    n = len(devs)
+    n_total = ((len(chunks) + n - 1) // n) * n
+    stacked = stack_region_batches(chunks, n_total=n_total)
+    mesh = region_mesh(n)
+    # overflow (too many groups / hash collision): retry with 4x capacity —
+    # the capacity also salts the hash, mirroring drive_program's contract —
+    # reusing the already-scanned chunks rather than rescanning
+    gc = group_capacity
+    for _ in range(3):
+        chunk, overflow = run_sharded_grouped_agg(dag, stacked, mesh, group_capacity=gc)
+        if not overflow:
+            from ..util import metrics
+
+            metrics.MESH_SELECTS.inc()
+            cols = [chunk.columns[i] for i in dag.output_offsets]
+            return Chunk(cols)
+        gc *= 4
+    return None  # caller falls back to the per-region path

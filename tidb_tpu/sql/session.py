@@ -665,21 +665,32 @@ class Session:
                     # evaluate the whole plan with the row-at-a-time oracle
                     chunk = self._select_via_oracle(plan, ranges, aux, ts)
                 else:
-                    chunk = execute_root(
-                        self.store,
-                        plan.dag,
-                        ranges,
-                        start_ts=ts,
-                        aux_chunks=aux,
-                        concurrency=self.sysvars.get_int("tidb_distsql_scan_concurrency"),
-                        paging_size=(
-                            self.sysvars.get_int("tidb_max_chunk_size")
-                            if self.sysvars.get_bool("tidb_enable_paging")
-                            else None
-                        ),
-                        batch_cop=self.sysvars.get_bool("tidb_allow_batch_cop"),
-                        summary_sink=self._explain_sink,
-                    )
+                    chunk = None
+                    if not aux and self._explain_sink is None and self.sysvars.get_bool("tidb_enable_tpu_mesh"):
+                        # EXPLAIN ANALYZE wants per-executor summaries,
+                        # which only the per-region path produces
+                        # MPP analog: eligible GROUP BY plans run as ONE
+                        # shard_map program over the region mesh
+                        # (ref: fragment.go GenerateRootMPPTasks gate)
+                        from ..parallel.sql import try_mesh_select
+
+                        chunk = try_mesh_select(self.store, plan.dag, ranges, ts)
+                    if chunk is None:
+                        chunk = execute_root(
+                            self.store,
+                            plan.dag,
+                            ranges,
+                            start_ts=ts,
+                            aux_chunks=aux,
+                            concurrency=self.sysvars.get_int("tidb_distsql_scan_concurrency"),
+                            paging_size=(
+                                self.sysvars.get_int("tidb_max_chunk_size")
+                                if self.sysvars.get_bool("tidb_enable_paging")
+                                else None
+                            ),
+                            batch_cop=self.sysvars.get_bool("tidb_allow_batch_cop"),
+                            summary_sink=self._explain_sink,
+                        )
             tracker.consume(chunk.nbytes())
         except QuotaExceeded as exc:
             raise SQLError(str(exc)) from exc

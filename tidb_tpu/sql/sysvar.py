@@ -60,6 +60,10 @@ DEFINITIONS = {
     for v in [
         # the TPU coprocessor gate (ref: TiDBAllowMPPExecution pattern)
         SysVar("tidb_enable_tpu_coprocessor", "ON", "both", _bool_validator),
+        # route eligible GROUP BY plans over the device mesh (Partial1 ->
+        # all_to_all exchange -> Final); needs >= 2 devices at runtime
+        # (ref: TiDBAllowMPPExecution / enforce-mpp engine selection)
+        SysVar("tidb_enable_tpu_mesh", "ON", "both", _bool_validator),
         # ref: sysvar.go:1956 TiDBDistSQLScanConcurrency
         SysVar("tidb_distsql_scan_concurrency", "4", "both", _int_validator(1, 256)),
         # ref: sysvar.go:2080 TiDBMaxChunkSize

@@ -26,6 +26,7 @@ Failure semantics match Percolator where observable in-process:
 from __future__ import annotations
 
 import threading
+from contextlib import contextmanager
 from dataclasses import dataclass
 
 from .kv import MemKV
@@ -168,14 +169,22 @@ class TxnEngine:
                 if l is not None and l.start_ts != start_ts:
                     raise KeyIsLocked(k, l.start_ts)
 
-    def bulk_ingest(self, items, ts: int):
-        """Atomically verify-and-apply (key, value) pairs for bulk import
-        (LOAD DATA / BR restore): the lock check and the writes happen
-        under ONE engine critical section, so a concurrent prewrite cannot
-        slip between them; readers see the whole batch or none of it
-        (lock order engine _mu -> kv.lock matches commit())."""
+    @contextmanager
+    def ingest_guard(self):
+        """One critical section for a whole bulk-import batch: the caller
+        draws its read/write timestamps, re-runs its duplicate checks, and
+        applies the writes all inside — no committed write or prewrite can
+        interleave (LOAD DATA / BR restore vs in-flight 2PC; lock order
+        engine _mu -> kv.lock matches commit())."""
         with self._mu:
-            self.check_unlocked([k for k, _ in items])
             with self.kv.lock:
-                for k, v in items:
-                    self.kv.put(k, v, ts)
+                yield
+
+    def bulk_ingest(self, items, ts: int):
+        """Atomically verify-and-apply (key, value) pairs (BR restore —
+        no value-level duplicate checks needed; LOAD DATA wraps its whole
+        check+apply in ingest_guard instead)."""
+        with self.ingest_guard():
+            self.check_unlocked([k for k, _ in items])
+            for k, v in items:
+                self.kv.put(k, v, ts)

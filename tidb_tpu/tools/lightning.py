@@ -91,43 +91,47 @@ def load_data(session, stmt) -> int:
         nonlocal imported
         if not batch_rows:
             return
-        ts = session.store.next_ts()
-        read_ts = session.store.next_ts()
-        # ALL conflict checks before ANY write: a mid-batch duplicate must
-        # not leave half a batch durable below the checkpoint (re-running
-        # would then collide with the crashed run's own rows)
-        seen_pk: set = set()
-        seen_uk: set = set()
-        for handle, datums in batch_rows:
-            if handle in seen_pk:
-                raise SQLError(f"LOAD DATA: duplicate primary key {handle} within the file")
-            seen_pk.add(handle)
-            key = tablecodec.encode_row_key(meta.table_id, handle)
-            if session.store.kv.get(key, read_ts) is not None:
-                raise SQLError(f"LOAD DATA: duplicate primary key {handle}")
-            for idx in uniq_idxs:
-                vals = [datums[pos[cn]] for cn in idx.col_names]
-                if any(d.is_null() for d in vals):
-                    continue
-                prefix = tablecodec.encode_index_key(meta.table_id, idx.index_id, vals)
-                if (idx.index_id, prefix) in seen_uk:
-                    raise SQLError(f"LOAD DATA: duplicate entry for unique key {idx.name!r} within the file")
-                seen_uk.add((idx.index_id, prefix))
-                if next(iter(session.store.kv.scan(prefix, prefix + b"\xff", read_ts)), None) is not None:
-                    raise SQLError(f"LOAD DATA: duplicate entry for unique key {idx.name!r}")
-        # the import must not clobber keys under an in-flight 2PC:
-        # lock-check + apply happen in ONE engine critical section
-        # (ADVICE r2: bulk writes vs lock table)
-        items = []
-        for handle, datums in batch_rows:
-            items.append((
-                tablecodec.encode_row_key(meta.table_id, handle),
-                session.store._row_encoder.encode(meta.col_ids(), datums),
-            ))
-            for idx in meta.indices:
-                vals = [datums[pos[cn]] for cn in idx.col_names] + [Datum.i64(handle)]
-                items.append((tablecodec.encode_index_key(meta.table_id, idx.index_id, vals), b"\x00"))
-        session.store.txn.bulk_ingest(items, ts)
+        # the WHOLE batch — timestamp draw, duplicate checks, lock check,
+        # writes — runs in one engine critical section, so no concurrent
+        # commit can land between the unique scan and the apply (ADVICE r2;
+        # review r3: the read_ts-before-lock window allowed duplicates)
+        with session.store.txn.ingest_guard():
+            ts = session.store.next_ts()
+            read_ts = session.store.next_ts()
+            # ALL conflict checks before ANY write: a mid-batch duplicate
+            # must not leave half a batch durable below the checkpoint
+            # (re-running would then collide with the crashed run's rows)
+            seen_pk: set = set()
+            seen_uk: set = set()
+            for handle, datums in batch_rows:
+                if handle in seen_pk:
+                    raise SQLError(f"LOAD DATA: duplicate primary key {handle} within the file")
+                seen_pk.add(handle)
+                key = tablecodec.encode_row_key(meta.table_id, handle)
+                if session.store.kv.get(key, read_ts) is not None:
+                    raise SQLError(f"LOAD DATA: duplicate primary key {handle}")
+                for idx in uniq_idxs:
+                    vals = [datums[pos[cn]] for cn in idx.col_names]
+                    if any(d.is_null() for d in vals):
+                        continue
+                    prefix = tablecodec.encode_index_key(meta.table_id, idx.index_id, vals)
+                    if (idx.index_id, prefix) in seen_uk:
+                        raise SQLError(f"LOAD DATA: duplicate entry for unique key {idx.name!r} within the file")
+                    seen_uk.add((idx.index_id, prefix))
+                    if next(iter(session.store.kv.scan(prefix, prefix + b"\xff", read_ts)), None) is not None:
+                        raise SQLError(f"LOAD DATA: duplicate entry for unique key {idx.name!r}")
+            items = []
+            for handle, datums in batch_rows:
+                items.append((
+                    tablecodec.encode_row_key(meta.table_id, handle),
+                    session.store._row_encoder.encode(meta.col_ids(), datums),
+                ))
+                for idx in meta.indices:
+                    vals = [datums[pos[cn]] for cn in idx.col_names] + [Datum.i64(handle)]
+                    items.append((tablecodec.encode_index_key(meta.table_id, idx.index_id, vals), b"\x00"))
+            session.store.txn.check_unlocked([k for k, _ in items])
+            for k, v in items:
+                session.store.kv.put(k, v, ts)
         session.store._bump_write_ver()
         # stats track per durable batch (a later failed batch must not
         # leave committed rows uncounted)

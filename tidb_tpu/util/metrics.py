@@ -102,6 +102,7 @@ COP_ERRORS = REGISTRY.counter("tidb_tpu_cop_errors_total", "coprocessor requests
 COP_FALLBACKS = REGISTRY.counter("tidb_tpu_cop_oracle_fallbacks_total", "cop requests served by the oracle fallback")
 COP_DURATION = REGISTRY.histogram("tidb_tpu_cop_duration_seconds", "coprocessor request latency")
 DISTSQL_TASKS = REGISTRY.counter("tidb_tpu_distsql_tasks_total", "per-region cop tasks dispatched")
+MESH_SELECTS = REGISTRY.counter("tidb_tpu_mesh_selects_total", "SQL plans executed over the device mesh")
 DISTSQL_RETRIES = REGISTRY.counter("tidb_tpu_distsql_region_retries_total", "region-error retries")
 PROGRAM_COMPILES = REGISTRY.counter("tidb_tpu_program_compiles_total", "fused XLA programs built")
 NATIVE_DECODES = REGISTRY.counter("tidb_tpu_native_decode_batches_total", "region batches decoded by the C++ rowcodec")
