@@ -201,3 +201,58 @@ def test_first_row_string_group():
         assert r[1].val == len(members)
         vals = {canon(m[5]) for m in members}
         assert canon(r[0]) in vals
+
+
+class TestFullSort:
+    """The Sort executor: ORDER BY without LIMIT returns EVERY row in order
+    (the r2 SORT_NO_LIMIT 2^20 truncation trap is gone)."""
+
+    def test_sort_beyond_old_topn_bound(self):
+        """> 2^21 rows through the device Sort — every row comes back,
+        globally ordered (the old bound silently dropped rows past 2^20)."""
+        import numpy as np
+
+        from tidb_tpu.chunk import Chunk, Column, to_device_batch
+        from tidb_tpu.exec import DAGRequest, Sort, TableScan, ColumnInfo
+        from tidb_tpu.exec.executor import drive_program
+        from tidb_tpu.exec.builder import ProgramCache
+        from tidb_tpu.expr import col
+        from tidb_tpu.types import new_longlong
+
+        n = (1 << 21) + 17
+        rng = np.random.default_rng(0)
+        vals = rng.integers(-(10**12), 10**12, n).astype(np.int64)
+        ft = new_longlong(notnull=True)
+        chunk = Chunk([Column(ft, vals.copy(), np.zeros(n, bool))])
+        scan = TableScan(1, (ColumnInfo(1, ft),))
+        dag = DAGRequest((scan, Sort(order_by=((col(0, ft), False),))), output_offsets=(0,))
+        batch = to_device_batch(chunk, capacity=1 << 22)
+        out, _ = drive_program(ProgramCache(), dag, [batch], group_capacity=64)
+        got = np.asarray(out.columns[0].data, dtype=np.int64)
+        assert got.shape[0] == n, f"rows dropped: {got.shape[0]} != {n}"
+        assert np.array_equal(got, np.sort(vals))
+
+    def test_sql_order_by_without_limit_matches_oracle(self):
+        from tidb_tpu.sql import Session
+
+        s = Session()
+        s.execute("create table srt (a bigint, b varchar(4))")
+        rows = ",".join(f"({(i * 7919) % 1000}, '{'wxyz'[i % 4]}')" for i in range(500))
+        s.execute("insert into srt values " + rows)
+        r = s.execute("select a, b from srt order by a desc, b")
+        assert len(r.rows) == 500  # every row, no bound
+        got = [(int(x[0].val), str(x[1].val)) for x in r.rows]
+        assert got == sorted(got, key=lambda t: (-t[0], t[1]))
+
+    def test_sql_sort_across_regions(self):
+        from tidb_tpu.codec import tablecodec
+        from tidb_tpu.sql import Session
+
+        s = Session()
+        s.execute("create table srt2 (a bigint)")
+        s.execute("insert into srt2 values " + ",".join(f"({999 - i})" for i in range(300)))
+        meta = s.catalog.table("srt2")
+        for h in (80, 160, 240):
+            s.store.cluster.split(tablecodec.encode_row_key(meta.table_id, h))
+        got = [int(x[0].val) for x in s.execute("select a from srt2 order by a").rows]
+        assert got == sorted(got) and len(got) == 300

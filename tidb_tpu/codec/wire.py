@@ -257,11 +257,11 @@ def r_agg_desc(r: Reader) -> AggDesc:
 
 # -------------------------------------------------------------- executors
 
-_EX_SCAN, _EX_SEL, _EX_PROJ, _EX_AGG, _EX_TOPN, _EX_LIMIT, _EX_JOIN, _EX_ISCAN = range(1, 9)
+_EX_SCAN, _EX_SEL, _EX_PROJ, _EX_AGG, _EX_TOPN, _EX_LIMIT, _EX_JOIN, _EX_ISCAN, _EX_SORT = range(1, 10)
 
 
 def w_executor(w: Writer, ex):
-    from ..exec.dag import Aggregation, ColumnInfo, IndexScan, Join, Limit, Projection, Selection, TableScan, TopN
+    from ..exec.dag import Aggregation, ColumnInfo, IndexScan, Join, Limit, Projection, Selection, Sort, TableScan, TopN
 
     if isinstance(ex, IndexScan):
         w.u8(_EX_ISCAN)
@@ -313,6 +313,12 @@ def w_executor(w: Writer, ex):
     elif isinstance(ex, Limit):
         w.u8(_EX_LIMIT)
         w.i64(ex.limit)
+    elif isinstance(ex, Sort):
+        w.u8(_EX_SORT)
+        w.i32(len(ex.order_by))
+        for e, desc in ex.order_by:
+            w_expr(w, e)
+            w.bool_(desc)
     elif isinstance(ex, Join):
         w.u8(_EX_JOIN)
         w.s(ex.join_type)
@@ -329,7 +335,7 @@ def w_executor(w: Writer, ex):
 
 
 def r_executor(r: Reader):
-    from ..exec.dag import Aggregation, ColumnInfo, IndexScan, Join, Limit, Projection, Selection, TableScan, TopN
+    from ..exec.dag import Aggregation, ColumnInfo, IndexScan, Join, Limit, Projection, Selection, Sort, TableScan, TopN
 
     tag = r.u8()
     if tag == _EX_ISCAN:
@@ -360,6 +366,8 @@ def r_executor(r: Reader):
         return TopN(order, limit)
     if tag == _EX_LIMIT:
         return Limit(r.i64())
+    if tag == _EX_SORT:
+        return Sort(tuple((r_expr(r), r.bool_()) for _ in range(r.i32())))
     if tag == _EX_JOIN:
         jt = r.s()
         build = tuple(r_executor(r) for _ in range(r.i32()))

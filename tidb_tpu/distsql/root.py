@@ -26,7 +26,7 @@ from dataclasses import dataclass, replace
 
 from ..chunk import Chunk
 from ..exec.builder import DEFAULT_GROUP_CAPACITY, ProgramCache
-from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, IndexScan, Join, Limit, Projection, Selection, TableScan, TopN, Window, current_schema_fts
+from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, IndexScan, Join, Limit, Projection, Selection, Sort, TableScan, TopN, Window, current_schema_fts
 from ..exec.executor import run_dag_on_chunks
 from ..expr.agg import AggDesc, AggMode
 from ..expr.ir import col
@@ -106,6 +106,13 @@ def split_dag(dag: DAGRequest) -> RootPlan:
             push.append(ex)  # per-region pre-prune
             root = list(executors[i:])  # re-apply globally, then the rest
             break
+        if isinstance(ex, Sort):
+            # the root sorts the full concatenation, so a per-region
+            # pre-sort would be pure wasted work (no k-way merge yet) —
+            # cut here like Window and keep paging usable for the
+            # row-local scan half (ref: sortexec/sort.go)
+            root = list(executors[i:])
+            break
         if isinstance(ex, Window):
             # window functions need the full partition: never per-region
             # (the reference runs Window at root or over whole-data TiFlash,
@@ -144,9 +151,9 @@ def execute_root(
     rejects paged aggregation/TopN/Limit); otherwise it is ignored here."""
     plan = split_dag(dag)
     if paging_size is not None:
-        from ..exec.dag import Aggregation as _A, Limit as _L, TopN as _T, executor_walk
+        from ..exec.dag import Aggregation as _A, Limit as _L, Sort as _S, TopN as _T, executor_walk
 
-        if any(isinstance(e, (_A, _T, _L)) for e in executor_walk(plan.push_dag.executors)):
+        if any(isinstance(e, (_A, _T, _L, _S)) for e in executor_walk(plan.push_dag.executors)):
             paging_size = None
     res: SelectResult = select(
         store,

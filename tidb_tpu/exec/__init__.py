@@ -4,6 +4,7 @@ from .dag import (
     Limit,
     Projection,
     Selection,
+    Sort,
     TableScan,
     TopN,
     ColumnInfo,
@@ -19,6 +20,7 @@ __all__ = [
     "Limit",
     "Projection",
     "Selection",
+    "Sort",
     "TableScan",
     "TopN",
     "ColumnInfo",

@@ -29,7 +29,7 @@ from ..expr.compile import CompVal, ExprCompiler, normalize_device_column
 from ..ops import apply_selection, group_aggregate, hash_join, scalar_aggregate, topn
 from ..ops.aggregate import GatherState, finalize_agg
 from ..types import FieldType
-from .dag import Aggregation, DAGRequest, IndexScan, Join, Limit, Projection, Selection, TableScan, TopN, Window, collect_scans, current_schema_fts
+from .dag import Aggregation, DAGRequest, IndexScan, Join, Limit, Projection, Selection, Sort, TableScan, TopN, Window, collect_scans, current_schema_fts
 
 DEFAULT_GROUP_CAPACITY = 4096
 
@@ -99,6 +99,14 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
             by = list(zip(order_vals, [d for _, d in ex.order_by]))
             idx, out_valid, t_ovf = topn(by, valid, ex.limit, full_sort=topn_full)
             state.topn_overflow = state.topn_overflow | t_ovf
+            cols = _gather(cols, idx)
+            valid = out_valid
+        elif isinstance(ex, Sort):
+            from ..ops.topn import sort_all
+
+            order_vals = comp.run([e for e, _ in ex.order_by], cols)
+            by = list(zip(order_vals, [d for _, d in ex.order_by]))
+            idx, out_valid = sort_all(by, valid)
             cols = _gather(cols, idx)
             valid = out_valid
         elif isinstance(ex, Join):

@@ -207,6 +207,20 @@ class TopN:
 
 
 @dataclass(frozen=True)
+class Sort:
+    """Full sort, no bound (ref: tipb.Sort with IsPartialSort=false;
+    root executor pkg/executor/sortexec/sort.go — the external merge sort).
+    Split shape: each region sorts its rows, the root re-sorts the
+    concatenation (the k-way merge specialization can land later —
+    correctness first: EVERY row comes back, in order)."""
+
+    order_by: tuple  # tuple[(Expr, desc: bool), ...]
+
+    def fingerprint(self):
+        return ("sort",) + tuple((e.fingerprint(), d) for e, d in self.order_by)
+
+
+@dataclass(frozen=True)
 class Limit:
     """(ref: tipb.Limit; mpp_exec.go:397 limitExec)."""
 
@@ -247,7 +261,7 @@ def current_schema_fts(executors) -> list[FieldType]:
     for ex in executors:
         if isinstance(ex, (TableScan, IndexScan)):
             fts = [c.ft for c in ex.columns]
-        elif isinstance(ex, (Selection, Limit, TopN)):
+        elif isinstance(ex, (Selection, Limit, TopN, Sort)):
             pass  # schema unchanged
         elif isinstance(ex, Projection):
             fts = [e.ft for e in ex.exprs]

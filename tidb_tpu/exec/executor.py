@@ -18,7 +18,7 @@ from ..expr.agg import AggDesc
 from ..expr.eval_ref import RefEvaluator, compare, _truth
 from ..types import Datum, DatumKind, FieldType, MyDecimal, MyTime
 from .builder import DEFAULT_GROUP_CAPACITY, CompiledDAG, ProgramCache, build_program
-from .dag import Aggregation, DAGRequest, Join, Limit, Projection, Selection, TableScan, TopN, Window, current_schema_fts
+from .dag import Aggregation, DAGRequest, Join, Limit, Projection, Selection, Sort, TableScan, TopN, Window, current_schema_fts
 
 
 def _pow2(n: int) -> int:
@@ -420,6 +420,20 @@ def _ref_pipeline(executors, chunks, cursor, ev) -> list[list[Datum]]:
                 return 0
 
             rows = sorted(rows, key=functools.cmp_to_key(cmp_rows))[: ex.limit]
+        elif isinstance(ex, Sort):
+            import functools
+
+            def cmp_rows_s(r1, r2, _ex=ex):
+                for e, desc in _ex.order_by:
+                    a, b = ev.eval(e, r1), ev.eval(e, r2)
+                    if a.is_null() and b.is_null():
+                        continue
+                    c = -1 if a.is_null() else (1 if b.is_null() else compare(a, b))
+                    if c:
+                        return -c if desc else c
+                return 0
+
+            rows = sorted(rows, key=functools.cmp_to_key(cmp_rows_s))
         elif isinstance(ex, Window):
             rows = _ref_window(ex, rows, ev)
         elif isinstance(ex, Join):

@@ -63,8 +63,7 @@ def topn(by: list, row_valid, k: int, full_sort: bool = False):
     out_valid = jnp.arange(k) < n_valid
 
     def full_sort_idx():
-        perm = lexsort([invalid_last] + keys)
-        return perm[:k].astype(jnp.int32)
+        return _stable_sort_idx(keys, invalid_last)[:k]
 
     stride = max(1, n // SAMPLE)
     s_count = n // stride  # sampled pairs
@@ -105,3 +104,24 @@ def topn(by: list, row_valid, k: int, full_sort: bool = False):
     perm_s = lexsort(small_keys, extra_key=cpos_c.astype(jnp.int64))
     fast_idx = cpos_c[perm_s[:k]].astype(jnp.int32)
     return fast_idx, out_valid, overflow
+
+
+def _stable_sort_idx(keys: list, invalid_last):
+    """Stable full-sort permutation with invalid rows compacted to the
+    tail — the ONE place the ordering/validity invariant lives (topn's
+    exact fallback and the Sort executor both use it)."""
+    return lexsort([invalid_last] + keys).astype(jnp.int32)
+
+
+def sort_all(by: list, row_valid):
+    """Full stable sort of the batch (the Sort executor's kernel): every
+    valid row, in ORDER BY order, invalid rows compacted to the tail.
+    Returns (row_indices[n], out_valid[n])."""
+    keys = []
+    for v, desc in by:
+        keys.extend(sort_key_arrays(v, desc=desc))
+    n = row_valid.shape[0]
+    invalid_last = jnp.where(row_valid, jnp.int64(0), jnp.int64(1))
+    idx = _stable_sort_idx(keys, invalid_last)
+    out_valid = jnp.arange(n) < row_valid.sum()
+    return idx, out_valid

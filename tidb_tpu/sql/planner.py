@@ -21,7 +21,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, IndexScan, Join, Limit, Projection, Selection, TableScan, TopN
+from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, IndexScan, Join, Limit, Projection, Selection, Sort, TableScan, TopN
 from ..expr.agg import AGG_FUNCS, AggDesc
 from ..expr.ir import Expr, col, func, lit
 from ..parser import ast as A
@@ -29,8 +29,6 @@ from ..types import Datum, DatumKind, FieldType, Flag, MyDecimal, MyTime, TypeCo
 from .catalog import Catalog, CatalogError, TableMeta, field_type_from_spec
 
 BOOL = new_longlong()
-SORT_NO_LIMIT = 1 << 20  # ORDER BY without LIMIT: TopN with a high bound
-                         # (full external sort is a later component)
 
 
 class PlanError(ValueError):
@@ -1188,8 +1186,13 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
         limit_n = limit_val(stmt.limit.count)
         offset_n = limit_val(stmt.limit.offset) or 0
     if order_items:
-        bound = (limit_n + offset_n) if limit_n is not None else SORT_NO_LIMIT
-        executors.append(TopN(order_by=tuple(order_items), limit=bound))
+        if limit_n is not None:
+            executors.append(TopN(order_by=tuple(order_items), limit=limit_n + offset_n))
+        else:
+            # ORDER BY without LIMIT: a REAL full sort — every row comes
+            # back in order (the r2 2^20 TopN truncation trap is gone;
+            # ref: sortexec/sort.go)
+            executors.append(Sort(order_by=tuple(order_items)))
     elif limit_n is not None:
         executors.append(Limit(limit_n + offset_n))
 

@@ -351,11 +351,11 @@ class TPUStore:
         page = None
         try:
             if req.paging_size is not None:
-                from ..exec.dag import Aggregation as _Agg, Limit as _Limit, TopN as _TopN, executor_walk
+                from ..exec.dag import Aggregation as _Agg, Limit as _Limit, Sort as _Sort, TopN as _TopN, executor_walk
 
                 if req.paging_size <= 0:
                     return CopResponse(other_error=f"invalid paging_size {req.paging_size}")
-                if any(isinstance(e, (_Agg, _TopN, _Limit)) for e in executor_walk(req.dag.executors)):
+                if any(isinstance(e, (_Agg, _TopN, _Limit, _Sort)) for e in executor_walk(req.dag.executors)):
                     # per-page agg/top-k/limit results are not mergeable by
                     # concatenation — row-local DAGs only (scan/sel/proj/join)
                     return CopResponse(other_error="paging requires a row-local DAG (no aggregation/TopN/Limit)")
