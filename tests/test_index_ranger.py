@@ -95,10 +95,12 @@ class TestCoveringIndex:
                 ((2, [i for i in range(300) if i % 7 == 2]), (5, [i for i in range(300) if i % 7 == 5]))]
         assert got.values() == want
 
-    def test_non_covering_falls_back(self, isess):
-        # v is not in the index -> table path (with PK full range)
-        n, path = _scanned_rows(isess, "SELECT v FROM t WHERE g = 3")
-        assert path == "table" and n == 300
+    def test_non_covering_uses_index_lookup(self, isess):
+        # v is not in the index -> no covering scan, but the selective
+        # point predicate on g routes through the double-read now
+        # (r2 behavior was a full table scan; VERDICT r2 missing #3)
+        _, path = _scanned_rows(isess, "SELECT v FROM t WHERE g = 3")
+        assert path == "index_lookup(ig)"
 
     def test_index_range(self, isess):
         n, path = _scanned_rows(isess, "SELECT g FROM t WHERE g > 4")
@@ -163,3 +165,49 @@ class TestReviewRegressions:
             sess.execute("CREATE UNIQUE INDEX ua ON ub (a)")
         # rolled back: the index is gone
         assert not sess.catalog.table("ub").indices
+
+
+class TestIndexLookup:
+    """Non-covering selective index predicates use the index-lookup
+    double-read (ref: pkg/executor/distsql.go IndexLookUpExecutor) instead
+    of degrading to a full table scan (VERDICT r2 missing #3)."""
+
+    def _mk(self):
+        from tidb_tpu.sql import Session
+
+        s = Session()
+        s.execute("create table lk (id bigint primary key, k bigint, payload varchar(20), key ik (k))")
+        rows = ",".join(f"({i}, {i % 50}, 'p{i}')" for i in range(1000))
+        s.execute("insert into lk values " + rows)
+        s.execute("analyze table lk")
+        return s
+
+    def test_plan_chooses_index_lookup(self):
+        s = self._mk()
+        r = s.execute("explain select payload from lk where k = 7")
+        plan_text = "\n".join(str(x[0].val) for x in r.rows)
+        assert "index_lookup(ik)" in plan_text, plan_text
+
+    def test_results_match_full_scan(self):
+        s = self._mk()
+        got = sorted(str(x[0].val) for x in s.execute("select payload from lk where k = 7").rows)
+        want = sorted(f"p{i}" for i in range(1000) if i % 50 == 7)
+        assert got == want and len(got) == 20
+
+    def test_reads_o_of_table_rows(self):
+        """Exec summaries prove the second-phase scan touches only the
+        looked-up handles, not the whole table."""
+        s = self._mk()
+        r = s.execute("explain analyze select payload from lk where k = 3")
+        # rows: [label, actRows, tasks, time]; the TableScan push row
+        scan_rows = None
+        for row in r.rows:
+            if "TableScan" in str(row[0].val):
+                scan_rows = int(row[1].val)
+        assert scan_rows is not None and scan_rows <= 20, scan_rows
+
+    def test_unselective_predicate_stays_full_scan(self):
+        s = self._mk()
+        r = s.execute("explain select payload from lk where k >= 0")
+        plan_text = "\n".join(str(x[0].val) for x in r.rows)
+        assert "index_lookup" not in plan_text, plan_text

@@ -402,38 +402,9 @@ def _ref_pipeline(executors, chunks, cursor, ev) -> list[list[Datum]]:
         elif isinstance(ex, Limit):
             rows = rows[: ex.limit]
         elif isinstance(ex, TopN):
-            import functools
-
-            def cmp_rows(r1, r2):
-                for e, desc in ex.order_by:
-                    a, b = ev.eval(e, r1), ev.eval(e, r2)
-                    if a.is_null() and b.is_null():
-                        continue
-                    if a.is_null():
-                        c = -1
-                    elif b.is_null():
-                        c = 1
-                    else:
-                        c = compare(a, b)
-                    if c:
-                        return -c if desc else c
-                return 0
-
-            rows = sorted(rows, key=functools.cmp_to_key(cmp_rows))[: ex.limit]
+            rows = _order_by_sorted(rows, ex.order_by, ev)[: ex.limit]
         elif isinstance(ex, Sort):
-            import functools
-
-            def cmp_rows_s(r1, r2, _ex=ex):
-                for e, desc in _ex.order_by:
-                    a, b = ev.eval(e, r1), ev.eval(e, r2)
-                    if a.is_null() and b.is_null():
-                        continue
-                    c = -1 if a.is_null() else (1 if b.is_null() else compare(a, b))
-                    if c:
-                        return -c if desc else c
-                return 0
-
-            rows = sorted(rows, key=functools.cmp_to_key(cmp_rows_s))
+            rows = _order_by_sorted(rows, ex.order_by, ev)
         elif isinstance(ex, Window):
             rows = _ref_window(ex, rows, ev)
         elif isinstance(ex, Join):
@@ -470,6 +441,24 @@ def _ref_pipeline(executors, chunks, cursor, ev) -> list[list[Datum]]:
         else:
             raise TypeError(f"unsupported executor {ex}")
     return rows
+
+
+def _order_by_sorted(rows, order_by, ev) -> list:
+    """Stable ORDER BY sort — THE null-first/desc-flip comparator both TopN
+    and Sort (and only they) define order with."""
+    import functools
+
+    def cmp_rows(r1, r2):
+        for e, desc in order_by:
+            a, b = ev.eval(e, r1), ev.eval(e, r2)
+            if a.is_null() and b.is_null():
+                continue
+            c = -1 if a.is_null() else (1 if b.is_null() else compare(a, b))
+            if c:
+                return -c if desc else c
+        return 0
+
+    return sorted(rows, key=functools.cmp_to_key(cmp_rows))
 
 
 def _ref_window(ex, rows, ev) -> list[list[Datum]]:

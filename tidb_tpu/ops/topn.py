@@ -53,11 +53,8 @@ def topn(by: list, row_valid, k: int, full_sort: bool = False):
     Ties keep input order (stable), like the reference's heap-pop order.
     On overflow=True the indices are unusable; the caller recompiles with
     full_sort=True (exact, no overflow possible)."""
-    keys = []
-    for v, desc in by:
-        keys.extend(sort_key_arrays(v, desc=desc))
+    keys, invalid_last = _order_keys(by, row_valid)
     n = row_valid.shape[0]
-    invalid_last = jnp.where(row_valid, jnp.int64(0), jnp.int64(1))
     k = min(k, n)
     n_valid = row_valid.sum()
     out_valid = jnp.arange(k) < n_valid
@@ -106,10 +103,20 @@ def topn(by: list, row_valid, k: int, full_sort: bool = False):
     return fast_idx, out_valid, overflow
 
 
+def _order_keys(by: list, row_valid):
+    """ORDER BY -> (normalized key words, invalid-last word) — the ONE
+    place the ordering/validity key construction lives (topn and sort_all
+    share it)."""
+    keys = []
+    for v, desc in by:
+        keys.extend(sort_key_arrays(v, desc=desc))
+    invalid_last = jnp.where(row_valid, jnp.int64(0), jnp.int64(1))
+    return keys, invalid_last
+
+
 def _stable_sort_idx(keys: list, invalid_last):
     """Stable full-sort permutation with invalid rows compacted to the
-    tail — the ONE place the ordering/validity invariant lives (topn's
-    exact fallback and the Sort executor both use it)."""
+    tail (topn's exact fallback and the Sort executor both use it)."""
     return lexsort([invalid_last] + keys).astype(jnp.int32)
 
 
@@ -117,11 +124,8 @@ def sort_all(by: list, row_valid):
     """Full stable sort of the batch (the Sort executor's kernel): every
     valid row, in ORDER BY order, invalid rows compacted to the tail.
     Returns (row_indices[n], out_valid[n])."""
-    keys = []
-    for v, desc in by:
-        keys.extend(sort_key_arrays(v, desc=desc))
+    keys, invalid_last = _order_keys(by, row_valid)
     n = row_valid.shape[0]
-    invalid_last = jnp.where(row_valid, jnp.int64(0), jnp.int64(1))
     idx = _stable_sort_idx(keys, invalid_last)
     out_valid = jnp.arange(n) < row_valid.sum()
     return idx, out_valid

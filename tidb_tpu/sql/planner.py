@@ -46,7 +46,11 @@ class PlannedQuery:
     column_names: list  # output column labels
     offset: int = 0  # LIMIT offset — applied by the session on final rows
     ranges: list | None = None  # pruned scan ranges (ranger); None = full table
-    access_path: str = "table"  # table | table-range | index(<name>)
+    access_path: str = "table"  # table | table-range | index(<name>) | index_lookup(<name>)
+    # non-covering selective index: (index_id, index key ranges) — the
+    # session runs the double-read (index scan -> handles -> table read,
+    # ref: pkg/executor/distsql.go IndexLookUpExecutor)
+    lookup: tuple | None = None
 
 
 # --------------------------------------------------------------------------
@@ -996,6 +1000,41 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
             scan_ranges = handle_ranges_from_intervals(probe_meta.table_id, ivs)
             access_path = "table-range"
 
+    lookup = None
+    if access_path == "table" and len(trefs) == 1 and probe_meta.indices:
+        # non-covering index with a range-constrained first column AND a
+        # selective predicate: the index-lookup double-read reads o(table)
+        # rows (ref: IndexLookUpExecutor pkg/executor/distsql.go; the
+        # cost-based choice mirrors find_best_task's row-count comparison)
+        from .stats import est_selectivity
+
+        tstats = catalog.stats.get(probe_meta.table_id)
+        best = None
+        for idx in probe_meta.indices:
+            first = probe_meta.col(idx.col_names[0])
+            ivs = intervals_for_column(local[probe_alias], first.name, range_const_of(first.ft))
+            if ivs is None:
+                continue
+            cs = tstats.columns.get(first.name) if tstats is not None else None
+            if cs is not None:
+                sel = est_selectivity(cs, ivs) if ivs else 0.0
+            else:
+                # no stats: assume point intervals are selective, ranges not
+                from ..expr.eval_ref import compare as _cmp
+
+                point = all(
+                    iv.low is not None and iv.high is not None and _cmp(iv.low, iv.high) == 0
+                    for iv in ivs
+                )
+                sel = 0.1 if point else 1.0
+            if best is None or sel < best[0]:
+                best = (sel, idx, ivs)
+        # double-read pays a per-row point cost: require clear selectivity
+        if best is not None and best[0] < 0.3:
+            _, idx, ivs = best
+            lookup = (idx.index_id, index_ranges_from_intervals(probe_meta.table_id, idx.index_id, ivs))
+            access_path = f"index_lookup({idx.name})"
+
     # ---- probe pipeline
     executors: list = [probe_scan]
     if local[probe_alias]:
@@ -1209,4 +1248,5 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
     return PlannedQuery(
         dag, probe_meta, build_tables, names,
         offset=offset_n or 0, ranges=scan_ranges, access_path=access_path,
+        lookup=lookup,
     )

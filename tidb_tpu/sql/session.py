@@ -660,6 +660,11 @@ class Session:
                 # flow through: execute_root dispatches zero tasks and the
                 # root merge still produces scalar-agg rows
                 ranges = plan.ranges if plan.ranges is not None else full_table_ranges(plan.probe_table.table_id)
+                if plan.lookup is not None:
+                    # index-lookup double-read phase 1: index scan -> row
+                    # handles -> coalesced table ranges (ref:
+                    # pkg/executor/distsql.go IndexLookUpExecutor)
+                    ranges = self._lookup_handle_ranges(plan, ts)
                 if not gate_on:
                     # feature gate OFF (ref: TiDBAllowMPPExecution pattern):
                     # evaluate the whole plan with the row-at-a-time oracle
@@ -932,6 +937,33 @@ class Session:
             # evaluate: lock the whole table (conservative, never unsound)
             matched = self._scan_rows_with_handles(meta, None, self.txn.start_ts)
         self._lock_rows(meta, [h for h, _ in matched])
+
+    def _lookup_handle_ranges(self, plan, ts) -> list:
+        """Phase 1 of the double-read: scan index entries over the pruned
+        index key ranges, collect handles, coalesce consecutive handles
+        into second-phase table ranges (batched + ordered — the keep_order
+        analog of IndexLookUpExecutor's handle batching)."""
+        from ..distsql import handle_ranges
+        from ..exec.dag import IndexScan
+
+        index_id, iranges = plan.lookup
+        meta = plan.probe_table
+        idx = next(i for i in meta.indices if i.index_id == index_id)
+        vcols = [meta.col(cn) for cn in idx.col_names]
+        icols = tuple(ColumnInfo(c.col_id, c.ft) for c in vcols) + (ColumnInfo(-1, HANDLE_FT),)
+        hdag = DAGRequest(
+            (IndexScan(meta.table_id, index_id, icols),),
+            output_offsets=(len(icols) - 1,),
+        )
+        chunk = execute_root(self.store, hdag, iranges, start_ts=ts)
+        handles = sorted({int(r[0].val) for r in chunk.rows()})
+        pairs: list[list[int]] = []
+        for h in handles:
+            if pairs and h == pairs[-1][1] + 1:
+                pairs[-1][1] = h
+            else:
+                pairs.append([h, h])
+        return handle_ranges(meta.table_id, [(a, b) for a, b in pairs])
 
     def _select_via_oracle(self, plan, ranges, aux, ts) -> Chunk:
         from ..exec import run_dag_reference
