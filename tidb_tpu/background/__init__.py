@@ -217,3 +217,29 @@ class AutoAnalyzer:
 
     def timer(self, interval: float) -> Timer:
         return Timer("auto-analyze", interval, self.run_once)
+
+
+class GCWorker:
+    """Safepoint-driven MVCC garbage collection on a timer (ref:
+    pkg/store/gcworker/gc_worker.go — leader-elected there, a plain
+    periodic worker in one process). Each tick garbage-collects versions
+    older than the current TSO, clamped below active transactions by
+    TPUStore.run_gc."""
+
+    def __init__(self, store, interval: float = 30.0):
+        self.store = store
+        self.removed_total = 0
+        self.runs = 0
+
+        def tick():
+            self.removed_total += self.store.run_gc()
+            self.runs += 1
+
+        self.timer = Timer("gc", interval, tick)
+
+    def start(self):
+        self.timer.start()
+        return self
+
+    def stop(self):
+        self.timer.stop()

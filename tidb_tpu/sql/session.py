@@ -131,6 +131,12 @@ class Session:
         from .sysvar import SysVarStore
 
         self.store = store or TPUStore()
+        if catalog is None and store is not None:
+            # reopening an existing store: recover the schema from the
+            # m-prefix keyspace (ref: domain.go:1131 infoschema reload)
+            from .meta import load_catalog
+
+            catalog = load_catalog(store)
         self.catalog = catalog or Catalog()
         self.txn: TxnState | None = None
         self.sysvars = SysVarStore()
@@ -164,6 +170,8 @@ class Session:
             explicit=explicit,
             schema_ver=self.catalog.version,
         )
+        # pin the snapshot against GC for the txn's lifetime
+        self.store.register_snapshot(self.txn.start_ts)
 
     def _commit(self):
         from ..store.txn import TxnError
@@ -171,6 +179,7 @@ class Session:
         txn, self.txn = self.txn, None
         if txn is None:
             return
+        self.store.unregister_snapshot(txn.start_ts)
         if not txn.mutations:
             self.store.txn.release_all(txn.start_ts)
             return
@@ -203,6 +212,7 @@ class Session:
     def _rollback(self):
         txn, self.txn = self.txn, None
         if txn is not None:
+            self.store.unregister_snapshot(txn.start_ts)
             self.store.txn.release_all(txn.start_ts)
 
     def _autocommit_dml(self, fn):
@@ -319,15 +329,19 @@ class Session:
         if isinstance(stmt, A.CreateTableStmt):
             self._implicit_commit()
             self.catalog.create_table(stmt)
+            self._persist_schema()
             return Result()
         if isinstance(stmt, A.DropTableStmt):
             self._implicit_commit()
             for t in stmt.tables:
                 self.catalog.drop_table(t.name, stmt.if_exists)
+            self._persist_schema()
             return Result()
         if isinstance(stmt, A.TruncateTableStmt):
             self._implicit_commit()
-            return self._autocommit_dml(lambda: self._truncate(stmt))
+            r = self._autocommit_dml(lambda: self._truncate(stmt))
+            self._persist_schema()
+            return r
         if isinstance(stmt, A.InsertStmt):
             return self._autocommit_dml(lambda: self._insert(stmt))
         if isinstance(stmt, A.UpdateStmt):
@@ -363,10 +377,14 @@ class Session:
             return Result()  # single implicit database
         if isinstance(stmt, A.CreateIndexStmt):
             self._implicit_commit()
-            return self._create_index(stmt)
+            r = self._create_index(stmt)
+            self._persist_schema()
+            return r
         if isinstance(stmt, A.DropIndexStmt):
             self._implicit_commit()
-            return self._drop_index(stmt)
+            r = self._drop_index(stmt)
+            self._persist_schema()
+            return r
         if isinstance(stmt, A.LoadDataStmt):
             from ..tools.lightning import load_data
 
@@ -391,6 +409,7 @@ class Session:
                 alter_table(self, stmt)
             except DDLError as exc:
                 raise SQLError(str(exc)) from exc
+            self._persist_schema()
             return Result()
         if isinstance(stmt, A.RenameTableStmt):
             from .ddl import DDLError, _rename_table, run_job
@@ -405,6 +424,7 @@ class Session:
                             lambda m=meta, n=new_name: _rename_table(self.catalog, m, n))
             except DDLError as exc:
                 raise SQLError(str(exc)) from exc
+            self._persist_schema()
             return Result()
         if isinstance(stmt, A.AdminStmt):
             return self._admin(stmt)
@@ -576,6 +596,14 @@ class Session:
     def _select(self, stmt: A.SelectStmt) -> Result:
         names, fts, rows = self._run_select(stmt, None)
         return Result(columns=names, rows=rows, fts=fts)
+
+    def _persist_schema(self) -> None:
+        """Write the catalog into the store's m-prefix keyspace after a
+        schema change (ref: pkg/meta/meta.go — every DDL job persists its
+        TableInfo; a reopened store recovers the schema from bytes)."""
+        from .meta import persist_catalog
+
+        persist_catalog(self.store, self.catalog)
 
     def _new_rewriter(self, parent_rw):
         from .subquery import SubqueryRewriter

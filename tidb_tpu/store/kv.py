@@ -74,6 +74,34 @@ class MemKV:
                 i += 1
         return iter(out)
 
+    def gc(self, safepoint: int) -> int:
+        """MVCC garbage collection at `safepoint`: per key, keep every
+        version newer than the safepoint plus the newest one at-or-below
+        it (the version a safepoint-old snapshot still reads); if that
+        survivor is a tombstone nothing can ever read, drop it too
+        (ref: pkg/store/gcworker/gc_worker.go resolve + delete-versions).
+        Returns the number of versions removed."""
+        removed = 0
+        with self.lock:
+            for key in list(self._data):
+                versions = self._data[key]  # ascending commit_ts
+                newest_le = None
+                keep = []
+                for vts, val in versions:
+                    if vts <= safepoint:
+                        newest_le = (vts, val)
+                    else:
+                        keep.append((vts, val))
+                if newest_le is not None and newest_le[1] is not None:
+                    keep.insert(0, newest_le)
+                removed += len(versions) - len(keep)
+                if keep:
+                    self._data[key] = keep
+                else:
+                    del self._data[key]
+                    self._dirty = True
+        return removed
+
     def latest_ts(self, key: bytes) -> int:
         """Commit ts of the newest version of `key` (0 if none) — the
         write-conflict check input (ref: mvcc.go checkConflict)."""

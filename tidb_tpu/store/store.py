@@ -95,6 +95,7 @@ class TPUStore:
         self.txn = TxnEngine(self.kv, on_commit=self._bump_write_ver)
         self._tso = itertools.count(100)
         self._tso_lock = threading.Lock()
+        self._active_snapshots: dict[int, int] = {}
         self._write_ver = 0
         self._chunk_cache: dict = {}
         self._batch_cache: dict = {}
@@ -108,6 +109,39 @@ class TPUStore:
         commit timestamps totally order across sessions."""
         with self._tso_lock:
             return next(self._tso)
+
+    def register_snapshot(self, start_ts: int) -> None:
+        """An open transaction pins its snapshot: GC never collects at or
+        above the oldest registered start_ts (ref: the reference's
+        min-start-ts reporting into PD's safepoint calculation,
+        gc_worker.go calcSafePointByMinStartTS)."""
+        with self._tso_lock:
+            self._active_snapshots[start_ts] = self._active_snapshots.get(start_ts, 0) + 1
+
+    def unregister_snapshot(self, start_ts: int) -> None:
+        with self._tso_lock:
+            n = self._active_snapshots.get(start_ts, 0) - 1
+            if n <= 0:
+                self._active_snapshots.pop(start_ts, None)
+            else:
+                self._active_snapshots[start_ts] = n
+
+    def run_gc(self, safepoint: int | None = None) -> int:
+        """MVCC GC pass (ref: gc_worker.go): the effective safepoint is
+        clamped strictly below every active transaction — both registered
+        snapshots (read-only txns included) and lock holders — so no
+        in-flight snapshot loses its read view and no write-conflict check
+        loses the tombstone it compares against. Default safepoint = the
+        current TSO (keep only the latest committed version per key).
+        Returns versions removed."""
+        sp = safepoint if safepoint is not None else self.next_ts()
+        with self._tso_lock:
+            for ts in self._active_snapshots:
+                sp = min(sp, ts - 1)
+        with self.txn._mu:
+            for l in self.txn.locks.values():
+                sp = min(sp, l.start_ts - 1)
+        return self.kv.gc(sp)
 
     def _bump_write_ver(self):
         self._write_ver += 1
