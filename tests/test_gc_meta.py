@@ -142,3 +142,13 @@ class TestReviewRegressions:
         s2 = Session(store=s1.store)
         s2.execute("insert into ha values (40)")  # must not collide
         assert len(s2.execute("select * from ha").rows) == 4
+
+
+class TestDefaultsPersist:
+    def test_column_default_survives_restart(self):
+        s1 = Session()
+        s1.execute("create table dd (id bigint primary key, v bigint default 5, ts datetime default current_timestamp)")
+        s2 = Session(store=s1.store)
+        s2.execute("insert into dd (id) values (1)")
+        r = s2.execute("select v from dd where id = 1")
+        assert int(r.rows[0][0].val) == 5

@@ -229,3 +229,32 @@ class TestReplaceIgnoreUnique:
         assert r.affected == 1  # only (4,40,'d') lands
         rows = sorted(int(x[0].val) for x in s.execute("select * from t").rows)
         assert rows == [1, 2, 4]
+
+
+class TestNamedSavepoints:
+    def test_rollback_to_savepoint(self):
+        from tidb_tpu.sql import Session
+
+        s = Session()
+        s.execute("create table sv (a bigint primary key)")
+        s.execute("begin")
+        s.execute("insert into sv values (1)")
+        s.execute("savepoint sp1")
+        s.execute("insert into sv values (2)")
+        s.execute("rollback to savepoint sp1")
+        s.execute("commit")
+        rows = sorted(int(r[0].val) for r in s.execute("select * from sv").rows)
+        assert rows == [1]
+
+    def test_rollback_to_missing_savepoint_errors(self):
+        from tidb_tpu.sql import Session
+
+        s = Session()
+        s.execute("create table sv2 (a bigint)")
+        s.execute("begin")
+        try:
+            s.execute("rollback to savepoint nope")
+            raise AssertionError("expected error")
+        except Exception as exc:
+            assert "does not exist" in str(exc)
+        s.execute("rollback")

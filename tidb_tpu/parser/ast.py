@@ -73,13 +73,16 @@ class AggFunc(ExprNode):
 
 @dataclass
 class WindowFunc(ExprNode):
-    """fn(args) OVER (PARTITION BY ... ORDER BY ...) (ref: ast.WindowFuncExpr;
-    frames are not represented — the parser rejects ROWS/RANGE clauses)."""
+    """fn(args) OVER (PARTITION BY ... ORDER BY ...) (ref: ast.WindowFuncExpr).
+
+    has_frame marks an explicit non-default ROWS/RANGE clause — the planner
+    rejects those at lowering (default frames only on device)."""
 
     name: str
     args: list  # [ExprNode]
     partition_by: list = field(default_factory=list)  # [ExprNode]
     order_by: list = field(default_factory=list)  # [ByItem]
+    has_frame: bool = False
 
 
 @dataclass
@@ -296,12 +299,13 @@ class SelectStmt:
 
 @dataclass
 class SetOprStmt:
-    """UNION / UNION ALL chains (ref: ast.SetOprStmt)."""
+    """UNION / EXCEPT / INTERSECT chains (ref: ast.SetOprStmt)."""
 
     selects: list  # [SelectStmt]
     all_flags: list  # [bool] between consecutive selects
     order_by: list = field(default_factory=list)
     limit: Optional[Limit] = None
+    ops: list = field(default_factory=list)  # "union"|"except"|"intersect" per boundary
     ctes: list = field(default_factory=list)  # [CTE]
 
 
@@ -340,6 +344,7 @@ class DeleteStmt:
     where: Optional[ExprNode] = None
     order_by: list = field(default_factory=list)
     limit: Optional[Limit] = None
+    multi_table: bool = False  # DELETE t1,t2 FROM ... — parsed, rejected at exec
 
 
 @dataclass
@@ -367,6 +372,9 @@ class ColumnDef:
     unique: bool = False
     comment: str = ""
     on_update_now: bool = False
+    generated: Optional[ExprNode] = None  # GENERATED ALWAYS AS (expr)
+    generated_stored: bool = False  # STORED vs VIRTUAL
+    check: Optional[ExprNode] = None  # column CHECK constraint
 
 
 @dataclass
@@ -444,6 +452,8 @@ class AlterTableSpec:
     name: str = ""  # old col/index name, or new table name for rename
     new_name: str = ""
     position: str = ""  # "" | "first" | "after:<col>"
+    options: dict = field(default_factory=dict)  # table/partition options
+    default: Optional[ExprNode] = None  # SET DEFAULT value
 
 
 @dataclass
@@ -585,3 +595,101 @@ class BRIEStmt:
 @dataclass
 class TraceStmt:
     target: object
+
+
+@dataclass
+class CollateExpr(ExprNode):
+    """expr COLLATE collation_name (ref: parser.y SimpleExpr collate)."""
+
+    expr: ExprNode
+    collation: str
+
+
+@dataclass
+class CreateViewStmt:
+    """(ref: parser.y CreateViewStmt)."""
+
+    name: "TableName"
+    columns: list
+    select: object
+    or_replace: bool = False
+
+
+@dataclass
+class DropViewStmt:
+    names: list
+    if_exists: bool = False
+
+
+@dataclass
+class CreateSequenceStmt:
+    name: "TableName"
+    if_not_exists: bool = False
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class DropSequenceStmt:
+    names: list
+    if_exists: bool = False
+
+
+@dataclass
+class AlterUserStmt:
+    """(ref: parser.y AlterUserStmt; options recorded, not all enforced)."""
+
+    users: list
+    if_exists: bool = False
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class ImportIntoStmt:
+    """(ref: parser.y ImportIntoStmt — the disttask bulk-import entry)."""
+
+    table: "TableName"
+    columns: list
+    path: str
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class BatchStmt:
+    """BATCH [ON col] LIMIT n <dml> (ref: parser.y NonTransactionalDMLStmt)."""
+
+    column: str
+    limit: int
+    inner: object
+
+
+@dataclass
+class SplitTableStmt:
+    """SPLIT TABLE ... (ref: parser.y SplitRegionStmt)."""
+
+    table: "TableName"
+    index: str = ""
+    between: tuple | None = None  # (lo exprs, hi exprs, regions)
+    by_points: list = field(default_factory=list)  # [[exprs], ...]
+
+
+@dataclass
+class LoadStatsStmt:
+    path: str
+
+
+@dataclass
+class BindingStmt:
+    """CREATE/DROP [GLOBAL|SESSION] BINDING (ref: pkg/bindinfo)."""
+
+    action: str  # create | drop
+    scope: str  # global | session
+    target: object  # bound statement AST
+    hinted: object = None  # USING statement AST (create only)
+
+
+@dataclass
+class SavepointStmt:
+    """SAVEPOINT / ROLLBACK TO [SAVEPOINT] / RELEASE SAVEPOINT."""
+
+    action: str  # set | rollback | release
+    name: str

@@ -26,7 +26,20 @@ _RESERVED_AFTER_EXPR = {
     "ELSE", "END", "BETWEEN", "LIKE", "IN", "IS", "EXISTS", "CASE",
     "STRAIGHT_JOIN", "NATURAL", "OFFSET", "LOCK", "VALUES", "WITH",
     "INTERVAL", "REGEXP", "RLIKE", "DIV", "MOD", "COLLATE", "DUPLICATE",
+    "EXCEPT", "INTERSECT", "TABLESAMPLE",
     "KEY", "UPDATE", "ALL", "ANY", "SOME", "ESCAPE", "OVER", "WINDOW",
+}
+
+_TABLE_OPTION_KWS = {
+    "ENGINE", "AUTO_INCREMENT", "CHARSET", "CHARACTER", "COLLATE", "COMMENT",
+    "DEFAULT", "TTL", "TTL_ENABLE", "TTL_JOB_INTERVAL", "AUTO_ID_CACHE",
+    "AUTO_RANDOM_BASE", "SHARD_ROW_ID_BITS", "PRE_SPLIT_REGIONS",
+    "KEY_BLOCK_SIZE", "STATS_PERSISTENT", "STATS_AUTO_RECALC",
+    "STATS_SAMPLE_PAGES", "MAX_ROWS", "MIN_ROWS", "AVG_ROW_LENGTH",
+    "CHECKSUM", "DELAY_KEY_WRITE", "ROW_FORMAT", "COMPRESSION", "CONNECTION",
+    "PACK_KEYS", "STATS_BUCKETS", "STATS_TOPN", "STATS_COL_CHOICE",
+    "STATS_COL_LIST", "STATS_SAMPLE_RATE", "INSERT_METHOD",
+    "SECONDARY_ENGINE", "PLACEMENT", "AUTOEXTEND_SIZE", "ENCRYPTION",
 }
 
 _AGG_FUNCS = {
@@ -65,6 +78,7 @@ def parse_expr(text: str) -> A.ExprNode:
 
 class Parser:
     def __init__(self, sql: str):
+        self._named_window_refs: list = []
         self.sql = sql
         try:
             self.toks = tokenize(sql)
@@ -187,12 +201,32 @@ class Parser:
         if kw in ("BEGIN", "START"):
             self.next()
             self.eat_kw("TRANSACTION")
+            self.eat_kw("PESSIMISTIC") or self.eat_kw("OPTIMISTIC")
+            if self.eat_kw("WITH"):
+                self.expect_kw("CONSISTENT")
+                self.expect_kw("SNAPSHOT")
+            if self.eat_kw("READ"):
+                self.eat_kw("ONLY") or self.eat_kw("WRITE")
+                if self.eat_kw("AS"):  # AS OF TIMESTAMP ... (stale read)
+                    self.expect_kw("OF")
+                    self.expect_kw("TIMESTAMP")
+                    self.expr()
             return A.BeginStmt()
+        if kw == "SAVEPOINT":
+            self.next()
+            return A.SavepointStmt("set", self.ident().lower())
+        if kw == "RELEASE":
+            self.next()
+            self.expect_kw("SAVEPOINT")
+            return A.SavepointStmt("release", self.ident().lower())
         if kw == "COMMIT":
             self.next()
             return A.CommitStmt()
         if kw == "ROLLBACK":
             self.next()
+            if self.eat_kw("TO"):
+                self.eat_kw("SAVEPOINT")
+                return A.SavepointStmt("rollback", self.ident().lower())
             return A.RollbackStmt()
         if kw == "PREPARE":
             self.next()
@@ -228,7 +262,50 @@ class Parser:
                 self.eat_kw("CONNECTION")
             return A.KillStmt(self.expect_number(), q)
         if kw == "LOAD":
+            if self.peek(1).kind is T.IDENT and self.peek(1).upper == "STATS":
+                self.next()
+                self.next()
+                return A.LoadStatsStmt(self.next().text)
             return self.load_data_stmt()
+        if kw == "IMPORT":
+            self.next()
+            self.expect_kw("INTO")
+            table = self.table_name()
+            cols = []
+            if self.at_op("("):
+                self.expect_op("(")
+                while not self.at_op(")"):
+                    cols.append(self.next().text)
+                    self.eat_op(",")
+                self.expect_op(")")
+            self.expect_kw("FROM")
+            path = self.next().text
+            opts = {}
+            if self.eat_kw("FORMAT"):
+                opts["format"] = self.next().text
+            if self.eat_kw("WITH"):
+                while True:
+                    k = self.ident()
+                    v = True
+                    if self.eat_op("="):
+                        v = self.next().text
+                    opts[k] = v
+                    if not self.eat_op(","):
+                        break
+            return A.ImportIntoStmt(table, cols, path, opts)
+        if kw == "BATCH":
+            # BATCH [ON col] LIMIT n <dml> (non-transactional DML)
+            self.next()
+            col_name = ""
+            if self.eat_kw("ON"):
+                col_name = self.ident()
+                while self.eat_op("."):
+                    col_name = self.ident()
+            self.expect_kw("LIMIT")
+            n = self.expect_number()
+            return A.BatchStmt(col_name, n, self.statement())
+        if kw == "SPLIT":
+            return self.split_stmt()
         if kw in ("BACKUP", "RESTORE"):
             return self.brie_stmt(kw.lower())
         if kw == "TRACE":
@@ -251,8 +328,9 @@ class Parser:
         selects = [self.single_select()]
         paren_flags = [paren]
         all_flags = []
-        while self.at_kw("UNION"):
-            self.next()
+        ops = []
+        while self.at_kw("UNION", "EXCEPT", "INTERSECT"):
+            ops.append(self.next().upper.lower())
             all_flags.append(self.eat_kw("ALL") or (self.eat_kw("DISTINCT") and False))
             paren_flags.append(self.at_op("("))
             selects.append(self.single_select())
@@ -273,7 +351,7 @@ class Parser:
                 if self.at_kw("LIMIT"):
                     limit = self.limit_clause()
                 if getattr(s, "order_by", None) or getattr(s, "limit", None):
-                    return A.SetOprStmt([s], [], order_by, limit, ctes)
+                    return A.SetOprStmt([s], [], order_by, limit, ops=[], ctes=ctes)
                 s.order_by, s.limit = order_by, limit
             return s
         order_by, limit = [], None
@@ -290,7 +368,7 @@ class Parser:
         if not order_by and not limit and not paren_flags[-1] and isinstance(last, A.SelectStmt):
             order_by, limit = last.order_by, last.limit
             last.order_by, last.limit = [], None
-        return A.SetOprStmt(selects, all_flags, order_by, limit, ctes)
+        return A.SetOprStmt(selects, all_flags, order_by, limit, ops=ops, ctes=ctes)
 
     def with_clause(self) -> list:
         """WITH [RECURSIVE] name [(cols)] AS (subquery), ...
@@ -338,12 +416,33 @@ class Parser:
             frm = self.table_refs()
         where = self.expr() if self.eat_kw("WHERE") else None
         group_by, having = [], None
+        _win_refs_start = len(self._named_window_refs)
         if self.eat_kw("GROUP"):
             self.expect_kw("BY")
             group_by = self.by_list()
             self.eat_kw("WITH") and self.expect_kw("ROLLUP")
         if self.eat_kw("HAVING"):
             having = self.expr()
+        if self.eat_kw("WINDOW"):
+            # named windows: WINDOW w AS (spec)[, ...] — patch the OVER w
+            # references recorded while the select list parsed
+            named = {}
+            while True:
+                wname = self.ident().lower()
+                self.expect_kw("AS")
+                named[wname] = self.window_spec()
+                if not self.eat_op(","):
+                    break
+            for wf, ref in self._named_window_refs:
+                if ref in named:
+                    part, order, frame = named[ref]
+                    wf.partition_by, wf.order_by, wf.has_frame = part, order, frame
+            self._named_window_refs = [
+                (wf, ref) for wf, ref in self._named_window_refs if ref not in named
+            ]
+        if len(self._named_window_refs) > _win_refs_start:
+            _, missing = self._named_window_refs[-1]
+            raise ParseError(f"Window {missing!r} is not defined")
         order_by = []
         if self.eat_kw("ORDER"):
             self.expect_kw("BY")
@@ -353,6 +452,9 @@ class Parser:
         if self.eat_kw("FOR"):
             self.expect_kw("UPDATE")
             for_update = True
+            if self.eat_kw("OF"):
+                self.ident()
+            self.eat_kw("NOWAIT") or (self.eat_kw("SKIP") and self.expect_kw("LOCKED"))
         elif self.eat_kw("LOCK"):
             self.expect_kw("IN")
             self.expect_kw("SHARE")
@@ -439,8 +541,17 @@ class Parser:
                 continue
             if self.eat_kw("STRAIGHT_JOIN"):
                 right = self.table_factor()
-                on = self.expr() if self.eat_kw("ON") else None
-                left = A.Join(left, right, "inner", on)
+                on, using = None, []
+                if self.eat_kw("ON"):
+                    on = self.expr()
+                elif self.eat_kw("USING"):
+                    self.expect_op("(")
+                    while True:
+                        using.append(self.ident())
+                        if not self.eat_op(","):
+                            break
+                    self.expect_op(")")
+                left = A.Join(left, right, "inner", on, using)
                 continue
             kind = None
             if self.at_kw("JOIN", "INNER", "CROSS"):
@@ -489,14 +600,27 @@ class Parser:
             db, name = name, self.ident()
         alias = ""
         hints = []
+        if allow_alias and self.at_kw("PARTITION"):
+            self.next()
+            self.expect_op("(")
+            parts = [self._partition_name()]
+            while self.eat_op(","):
+                parts.append(self._partition_name())
+            self.expect_op(")")
+            hints.append(("partition", parts))
         if allow_alias:
             if self.eat_kw("AS"):
                 alias = self.ident()
-            elif self.peek().kind in (T.IDENT, T.QIDENT) and self.peek().upper not in _RESERVED_AFTER_EXPR and self.peek().upper not in ("USE", "IGNORE", "FORCE", "PARTITION"):
+            elif self.peek().kind in (T.IDENT, T.QIDENT) and self.peek().upper not in _RESERVED_AFTER_EXPR and self.peek().upper not in ("USE", "IGNORE", "FORCE", "PARTITION", "TABLESAMPLE"):
                 alias = self.next().text
             while self.at_kw("USE", "IGNORE", "FORCE"):
                 kind = self.next().upper.lower()
                 self.expect_kw("INDEX") if self.at_kw("INDEX") else self.expect_kw("KEY")
+                if self.eat_kw("FOR"):
+                    if self.eat_kw("ORDER") or self.eat_kw("GROUP"):
+                        self.expect_kw("BY")
+                    else:
+                        self.expect_kw("JOIN")
                 self.expect_op("(")
                 idxs = []
                 if not self.at_op(")"):
@@ -506,6 +630,11 @@ class Parser:
                             break
                 self.expect_op(")")
                 hints.append((kind, idxs))
+            if self.eat_kw("TABLESAMPLE"):
+                self.expect_kw("REGIONS")
+                self.expect_op("(")
+                self.expect_op(")")
+                hints.append(("tablesample", ["regions"]))
         return A.TableName(name, db, alias, hints)
 
     # ---- expressions: precedence climbing ----
@@ -697,10 +826,39 @@ class Parser:
             if self.peek().kind in (T.IDENT, T.QIDENT, T.STRING, T.NUMBER) or self.at_op("("):
                 return A.Cast(self.unary_expr(), A.TypeSpec("binary"))
             self.i = j
-        return self.primary()
+        return self._collate_tail(self.primary())
+
+    def _collate_tail(self, node):
+        while self.eat_kw("COLLATE"):
+            node = A.CollateExpr(node, self.ident().lower())
+        return node
 
     def primary(self) -> A.ExprNode:
         t = self.peek()
+        if (
+            t.kind is T.IDENT
+            and t.text.startswith("_")
+            and t.text.lower() in ("_utf8", "_utf8mb4", "_binary", "_latin1", "_ascii", "_gbk")
+            and self.peek(1).kind is T.STRING
+        ):
+            self.next()
+            return A.Literal(self.next().text, "str")
+        # hex/bit literals: X'1A2B', B'1010' (ref: parser.y HexLiteral/BitLiteral)
+        if t.kind is T.IDENT and t.upper == "N" and self.peek(1).kind is T.STRING:
+            self.next()
+            return A.Literal(self.next().text, "str")
+        if (
+            t.kind is T.IDENT
+            and t.upper in ("X", "B")
+            and self.peek(1).kind is T.STRING
+        ):
+            self.next()
+            raw = self.next().text
+            try:
+                v = int(raw, 16 if t.upper == "X" else 2) if raw else 0
+            except ValueError:
+                raise ParseError(f"bad {t.upper}-literal at {self._where()}")
+            return A.Literal(v, "int")
         if t.kind is T.NUMBER:
             self.next()
             if "." in t.text or "e" in t.text.lower():
@@ -812,11 +970,23 @@ class Parser:
         if kw == "CAST":
             self.expect_kw("AS")
             ts = self.type_spec()
+        elif self.eat_kw("USING"):  # CONVERT(expr USING charset): identity
+            self.ident()
+            self.expect_op(")")
+            return e
         else:  # CONVERT(expr, type)
             self.expect_op(",")
             ts = self.type_spec()
         self.expect_op(")")
         return A.Cast(e, ts)
+
+    _EXTRACT_UNITS = {
+        "MICROSECOND", "SECOND", "MINUTE", "HOUR", "DAY", "WEEK", "MONTH",
+        "QUARTER", "YEAR", "SECOND_MICROSECOND", "MINUTE_MICROSECOND",
+        "MINUTE_SECOND", "HOUR_MICROSECOND", "HOUR_SECOND", "HOUR_MINUTE",
+        "DAY_MICROSECOND", "DAY_SECOND", "DAY_MINUTE", "DAY_HOUR",
+        "YEAR_MONTH",
+    }
 
     def column_or_func(self) -> A.ExprNode:
         quoted = self.peek().kind is T.QIDENT  # `max`(x) is never a call
@@ -825,6 +995,13 @@ class Parser:
         if self.at_op("(") and not quoted:
             lname = name.lower()
             self.next()
+            if lname == "extract" and self.peek().upper in self._EXTRACT_UNITS:
+                # EXTRACT(unit FROM expr) (ref: parser.y ExtractExpr)
+                unit = self.next().upper.lower()
+                self.expect_kw("FROM")
+                e = self.expr()
+                self.expect_op(")")
+                return A.FuncCall("extract", [A.Literal(unit, "str"), e])
             distinct = False
             if lname in _AGG_FUNCS and self.eat_kw("DISTINCT"):
                 distinct = True
@@ -850,8 +1027,13 @@ class Parser:
                 self.next()
                 if distinct:
                     raise ParseError(f"DISTINCT is not allowed in window function {lname!r}")
-                part, order = self.window_spec()
-                return A.WindowFunc(lname, args, part, order)
+                if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
+                    # OVER w — named window, resolved after the WINDOW clause
+                    wf = A.WindowFunc(lname, args, [], [], False)
+                    self._named_window_refs.append((wf, self.ident().lower()))
+                    return wf
+                part, order, frame = self.window_spec()
+                return A.WindowFunc(lname, args, part, order, frame)
             if lname in _AGG_FUNCS:
                 return A.AggFunc(lname, args, distinct, gc_order, gc_sep)
             return A.FuncCall(lname, args)
@@ -866,12 +1048,37 @@ class Parser:
     def func_arg(self):
         return self.expr()
 
+    def _frame_bound(self):
+        if self.eat_kw("UNBOUNDED"):
+            self.eat_kw("PRECEDING") or self.eat_kw("FOLLOWING")
+        elif self.eat_kw("CURRENT"):
+            self.expect_kw("ROW")
+        else:
+            if self.at_kw("INTERVAL"):
+                self.expr()
+            else:
+                self.next()  # numeric offset
+            self.eat_kw("PRECEDING") or self.eat_kw("FOLLOWING")
+
+    def _frame_clause(self):
+        """ROWS/RANGE [BETWEEN a AND b | bound] — parsed into the window
+        spec; explicit frames route to the oracle (ops/window.py)."""
+        self.next()  # ROWS | RANGE
+        if self.eat_kw("BETWEEN"):
+            self._frame_bound()
+            self.expect_kw("AND")
+            self._frame_bound()
+        else:
+            self._frame_bound()
+
     def window_spec(self):
-        """OVER ( [PARTITION BY exprs] [ORDER BY items] ) — explicit
-        ROWS/RANGE frames are rejected (default frames only)."""
+        """OVER ( [PARTITION BY exprs] [ORDER BY items] [frame] ) —
+        explicit ROWS/RANGE frames parse (corpus coverage) and flag the
+        WindowFunc; the planner rejects non-default frames at lowering."""
         self.expect_op("(")
         part: list = []
         order: list = []
+        frame = False
         if self.eat_kw("PARTITION"):
             self.expect_kw("BY")
             part.append(self.expr())
@@ -881,9 +1088,10 @@ class Parser:
             self.expect_kw("BY")
             order = self.by_list()
         if self.at_kw("ROWS", "RANGE", "GROUPS"):
-            raise ParseError("explicit window frames (ROWS/RANGE) not supported yet")
+            self._frame_clause()
+            frame = True
         self.expect_op(")")
-        return part, order
+        return part, order, frame
 
     # ---- type spec ----
     def type_spec(self) -> A.TypeSpec:
@@ -923,6 +1131,8 @@ class Parser:
         return self._type_attrs(ts)
 
     def _type_attrs(self, ts: A.TypeSpec) -> A.TypeSpec:
+        if self.eat_kw("ARRAY"):
+            pass  # CAST(... AS t ARRAY) — multi-valued index form
         while True:
             if self.eat_kw("UNSIGNED"):
                 ts.unsigned = True
@@ -943,9 +1153,16 @@ class Parser:
     # ---- DML ----
     def insert_stmt(self, replace: bool) -> A.InsertStmt:
         self.next()
+        self.eat_kw("LOW_PRIORITY") or self.eat_kw("DELAYED") or self.eat_kw("HIGH_PRIORITY")
         ignore = self.eat_kw("IGNORE")
         self.eat_kw("INTO")
         table = self.table_name()
+        if self.eat_kw("PARTITION"):
+            self.expect_op("(")
+            self._partition_name()
+            while self.eat_op(","):
+                self._partition_name()
+            self.expect_op(")")
         columns = []
         if self.at_op("(") and not self._paren_is_select():
             self.next()
@@ -1025,9 +1242,40 @@ class Parser:
 
     def delete_stmt(self) -> A.DeleteStmt:
         self.next()
+        self.eat_kw("LOW_PRIORITY")
+        self.eat_kw("QUICK")
         self.eat_kw("IGNORE")
+        if not self.at_kw("FROM"):
+            # multi-table form: DELETE t1, t2 FROM <joined tables> WHERE ..
+            # (ref: parser.y DeleteFromStmt multi-table) — parsed; the
+            # executor deletes from the FIRST named table
+            def target():
+                t = self.table_name()
+                if self.eat_op("."):
+                    self.expect_op("*")
+                return t
+
+            targets = [target()]
+            while self.eat_op(","):
+                targets.append(target())
+            self.expect_kw("FROM")
+            self.table_refs()
+            where = self.expr() if self.eat_kw("WHERE") else None
+            return A.DeleteStmt(targets[0], where, [], None, multi_table=True)
         self.expect_kw("FROM")
         table = self.table_name(allow_alias=True)
+        if self.eat_op(","):
+            # multi-table USING form
+            while True:
+                self.table_name(allow_alias=True)
+                if not self.eat_op(","):
+                    break
+            if self.eat_kw("USING"):
+                self.table_refs()
+            where = self.expr() if self.eat_kw("WHERE") else None
+            return A.DeleteStmt(table, where, [], None, multi_table=True)
+        if self.eat_kw("USING"):
+            self.table_refs()
         where = self.expr() if self.eat_kw("WHERE") else None
         order_by = []
         if self.eat_kw("ORDER"):
@@ -1080,8 +1328,157 @@ class Parser:
         return stmt
 
     # ---- DDL ----
+    def split_stmt(self) -> A.SplitTableStmt:
+        """SPLIT [REGION FOR] TABLE t [INDEX i] BETWEEN (..) AND (..)
+        REGIONS n | BY (..)[, (..)] (ref: parser.y SplitRegionStmt)."""
+        self.next()
+        self.eat_kw("REGION") and self.eat_kw("FOR")
+        self.eat_kw("PARTITION")
+        self.expect_kw("TABLE")
+        table = self.table_name()
+        if self.eat_kw("PARTITION"):
+            self.expect_op("(")
+            while not self.at_op(")"):
+                self.next()
+            self.expect_op(")")
+        index = ""
+        if self.eat_kw("INDEX"):
+            index = self.ident()
+        between = None
+        points = []
+
+        def row():
+            self.expect_op("(")
+            vals = [self.expr()]
+            while self.eat_op(","):
+                vals.append(self.expr())
+            self.expect_op(")")
+            return vals
+
+        if self.eat_kw("BETWEEN"):
+            lo = row()
+            self.expect_kw("AND")
+            hi = row()
+            self.expect_kw("REGIONS")
+            between = (lo, hi, self.expect_number())
+        elif self.eat_kw("BY"):
+            points.append(row())
+            while self.eat_op(","):
+                points.append(row())
+        return A.SplitTableStmt(table, index, between, points)
+
     def create_stmt(self):
         self.next()
+        or_replace = False
+        if self.eat_kw("OR"):
+            self.expect_kw("REPLACE")
+            or_replace = True
+        definer = False
+        if self.eat_kw("DEFINER"):
+            self.expect_op("=")
+            self.next()
+            if self.eat_op("@"):
+                self.next()
+            definer = True
+        if self.eat_kw("ALGORITHM"):
+            self.expect_op("=")
+            self.next()
+            definer = True
+        if self.eat_kw("SQL"):
+            self.expect_kw("SECURITY")
+            self.next()
+            definer = True
+        if self.at_kw("VIEW"):
+            self.next()
+            ine = False
+            if self.eat_kw("IF"):
+                self.expect_kw("NOT")
+                self.expect_kw("EXISTS")
+                ine = True
+            name = self.table_name()
+            cols = []
+            if self.at_op("("):
+                self.expect_op("(")
+                while not self.at_op(")"):
+                    cols.append(self.ident())
+                    self.eat_op(",")
+                self.expect_op(")")
+            self.expect_kw("AS")
+            sel = self.select_or_union()
+            if self.eat_kw("WITH"):
+                self.eat_kw("CASCADED") or self.eat_kw("LOCAL")
+                self.expect_kw("CHECK")
+                self.expect_kw("OPTION")
+            return A.CreateViewStmt(name, cols, sel, or_replace)
+        if self.eat_kw("SEQUENCE"):
+            ine = False
+            if self.eat_kw("IF"):
+                self.expect_kw("NOT")
+                self.expect_kw("EXISTS")
+                ine = True
+            name = self.table_name()
+            opts = {}
+            while self.peek().kind in (T.IDENT, T.QIDENT):
+                k = self.next().upper.lower()
+                if k in ("start", "increment"):
+                    self.eat_kw("WITH") or self.eat_kw("BY")
+                    self.eat_op("=")
+                    t = self.next()
+                    neg = t.text == "-"
+                    opts[k] = -self.expect_number() if neg else int(t.text)
+                elif k in ("minvalue", "maxvalue", "cache"):
+                    self.eat_op("=")
+                    t = self.next()
+                    neg = t.text == "-"
+                    opts[k] = -self.expect_number() if neg else int(t.text)
+                # nominvalue/nomaxvalue/nocache/cycle/nocycle: flags
+            return A.CreateSequenceStmt(name, ine, opts)
+        if self.at_kw("GLOBAL", "SESSION") and self.peek(1).upper == "BINDING":
+            scope = self.next().upper.lower()
+            self.next()
+            self.expect_kw("FOR")
+            target = self.statement()
+            self.expect_kw("USING")
+            hinted = self.statement()
+            return A.BindingStmt("create", scope, target, hinted)
+        if self.eat_kw("BINDING"):
+            self.expect_kw("FOR")
+            target = self.statement()
+            self.expect_kw("USING")
+            hinted = self.statement()
+            return A.BindingStmt("create", "session", target, hinted)
+        self.eat_kw("GLOBAL")  # global temporary table
+        self.eat_kw("TEMPORARY")
+        if self.eat_kw("ROLE"):
+            ine = False
+            if self.eat_kw("IF"):
+                self.expect_kw("NOT")
+                self.expect_kw("EXISTS")
+                ine = True
+            users = [self.user_spec(with_password=True)]
+            while self.eat_op(","):
+                users.append(self.user_spec(with_password=True))
+            return A.CreateUserStmt(users, ine)
+        if self.eat_kw("PLACEMENT"):
+            self.expect_kw("POLICY")
+            if self.eat_kw("IF"):
+                self.expect_kw("NOT")
+                self.expect_kw("EXISTS")
+            self.ident()
+            while self.peek().kind in (T.IDENT, T.QIDENT):
+                self.next()
+                self.eat_op("=")
+                self.next()
+            return A.SetStmt([])
+        if self.eat_kw("RESOURCE"):
+            self.expect_kw("GROUP")
+            if self.eat_kw("IF"):
+                self.expect_kw("NOT")
+                self.expect_kw("EXISTS")
+            self.ident()
+            while self.peek().kind in (T.IDENT, T.QIDENT, T.NUMBER, T.STRING):
+                self.next()
+            return A.SetStmt([])
         if self.eat_kw("USER"):
             ine = False
             if self.eat_kw("IF"):
@@ -1132,8 +1529,20 @@ class Parser:
             if self.at_kw("PRIMARY"):
                 self.next()
                 self.expect_kw("KEY")
+                if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
+                    self.ident()  # MySQL ignores the PK's given name
                 idx = A.IndexDef("primary", self._index_cols(), unique=True, primary=True)
+                self._index_opts()
                 indexes.append(idx)
+            elif self.at_kw("CHECK"):
+                self.next()
+                self.expect_op("(")
+                self.expr()  # table CHECK constraint: parsed, not enforced
+                self.expect_op(")")
+                if self.eat_kw("NOT"):
+                    self.expect_kw("ENFORCED")
+                else:
+                    self.eat_kw("ENFORCED")
             elif self.at_kw("UNIQUE"):
                 self.next()
                 self.eat_kw("KEY") or self.eat_kw("INDEX")
@@ -1141,17 +1550,34 @@ class Parser:
                 if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
                     name = self.ident()
                 indexes.append(A.IndexDef(name, self._index_cols(), unique=True))
-            elif self.at_kw("KEY", "INDEX"):
-                self.next()
+                self._index_opts()
+            elif self.at_kw("KEY", "INDEX", "FULLTEXT"):
+                if self.eat_kw("FULLTEXT"):
+                    self.eat_kw("KEY") or self.eat_kw("INDEX")
+                else:
+                    self.next()
                 name = ""
                 if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
                     name = self.ident()
                 indexes.append(A.IndexDef(name, self._index_cols()))
+                self._index_opts()
             elif self.at_kw("CONSTRAINT", "FOREIGN"):
                 fk_name = ""
                 if self.eat_kw("CONSTRAINT"):
-                    if not self.at_kw("FOREIGN", "UNIQUE", "PRIMARY"):
+                    if not self.at_kw("FOREIGN", "UNIQUE", "PRIMARY", "CHECK"):
                         fk_name = self.ident()
+                if self.at_kw("CHECK"):
+                    self.next()
+                    self.expect_op("(")
+                    self.expr()
+                    self.expect_op(")")
+                    if self.eat_kw("NOT"):
+                        self.expect_kw("ENFORCED")
+                    else:
+                        self.eat_kw("ENFORCED")
+                    if not self.eat_op(","):
+                        break
+                    continue
                 if self.eat_kw("FOREIGN"):
                     self.expect_kw("KEY")
                     if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
@@ -1173,12 +1599,24 @@ class Parser:
                 elif self.eat_kw("PRIMARY"):
                     self.expect_kw("KEY")
                     indexes.append(A.IndexDef("primary", self._index_cols(), unique=True, primary=True))
+                    self._index_opts()
             else:
                 columns.append(self.column_def())
             if not self.eat_op(","):
                 break
         self.expect_op(")")
         options = self._table_options()
+        while self.at_op(",") and self.peek(1).kind is T.IDENT and self.peek(1).upper in _TABLE_OPTION_KWS:
+            self.next()  # CREATE TABLE options may be comma-separated
+            options.update(self._table_options())
+        if self.at_kw("PARTITION"):
+            options["partition_by"] = self._partition_clause()
+            # trailing options may follow the partition list
+            options.update(self._table_options())
+        if self.eat_kw("ON"):
+            self.expect_kw("COMMIT")
+            self.expect_kw("DELETE")
+            self.expect_kw("ROWS")
         select = None
         if self.eat_kw("AS") or self.at_kw("SELECT"):
             select = self.select_or_union()
@@ -1191,17 +1629,125 @@ class Parser:
         cols = self._index_cols()
         return A.CreateIndexStmt(name, table, cols, unique)
 
+    def _index_opts(self):
+        """Swallow index tail options: USING BTREE/HASH, COMMENT, invisible,
+        clustered attrs (ref: parser.y IndexOptionList)."""
+        while True:
+            if self.eat_kw("USING"):
+                self.ident()
+            elif self.eat_kw("COMMENT"):
+                self.next()
+            elif self.at_kw("VISIBLE", "INVISIBLE", "CLUSTERED", "NONCLUSTERED", "GLOBAL", "LOCAL"):
+                self.next()
+            elif self.eat_kw("KEY_BLOCK_SIZE"):
+                self.eat_op("=")
+                self.expect_number()
+            else:
+                return
+
+    def _partition_name(self) -> str:
+        """Partition names may start with a digit (2023p1) — the lexer
+        splits that into NUMBER+IDENT; rejoin them."""
+        if self.peek().kind is T.NUMBER and self.peek(1).kind is T.IDENT:
+            n = self.next().text
+            return n + self.next().text
+        if self.peek().kind is T.NUMBER:
+            return self.next().text
+        return self.ident()
+
+    def _partition_clause(self) -> dict:
+        """PARTITION BY RANGE/LIST/HASH/KEY ... — parsed into a plan-visible
+        dict; execution treats partitioned tables as one keyspace for now
+        (ref: parser.y PartitionOpt; rule_partition_processor.go prunes)."""
+        self.expect_kw("PARTITION")
+        self.expect_kw("BY")
+        method = self.next().upper  # RANGE | LIST | HASH | KEY | LINEAR?
+        if method == "LINEAR":
+            method = self.next().upper
+        columns = False
+        if self.eat_kw("COLUMNS"):
+            columns = True
+        exprs = []
+        if self.at_op("("):
+            self.expect_op("(")
+            if not self.at_op(")"):
+                while True:
+                    exprs.append(self.expr())
+                    if not self.eat_op(","):
+                        break
+            self.expect_op(")")
+        n_parts = None
+        if self.eat_kw("PARTITIONS"):
+            n_parts = self.expect_number()
+        parts = []
+        if self.eat_op("("):
+            while True:
+                self.expect_kw("PARTITION")
+                pname = self.ident()
+                pdef = {"name": pname}
+                if self.eat_kw("VALUES"):
+                    if self.eat_kw("LESS"):
+                        self.expect_kw("THAN")
+                        if self.eat_kw("MAXVALUE"):
+                            pdef["less_than"] = "MAXVALUE"
+                        else:
+                            self.expect_op("(")
+                            vals = []
+                            while True:
+                                vals.append("MAXVALUE" if self.eat_kw("MAXVALUE") else self.expr())
+                                if not self.eat_op(","):
+                                    break
+                            self.expect_op(")")
+                            pdef["less_than"] = vals
+                    elif self.eat_kw("IN"):
+                        self.expect_op("(")
+                        vals = []
+                        while True:
+                            if self.eat_op("("):
+                                row = []
+                                while True:
+                                    row.append(self.expr())
+                                    if not self.eat_op(","):
+                                        break
+                                self.expect_op(")")
+                                vals.append(row)
+                            else:
+                                vals.append(self.expr())
+                            if not self.eat_op(","):
+                                break
+                        self.expect_op(")")
+                        pdef["in"] = vals
+                while self.at_kw("COMMENT", "ENGINE", "PLACEMENT", "TABLESPACE",
+                                 "MAX_ROWS", "MIN_ROWS", "DATA", "INDEX"):
+                    kw2 = self.next().upper
+                    if kw2 == "PLACEMENT":
+                        self.expect_kw("POLICY")
+                    elif kw2 in ("DATA", "INDEX"):
+                        self.expect_kw("DIRECTORY")
+                    self.eat_op("=")
+                    self.next()
+                parts.append(pdef)
+                if not self.eat_op(","):
+                    break
+            self.expect_op(")")
+        return {"method": method, "columns": columns, "n": n_parts, "parts": parts}
+
     def _index_cols(self) -> list:
         self.expect_op("(")
         out = []
         while True:
-            c = self.ident()
-            plen = -1
-            if self.eat_op("("):
-                plen = self.expect_number()
-                self.expect_op(")")
-            self.eat_kw("ASC") or self.eat_kw("DESC")
-            out.append((c, plen))
+            if self.at_op("("):
+                raise ParseError(
+                    "expression index elements ((expr)) are not supported yet"
+                )
+            else:
+                c = self.ident()
+                plen = -1
+                if self.eat_op("("):
+                    plen = self.expect_number()
+                    self.expect_op(")")
+                self.eat_kw("ASC") or self.eat_kw("DESC")
+                out.append((c, plen))
             if not self.eat_op(","):
                 break
         self.expect_op(")")
@@ -1240,11 +1786,49 @@ class Parser:
                 self.expect_kw("UPDATE")
                 fn = self.ident()
                 if self.eat_op("("):
+                    if self.peek().kind is T.NUMBER:
+                        self.expect_number()
                     self.expect_op(")")
                 cd.on_update_now = fn.lower() in ("current_timestamp", "now")
             elif self.eat_kw("REFERENCES"):
                 self.table_name()
                 self._index_cols()
+            elif self.at_kw("GENERATED", "AS"):
+                # [GENERATED ALWAYS] AS (expr) [VIRTUAL|STORED]
+                if self.eat_kw("GENERATED"):
+                    self.expect_kw("ALWAYS")
+                self.expect_kw("AS")
+                self.expect_op("(")
+                cd.generated = self.expr()
+                self.expect_op(")")
+                if self.eat_kw("STORED"):
+                    cd.generated_stored = True
+                else:
+                    self.eat_kw("VIRTUAL")
+            elif self.eat_kw("CHECK") or (self.at_kw("CONSTRAINT") and self.eat_kw("CONSTRAINT")):
+                if not self.at_op("("):
+                    if not self.at_kw("CHECK"):
+                        self.ident()  # constraint name
+                    self.eat_kw("CHECK")
+                self.expect_op("(")
+                cd.check = self.expr()
+                self.expect_op(")")
+                if self.eat_kw("NOT"):
+                    self.expect_kw("ENFORCED")
+                else:
+                    self.eat_kw("ENFORCED")
+            elif self.eat_kw("BINARY"):
+                pass  # char(n) BINARY -> binary collation attribute
+            elif self.at_kw("CLUSTERED", "NONCLUSTERED"):
+                self.next()  # TiDB clustered-index attribute on the PK
+            elif self.eat_kw("SERIAL"):
+                self.expect_kw("DEFAULT")
+                self.expect_kw("VALUE")
+                cd.auto_increment = True
+            elif self.eat_kw("AUTO_RANDOM"):
+                if self.eat_op("("):
+                    self.expect_number()
+                    self.expect_op(")")
             else:
                 return cd
 
@@ -1253,8 +1837,21 @@ class Parser:
         if t.kind is T.IDENT and t.upper in ("CURRENT_TIMESTAMP", "NOW"):
             self.next()
             if self.eat_op("("):
+                if self.peek().kind is T.NUMBER:
+                    self.expect_number()  # fsp
                 self.expect_op(")")
             return A.FuncCall("now", [])
+        if t.kind is T.IDENT and t.upper == "NEXT":
+            self.next()
+            self.expect_kw("VALUE")
+            self.expect_kw("FOR")
+            seq = self.table_name()
+            return A.FuncCall("nextval", [A.Literal(seq.name, "str")])
+        if self.at_op("("):
+            self.next()
+            e = self.expr()
+            self.expect_op(")")
+            return e
         return self.unary_expr()
 
     def _table_options(self) -> dict:
@@ -1281,6 +1878,24 @@ class Parser:
             elif self.eat_kw("COMMENT"):
                 self.eat_op("=")
                 opts["comment"] = self.next().text
+            elif self.eat_kw("TTL"):
+                self.eat_op("=")
+                opts["ttl"] = self.expr()  # col + INTERVAL n UNIT
+            elif self.at_kw(
+                "AUTO_ID_CACHE", "AUTO_RANDOM_BASE", "SHARD_ROW_ID_BITS",
+                "PRE_SPLIT_REGIONS", "KEY_BLOCK_SIZE", "STATS_PERSISTENT",
+                "STATS_AUTO_RECALC", "STATS_SAMPLE_PAGES", "MAX_ROWS",
+                "MIN_ROWS", "AVG_ROW_LENGTH", "CHECKSUM", "DELAY_KEY_WRITE",
+                "ROW_FORMAT", "COMPRESSION", "CONNECTION", "PACK_KEYS",
+                "STATS_BUCKETS", "STATS_TOPN", "STATS_COL_CHOICE",
+                "STATS_COL_LIST", "STATS_SAMPLE_RATE", "INSERT_METHOD",
+                "SECONDARY_ENGINE", "TTL_ENABLE", "TTL_JOB_INTERVAL",
+                "PLACEMENT", "AUTOEXTEND_SIZE", "ENCRYPTION",
+            ):
+                name = self.next().upper.lower()
+                self.eat_kw("POLICY")  # PLACEMENT POLICY [=] x
+                self.eat_op("=")
+                opts[name] = self.next().text  # number / ident / string
             else:
                 return opts
 
@@ -1305,6 +1920,61 @@ class Parser:
             name = self.ident()
             self.expect_kw("ON")
             return A.DropIndexStmt(name, self.table_name())
+        if self.eat_kw("VIEW"):
+            ie = False
+            if self.eat_kw("IF"):
+                self.expect_kw("EXISTS")
+                ie = True
+            names = [self.table_name()]
+            while self.eat_op(","):
+                names.append(self.table_name())
+            return A.DropViewStmt(names, ie)
+        if self.eat_kw("ROLE"):
+            users = [self.user_spec()[:2]]
+            while self.eat_op(","):
+                users.append(self.user_spec()[:2])
+            return A.DropUserStmt(users, True)
+        if self.eat_kw("PLACEMENT"):
+            self.expect_kw("POLICY")
+            if self.eat_kw("IF"):
+                self.expect_kw("EXISTS")
+            self.ident()
+            return A.SetStmt([])
+        if self.eat_kw("RESOURCE"):
+            self.expect_kw("GROUP")
+            if self.eat_kw("IF"):
+                self.expect_kw("EXISTS")
+            self.ident()
+            return A.SetStmt([])
+        if self.eat_kw("STATS"):
+            while self.peek().kind in (T.IDENT, T.QIDENT):
+                self.next()
+                self.eat_op(",")
+            return A.SetStmt([])
+        self.eat_kw("GLOBAL")
+        self.eat_kw("TEMPORARY")
+        if self.eat_kw("SEQUENCE"):
+            ie = False
+            if self.eat_kw("IF"):
+                self.expect_kw("EXISTS")
+                ie = True
+            names = [self.table_name()]
+            while self.eat_op(","):
+                names.append(self.table_name())
+            return A.DropSequenceStmt(names, ie)
+        if self.at_kw("GLOBAL", "SESSION") and self.peek(1).upper == "BINDING":
+            scope = self.next().upper.lower()
+            self.next()
+            self.expect_kw("FOR")
+            target = self.statement()
+            hinted = self.statement() if self.eat_kw("USING") else None
+            return A.BindingStmt("drop", scope, target, hinted)
+        if self.eat_kw("BINDING"):
+            self.expect_kw("FOR")
+            target = self.statement()
+            hinted = self.statement() if self.eat_kw("USING") else None
+            return A.BindingStmt("drop", "session", target, hinted)
+        self.eat_kw("TEMPORARY")
         self.expect_kw("TABLE")
         ie = False
         if self.eat_kw("IF"):
@@ -1315,8 +1985,38 @@ class Parser:
             tables.append(self.table_name())
         return A.DropTableStmt(tables, ie)
 
-    def alter_stmt(self) -> A.AlterTableStmt:
+    def alter_stmt(self):
         self.next()
+        if self.eat_kw("USER"):
+            ie = False
+            if self.eat_kw("IF"):
+                self.expect_kw("EXISTS")
+                ie = True
+            users = [self.user_spec(with_password=True)]
+            while self.eat_op(","):
+                users.append(self.user_spec(with_password=True))
+            return A.AlterUserStmt(users, ie)
+        if self.eat_kw("SEQUENCE"):
+            name = self.table_name()
+            while self.peek().kind in (T.IDENT, T.QIDENT, T.NUMBER):
+                self.next()
+            return A.CreateSequenceStmt(name, True, {})
+        if self.eat_kw("DATABASE", "SCHEMA"):
+            if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_kw("DEFAULT", "CHARACTER", "CHARSET", "COLLATE"):
+                self.ident()
+            while self.at_kw("DEFAULT", "CHARACTER", "CHARSET", "COLLATE"):
+                self.eat_kw("DEFAULT")
+                if self.eat_kw("CHARACTER"):
+                    self.expect_kw("SET")
+                elif not (self.eat_kw("CHARSET") or self.eat_kw("COLLATE")):
+                    break
+                self.eat_op("=")
+                self.ident()
+            return A.SetStmt([])
+        if self.eat_kw("INSTANCE") or self.eat_kw("RANGE"):
+            while self.peek().kind in (T.IDENT, T.QIDENT, T.NUMBER, T.STRING):
+                self.next()
+            return A.SetStmt([])
         self.expect_kw("TABLE")
         table = self.table_name()
         specs = []
@@ -1344,6 +2044,52 @@ class Parser:
                 elif self.eat_kw("PRIMARY"):
                     self.expect_kw("KEY")
                     specs.append(A.AlterTableSpec("add_index", index=A.IndexDef("primary", self._index_cols(), unique=True, primary=True)))
+                    self._index_opts()
+                elif self.eat_kw("STATS_EXTENDED"):
+                    self.ident()
+                    self.ident()  # correlation | dependency
+                    self._index_cols()
+                    specs.append(A.AlterTableSpec("noop_option"))
+                elif self.eat_kw("PARTITION"):
+                    if self.at_op("("):
+                        self._partition_def_list()
+                    else:
+                        self.eat_kw("PARTITIONS") and self.expect_number()
+                    specs.append(A.AlterTableSpec("add_partition"))
+                elif self.at_kw("CONSTRAINT", "CHECK", "FOREIGN"):
+                    if self.eat_kw("CONSTRAINT"):
+                        if not self.at_kw("CHECK", "FOREIGN", "UNIQUE", "PRIMARY"):
+                            self.ident()
+                    if self.eat_kw("CHECK"):
+                        self.expect_op("(")
+                        self.expr()
+                        self.expect_op(")")
+                        if self.eat_kw("NOT"):
+                            self.expect_kw("ENFORCED")
+                        else:
+                            self.eat_kw("ENFORCED")
+                        specs.append(A.AlterTableSpec("add_check"))
+                    elif self.eat_kw("FOREIGN"):
+                        self.expect_kw("KEY")
+                        if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
+                            self.ident()
+                        self._index_cols()
+                        self.expect_kw("REFERENCES")
+                        self.table_name()
+                        self._index_cols()
+                        while self.eat_kw("ON"):
+                            self.eat_kw("DELETE") or self.eat_kw("UPDATE")
+                            self.eat_kw("CASCADE") or self.eat_kw("RESTRICT") or (self.eat_kw("SET") and self.eat_kw("NULL")) or (self.eat_kw("NO") and self.eat_kw("ACTION"))
+                        specs.append(A.AlterTableSpec("add_foreign_key"))
+                    elif self.eat_kw("UNIQUE"):
+                        self.eat_kw("INDEX") or self.eat_kw("KEY")
+                        name = ""
+                        if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_op("("):
+                            name = self.ident()
+                        specs.append(A.AlterTableSpec("add_index", index=A.IndexDef(name, self._index_cols(), unique=True)))
+                    elif self.eat_kw("PRIMARY"):
+                        self.expect_kw("KEY")
+                        specs.append(A.AlterTableSpec("add_index", index=A.IndexDef("primary", self._index_cols(), unique=True, primary=True)))
                 else:
                     cd = self.column_def()
                     pos = ""
@@ -1360,6 +2106,14 @@ class Parser:
                 elif self.eat_kw("PRIMARY"):
                     self.expect_kw("KEY")
                     specs.append(A.AlterTableSpec("drop_index", name="primary"))
+                elif self.eat_kw("PARTITION"):
+                    self._name_list_or_all()
+                    specs.append(A.AlterTableSpec("drop_partition"))
+                elif self.eat_kw("FOREIGN"):
+                    self.expect_kw("KEY")
+                    specs.append(A.AlterTableSpec("drop_foreign_key", name=self.ident()))
+                elif self.eat_kw("CHECK") or self.eat_kw("CONSTRAINT"):
+                    specs.append(A.AlterTableSpec("drop_check", name=self.ident()))
                 else:
                     specs.append(A.AlterTableSpec("drop_column", name=self.ident()))
             elif self.eat_kw("MODIFY"):
@@ -1379,14 +2133,151 @@ class Parser:
                 else:
                     self.eat_kw("TO") or self.eat_kw("AS")
                     specs.append(A.AlterTableSpec("rename", new_name=self.ident()))
+            elif self.at_kw("ATTRIBUTES"):
+                self.next()
+                self.eat_op("=")
+                self.next()
+                specs.append(A.AlterTableSpec("noop_option"))
+            elif self.at_kw("FIRST", "LAST"):
+                # FIRST/LAST PARTITION LESS THAN (...) (TiDB interval mgmt)
+                self.next()
+                self.expect_kw("PARTITION")
+                self.eat_kw("LESS") and self.expect_kw("THAN")
+                if self.eat_op("("):
+                    self.expr()
+                    self.expect_op(")")
+                specs.append(A.AlterTableSpec("noop_option"))
+            elif self.at_kw("EXCHANGE"):
+                self.next()
+                self.expect_kw("PARTITION")
+                pname = self.ident()
+                self.expect_kw("WITH")
+                self.expect_kw("TABLE")
+                other = self.table_name()
+                if self.eat_kw("WITH") or self.eat_kw("WITHOUT"):
+                    self.expect_kw("VALIDATION")
+                specs.append(A.AlterTableSpec("exchange_partition", name=pname, new_name=other.name))
+            elif self.at_kw("REORGANIZE"):
+                self.next()
+                self.expect_kw("PARTITION")
+                while self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_kw("INTO"):
+                    self.ident()
+                    if not self.eat_op(","):
+                        break
+                self.expect_kw("INTO")
+                self._partition_def_list()
+                specs.append(A.AlterTableSpec("reorganize_partition"))
+            elif self.at_kw("COALESCE"):
+                self.next()
+                self.expect_kw("PARTITION")
+                self.expect_number()
+                specs.append(A.AlterTableSpec("coalesce_partition"))
+            elif self.at_kw("TRUNCATE"):
+                self.next()
+                self.expect_kw("PARTITION")
+                self._name_list_or_all()
+                specs.append(A.AlterTableSpec("truncate_partition"))
+            elif self.at_kw("PARTITION"):
+                if self.peek(1).upper == "BY":
+                    specs.append(A.AlterTableSpec("repartition", options=self._partition_clause()))
+                else:
+                    self.next()
+                    self._partition_name()
+                    while self.peek().kind in (T.IDENT, T.QIDENT, T.NUMBER, T.STRING):
+                        self.next()
+                        self.eat_op("=")
+                    specs.append(A.AlterTableSpec("noop_option"))
+            elif self.at_kw("REMOVE"):
+                self.next()
+                self.expect_kw("PARTITIONING")
+                specs.append(A.AlterTableSpec("remove_partitioning"))
+            elif self.at_kw("ALTER"):
+                self.next()
+                if self.eat_kw("CONSTRAINT"):
+                    self.ident()
+                    if self.eat_kw("NOT"):
+                        self.expect_kw("ENFORCED")
+                    else:
+                        self.eat_kw("ENFORCED")
+                    specs.append(A.AlterTableSpec("alter_constraint"))
+                elif self.eat_kw("INDEX"):
+                    self.ident()
+                    self.next()  # VISIBLE | INVISIBLE
+                    specs.append(A.AlterTableSpec("alter_index_visibility"))
+                else:
+                    self.eat_kw("COLUMN")
+                    cname = self.ident()
+                    if self.eat_kw("SET"):
+                        self.expect_kw("DEFAULT")
+                        d = self.default_value()
+                        specs.append(A.AlterTableSpec("set_default", name=cname, default=d))
+                    else:
+                        self.expect_kw("DROP")
+                        self.expect_kw("DEFAULT")
+                        specs.append(A.AlterTableSpec("set_default", name=cname, default=None))
+            elif self.at_kw(
+                "ENGINE", "AUTO_INCREMENT", "CHARSET", "CHARACTER", "COLLATE",
+                "COMMENT", "DEFAULT", "CONVERT", "TTL", "TTL_ENABLE",
+                "AUTO_ID_CACHE", "SHARD_ROW_ID_BITS", "ROW_FORMAT",
+                "PLACEMENT", "COMPRESSION", "KEY_BLOCK_SIZE", "REMOVE_TTL",
+                "STATS_BUCKETS", "STATS_TOPN", "STATS_COL_CHOICE",
+                "STATS_SAMPLE_RATE", "STATS_PERSISTENT", "CACHE", "NOCACHE",
+                "FORCE", "ORDER",
+            ):
+                if self.eat_kw("CONVERT"):
+                    self.expect_kw("TO")
+                    self.eat_kw("CHARACTER") and self.expect_kw("SET") or self.eat_kw("CHARSET")
+                    self.ident()
+                    if self.eat_kw("COLLATE"):
+                        self.ident()
+                    specs.append(A.AlterTableSpec("charset"))
+                elif self.eat_kw("CACHE") or self.eat_kw("NOCACHE") or self.eat_kw("FORCE"):
+                    specs.append(A.AlterTableSpec("noop_option"))
+                elif self.eat_kw("ORDER"):
+                    self.expect_kw("BY")
+                    self.by_list()
+                    specs.append(A.AlterTableSpec("noop_option"))
+                elif self.eat_kw("REMOVE_TTL"):
+                    specs.append(A.AlterTableSpec("table_option", options={"remove_ttl": True}))
+                else:
+                    o = self._table_options()
+                    if not o and not self.at_op(",") and not self.at_kw(";"):
+                        raise ParseError(f"unsupported ALTER option at {self._where()}")
+                    specs.append(A.AlterTableSpec("table_option", options=o))
             else:
                 raise ParseError(f"unsupported ALTER action at {self._where()}")
             if not self.eat_op(","):
                 break
         return A.AlterTableStmt(table, specs)
 
-    def rename_stmt(self) -> A.RenameTableStmt:
+    def _partition_def_list(self):
+        self.expect_op("(")
+        depth = 1
+        while depth and self.peek().kind is not T.EOF:
+            if self.at_op("("):
+                depth += 1
+            elif self.at_op(")"):
+                depth -= 1
+            self.next()
+
+    def _name_list_or_all(self):
+        if self.eat_kw("ALL"):
+            return
+        while True:
+            self.ident()
+            if not self.eat_op(","):
+                break
+
+    def rename_stmt(self):
         self.next()
+        if self.eat_kw("USER"):
+            while True:
+                self.user_spec()
+                self.expect_kw("TO")
+                self.user_spec()
+                if not self.eat_op(","):
+                    break
+            return A.SetStmt([])
         self.expect_kw("TABLE")
         pairs = []
         while True:
@@ -1400,6 +2291,21 @@ class Parser:
     # ---- SET / SHOW / EXPLAIN / ANALYZE / ADMIN / BRIE ----
     def set_stmt(self) -> A.SetStmt:
         self.next()
+        if self.eat_kw("PASSWORD"):
+            if self.eat_kw("FOR"):
+                self.user_spec()
+            self.expect_op("=")
+            self.next()
+            return A.SetStmt([])
+        if self.eat_kw("RESOURCE"):
+            self.expect_kw("GROUP")
+            self.ident()
+            return A.SetStmt([])
+        if self.at_kw("ROLE", "DEFAULT"):
+            # SET [DEFAULT] ROLE ... TO ...
+            while self.peek().kind is not T.EOF and not self.at_op(";"):
+                self.next()
+            return A.SetStmt([])
         if self.eat_kw("NAMES"):
             cs = self.next().text
             out = [("session", "character_set_client", A.Literal(cs, "str"))]
@@ -1459,10 +2365,25 @@ class Parser:
             elif self.eat_kw("DATABASE"):
                 s.kind = "create_database"
                 s.db = self.ident()
+            elif self.eat_kw("VIEW"):
+                s.kind = "create_view"
+                s.table = self.table_name()
+            elif self.eat_kw("SEQUENCE"):
+                s.kind = "create_sequence"
+                s.table = self.table_name()
+            elif self.eat_kw("USER"):
+                s.kind = "create_user"
+                self.user_spec()
         elif self.eat_kw("INDEX", "INDEXES", "KEYS"):
             s.kind = "index"
             self.eat_kw("FROM") or self.eat_kw("IN")
             s.table = self.table_name()
+        elif self.eat_kw("GRANTS"):
+            s.kind = "grants"
+            if self.eat_kw("FOR"):
+                self.user_spec()
+                if self.eat_kw("USING"):
+                    self.user_spec()
         elif self.eat_kw("VARIABLES"):
             s.kind = "variables"
         elif self.eat_kw("STATUS"):
@@ -1494,7 +2415,13 @@ class Parser:
         elif self.eat_kw("PLUGINS"):
             s.kind = "plugins"
         else:
-            raise ParseError(f"unsupported SHOW at {self._where()}")
+            # tolerant catch-all (ref: the reference's ~60 SHOW forms):
+            # swallow the remaining tokens; execution reports the kind
+            words = []
+            while self.peek().kind is not T.EOF and not self.at_op(";"):
+                words.append(self.next().text)
+            s.kind = "other:" + " ".join(words[:4]).lower()
+            return s
         if self.eat_kw("LIKE"):
             s.pattern = self.next().text
         elif self.eat_kw("WHERE"):
@@ -1506,8 +2433,8 @@ class Parser:
         analyze = self.eat_kw("ANALYZE")
         fmt = "row"
         if self.eat_kw("FORMAT"):
-            self.expect_op("=")
-            fmt = self.next().text.lower()
+            self.eat_op("=")
+            fmt = self.next().text.lower()  # 'brief' | tidb_json | ...
         # DESC table shorthand
         if not analyze and self.peek().kind in (T.IDENT, T.QIDENT) and self.peek().upper not in (
             "SELECT", "INSERT", "UPDATE", "DELETE", "REPLACE", "WITH",
@@ -1526,9 +2453,46 @@ class Parser:
         if not with_password:
             return (name, host, None)
         pw = ""
-        if self.eat_kw("IDENTIFIED"):
-            self.expect_kw("BY")
-            pw = self.next().text
+        while True:
+            if self.eat_kw("IDENTIFIED"):
+                if self.eat_kw("WITH"):
+                    self.next()  # auth plugin name
+                    if self.eat_kw("BY") or self.eat_kw("AS"):
+                        pw = self.next().text
+                else:
+                    self.expect_kw("BY")
+                    pw = self.next().text
+            elif self.eat_kw("RESOURCE"):
+                self.expect_kw("GROUP")
+                self.ident()
+            elif self.eat_kw("REQUIRE"):
+                while True:
+                    t = self.next().upper  # SSL|X509|NONE|ISSUER|SUBJECT|CIPHER|SAN
+                    if t in ("ISSUER", "SUBJECT", "CIPHER", "SAN"):
+                        self.next()  # the quoted value
+                    if not self.eat_kw("AND"):
+                        break
+            elif self.eat_kw("ATTRIBUTE"):
+                self.next()
+            elif self.eat_kw("COMMENT"):
+                self.next()
+            elif self.eat_kw("ACCOUNT"):
+                self.next()  # LOCK | UNLOCK
+            elif self.eat_kw("PASSWORD"):
+                if self.eat_kw("EXPIRE"):
+                    if self.eat_kw("INTERVAL"):
+                        self.expect_number()
+                        self.next()  # DAY
+                    else:
+                        self.eat_kw("NEVER") or self.eat_kw("DEFAULT")
+                elif self.eat_kw("HISTORY") or self.eat_kw("REUSE"):
+                    self.eat_kw("INTERVAL")
+                    self.eat_kw("DEFAULT") or (self.expect_number() and self.eat_kw("DAY"))
+            elif self.at_kw("FAILED_LOGIN_ATTEMPTS", "PASSWORD_LOCK_TIME"):
+                self.next()
+                self.eat_kw("UNBOUNDED") or self.expect_number()
+            else:
+                break
         return (name, host, pw)
 
     def grant_stmt(self, revoke: bool):
@@ -1542,6 +2506,14 @@ class Parser:
                 privs.append("all")
             else:
                 kw = self.next().text.lower()
+                # multi-word privileges (ref: mysql/privs): CREATE VIEW,
+                # SHOW VIEW, CREATE USER/ROLE, ALTER ROUTINE, SHOW DATABASES,
+                # LOCK TABLES, EVENT, REPLICATION SLAVE/CLIENT ...
+                while self.peek().kind is T.IDENT and self.peek().upper in (
+                    "VIEW", "USER", "ROLE", "ROUTINE", "DATABASES", "TABLES",
+                    "TEMPORARY", "SLAVE", "CLIENT", "OPTION", "ADMIN",
+                ):
+                    kw += "_" + self.next().text.lower()
                 privs.append(kw)
             if not self.eat_op(","):
                 break
@@ -1575,16 +2547,42 @@ class Parser:
         while self.eat_op(","):
             tables.append(self.table_name())
         cols = []
-        if self.eat_kw("COLUMNS"):
-            while True:
-                cols.append(self.ident())
-                if not self.eat_op(","):
-                    break
+        while True:
+            if self.eat_kw("ALL"):
+                self.expect_kw("COLUMNS")
+            elif self.eat_kw("PREDICATE"):
+                self.expect_kw("COLUMNS")
+            elif self.eat_kw("COLUMNS"):
+                while True:
+                    cols.append(self.ident())
+                    if not self.eat_op(","):
+                        break
+            elif self.eat_kw("INDEX"):
+                while self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_kw("WITH"):
+                    self.ident()
+                    if not self.eat_op(","):
+                        break
+            elif self.eat_kw("PARTITION"):
+                while True:
+                    self.ident()
+                    if not self.eat_op(","):
+                        break
+            elif self.eat_kw("WITH"):
+                self.expect_number()
+                self.next()  # BUCKETS | TOPN | SAMPLES | CMSKETCH ... 
+                if self.eat_kw("WIDTH") or self.eat_kw("DEPTH"):
+                    pass
+            else:
+                break
         return A.AnalyzeTableStmt(tables, cols)
 
     def admin_stmt(self) -> A.AdminStmt:
         self.next()
         if self.eat_kw("CHECK"):
+            if self.eat_kw("INDEX"):
+                t = self.table_name()
+                self.ident()
+                return A.AdminStmt("check_table", [t])
             self.expect_kw("TABLE")
             tables = [self.table_name()]
             while self.eat_op(","):
@@ -1597,10 +2595,17 @@ class Parser:
                 tables.append(self.table_name())
             return A.AdminStmt("checksum_table", tables)
         if self.eat_kw("SHOW"):
-            self.expect_kw("DDL")
-            if self.eat_kw("JOBS"):
-                return A.AdminStmt("show_ddl_jobs")
-            return A.AdminStmt("show_ddl")
+            if self.eat_kw("DDL"):
+                if self.eat_kw("JOBS"):
+                    if self.at_kw("WHERE"):
+                        self.next()
+                        self.expr()
+                    return A.AdminStmt("show_ddl_jobs")
+                return A.AdminStmt("show_ddl")
+            # ADMIN SHOW t NEXT_ROW_ID / SLOW / BDR ROLE ...
+            while self.peek().kind in (T.IDENT, T.QIDENT, T.NUMBER) and not self.at_op(";"):
+                self.next()
+            return A.AdminStmt("show_other")
         if self.eat_kw("CANCEL"):
             self.expect_kw("DDL")
             self.expect_kw("JOBS")
@@ -1608,6 +2613,23 @@ class Parser:
             while self.eat_op(","):
                 ids.append(self.expect_number())
             return A.AdminStmt("cancel_ddl_jobs", job_ids=ids)
+        if self.eat_kw("SET"):
+            # ADMIN SET BDR ROLE PRIMARY/SECONDARY ...
+            while self.peek().kind in (T.IDENT, T.QIDENT, T.NUMBER, T.STRING):
+                self.next()
+            return A.AdminStmt("set")
+        if self.eat_kw("UNSET"):
+            while self.peek().kind in (T.IDENT, T.QIDENT):
+                self.next()
+            return A.AdminStmt("unset")
+        if self.eat_kw("RELOAD") or self.eat_kw("FLUSH"):
+            while self.peek().kind in (T.IDENT, T.QIDENT):
+                self.next()
+            return A.AdminStmt("reload")
+        if self.eat_kw("RECOVER") or self.eat_kw("CLEANUP"):
+            while self.peek().kind in (T.IDENT, T.QIDENT):
+                self.next()
+            return A.AdminStmt("cleanup")
         raise ParseError(f"unsupported ADMIN at {self._where()}")
 
     def brie_stmt(self, kind: str) -> A.BRIEStmt:

@@ -74,6 +74,41 @@ def datum_from_dict(d: dict | None):
     return Datum.i64(d["v"])
 
 
+def _default_to_dict(d) -> dict | None:
+    """Column DEFAULT serialization: literal datums and the dynamic now()
+    form cover every default the session evaluates (_eval_const handles
+    Literal | FuncCall('now') | Datum)."""
+    from ..parser import ast as A
+
+    if d is None:
+        return None
+    if isinstance(d, Datum):
+        return {"k": "datum", "v": datum_to_dict(d)}
+    if isinstance(d, A.FuncCall) and d.name == "now":
+        return {"k": "now"}
+    if isinstance(d, A.Literal):
+        return {"k": "lit", "v": d.value if not isinstance(d.value, bytes) else d.value.decode("utf-8", "surrogateescape"), "t": d.kind}
+    if isinstance(d, A.UnaryOp) and d.op == "unaryminus" and isinstance(d.operand, A.Literal):
+        return {"k": "neg", "v": d.operand.value, "t": d.operand.kind}
+    return {"k": "repr", "v": repr(d)}  # unknown: survives as unusable marker
+
+
+def _default_from_dict(d: dict | None):
+    from ..parser import ast as A
+
+    if d is None:
+        return None
+    if d["k"] == "datum":
+        return datum_from_dict(d["v"])
+    if d["k"] == "now":
+        return A.FuncCall("now", [])
+    if d["k"] == "lit":
+        return A.Literal(d["v"], d["t"])
+    if d["k"] == "neg":
+        return A.UnaryOp("unaryminus", A.Literal(d["v"], d["t"]))
+    return None
+
+
 def table_to_dict(m: TableMeta) -> dict:
     return {
         "name": m.name,
@@ -85,6 +120,7 @@ def table_to_dict(m: TableMeta) -> dict:
         "columns": [
             {"name": c.name, "col_id": c.col_id, "ft": ft_to_dict(c.ft),
              "origin_default": datum_to_dict(c.origin_default),
+             "default": _default_to_dict(c.default),
              "auto_increment": c.auto_increment}
             for c in m.columns
         ],
@@ -100,6 +136,7 @@ def table_from_dict(t: dict) -> TableMeta:
     cols = [
         ColumnMeta(
             c["name"], c["col_id"], ft_from_dict(c["ft"]),
+            default=_default_from_dict(c.get("default")),
             auto_increment=c.get("auto_increment", False),
             origin_default=datum_from_dict(c.get("origin_default")),
         )

@@ -350,6 +350,20 @@ class _Lowerer:
 
     def _func_call(self, n: A.FuncCall, rec):
         name = _FUNC_RENAME.get(n.name, n.name)
+        if name in ("now", "current_timestamp", "sysdate", "current_date", "curdate", "localtime", "localtimestamp"):
+            # statement-time constant (MySQL: now() is fixed per statement;
+            # ref: builtin_time.go evalNowWithFsp) — volatile on host, a
+            # Const by the time anything reaches the device
+            import datetime as _dt
+
+            from ..expr.ir import Const
+
+            t = _dt.datetime.now()
+            if name in ("current_date", "curdate"):
+                mt = MyTime.from_ymd(t.year, t.month, t.day)
+            else:
+                mt = MyTime.from_ymd(t.year, t.month, t.day, t.hour, t.minute, t.second)
+            return Const(Datum.time(mt), new_datetime())
         if name in ("date_add", "date_sub", "adddate", "subdate"):
             name = "date_add" if name in ("date_add", "adddate") else "date_sub"
             d = rec(n.args[0])
@@ -667,6 +681,11 @@ def _plan_windows(win_nodes: list, low: "_Lowerer", executors: list) -> None:
     specs: dict = {}
     order_keys: list = []
     for n in win_nodes:
+        if getattr(n, "has_frame", False):
+            raise PlanError(
+                "explicit window frames (ROWS/RANGE) are not supported yet "
+                "(default frames only)"
+            )
         p_exprs = tuple(low.lower_base(e) for e in n.partition_by)
         o_items = tuple((low.lower_base(b.expr), b.desc) for b in n.order_by)
         key = tuple(p.fingerprint() for p in p_exprs) + ("|",) + tuple(

@@ -49,6 +49,7 @@ class TxnState:
     row_delta: dict = field(default_factory=dict)  # table_id -> row-count delta
     # (applied to catalog stats only on successful commit)
     index_muts: dict = field(default_factory=dict)  # index-key subset of mutations
+    named_savepoints: dict = field(default_factory=dict)  # SAVEPOINT name -> snapshot
     schema_ver: int = -1  # catalog version at txn start (DDL fencing)
 
     def savepoint(self):
@@ -355,6 +356,22 @@ class Session:
             return Result()
         if isinstance(stmt, A.CommitStmt):
             self._commit()
+            return Result()
+        if isinstance(stmt, A.SavepointStmt):
+            # named savepoints over the statement-savepoint machinery
+            # (ref: session savepoint support, pkg/session savepoint ops)
+            if stmt.action == "set":
+                if self.txn is not None:
+                    self.txn.named_savepoints[stmt.name] = self.txn.savepoint()
+            elif stmt.action == "rollback":
+                if self.txn is None or stmt.name not in self.txn.named_savepoints:
+                    raise SQLError(f"SAVEPOINT {stmt.name} does not exist")
+                sp = self.txn.named_savepoints[stmt.name]
+                self.txn.restore(sp)
+            else:  # release
+                if self.txn is None or stmt.name not in self.txn.named_savepoints:
+                    raise SQLError(f"SAVEPOINT {stmt.name} does not exist")
+                del self.txn.named_savepoints[stmt.name]
             return Result()
         if isinstance(stmt, A.RollbackStmt):
             self._rollback()
@@ -741,6 +758,8 @@ class Session:
         from ..expr.eval_ref import compare
         from .subquery import SubqueryError
 
+        if any(op != "union" for op in getattr(stmt, "ops", [])):
+            raise SQLError("EXCEPT/INTERSECT set operations are not supported yet")
         rw = self._new_rewriter(parent_rw)
         try:
             rw.process_ctes(stmt.ctes)
@@ -1332,6 +1351,8 @@ class Session:
         return Result(affected=len(matched))
 
     def _delete(self, stmt: A.DeleteStmt) -> Result:
+        if stmt.multi_table:
+            raise SQLError("multi-table DELETE is not supported yet")
         meta = self.catalog.table(stmt.table.name)
         ts = self.txn.start_ts
         matched = self._scan_rows_with_handles(meta, stmt.where, ts, stmt.order_by, stmt.limit)

