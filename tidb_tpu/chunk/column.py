@@ -125,6 +125,10 @@ class Column:
             return Datum.time(MyTime(int(v), max(ft.decimal, 0)))
         if ft.is_duration():
             return Datum.duration(int(v))
+        if ft.tp == TypeCode.Enum:
+            return Datum.enum_from(ft.elems, int(v))
+        if ft.tp == TypeCode.Set:
+            return Datum.set_from(ft.elems, int(v))
         return Datum.u64(int(v))
 
     def take(self, idx: np.ndarray) -> "Column":

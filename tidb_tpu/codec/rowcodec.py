@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import struct
 
-from ..types import Datum, DatumKind, FieldType, MyDecimal, MyTime
+from ..types import Datum, DatumKind, FieldType, MyDecimal, MyTime, TypeCode
 from . import number
 from .decimal_bin import decode_decimal, encode_decimal
 
@@ -54,7 +54,7 @@ def encode_row_value(d: Datum) -> bytes:
     if k == DatumKind.Int64:
         return number.encode_int_value(d.val)
     if k in (DatumKind.Uint64, DatumKind.MysqlEnum, DatumKind.MysqlSet, DatumKind.MysqlBit):
-        return number.encode_uint_value(d.val)
+        return number.encode_uint_value(int(d.val))
     if k in (DatumKind.String, DatumKind.Bytes):
         return d.val.encode() if isinstance(d.val, str) else bytes(d.val)
     if k == DatumKind.MysqlTime:
@@ -92,7 +92,13 @@ def decode_row_value(b: bytes, ft: FieldType) -> Datum:
         return Datum.time(MyTime(number.decode_uint_value(b), max(ft.decimal, 0)))
     if ft.is_duration():
         return Datum.duration(number.decode_int_value(b))
-    # Enum/Set/Bit land as uint
+    if ft.tp == TypeCode.JSON:
+        return Datum.json(bytes(b))
+    if ft.tp == TypeCode.Enum:
+        return Datum.enum_from(ft.elems, number.decode_uint_value(b))
+    if ft.tp == TypeCode.Set:
+        return Datum.set_from(ft.elems, number.decode_uint_value(b))
+    # Bit lands as uint
     return Datum.u64(number.decode_uint_value(b))
 
 

@@ -63,7 +63,14 @@ def _has_host_only_op(ex) -> bool:
     the oracle fallback can evaluate them."""
     from ..expr.ir import EXTENSION_OPS, ScalarFunc
 
-    HOST_ONLY = {"replace"}
+    HOST_ONLY = {
+        "replace",
+        # JSON + regexp evaluate on the host oracle (ref: the per-store
+        # pushdown whitelists, infer_pushdown.go scalarExprSupportedByTiKV)
+        "json_extract", "json_unquote", "json_type", "json_valid",
+        "json_length", "json_keys", "json_contains", "json_member_of",
+        "json_array", "json_object", "json_quote", "regexp", "regexp_like",
+    }
 
     def walk(e):
         if isinstance(e, ScalarFunc):

@@ -165,6 +165,10 @@ def run_dag_on_chunk(
 def datum_group_key(d: Datum, ft: FieldType | None = None):
     if d.is_null():
         return (0, None)
+    if d.kind == DatumKind.MysqlJSON:
+        return (1, bytes(d.val))
+    if d.kind in (DatumKind.MysqlEnum, DatumKind.MysqlSet):
+        return (1, int(d.val))
     if d.kind == DatumKind.MysqlDecimal:
         return (1, str(d.val.d.normalize()))
     if d.kind in (DatumKind.String, DatumKind.Bytes):

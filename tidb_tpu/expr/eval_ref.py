@@ -70,6 +70,9 @@ def _class2(a: Datum, b: Datum) -> str:
     return "int"
 
 
+_JNULL = None  # python None doubles as JSON null (SQL NULL is Datum.NULL)
+
+
 def _truth(d: Datum) -> bool | None:
     if d.is_null():
         return None
@@ -104,6 +107,31 @@ def compare(a: Datum, b: Datum, ci: bool = False) -> int | None:
         av = a.val.packed if isinstance(a.val, MyTime) else a.val
         bv = b.val.packed if isinstance(b.val, MyTime) else b.val
         return (av > bv) - (av < bv)
+    if a.kind in (DatumKind.MysqlEnum, DatumKind.MysqlSet) or b.kind in (DatumKind.MysqlEnum, DatumKind.MysqlSet):
+        ek = (DatumKind.MysqlEnum, DatumKind.MysqlSet)
+        if a.kind in ek and b.kind in ek:
+            av, bv = int(a.val), int(b.val)  # member number (ref: types/enum.go)
+        elif (b if a.kind in ek else a).kind in (DatumKind.String, DatumKind.Bytes):
+            # enum vs string compares by NAME (ref: enum.go ConvertToString)
+            av, bv = str(a.val), str(b.val)
+            if ci:
+                av, bv = av.upper(), bv.upper()
+            return (av > bv) - (av < bv)
+        else:
+            av, bv = int(a.val), int(b.val)
+        return (av > bv) - (av < bv)
+    if a.kind == DatumKind.MysqlJSON or b.kind == DatumKind.MysqlJSON:
+        # JSON equality is exact after coercing the other side to a JSON
+        # scalar; ordering approximates MySQL's type-precedence rules with
+        # text order (documented divergence)
+        from ..types import json_binary as jb
+
+        ja = jb.decode(a.val) if a.kind == DatumKind.MysqlJSON else RefEvaluator._jscalar(a)
+        jv = jb.decode(b.val) if b.kind == DatumKind.MysqlJSON else RefEvaluator._jscalar(b)
+        if jb._eq(ja, jv):
+            return 0
+        at, bt = jb.to_text(ja), jb.to_text(jv)
+        return (at > bt) - (at < bt)
     av, bv = a.val, b.val  # python ints compare exactly regardless of sign
     return (av > bv) - (av < bv)
 
@@ -131,6 +159,218 @@ class RefEvaluator:
     # -- helpers -------------------------------------------------------------
     def _args(self, e, row):
         return [self.eval(a, row) for a in e.args]
+
+    @staticmethod
+    def _jval(d: Datum):
+        """Datum -> python JSON value (None return means SQL NULL input)."""
+        from ..types import json_binary as jb
+
+        if d.is_null():
+            return _JNULL
+        if d.kind == DatumKind.MysqlJSON:
+            return jb.decode(d.val)
+        if d.kind in (DatumKind.String, DatumKind.Bytes):
+            txt = d.val if isinstance(d.val, str) else bytes(d.val).decode("utf-8", "surrogateescape")
+            return jb.parse_text(txt)
+        if d.kind in (DatumKind.Int64, DatumKind.Uint64):
+            return int(d.val)
+        if d.kind in (DatumKind.Float32, DatumKind.Float64):
+            return float(d.val)
+        if d.kind == DatumKind.MysqlDecimal:
+            return float(d.val.to_float())
+        raise NotImplementedError(f"cannot treat {d.kind.name} as JSON")
+
+    @staticmethod
+    def _jscalar(d: Datum):
+        """SQL value -> JSON SCALAR (strings stay strings — MySQL treats
+        string args of JSON_ARRAY/JSON_OBJECT/MEMBER OF as values, not
+        JSON text to parse)."""
+        from ..types import json_binary as jb
+
+        if d.kind == DatumKind.MysqlJSON:
+            return jb.decode(d.val)
+        if d.kind in (DatumKind.String, DatumKind.Bytes):
+            return d.val if isinstance(d.val, str) else bytes(d.val).decode("utf-8", "surrogateescape")
+        if d.kind in (DatumKind.Int64, DatumKind.Uint64):
+            return int(d.val)
+        if d.kind in (DatumKind.Float32, DatumKind.Float64):
+            return float(d.val)
+        if d.kind == DatumKind.MysqlDecimal:
+            return float(d.val.to_float())
+        return str(d.val)
+
+    @staticmethod
+    def _jdatum(v) -> Datum:
+        from ..types import json_binary as jb
+
+        return Datum.json(jb.encode(v))
+
+    # -- JSON (ref: pkg/expression/builtin_json_vec.go; semantics
+    # pkg/types/json_binary_functions.go) --------------------------------
+    def _op_json_extract(self, e, row):
+        args = self._args(e, row)
+        if any(a.is_null() for a in args):
+            return Datum.NULL
+        doc = self._jval(args[0])
+        paths = [str(a.val) for a in args[1:]]
+        from ..types import json_binary as jb
+
+        found, v = jb.extract(doc, paths)
+        return self._jdatum(v) if found else Datum.NULL
+
+    def _op_json_unquote(self, e, row):
+        a = self._args(e, row)[0]
+        if a.is_null():
+            return Datum.NULL
+        from ..types import json_binary as jb
+
+        if a.kind in (DatumKind.String, DatumKind.Bytes):
+            # MySQL only parses/unquotes double-quoted JSON strings; any
+            # other plain string passes through unchanged
+            txt = a.val if isinstance(a.val, str) else bytes(a.val).decode("utf-8", "surrogateescape")
+            if txt.startswith('"') and txt.endswith('"'):
+                try:
+                    v = jb.parse_text(txt)
+                    if isinstance(v, str):
+                        return Datum.string(v)
+                except ValueError:
+                    pass
+            return Datum.string(txt)
+        v = self._jval(a)
+        if isinstance(v, str):
+            return Datum.string(v)
+        return Datum.string(jb.to_text(v))
+
+    def _op_json_type(self, e, row):
+        a = self._args(e, row)[0]
+        if a.is_null():
+            return Datum.NULL
+        from ..types import json_binary as jb
+
+        return Datum.string(jb.json_type_name(self._jval(a)))
+
+    def _op_json_valid(self, e, row):
+        a = self._args(e, row)[0]
+        if a.is_null():
+            return Datum.NULL
+        if a.kind == DatumKind.MysqlJSON:
+            return Datum.i64(1)
+        if a.kind not in (DatumKind.String, DatumKind.Bytes):
+            return Datum.i64(0)
+        try:
+            self._jval(a)
+            return Datum.i64(1)
+        except ValueError:
+            return Datum.i64(0)
+
+    def _op_json_length(self, e, row):
+        args = self._args(e, row)
+        if any(a.is_null() for a in args):
+            return Datum.NULL
+        v = self._jval(args[0])
+        if len(args) > 1:
+            from ..types import json_binary as jb
+
+            found, v = jb.extract(v, [str(args[1].val)])
+            if not found:
+                return Datum.NULL
+        if isinstance(v, (list, dict)):
+            return Datum.i64(len(v))
+        return Datum.i64(1)
+
+    def _op_json_keys(self, e, row):
+        args = self._args(e, row)
+        if any(a.is_null() for a in args):
+            return Datum.NULL
+        v = self._jval(args[0])
+        if len(args) > 1:
+            from ..types import json_binary as jb
+
+            found, v = jb.extract(v, [str(args[1].val)])
+            if not found:
+                return Datum.NULL
+        if not isinstance(v, dict):
+            return Datum.NULL
+        return self._jdatum(list(v.keys()))
+
+    def _op_json_contains(self, e, row):
+        args = self._args(e, row)
+        if any(a.is_null() for a in args):
+            return Datum.NULL
+        from ..types import json_binary as jb
+
+        return Datum.i64(1 if jb.contains(self._jval(args[0]), self._jval(args[1])) else 0)
+
+    def _op_json_member_of(self, e, row):
+        args = self._args(e, row)
+        if any(a.is_null() for a in args):
+            return Datum.NULL
+        from ..types import json_binary as jb
+
+        target, arr = self._jscalar(args[0]), self._jval(args[1])
+        if isinstance(arr, list):
+            return Datum.i64(1 if any(jb._eq(x, target) for x in arr) else 0)
+        return Datum.i64(1 if jb._eq(arr, target) else 0)
+
+    def _op_json_array(self, e, row):
+        return self._jdatum([None if a.is_null() else self._jscalar(a) for a in self._args(e, row)])
+
+    def _op_json_object(self, e, row):
+        args = self._args(e, row)
+        obj = {}
+        for i in range(0, len(args), 2):
+            k = args[i]
+            if k.is_null():
+                raise ValueError("JSON documents may not contain NULL member names")
+            obj[str(k.val)] = None if args[i + 1].is_null() else self._jscalar(args[i + 1])
+        return self._jdatum(obj)
+
+    def _op_json_quote(self, e, row):
+        a = self._args(e, row)[0]
+        if a.is_null():
+            return Datum.NULL
+        import json as _pyjson
+
+        return Datum.string(_pyjson.dumps(str(a.val), ensure_ascii=False))
+
+    # -- regexp (ref: pkg/expression/builtin_regexp_vec.go) --------------
+    def _regexp_match(self, e, row, with_match_type: bool):
+        import re as _re
+
+        args = self._args(e, row)
+        if any(a.is_null() for a in args[:2]):
+            return None
+        def _txt(d):
+            if isinstance(d.val, str):
+                return d.val
+            if isinstance(d.val, (bytes, bytearray, memoryview)):
+                return bytes(d.val).decode("utf-8", "surrogateescape")
+            return str(d.val)  # enum/set render as member names
+
+        subject, pattern = _txt(args[0]), _txt(args[1])
+        flags = 0
+        ci = bool(e.args[0].ft.is_ci() or e.args[1].ft.is_ci())
+        if with_match_type and len(args) > 2 and not args[2].is_null():
+            mt = str(args[2].val)
+            if "c" in mt:
+                ci = False
+            if "i" in mt:
+                ci = True
+            if "n" in mt:
+                flags |= _re.DOTALL
+            if "m" in mt:
+                flags |= _re.MULTILINE
+        if ci:
+            flags |= _re.IGNORECASE
+        return _re.search(pattern, subject, flags) is not None
+
+    def _op_regexp(self, e, row):
+        m = self._regexp_match(e, row, False)
+        return Datum.NULL if m is None else Datum.i64(1 if m else 0)
+
+    def _op_regexp_like(self, e, row):
+        m = self._regexp_match(e, row, True)
+        return Datum.NULL if m is None else Datum.i64(1 if m else 0)
 
     def _result_num(self, v, ft: FieldType) -> Datum:
         if v is None:

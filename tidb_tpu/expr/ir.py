@@ -30,6 +30,11 @@ SCALAR_OPS = frozenset(
         "eq", "ne", "lt", "le", "gt", "ge", "nulleq", "in", "between",
         # logical
         "and", "or", "not", "xor",
+        # JSON + regexp (host-only: distsql/root.py HOST_ONLY keeps them
+        # at the root oracle; ref: builtin_json_vec.go, builtin_regexp_vec.go)
+        "json_extract", "json_unquote", "json_type", "json_valid",
+        "json_length", "json_keys", "json_contains", "json_member_of",
+        "json_array", "json_object", "json_quote", "regexp", "regexp_like",
         # null handling / control
         "isnull", "ifnull", "if", "case", "coalesce",
         # casts (target class from result ft)

@@ -38,7 +38,7 @@ class Token:
 
 
 # Multi-char operators, longest first (ref: lexer.go startWithOp tables).
-_OPS3 = ("<=>",)
+_OPS3 = ("<=>", "->>")
 _OPS2 = ("<=", ">=", "<>", "!=", ":=", "||", "&&", "<<", ">>", "->")
 _OPS1 = "+-*/%()=<>,.;@~&|^!"
 

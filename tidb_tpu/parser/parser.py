@@ -395,6 +395,7 @@ class Parser:
         return ctes
 
     def single_select(self) -> A.SelectStmt:
+        _win_refs_start = len(self._named_window_refs)
         if self.eat_op("("):
             s = self.select_or_union()
             self.expect_op(")")
@@ -416,7 +417,6 @@ class Parser:
             frm = self.table_refs()
         where = self.expr() if self.eat_kw("WHERE") else None
         group_by, having = [], None
-        _win_refs_start = len(self._named_window_refs)
         if self.eat_kw("GROUP"):
             self.expect_kw("BY")
             group_by = self.by_list()
@@ -685,6 +685,14 @@ class Parser:
                 else:
                     left = A.BinaryOp(op, left, self.bit_or_expr())
                 continue
+            if self.at_kw("MEMBER"):
+                self.next()
+                self.expect_kw("OF")
+                self.expect_op("(")
+                arr = self.expr()
+                self.expect_op(")")
+                left = A.FuncCall("json_member_of", [left, arr])
+                continue
             negated = False
             j = self.i
             if self.at_kw("NOT"):
@@ -829,9 +837,21 @@ class Parser:
         return self._collate_tail(self.primary())
 
     def _collate_tail(self, node):
-        while self.eat_kw("COLLATE"):
-            node = A.CollateExpr(node, self.ident().lower())
-        return node
+        while True:
+            if self.eat_kw("COLLATE"):
+                node = A.CollateExpr(node, self.ident().lower())
+            elif self.at_op("->") or self.at_op("->>"):
+                # JSON path operators (ref: parser.y: col->path ==
+                # json_extract, ->> wraps json_unquote)
+                unq = self.next().text == "->>"
+                ptok = self.next()
+                if ptok.kind is not T.STRING:
+                    raise ParseError(f"expected JSON path string at {self._where()}")
+                node = A.FuncCall("json_extract", [node, A.Literal(ptok.text, "str")])
+                if unq:
+                    node = A.FuncCall("json_unquote", [node])
+            else:
+                return node
 
     def primary(self) -> A.ExprNode:
         t = self.peek()

@@ -33,6 +33,10 @@ def datum_text(d: Datum) -> str | None:
         if v == int(v) and abs(v) < 1e15:
             return str(int(v))
         return repr(v)
+    if d.kind == DatumKind.MysqlJSON:
+        from ..types import json_binary as jb
+
+        return jb.to_text(jb.decode(d.val))
     return str(d.val)
 
 

@@ -40,8 +40,20 @@ def field_type_from_spec(ts: A.TypeSpec, not_null: bool = False) -> FieldType:
         if not_null:
             ft = FieldType(ft.tp, ft.flag | Flag.NotNull, ft.flen, ft.decimal)
         return ft
+    if name == "json":
+        from ..types import new_json
+
+        ft = new_json()
+        if not_null:
+            ft = FieldType(ft.tp, ft.flag | Flag.NotNull, ft.flen, ft.decimal)
+        return ft
+    if name in ("enum", "set"):
+        from ..types import new_enum, new_set
+
+        mk = new_enum if name == "enum" else new_set
+        return mk(tuple(ts.elems), notnull=not_null)
     if name in ("char", "varchar", "binary", "varbinary", "text", "tinytext", "mediumtext", "longtext",
-                "blob", "tinyblob", "mediumblob", "longblob", "enum", "set", "json"):
+                "blob", "tinyblob", "mediumblob", "longblob"):
         flen = ts.length if ts.length > 0 else 255
         ft = new_varchar(flen)
         if not_null:

@@ -28,12 +28,14 @@ M_STATE_KEY = b"m\x00schema"
 # ---------------------------------------------------------------- dicts
 def ft_to_dict(ft: FieldType) -> dict:
     return {"tp": int(ft.tp), "flag": int(ft.flag), "flen": ft.flen,
-            "decimal": ft.decimal, "charset": ft.charset, "collate": int(ft.collate)}
+            "decimal": ft.decimal, "charset": ft.charset, "collate": int(ft.collate),
+            "elems": list(ft.elems)}
 
 
 def ft_from_dict(d: dict) -> FieldType:
     return FieldType(TypeCode(d["tp"]), Flag(d["flag"]), d["flen"], d["decimal"],
-                     d.get("charset", "utf8mb4"), Collation(d.get("collate", 0)))
+                     d.get("charset", "utf8mb4"), Collation(d.get("collate", 0)),
+                     tuple(d.get("elems", ())))
 
 
 def datum_to_dict(d) -> dict | None:

@@ -346,10 +346,51 @@ class _Lowerer:
             return func("cast", ft, rec(n.expr))
         if isinstance(n, A.FuncCall):
             return self._func_call(n, rec)
+        if isinstance(n, A.CollateExpr):
+            # expr COLLATE c: same value, comparisons use the named
+            # collation (ref: expression.BuildCollationFunction) — only the
+            # ci-ness matters to this engine's compare kernels
+            e = rec(n.expr)
+            ft = e.ft.clone()
+            from ..types import Collation
+
+            ft.collate = (
+                Collation.Utf8MB4GeneralCI
+                if n.collation.endswith(("_general_ci", "_0900_ai_ci", "_ci"))
+                else Collation.Utf8MB4Bin
+            )
+            import dataclasses
+
+            return dataclasses.replace(e, ft=ft)
+        if isinstance(n, A.Regexp):
+            l, r = rec(n.expr), rec(n.pattern)
+            out = func("regexp", BOOL, l, r)
+            return func("not", BOOL, out) if n.negated else out
         raise PlanError(f"unsupported expression {type(n).__name__}")
+
+    _JSON_FUNCS = {
+        "json_extract": "json", "json_unquote": "varchar", "json_type": "varchar",
+        "json_valid": "bool", "json_length": "int", "json_keys": "json",
+        "json_contains": "bool", "json_member_of": "bool", "json_array": "json",
+        "json_object": "json", "json_quote": "varchar",
+    }
 
     def _func_call(self, n: A.FuncCall, rec):
         name = _FUNC_RENAME.get(n.name, n.name)
+        if name in self._JSON_FUNCS:
+            from ..types import new_json
+
+            args = [rec(a) for a in n.args]
+            kind = self._JSON_FUNCS[name]
+            ft = (
+                new_json() if kind == "json"
+                else new_varchar() if kind == "varchar"
+                else new_longlong() if kind == "int"
+                else BOOL
+            )
+            return func(name, ft, *args)
+        if name in ("regexp_like",):
+            return func("regexp_like", BOOL, *[rec(a) for a in n.args])
         if name in ("now", "current_timestamp", "sysdate", "current_date", "curdate", "localtime", "localtimestamp"):
             # statement-time constant (MySQL: now() is fixed per statement;
             # ref: builtin_time.go evalNowWithFsp) — volatile on host, a
@@ -463,7 +504,8 @@ class _Lowerer:
     @staticmethod
     def _coerce_const(target: Expr, e: Expr) -> Expr:
         """String literals compared with time columns re-parse as datetime
-        consts (MySQL implicit temporal coercion)."""
+        consts; with ENUM/SET columns they become member numbers (MySQL
+        implicit coercion; ref: types/enum.go ParseEnumName)."""
         from ..expr.ir import Const
 
         if (
@@ -473,6 +515,19 @@ class _Lowerer:
             and e.datum.val is not None
         ):
             return lit(str(e.datum.val), new_datetime())
+        if (
+            isinstance(e, Const)
+            and target.ft.tp in (TypeCode.Enum, TypeCode.Set)
+            and e.ft.is_string()
+            and e.datum.val is not None
+        ):
+            try:
+                d = _coerce_datum(e.datum, target.ft)
+            except PlanError:
+                # non-member literal COMPARES as match-nothing (MySQL:
+                # strictness belongs to the insert cast, not predicates)
+                return Const(Datum.i64(-1), new_longlong())
+            return Const(Datum.u64(int(d.val)), new_longlong(unsigned=True))
         return e
 
 
@@ -512,6 +567,33 @@ def _coerce_datum(d: Datum, ft: FieldType) -> Datum:
     """Datum -> column type (insert/update path; ref: table.CastValue)."""
     if d.is_null():
         return d
+    if ft.tp == TypeCode.Enum:
+        if d.kind == DatumKind.MysqlEnum:
+            return d
+        if d.kind in (DatumKind.String, DatumKind.Bytes):
+            name = d.val if isinstance(d.val, str) else bytes(d.val).decode()
+            low = [e.lower() for e in ft.elems]
+            if name.lower() not in low:
+                raise PlanError(f"invalid enum value {name!r}")
+            return Datum.enum_from(ft.elems, low.index(name.lower()) + 1)
+        n = int(d.val)
+        if not 0 < n <= len(ft.elems):
+            raise PlanError(f"invalid enum number {n}")
+        return Datum.enum_from(ft.elems, n)
+    if ft.tp == TypeCode.Set:
+        if d.kind == DatumKind.MysqlSet:
+            return d
+        if d.kind in (DatumKind.String, DatumKind.Bytes):
+            raw = d.val if isinstance(d.val, str) else bytes(d.val).decode()
+            low = [e.lower() for e in ft.elems]
+            mask = 0
+            for part in ([] if raw == "" else raw.split(",")):
+                if part.lower() not in low:
+                    raise PlanError(f"invalid set member {part!r}")
+                mask |= 1 << low.index(part.lower())
+            return Datum.set_from(ft.elems, mask)
+        mask = int(d.val)
+        return Datum.set_from(ft.elems, mask)
     et = ft.eval_type()
     if et == "decimal":
         if d.kind == DatumKind.MysqlDecimal:
@@ -537,6 +619,22 @@ def _coerce_datum(d: Datum, ft: FieldType) -> Datum:
         if d.kind in (DatumKind.String, DatumKind.Bytes):
             return d
         return Datum.string(str(d.val))
+    if et == "json":
+        from ..types import json_binary as _jb
+
+        if d.kind == DatumKind.MysqlJSON:
+            return d
+        if d.kind in (DatumKind.String, DatumKind.Bytes):
+            txt = d.val if isinstance(d.val, str) else bytes(d.val).decode("utf-8", "surrogateescape")
+            try:
+                return Datum.json(_jb.encode(_jb.parse_text(txt)))
+            except ValueError as exc:
+                raise PlanError(f"invalid JSON text: {exc}") from exc
+        if d.kind in (DatumKind.Int64, DatumKind.Uint64):
+            return Datum.json(_jb.encode(int(d.val)))
+        if d.kind in (DatumKind.Float32, DatumKind.Float64):
+            return Datum.json(_jb.encode(float(d.val)))
+        raise PlanError(f"cannot cast {d.kind.name} to JSON")
     return d
 
 

@@ -401,9 +401,10 @@ class TPUStore:
                 batch = self.region_device_batch(region, req.ranges, req.dag, req.start_ts)
             batches = [batch] + [self._aux_batch(c) for c in req.aux_chunks]
             chunk, ex_rows = drive_program(self.programs, req.dag, batches, group_capacity)
-        except OverflowRetryError:
-            # degenerate fan-out: fall back to the row-at-a-time oracle
-            # (the host fallback SURVEY §7 / exec/builder.py promise)
+        except (OverflowRetryError, NotImplementedError):
+            # degenerate fan-out OR an op the device cannot express (JSON,
+            # regexp, host-only funcs reaching a pushed executor): fall back
+            # to the row-at-a-time oracle (SURVEY §7 / exec/builder.py)
             from ..util import metrics as _m
 
             _m.COP_FALLBACKS.inc()
@@ -418,8 +419,12 @@ class TPUStore:
                 # the final row count
                 ex_rows = [chunk.num_rows()] * len(executor_walk(req.dag.executors))
             except (RuntimeError, TypeError, NotImplementedError, ValueError) as exc:
+                if failpoint.eval("cop-debug-raise"):
+                    raise  # loud-failure gate (VERDICT r2 weak #10)
                 return CopResponse(other_error=f"oracle fallback failed: {exc}")
-        except (RuntimeError, TypeError, NotImplementedError) as exc:
+        except (RuntimeError, TypeError) as exc:
+            if failpoint.eval("cop-debug-raise"):
+                raise  # surface kernel bugs with a stack when armed
             return CopResponse(other_error=str(exc))
         elapsed = time.monotonic_ns() - t0
         # per-executor produced-row counts are real (measured inside the

@@ -1,4 +1,7 @@
 from .field_type import (
+    new_json,
+    new_enum,
+    new_set,
     FieldType,
     TypeCode,
     Flag,
@@ -12,7 +15,7 @@ from .field_type import (
     new_date,
     new_datetime,
 )
-from .datum import Datum, DatumKind
+from .datum import EnumVal, SetVal, Datum, DatumKind
 from .mydecimal import MyDecimal, DIV_FRAC_INCR
 from .mytime import MyTime, pack_datetime, unpack_datetime
 

@@ -39,6 +39,61 @@ class DatumKind(enum.IntEnum):
     MysqlJSON = 18
 
 
+class EnumVal:
+    """ENUM value: 1-based member number + resolved name (ref:
+    pkg/types/enum.go). Compares and stores by number; renders as name."""
+
+    __slots__ = ("number", "name")
+
+    def __init__(self, number: int, name: str):
+        self.number = int(number)
+        self.name = name
+
+    def __int__(self):
+        return self.number
+
+    __index__ = __int__
+
+    def __str__(self):
+        return self.name
+
+    def __eq__(self, other):
+        return isinstance(other, EnumVal) and other.number == self.number
+
+    def __hash__(self):
+        return hash(("enum", self.number))
+
+    def __repr__(self):
+        return f"EnumVal({self.number}, {self.name!r})"
+
+
+class SetVal:
+    """SET value: member bitmask + resolved names (ref: pkg/types/set.go)."""
+
+    __slots__ = ("number", "names")
+
+    def __init__(self, number: int, names: tuple):
+        self.number = int(number)
+        self.names = tuple(names)
+
+    def __int__(self):
+        return self.number
+
+    __index__ = __int__
+
+    def __str__(self):
+        return ",".join(self.names)
+
+    def __eq__(self, other):
+        return isinstance(other, SetVal) and other.number == self.number
+
+    def __hash__(self):
+        return hash(("set", self.number))
+
+    def __repr__(self):
+        return f"SetVal({self.number}, {self.names!r})"
+
+
 @dataclass(frozen=True)
 class Datum:
     kind: DatumKind
@@ -73,6 +128,32 @@ class Datum:
     @classmethod
     def time(cls, v: MyTime) -> "Datum":
         return cls(DatumKind.MysqlTime, v)
+
+    @classmethod
+    def json(cls, binary: bytes) -> "Datum":
+        """JSON datum over the BINARY encoding (types/json_binary.py) —
+        the canonical in-engine representation, decoded lazily."""
+        return cls(DatumKind.MysqlJSON, bytes(binary))
+
+    @classmethod
+    def enum(cls, number: int, name: str) -> "Datum":
+        return cls(DatumKind.MysqlEnum, EnumVal(number, name))
+
+    @classmethod
+    def set_val(cls, number: int, names: tuple) -> "Datum":
+        return cls(DatumKind.MysqlSet, SetVal(number, names))
+
+    @classmethod
+    def enum_from(cls, elems: tuple, number: int) -> "Datum":
+        """Member number -> ENUM datum (name resolved; THE one place the
+        out-of-range rule lives)."""
+        name = elems[number - 1] if 0 < number <= len(elems) else ""
+        return cls(DatumKind.MysqlEnum, EnumVal(number, name))
+
+    @classmethod
+    def set_from(cls, elems: tuple, mask: int) -> "Datum":
+        names = tuple(e for i, e in enumerate(elems) if mask >> i & 1)
+        return cls(DatumKind.MysqlSet, SetVal(mask, names))
 
     @classmethod
     def duration(cls, nanos: int) -> "Datum":

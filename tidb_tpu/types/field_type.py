@@ -162,6 +162,8 @@ class FieldType:
             return "duration"
         if self.tp == TypeCode.JSON:
             return "json"
+        if self.tp in (TypeCode.Enum, TypeCode.Set, TypeCode.Bit):
+            return "int"  # device compare/order by member number
         return "string"
 
     def clone(self) -> "FieldType":
@@ -204,6 +206,18 @@ def new_varchar(flen: int = UNSPECIFIED_LENGTH, collate: Collation = Collation.U
 
 def new_date() -> FieldType:
     return FieldType(TypeCode.Date, Flag.Binary, flen=10, decimal=0)
+
+
+def new_json() -> FieldType:
+    return FieldType(TypeCode.JSON, Flag(0), UNSPECIFIED_LENGTH, 0)
+
+
+def new_enum(elems: tuple, notnull: bool = False) -> FieldType:
+    return FieldType(TypeCode.Enum, Flag.NotNull if notnull else Flag(0), UNSPECIFIED_LENGTH, 0, elems=tuple(elems))
+
+
+def new_set(elems: tuple, notnull: bool = False) -> FieldType:
+    return FieldType(TypeCode.Set, Flag.NotNull if notnull else Flag(0), UNSPECIFIED_LENGTH, 0, elems=tuple(elems))
 
 
 def new_datetime(fsp: int = 0) -> FieldType:
