@@ -156,3 +156,52 @@ class TestVarsAndConfig2:
         assert sorted(x for x, in sess.execute("SELECT id FROM pu").values()) == [2, 3]
         with pytest.raises(SQLError, match="duplicate"):
             sess.execute("UPDATE pu SET u = 7 WHERE id = 2")
+
+
+class TestSpillDegrade:
+    """Quota-bounded aggregation completes via the degraded low-memory
+    fold instead of erroring (VERDICT r2 next #10; ref: util/memory
+    action chain + the bounded-memory intent of agg_spill.go)."""
+
+    def _big_agg_session(self):
+        from tidb_tpu.codec import tablecodec
+        from tidb_tpu.sql import Session
+
+        s = Session()
+        s.execute("create table sp (id bigint primary key, g bigint, v bigint)")
+        rows = ",".join(f"({i}, {i % 500}, {i})" for i in range(3000))
+        s.execute("insert into sp values " + rows)
+        meta = s.catalog.table("sp")
+        for h in range(500, 3000, 500):
+            s.store.cluster.split(tablecodec.encode_row_key(meta.table_id, h))
+        return s
+
+    def test_degraded_path_completes(self):
+        from tidb_tpu.util import metrics
+
+        s = self._big_agg_session()
+        want = {}
+        for i in range(3000):
+            want[i % 500] = want.get(i % 500, 0) + i
+        # mesh path doesn't exercise the tracker — force the per-region
+        # thread-pool path, with a quota small enough that holding every
+        # region's partial table breaches it but one region + the fold
+        # accumulator fits
+        s.execute("set tidb_enable_tpu_mesh = OFF")
+        s.execute("set tidb_mem_quota_query = 30000")
+        before = metrics.MEM_DEGRADED_QUERIES.value
+        r = s.execute("select g, sum(v) from sp group by g")
+        assert metrics.MEM_DEGRADED_QUERIES.value == before + 1, "did not degrade"
+        got = {int(x[0].val): int(str(x[1].val).split(".")[0]) for x in r.rows}
+        assert got == want
+
+    def test_eviction_action_runs_first(self):
+        from tidb_tpu.util import metrics
+
+        s = self._big_agg_session()
+        s.execute("select g, sum(v) from sp group by g")  # warm the caches
+        s.execute("set tidb_enable_tpu_mesh = OFF")
+        s.execute("set tidb_mem_quota_query = 30000")
+        before = metrics.MEM_EVICTIONS.value
+        s.execute("select g, sum(v) from sp group by g")
+        assert metrics.MEM_EVICTIONS.value == before + 1

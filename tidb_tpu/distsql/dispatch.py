@@ -99,6 +99,47 @@ def _build_tasks(store: TPUStore, ranges: list) -> list[CopTask]:
     return ordered
 
 
+def select_stream(store: TPUStore, req: KVRequest):
+    """Sequential per-task chunk generator — the bounded-memory dispatch
+    the degraded OOM path uses (one region's result live at a time;
+    ref: copr worker pool degraded to a single in-order worker)."""
+    res = SelectResult(chunks=[])
+    tasks = _build_tasks(store, req.ranges)
+    for i, task in enumerate(tasks):
+        one = SelectResult(chunks=[])
+        _run_one_task(store, req, i, task, one.chunks, one.exec_summaries)
+        for c in one.chunks:
+            if c is not None:
+                yield c, one.exec_summaries
+
+
+def _run_one_task(store, req, i, task, out_chunks, summaries, retries=MAX_RETRY):
+    ranges = task.ranges
+    while True:
+        from ..util import metrics
+
+        metrics.DISTSQL_TASKS.inc()
+        creq = CopRequest(
+            req.dag, ranges, req.start_ts, task.region_id, task.epoch,
+            aux_chunks=req.aux_chunks, paging_size=req.paging_size,
+        )
+        resp = store.coprocessor(creq)
+        if resp.region_error is not None:
+            if retries <= 0:
+                raise RuntimeError(f"region retries exhausted: {resp.region_error}")
+            metrics.DISTSQL_RETRIES.inc()
+            for s2 in _build_tasks(store, ranges):
+                _run_one_task(store, req, i, s2, out_chunks, summaries, retries - 1)
+            return
+        if resp.other_error is not None:
+            raise RuntimeError(resp.other_error)
+        summaries.append(resp.exec_summaries)
+        out_chunks.append(resp.chunk)
+        if resp.last_range is None:
+            return
+        ranges = resp.last_range
+
+
 def select(store: TPUStore, req: KVRequest) -> SelectResult:
     tasks = _build_tasks(store, req.ranges)
     results: list = [None] * len(tasks)

@@ -157,7 +157,7 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
                 k += len(a.args)
             new_cols: list[CompVal] = []
             if ex.group_by:
-                res = group_aggregate(gvals, aggs, valid, group_capacity, merge=ex.merge, small_groups=small_groups)
+                res = group_aggregate(gvals, aggs, valid, group_capacity, merge=ex.merge, small_groups=small_groups, stream=ex.stream)
                 state.group_overflow = state.group_overflow | res.overflow
                 for (a, av), st in zip(aggs, res.states):
                     new_cols.extend(_agg_result_cols(a, av, st, res.group_valid, ex.partial))

@@ -91,8 +91,9 @@ class Aggregation:
     """(ref: tipb.Aggregation; mpp_exec.go:999 aggExec). Output schema is
     [agg results..., group-by keys...] matching the reference's layout.
 
-    `stream` marks input already sorted by group keys (StreamAgg) — same
-    kernel here, the sort inside is nearly free on sorted input.
+    `stream` marks input already sorted by group keys (StreamAgg): the
+    boundary-scan kernel runs — no sort, no hash (ops/aggregate.py
+    _group_aggregate_stream; ref: agg_stream_executor.go).
     `partial` True emits partial states instead of finalized values.
     """
 

@@ -458,6 +458,71 @@ def _group_aggregate_dense(group_bys, aggs, row_valid, g_cap: int, merge: bool):
     return GroupAggResult(group_rep, group_valid, jnp.minimum(n_groups, g_cap), overflow, out_states)
 
 
+def _group_aggregate_stream(group_bys, aggs, row_valid, group_capacity: int, merge: bool):
+    """StreamAgg kernel (ref: pkg/executor/aggregate/agg_stream_executor.go,
+    cophandler's sorted-input aggregation): the input arrives ALREADY sorted
+    on the group keys (index order, or below a Sort), so group boundaries
+    are plain neighbor compares over the key words — no sort, no hash, no
+    collision risk. Rows keep their original order (seg is monotone), so
+    the whole segment machinery applies directly; filtered rows stay inside
+    their key run and are masked by the states, and key runs whose rows are
+    ALL filtered compact away through the first-encounter reorder."""
+    n = row_valid.shape[0]
+    keys: list[jax.Array] = []
+    for g in group_bys:
+        keys.extend(sort_key_arrays(g))
+    one = jnp.ones(1, bool)
+    diff = one
+    for k in keys:
+        d = jnp.concatenate([one, k[1:] != k[:-1]])
+        diff = d if diff is one else (diff | d)
+    if diff is one:
+        diff = jnp.ones(n, bool)
+    seg = jnp.cumsum(diff.astype(jnp.int32)) - 1
+    raw_groups = seg[-1] + 1
+    overflow = raw_groups > group_capacity
+    nseg = group_capacity + 1
+    seg = jnp.minimum(seg, nseg - 1)
+    ctx = make_segctx(seg, nseg)
+    perm = jnp.arange(n, dtype=jnp.int32)
+
+    group_rep_full, has_rep = _first_match_idx(row_valid, perm, ctx, n)
+    group_rep = group_rep_full[:group_capacity]
+    has_g = has_rep[:group_capacity]
+    n_groups = has_g.sum().astype(jnp.int32)
+
+    states = []
+    for desc, arg_vals in aggs:
+        if _is_distinct_special(desc, arg_vals, merge):
+            # DISTINCT needs the hash machinery's group-id alignment;
+            # the planner never sets stream for distinct aggs (guard)
+            raise NotImplementedError("DISTINCT aggregates in stream mode")
+        if _needs_gather_state(desc, arg_vals):
+            st = _gather_state_sorted(desc, arg_vals, row_valid, ctx, perm, n, merge)
+        else:
+            fn = _agg_states_merge if merge else _agg_states_raw
+            st = fn(desc, arg_vals, row_valid, ctx)
+        if isinstance(st, GatherState):
+            states.append(GatherState(st.idx[:group_capacity], st.has[:group_capacity] & has_g))
+            continue
+        st = [(v[:group_capacity], nl[:group_capacity]) for v, nl in st]
+        st = [(v, nl | ~has_g) for v, nl in st]
+        states.append(st)
+
+    # compact: runs with >=1 surviving row first, in first-encounter order
+    order = jnp.argsort(jnp.where(has_g, group_rep, jnp.int32(n)))
+    group_rep = group_rep[order]
+    gids = jnp.arange(group_capacity, dtype=jnp.int32)
+    group_valid = gids < n_groups
+    out_states: list = []
+    for st in states:
+        if isinstance(st, GatherState):
+            out_states.append(GatherState(st.idx[order], st.has[order]))
+        else:
+            out_states.append([(v[order], nl[order]) for v, nl in st])
+    return GroupAggResult(group_rep, group_valid, n_groups, overflow, out_states)
+
+
 def group_aggregate(
     group_bys: list[CompVal],
     aggs: list,
@@ -465,6 +530,7 @@ def group_aggregate(
     group_capacity: int,
     merge: bool = False,
     small_groups: int | None = None,
+    stream: bool = False,
 ):
     """Hash-cluster group aggregation.
 
@@ -473,7 +539,11 @@ def group_aggregate(
     small_groups: statistics-driven hint (planner NDV product) — when set
     and the agg mix allows it, the sort-free dense kernel runs instead; its
     overflow flag routes the driver back here.
+    stream: input is pre-sorted on the group keys (planner-proven): the
+    boundary-scan StreamAgg kernel runs — no sort, no hash at all.
     """
+    if stream and group_bys and not any(d.distinct for d, _ in aggs):
+        return _group_aggregate_stream(group_bys, aggs, row_valid, group_capacity, merge)
     if small_groups and group_bys and _dense_eligible(aggs, merge):
         return _group_aggregate_dense(group_bys, aggs, row_valid, small_groups, merge)
     n = row_valid.shape[0]

@@ -1320,7 +1320,23 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
         having_e = _resolve_deferred(having_e, n_aggs) if having_e is not None else None
         order_items = [(_resolve_deferred(e, n_aggs), d) for e, d in order_items]
         groups = tuple(low.lower_base(g) for g in group_asts)
-        executors.append(Aggregation(group_by=groups, aggs=tuple(low.agg_descs)))
+        # StreamAgg: a covering IndexScan yields rows in index-key order,
+        # so a GROUP BY on a prefix of the index columns (bare ColumnRefs,
+        # in order) is already sorted — the boundary-scan kernel applies
+        # (ref: agg_stream_executor.go; physical prop enforcement in
+        # find_best_task choosing StreamAgg over sorted sources)
+        stream = False
+        from ..expr.ir import ColumnRef as _CRef
+
+        if (
+            isinstance(probe_scan, IndexScan)
+            and groups
+            and not any(d.distinct for d in low.agg_descs)
+            and all(isinstance(g, _CRef) for g in groups)
+            and [g.index for g in groups] == list(range(len(groups)))
+        ):
+            stream = True
+        executors.append(Aggregation(group_by=groups, aggs=tuple(low.agg_descs), stream=stream))
         if having_e is not None:
             executors.append(Selection((having_e,)))
     else:

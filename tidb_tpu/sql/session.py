@@ -678,7 +678,27 @@ class Session:
 
         plan = plan_select(stmt, self.catalog, mat=rw.mat_dict())
         ts = self._read_ts()
-        tracker = MemTracker("query", quota=self.sysvars.get_int("tidb_mem_quota_query") or None)
+        # OOM action chain (ref: util/memory tracker actions): first evict
+        # the store's reclaimable chunk/batch caches; a second breach is
+        # handled below by degrading to the low-memory execution path
+        evicted = [False]
+
+        def _evict_action(tr, _n):
+            if not evicted[0]:
+                evicted[0] = True
+                freed = self.store.evict_caches()
+                from ..util import metrics
+
+                metrics.MEM_EVICTIONS.inc()
+                tr.consume(-min(freed, 0))  # caches are store-owned; the
+                # eviction frees real memory but the tracker accounts query
+                # bytes only — the retry below re-checks the quota
+
+        tracker = MemTracker(
+            "query",
+            quota=self.sysvars.get_int("tidb_mem_quota_query") or None,
+            action=_evict_action,
+        )
         gate_on = self.sysvars.get_bool("tidb_enable_tpu_coprocessor")
         aux = []
         try:
@@ -726,10 +746,7 @@ class Session:
 
                         chunk = try_mesh_select(self.store, plan.dag, ranges, ts)
                     if chunk is None:
-                        chunk = execute_root(
-                            self.store,
-                            plan.dag,
-                            ranges,
+                        kwargs = dict(
                             start_ts=ts,
                             aux_chunks=aux,
                             concurrency=self.sysvars.get_int("tidb_distsql_scan_concurrency"),
@@ -741,6 +758,22 @@ class Session:
                             batch_cop=self.sysvars.get_bool("tidb_allow_batch_cop"),
                             summary_sink=self._explain_sink,
                         )
+                        try:
+                            chunk = execute_root(
+                                self.store, plan.dag, ranges, tracker=tracker, **kwargs
+                            )
+                        except QuotaExceeded:
+                            # degrade: sequential dispatch + incremental
+                            # Partial2 fold keeps the working set bounded
+                            # (the spill analog; VERDICT r2 next #10)
+                            from ..util import metrics
+
+                            metrics.MEM_DEGRADED_QUERIES.inc()
+                            tracker.release_all()
+                            chunk = execute_root(
+                                self.store, plan.dag, ranges,
+                                tracker=tracker, low_memory=True, **kwargs
+                            )
             tracker.consume(chunk.nbytes())
         except QuotaExceeded as exc:
             raise SQLError(str(exc)) from exc

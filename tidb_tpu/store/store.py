@@ -103,6 +103,18 @@ class TPUStore:
         self._aux_lock = threading.Lock()  # select() fans tasks over threads
         self._row_encoder = RowEncoder()
 
+    def evict_caches(self) -> int:
+        """Drop the decoded-chunk and device-batch caches — the first OOM
+        action in the chain (ref: pkg/util/memory ActionOnExceed
+        SoftLimit/spill ordering: free reclaimable buffers before killing
+        the query). Returns an approximate byte count freed."""
+        freed = 0
+        for c in self._chunk_cache.values():
+            freed += c.nbytes()
+        for cache in (self._chunk_cache, self._batch_cache, self._aux_batch_cache):
+            cache.clear()
+        return freed
+
     def next_ts(self) -> int:
         """Store-global TSO (ref: PD timestamp oracle; mock unistore/pd.go).
         Sessions sharing a store draw from one clock so snapshots and
