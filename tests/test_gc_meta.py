@@ -152,3 +152,27 @@ class TestDefaultsPersist:
         s2.execute("insert into dd (id) values (1)")
         r = s2.execute("select v from dd where id = 1")
         assert int(r.rows[0][0].val) == 5
+
+
+class TestAutocommitReadPin:
+    def test_autocommit_read_ts_pins_snapshot_against_gc(self):
+        """ADVICE r3: a background GC tick between an autocommit read's TSO
+        draw and its kv reads must not collect the version visible at the
+        read ts (ref: gc_worker.go calcSafePointByMinStartTS)."""
+        s = Session()
+        s.execute("create table gp (id bigint primary key, v bigint)")
+        s.execute("insert into gp values (1, 10)")
+        ts = s._pin_read_ts()  # autocommit statement's ts draw
+        s.execute("update gp set v = 11 where id = 1")  # newer version lands
+        s.store.run_gc()  # background GCWorker tick mid-statement
+        from tidb_tpu.codec import tablecodec
+
+        meta = s.catalog.table("gp")
+        key = tablecodec.encode_row_key(meta.table_id, 1)
+        # the version visible at `ts` survived the GC pass
+        assert any(vts <= ts for vts, _ in s.store.kv._data[key])
+        row = s._read_row(meta, 1, ts)
+        assert row is not None and int(row[1].val) == 10
+        s._unpin_read_ts(ts)
+        s.store.run_gc()  # unpinned: the old version may now go
+        assert len(s.store.kv._data[key]) == 1

@@ -144,3 +144,78 @@ class TestReviewRegressions:
             raise AssertionError("expected undefined-window error")
         except Exception as exc:
             assert "not defined" in str(exc)
+
+    def test_enum_nonmember_ne_matches_all(self):
+        # ADVICE r3: != against a non-member must match every non-NULL row
+        s = _mk()
+        r = s.execute("select id from j where tag != 'purple'")
+        assert sorted(int(x[0].val) for x in r.rows) == [1, 2, 3]
+
+    def test_enum_nonmember_in_list(self):
+        s = _mk()
+        r = s.execute("select id from j where tag in ('purple', 'red')")
+        assert [int(x[0].val) for x in r.rows] == [1]
+
+    def test_enum_nonmember_ordering_raises(self):
+        # ADVICE r3: `tag > 'purple'` must NOT lower to `tag > -1`
+        # (match-everything); ordering against a non-member raises
+        s = _mk()
+        for q in ("select id from j where tag > 'purple'",
+                  "select id from j where tag between 'purple' and 'red'"):
+            try:
+                s.execute(q)
+                raise AssertionError(f"expected non-member ordering error: {q}")
+            except Exception as exc:
+                assert "non-member" in str(exc), exc
+
+    def test_json_object_odd_arity_is_sql_error(self):
+        # ADVICE r3: odd argument count raises a SQL-level error, not
+        # IndexError out of the evaluator
+        s = _mk()
+        try:
+            s.execute("select json_object('k')")
+            raise AssertionError("expected arity error")
+        except IndexError:
+            raise AssertionError("IndexError leaked out of the evaluator")
+        except Exception as exc:
+            assert "json_object" in str(exc)
+
+    def test_named_window_referenced_from_order_by(self):
+        # ADVICE r3: WINDOW clause windows are visible to window functions
+        # in ORDER BY (parsed after the WINDOW clause)
+        s = _mk()
+        r = s.execute(
+            "select id from j window w as (order by id desc) order by rank() over w"
+        )
+        assert [int(x[0].val) for x in r.rows] == [3, 2, 1]
+
+    def test_json_group_by_on_multidevice_mesh_falls_back(self):
+        # ADVICE r3 (medium): host-only exprs in group-by must not reach the
+        # shard_map trace — the mesh gate rejects them and the per-region
+        # path answers (8-device CPU mesh active in tests)
+        s = _mk()
+        assert s.sysvars.get_bool("tidb_enable_tpu_mesh")
+        r = s.execute("select json_type(doc), count(*) from j group by json_type(doc)")
+        got = sorted((str(x[0].val), int(x[1].val)) for x in r.rows)
+        assert got == [("ARRAY", 1), ("OBJECT", 2)]
+
+    def test_named_window_block_scoped_in_order_by_subquery(self):
+        # code-review r4: a same-named WINDOW in an ORDER BY subquery must
+        # not capture the outer block's OVER w reference
+        from tidb_tpu.parser import parse_one
+
+        st = parse_one(
+            "select rank() over w as r from t window w as (order by id desc) "
+            "order by (select count(*) over w from t2 window w as (order by x asc))"
+        )
+        wf = st.fields[0].expr
+        bi = wf.order_by[0]
+        assert (bi.expr.name if hasattr(bi, "expr") else bi.name) == "id"
+        try:
+            parse_one(
+                "select rank() over w from t order by "
+                "(select count(*) over wi from t2 window wi as (order by x), w as (order by y))"
+            )
+            raise AssertionError("outer w resolved against inner block")
+        except Exception as exc:
+            assert "not defined" in str(exc)

@@ -57,10 +57,9 @@ def _merge_aggregation(agg: Aggregation) -> Aggregation:
     return Aggregation(group_by=group_refs, aggs=tuple(merge_descs), merge=True)
 
 
-def _has_host_only_op(ex) -> bool:
-    """Expressions the device whitelist excludes (the runtime-blocklist
-    analog of infer_pushdown.go IsPushDownEnabled): keep them at root where
-    the oracle fallback can evaluate them."""
+def host_only_exprs(exprs) -> bool:
+    """True if any expression uses an op the device whitelist excludes (the
+    runtime-blocklist analog of infer_pushdown.go IsPushDownEnabled)."""
     from ..expr.ir import EXTENSION_OPS, ScalarFunc
 
     HOST_ONLY = {
@@ -79,12 +78,18 @@ def _has_host_only_op(ex) -> bool:
             return any(walk(a) for a in e.args)
         return False
 
+    return any(walk(e) for e in exprs)
+
+
+def _has_host_only_op(ex) -> bool:
+    """Executor-level screen: keep Selection/Projection with host-only
+    expressions at root where the oracle fallback can evaluate them."""
     exprs = []
     if isinstance(ex, Selection):
         exprs = ex.conditions
     elif isinstance(ex, Projection):
         exprs = ex.exprs
-    return any(walk(e) for e in exprs)
+    return host_only_exprs(exprs)
 
 
 def split_dag(dag: DAGRequest) -> RootPlan:

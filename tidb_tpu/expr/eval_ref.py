@@ -317,6 +317,10 @@ class RefEvaluator:
 
     def _op_json_object(self, e, row):
         args = self._args(e, row)
+        if len(args) % 2 != 0:
+            raise ValueError(
+                "Incorrect parameter count in the call to native function 'json_object'"
+            )
         obj = {}
         for i in range(0, len(args), 2):
             k = args[i]

@@ -41,6 +41,8 @@ def mesh_eligible(dag: DAGRequest) -> bool:
     """Shape gate: TableScan [Selection]* Aggregation(GROUP BY) with
     exchange-safe aggregates and key types (ref: the reference's
     per-operator CanPushToTiFlash checks in exhaust_physical_plans)."""
+    from ..distsql.root import host_only_exprs
+
     exs = dag.executors
     if len(exs) < 2 or not isinstance(exs[0], TableScan):
         return False
@@ -52,6 +54,13 @@ def mesh_eligible(dag: DAGRequest) -> bool:
     for d in agg.aggs:
         if d.distinct or d.name == "group_concat":
             return False
+    # the device ExprCompiler cannot trace host-only ops (json_*, regexp,
+    # extensions) — the thread-pool path keeps them at root, so the mesh
+    # path must refuse them too rather than fail inside the shard_map trace
+    exprs = [c for e in exs[1:-1] for c in e.conditions]
+    exprs += list(agg.group_by) + [a for d in agg.aggs for a in d.args]
+    if host_only_exprs(exprs):
+        return False
     return True
 
 
@@ -100,7 +109,14 @@ def try_mesh_select(
     # reusing the already-scanned chunks rather than rescanning
     gc = group_capacity
     for _ in range(3):
-        chunk, overflow = run_sharded_grouped_agg(dag, stacked, mesh, group_capacity=gc)
+        try:
+            chunk, overflow = run_sharded_grouped_agg(dag, stacked, mesh, group_capacity=gc)
+        except NotImplementedError:
+            # an op the device compiler refuses slipped past the static
+            # gate: fall back to the per-region thread-pool path, which
+            # keeps host-only work at root (mirrors store.coprocessor's
+            # oracle fallback)
+            return None
         if not overflow:
             from ..util import metrics
 

@@ -423,31 +423,39 @@ class Parser:
             self.eat_kw("WITH") and self.expect_kw("ROLLUP")
         if self.eat_kw("HAVING"):
             having = self.expr()
+        named = {}
         if self.eat_kw("WINDOW"):
-            # named windows: WINDOW w AS (spec)[, ...] — patch the OVER w
-            # references recorded while the select list parsed
-            named = {}
+            # named windows: WINDOW w AS (spec)[, ...]
             while True:
                 wname = self.ident().lower()
                 self.expect_kw("AS")
                 named[wname] = self.window_spec()
                 if not self.eat_op(","):
                     break
-            for wf, ref in self._named_window_refs:
-                if ref in named:
-                    part, order, frame = named[ref]
-                    wf.partition_by, wf.order_by, wf.has_frame = part, order, frame
-            self._named_window_refs = [
-                (wf, ref) for wf, ref in self._named_window_refs if ref not in named
-            ]
-        if len(self._named_window_refs) > _win_refs_start:
-            _, missing = self._named_window_refs[-1]
-            raise ParseError(f"Window {missing!r} is not defined")
         order_by = []
         if self.eat_kw("ORDER"):
             self.expect_kw("BY")
             order_by = self.by_list()
         limit = self.limit_clause() if self.at_kw("LIMIT") else None
+        # resolve OVER w references only AFTER ORDER BY/LIMIT parse: a
+        # window function in ORDER BY may legally name a WINDOW-clause
+        # window (MySQL window resolution is per query block, clause order
+        # notwithstanding)
+        if named:
+            # only THIS query block's refs (index >= _win_refs_start):
+            # a subquery inside ORDER BY parses while the outer refs are
+            # still pending, and windows are block-scoped in MySQL
+            mine = self._named_window_refs[_win_refs_start:]
+            for wf, ref in mine:
+                if ref in named:
+                    part, order, frame = named[ref]
+                    wf.partition_by, wf.order_by, wf.has_frame = part, order, frame
+            self._named_window_refs = self._named_window_refs[:_win_refs_start] + [
+                (wf, ref) for wf, ref in mine if ref not in named
+            ]
+        if len(self._named_window_refs) > _win_refs_start:
+            _, missing = self._named_window_refs[-1]
+            raise ParseError(f"Window {missing!r} is not defined")
         for_update = False
         if self.eat_kw("FOR"):
             self.expect_kw("UPDATE")

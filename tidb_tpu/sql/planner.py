@@ -311,12 +311,12 @@ class _Lowerer:
             return func("not", BOOL, e) if n.negated else e
         if isinstance(n, A.Between):
             x = rec(n.expr)
-            lo, hi = self._coerce_const(x, rec(n.low)), self._coerce_const(x, rec(n.high))
+            lo, hi = self._coerce_const(x, rec(n.low), "lt"), self._coerce_const(x, rec(n.high), "lt")
             e = func("between", BOOL, x, lo, hi)
             return func("not", BOOL, e) if n.negated else e
         if isinstance(n, A.InList):
             x = rec(n.expr)
-            items = [self._coerce_const(x, rec(i)) for i in n.items]
+            items = [self._coerce_const(x, rec(i), "in") for i in n.items]
             e = func("in", BOOL, x, *items)
             return func("not", BOOL, e) if n.negated else e
         if isinstance(n, A.Like):
@@ -491,18 +491,18 @@ class _Lowerer:
 
     def _binary(self, op: str, l: Expr, r: Expr) -> Expr:
         if op in _CMP_OPS:
-            l, r = self._coerce_pair(l, r)
+            l, r = self._coerce_pair(l, r, op)
             return func(op, BOOL, l, r)
         if op in _LOGIC_OPS:
             return func(op, BOOL, l, r)
         ft = _arith_ft(op, l.ft, r.ft)
         return func(op, ft, l, r)
 
-    def _coerce_pair(self, l: Expr, r: Expr):
-        return self._coerce_const(r, l), self._coerce_const(l, r)
+    def _coerce_pair(self, l: Expr, r: Expr, op: str = "eq"):
+        return self._coerce_const(r, l, op), self._coerce_const(l, r, op)
 
     @staticmethod
-    def _coerce_const(target: Expr, e: Expr) -> Expr:
+    def _coerce_const(target: Expr, e: Expr, op: str = "eq") -> Expr:
         """String literals compared with time columns re-parse as datetime
         consts; with ENUM/SET columns they become member numbers (MySQL
         implicit coercion; ref: types/enum.go ParseEnumName)."""
@@ -524,9 +524,16 @@ class _Lowerer:
             try:
                 d = _coerce_datum(e.datum, target.ft)
             except PlanError:
-                # non-member literal COMPARES as match-nothing (MySQL:
-                # strictness belongs to the insert cast, not predicates)
-                return Const(Datum.i64(-1), new_longlong())
+                # non-member literal: the -1 sentinel is match-nothing only
+                # under (in)equality (member numbers are >= 1, so eq/in
+                # never match and ne matches every non-NULL row); ordering
+                # against it would invert range predicates, so raise there
+                if op in ("eq", "ne", "nulleq", "in"):
+                    return Const(Datum.i64(-1), new_longlong())
+                raise PlanError(
+                    f"cannot order {target.ft.tp.name} column against "
+                    f"non-member literal {e.datum.val!r}"
+                ) from None
             return Const(Datum.u64(int(d.val)), new_longlong(unsigned=True))
         return e
 

@@ -163,6 +163,21 @@ class Session:
         fresh TSO tick (ref: sessiontxn isolation providers)."""
         return self.txn.start_ts if self.txn is not None else self.store.next_ts()
 
+    def _pin_read_ts(self) -> int:
+        """_read_ts, registered against GC for the statement's duration so a
+        background run_gc tick cannot collect the version this read is
+        looking at mid-statement (ref: gc_worker.go
+        calcSafePointByMinStartTS — the safepoint honors every active
+        operation, not only explicit txns). Pair with _unpin_read_ts."""
+        ts = self._read_ts()
+        if self.txn is None:
+            self.store.register_snapshot(ts)
+        return ts
+
+    def _unpin_read_ts(self, ts: int) -> None:
+        if self.txn is None or self.txn.start_ts != ts:
+            self.store.unregister_snapshot(ts)
+
     # ---------------------------------------------------------------- txn
     def _begin(self, explicit: bool = True):
         self.txn = TxnState(
@@ -677,7 +692,7 @@ class Session:
         from ..util.memory import MemTracker, QuotaExceeded
 
         plan = plan_select(stmt, self.catalog, mat=rw.mat_dict())
-        ts = self._read_ts()
+        ts = self._pin_read_ts()
         # OOM action chain (ref: util/memory tracker actions): first evict
         # the store's reclaimable chunk/batch caches; a second breach is
         # handled below by degrading to the low-memory execution path
@@ -779,6 +794,7 @@ class Session:
             raise SQLError(str(exc)) from exc
         finally:
             tracker.release_all()
+            self._unpin_read_ts(ts)
         rows = chunk.rows()
         if plan.offset:
             rows = rows[plan.offset :]
@@ -1495,12 +1511,15 @@ class Session:
             e = f.expr if isinstance(f, A.SelectField) else f
             if not isinstance(e, A.Star) and (_has_agg(e) or _has_window(e)):
                 return None
-        ts = self._read_ts()
-        rows = []
-        for h in handles:
-            row = self._read_row(meta, h, ts)
-            if row is not None:
-                rows.append(row)
+        ts = self._pin_read_ts()
+        try:
+            rows = []
+            for h in handles:
+                row = self._read_row(meta, h, ts)
+                if row is not None:
+                    rows.append(row)
+        finally:
+            self._unpin_read_ts(ts)
         scope = _Scope([_TableRef(meta, alias, 0)])
         lw = _Lowerer(scope)
         ev = RefEvaluator()
