@@ -288,3 +288,40 @@ def test_q3_through_distsql_broadcast():
     want = sorted(str(r[0].val) for r in ordered)
     assert final.num_rows() == len(ordered)
     assert got == want, f"\ngot ={got}\nwant={want}"
+
+
+def test_planner_marks_pk_build_unique():
+    """Joins whose build keys are the build table's PK handle (or a unique
+    index) carry build_unique=True; non-unique keys do not."""
+    from tidb_tpu.exec.dag import Join
+    from tidb_tpu.sql import Session
+
+    s = Session()
+    s.execute("create table orders (o_id bigint primary key, o_cust bigint)")
+    s.execute("create table lineitem (l_id bigint primary key, l_oid bigint, qty bigint)")
+    s.execute("create table tags (t bigint, name varchar(10))")
+    s.execute("create unique index uq_t on tags (t)")
+    s.execute("insert into orders values (1, 10), (2, 20)")
+    s.execute("insert into lineitem values (1, 1, 5), (2, 1, 7), (3, 2, 9)")
+    s.execute("insert into tags values (10, 'a'), (20, 'b')")
+
+    from tidb_tpu.parser import parse_one
+    from tidb_tpu.sql.planner import plan_select
+
+    def joins_of(sql):
+        plan = plan_select(parse_one(sql), s.catalog)
+        return [e for e in plan.dag.executors if isinstance(e, Join)]
+
+    js = joins_of("select count(*) from lineitem, orders where l_oid = o_id")
+    assert len(js) == 1 and js[0].build_unique  # PK handle build key
+    js = joins_of("select count(*) from orders, tags where o_cust = t")
+    assert len(js) == 1 and js[0].build_unique  # unique index build key
+    # self-join on a NON-unique column: neither side's key is unique
+    js = joins_of("select count(*) from lineitem a, lineitem b where a.l_oid = b.l_oid")
+    assert len(js) == 1 and not js[0].build_unique
+
+    # end-to-end result through the unique fast path
+    r = s.execute(
+        "select o_id, sum(qty) from lineitem join orders on l_oid = o_id group by o_id order by o_id"
+    )
+    assert [(int(x[0].val), int(str(x[1].val))) for x in r.rows] == [(1, 12), (2, 9)]

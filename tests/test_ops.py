@@ -256,6 +256,56 @@ class TestHashJoin:
         # anti: non-matching incl. NULL lhs? MySQL NOT IN with NULL rhs absent here -> NULL key rows dropped...
         assert av.tolist() == [True, False, True, False, True, True]
 
+    def test_build_unique_fast_path(self):
+        """Unique-build hint: expansion-free probe-layout output equals the
+        general kernel on unique build keys; a duplicate build key flips
+        overflow instead of emitting wrong rows."""
+        rng = np.random.default_rng(12)
+        fts = [new_longlong()]
+        lrows = [[Datum.NULL if rng.random() < 0.1 else Datum.i64(int(rng.integers(0, 30)))] for _ in range(50)]
+        rrows = [[Datum.i64(v)] for v in rng.permutation(24)[:16]]  # unique
+        lch, rch = Chunk.from_rows(fts, lrows), Chunk.from_rows(fts, rrows)
+        ldb, lvals = eval_vals(fts, lch, [col(0, fts[0])])
+        rdb, rvals = eval_vals(fts, rch, [col(0, fts[0])])
+        for jt in ("inner", "left_outer"):
+            res = hash_join(rvals, lvals, rdb.row_valid, ldb.row_valid, 256, jt, build_unique=True)
+            assert not bool(res.overflow), jt
+            got = []
+            pv, bv, bn, ov = (np.asarray(x) for x in (res.probe_idx, res.build_idx, res.build_null, res.out_valid))
+            for s in range(len(ov)):
+                if ov[s]:
+                    got.append((int(pv[s]), None if bn[s] else int(bv[s])))
+            got.sort(key=lambda t: (t[0], -1 if t[1] is None else t[1]))
+            want = self._join_oracle(lrows, rrows, 0, 0, jt)
+            assert got == want, jt
+        # violated hint: duplicate build keys -> overflow, driver falls back
+        rrows_dup = rrows + [rrows[0]]
+        rch2 = Chunk.from_rows(fts, rrows_dup)
+        rdb2, rvals2 = eval_vals(fts, rch2, [col(0, fts[0])])
+        res = hash_join(rvals2, lvals, rdb2.row_valid, ldb.row_valid, 256, "inner", build_unique=True)
+        assert bool(res.overflow)
+
+    def test_build_unique_multiword_keys(self):
+        """Unique path over composite (hashed) keys, incl. collision checks."""
+        fts = [new_longlong(), new_varchar(8)]
+        rng = np.random.default_rng(13)
+        rrows = [[Datum.i64(i), Datum.string(f"k{i}")] for i in range(12)]
+        lrows = [[Datum.i64(int(rng.integers(0, 15))), Datum.string(f"k{int(rng.integers(0, 15))}")] for _ in range(40)]
+        lrows = [[r[0], Datum.string("k" + str(r[0].val))] for r in lrows]  # aligned pairs
+        lch, rch = Chunk.from_rows(fts, lrows), Chunk.from_rows(fts, rrows)
+        ldb, lvals = eval_vals(fts, lch, [col(0, fts[0]), col(1, fts[1])])
+        rdb, rvals = eval_vals(fts, rch, [col(0, fts[0]), col(1, fts[1])])
+        res = hash_join(rvals, lvals, rdb.row_valid, ldb.row_valid, 128, "inner", build_unique=True)
+        assert not bool(res.overflow)
+        pv, bv, ov = (np.asarray(x) for x in (res.probe_idx, res.build_idx, res.out_valid))
+        got = sorted((int(pv[s]), int(bv[s])) for s in range(len(ov)) if ov[s])
+        want = []
+        for i, lr in enumerate(lrows):
+            for j, rr in enumerate(rrows):
+                if lr[0].val == rr[0].val and lr[1].val == rr[1].val:
+                    want.append((i, j))
+        assert got == sorted(want)
+
     def test_multiword_string_key_join(self):
         fts = [new_varchar(20)]
         import random
@@ -375,6 +425,30 @@ class TestDenseSmallG:
                     else:
                         assert jnp.array_equal(rv[:ng], dv[:ng])
                     assert jnp.array_equal(rn[:ng], dn[:ng])
+
+    def test_dense_sample_missed_group_overflows(self):
+        """A group invisible to the strided extraction sample must raise
+        overflow, never silently merge/drop (dense kernel exactness check
+        #1 — every valid row's hash must be a table entry)."""
+        import numpy as np
+
+        from tidb_tpu.chunk import Chunk, to_device_batch
+        from tidb_tpu.expr import col
+        from tidb_tpu.expr.agg import AggDesc
+        from tidb_tpu.expr.compile import normalize_device_column
+        from tidb_tpu.ops.aggregate import group_aggregate
+        from tidb_tpu.types import Datum, new_longlong
+
+        ft = new_longlong()
+        n = 8192  # stride = n // 4096 = 2: the sample sees even indices only
+        vals = np.zeros(n, np.int64)
+        vals[1] = 77  # a whole group living ONLY at an odd index
+        rows = [[Datum.i64(int(v))] for v in vals]
+        ch = Chunk.from_rows([ft], rows)
+        db = to_device_batch(ch, capacity=n)
+        g = normalize_device_column(db.cols[0])
+        res = group_aggregate([g], [(AggDesc("count", ()), [])], db.row_valid, 64, small_groups=8)
+        assert bool(res.overflow)
 
     def test_dense_overflow_when_hint_wrong(self):
         """More groups than the hint -> overflow flag (driver falls back)."""

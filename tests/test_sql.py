@@ -305,3 +305,68 @@ class TestMeta:
         want_sum = {g: sum(i + 0.25 for i in range(200) if i % 5 == g) for g in range(5)}
         for g, _, sv in r.values():
             assert float(str(sv)) == pytest.approx(want_sum[g])
+
+
+class TestStaleReadAndSelectLimit:
+    def test_sql_select_limit_top_level_only(self):
+        """code-review r4: sql_select_limit must not leak into subqueries"""
+        from tidb_tpu.sql import Session
+
+        s = Session()
+        s.execute("create table sl (a bigint primary key)")
+        s.execute("insert into sl values (1),(2),(3),(4),(5)")
+        s.execute("set sql_select_limit = 2")
+        assert len(s.execute("select * from sl").rows) == 2
+        r = s.execute("select count(*) from (select * from sl) d")
+        assert int(r.rows[0][0].val) == 5
+        r = s.execute("select a from sl where a in (select a from sl) order by a")
+        assert len(r.rows) == 2  # top-level cap only; subquery saw all 5
+        r = s.execute("select a from sl union select a from sl")
+        assert len(r.rows) == 2
+        s.execute("set sql_select_limit = 18446744073709551615")
+        assert len(s.execute("select * from sl").rows) == 5
+
+    def test_tidb_snapshot_stale_read(self):
+        """tidb_snapshot: reads rewind to the TSO; writes rejected
+        (ref: pkg/sessiontxn/staleread)."""
+        import pytest
+
+        from tidb_tpu.sql import Session
+
+        s = Session()
+        s.execute("create table sr (id bigint primary key, v bigint)")
+        s.execute("insert into sr values (1, 10)")
+        ts = s.store.next_ts()
+        s.execute("update sr set v = 20 where id = 1")
+        s.execute(f"set tidb_snapshot = {ts}")
+        assert int(s.execute("select v from sr").rows[0][0].val) == 10
+        with pytest.raises(Exception, match="tidb_snapshot"):
+            s.execute("update sr set v = 30 where id = 1")
+        s.execute("set tidb_snapshot = ''")
+        assert int(s.execute("select v from sr").rows[0][0].val) == 20
+
+    def test_tidb_snapshot_rejects_begin_ddl_and_pre_gc_ts(self):
+        """code-review r4: stale-read mode must reject BEGIN and DDL, and a
+        snapshot at/below the GC safepoint must error, not return holes."""
+        import pytest
+
+        from tidb_tpu.sql import Session
+
+        s = Session()
+        s.execute("create table sg (id bigint primary key, v bigint)")
+        s.execute("insert into sg values (1, 10)")
+        old = s.store.next_ts()
+        s.execute("update sg set v = 20 where id = 1")
+        s.store.run_gc()  # collects v=10; safepoint recorded
+        s.execute(f"set tidb_snapshot = {old}")
+        with pytest.raises(Exception, match="GC safe point"):
+            s.execute("select v from sg")
+        fresh = s.store.next_ts()
+        s.execute(f"set tidb_snapshot = {fresh}")
+        with pytest.raises(Exception, match="tidb_snapshot"):
+            s.execute("begin")
+        with pytest.raises(Exception, match="tidb_snapshot"):
+            s.execute("create table nope (a bigint)")
+        s.execute("set tidb_snapshot = ''")
+        s.execute("begin")
+        s.execute("commit")

@@ -1,0 +1,98 @@
+"""Views: CREATE/DROP/query-through/SHOW CREATE/persistence (VERDICT r3
+missing #5; ref: pkg/planner/core/logical_plan_builder.go buildDataSource
+view branch, meta/model ViewInfo)."""
+
+import pytest
+
+from tidb_tpu.sql import Session
+
+
+def _mk():
+    s = Session()
+    s.execute("create table t (id bigint primary key, g varchar(8), v bigint)")
+    s.execute("insert into t values (1,'a',10),(2,'b',20),(3,'a',30),(4,'c',40)")
+    return s
+
+
+class TestViews:
+    def test_create_and_query(self):
+        s = _mk()
+        s.execute("create view va as select g, sum(v) as total from t group by g")
+        r = s.execute("select g, total from va order by g")
+        assert [(str(x[0].val), int(str(x[1].val))) for x in r.rows] == [
+            ("a", 40), ("b", 20), ("c", 40)]
+        # views join with tables
+        r = s.execute("select t.id from t join va on t.g = va.g where va.total > 30 order by t.id")
+        assert [int(x[0].val) for x in r.rows] == [1, 3, 4]
+
+    def test_view_with_column_list(self):
+        s = _mk()
+        s.execute("create view vc (grp, cnt) as select g, count(*) from t group by g")
+        r = s.execute("select grp, cnt from vc order by grp")
+        assert [(str(x[0].val), int(x[1].val)) for x in r.rows] == [("a", 2), ("b", 1), ("c", 1)]
+
+    def test_view_over_view(self):
+        s = _mk()
+        s.execute("create view v1 as select id, v from t where v >= 20")
+        s.execute("create view v2 as select id from v1 where v < 40")
+        r = s.execute("select * from v2 order by id")
+        assert [int(x[0].val) for x in r.rows] == [2, 3]
+
+    def test_show_create_view_and_show_tables(self):
+        s = _mk()
+        s.execute("create view va as select id from t")
+        r = s.execute("show create view va")
+        assert r.columns == ["View", "Create View"]
+        assert "select id from t" in str(r.rows[0][1].val)
+        names = [str(x[0].val) for x in s.execute("show tables").rows]
+        assert "va" in names and "t" in names
+
+    def test_or_replace_and_drop(self):
+        s = _mk()
+        s.execute("create view va as select id from t")
+        with pytest.raises(Exception):
+            s.execute("create view va as select v from t")
+        s.execute("create or replace view va as select v from t")
+        r = s.execute("select * from va order by v")
+        assert int(r.rows[0][0].val) == 10
+        s.execute("drop view va")
+        with pytest.raises(Exception):
+            s.execute("select * from va")
+        s.execute("drop view if exists va")
+
+    def test_view_sees_current_data(self):
+        s = _mk()
+        s.execute("create view va as select count(*) as n from t")
+        assert int(s.execute("select n from va").rows[0][0].val) == 4
+        s.execute("insert into t values (5,'d',50)")
+        assert int(s.execute("select n from va").rows[0][0].val) == 5
+
+    def test_view_name_clashes(self):
+        s = _mk()
+        s.execute("create view va as select id from t")
+        with pytest.raises(Exception):
+            s.execute("create table va (x bigint)")
+        with pytest.raises(Exception):
+            s.execute("drop table va")  # it's a view
+        with pytest.raises(Exception):
+            s.execute("create view t as select 1")  # t is a table
+
+    def test_create_view_validates_body(self):
+        s = _mk()
+        with pytest.raises(Exception):
+            s.execute("create view bad as select nosuchcol from t")
+        with pytest.raises(Exception):
+            s.execute("create view bad (a, b) as select id from t")  # arity
+
+    def test_view_survives_restart(self):
+        s = _mk()
+        s.execute("create view va as select id from t where v > 15")
+        s2 = Session(store=s.store)
+        r = s2.execute("select * from va order by id")
+        assert [int(x[0].val) for x in r.rows] == [2, 3, 4]
+
+    def test_cte_shadows_view(self):
+        s = _mk()
+        s.execute("create view va as select id from t")
+        r = s.execute("with va as (select 99 as id) select id from va")
+        assert [int(x[0].val) for x in r.rows] == [99]

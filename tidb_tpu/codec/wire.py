@@ -322,6 +322,7 @@ def w_executor(w: Writer, ex):
     elif isinstance(ex, Join):
         w.u8(_EX_JOIN)
         w.s(ex.join_type)
+        w.bool_(ex.build_unique)
         w.i32(len(ex.build))
         for b in ex.build:
             w_executor(w, b)
@@ -370,11 +371,12 @@ def r_executor(r: Reader):
         return Sort(tuple((r_expr(r), r.bool_()) for _ in range(r.i32())))
     if tag == _EX_JOIN:
         jt = r.s()
+        bu = r.bool_()
         build = tuple(r_executor(r) for _ in range(r.i32()))
         nk = r.i32()
         pks = tuple(r_expr(r) for _ in range(nk))
         bks = tuple(r_expr(r) for _ in range(nk))
-        return Join(build, pks, bks, jt)
+        return Join(build, pks, bks, jt, build_unique=bu)
     raise ValueError(f"bad executor tag {tag}")
 
 

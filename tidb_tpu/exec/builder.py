@@ -67,7 +67,82 @@ class _TraceState:
         self.ex_rows: list = []
 
 
-def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, state: _TraceState, topn_full: bool = False, small_groups: int | None = None):
+def _used_cols_after(rest, width: int, out_offsets):
+    """Column indexes < width referenced by the remaining executors (or by
+    the DAG outputs when the schema survives to the end) — the builder's
+    column-pruning analog of the reference's columnPruner rule
+    (pkg/planner/core/rule_column_pruning.go), applied at join output where
+    every live column costs a ~16ns/row random gather on TPU.
+
+    Schema-REPLACING executors (Projection/Aggregation) consume their
+    inputs and cut the walk; schema-EXTENDING ones (Join, Window) preserve
+    the prefix, so later references < width still mean these columns."""
+    from ..expr.ir import ColumnRef, ScalarFunc
+
+    used: set = set()
+
+    def collect(e):
+        if isinstance(e, ColumnRef):
+            if e.index < width:
+                used.add(e.index)
+        elif isinstance(e, ScalarFunc):
+            for a in e.args:
+                collect(a)
+
+    for ex in rest:
+        if isinstance(ex, Selection):
+            for c in ex.conditions:
+                collect(c)
+        elif isinstance(ex, (TopN, Sort)):
+            for e, _ in ex.order_by:
+                collect(e)
+        elif isinstance(ex, Limit):
+            pass
+        elif isinstance(ex, Window):
+            for e in ex.partition_by:
+                collect(e)
+            for e, _ in ex.order_by:
+                collect(e)
+            for w in ex.funcs:
+                for a in w.args:
+                    collect(a)
+                if w.default is not None:
+                    collect(w.default)
+        elif isinstance(ex, Join):
+            for e in ex.probe_keys:
+                collect(e)
+        elif isinstance(ex, Projection):
+            for e in ex.exprs:
+                collect(e)
+            return used
+        elif isinstance(ex, Aggregation):
+            for e in ex.group_by:
+                collect(e)
+            for d in ex.aggs:
+                for a in d.args:
+                    collect(a)
+            return used
+    if out_offsets is None:
+        return set(range(width))
+    used.update(o for o in out_offsets if o < width)
+    return used
+
+
+def _gather_pruned(cols: list, idx, used: set, base: int) -> list:
+    """Gather only the live columns; dead slots get an all-NULL zero column
+    (schema positions preserved, no HBM traffic)."""
+    n = idx.shape[0]
+    out = []
+    for j, c in enumerate(cols):
+        if (base + j) in used:
+            out.append(_gather([c], idx)[0])
+        else:
+            v = jnp.zeros((n,) + c.value.shape[1:], c.value.dtype)
+            out.append(CompVal(v, jnp.ones(n, bool), c.ft))
+    return out
+
+
+def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, state: _TraceState, topn_full: bool = False, small_groups: int | None = None, unique_joins: bool = True, out_offsets=None):
     """Trace one executor pipeline; recursion handles Join build sides.
 
     batches are consumed in canonical scan order (dag.collect_scans);
@@ -83,7 +158,8 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
     # exec summaries — ref: tipb.ExecutorExecutionSummary NumProducedRows)
     state.ex_rows.append(batch.n_rows.astype(jnp.int64))
 
-    for ex in executors[1:]:
+    for ei in range(1, len(executors)):
+        ex = executors[ei]
         comp = ExprCompiler(fts)
         if isinstance(ex, Selection):
             conds = comp.run(list(ex.conditions), cols)
@@ -110,20 +186,25 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
             cols = _gather(cols, idx)
             valid = out_valid
         elif isinstance(ex, Join):
-            bcols, bvalid, bfts = _run_pipeline(ex.build, batches, cursor, group_capacity, join_capacity, state, topn_full, small_groups)
+            bcols, bvalid, bfts = _run_pipeline(ex.build, batches, cursor, group_capacity, join_capacity, state, topn_full, small_groups, unique_joins)
             bcomp = ExprCompiler(bfts)
             bkeys = bcomp.run(list(ex.build_keys), bcols)
             pkeys = comp.run(list(ex.probe_keys), cols)
             _check_join_key_types(pkeys, bkeys)
-            res = hash_join(bkeys, pkeys, bvalid, valid, join_capacity, ex.join_type)
+            res = hash_join(bkeys, pkeys, bvalid, valid, join_capacity, ex.join_type,
+                            build_unique=ex.build_unique and unique_joins)
             state.join_overflow = state.join_overflow | res.overflow
             if ex.join_type in ("semi", "anti"):
                 # probe schema preserved, rows filtered by match-existence
                 valid = res.out_valid
             else:
                 nb = bvalid.shape[0]
-                p_g = _gather(cols, res.probe_idx)
-                b_g = _gather(bcols, jnp.clip(res.build_idx, 0, nb - 1))
+                used = _used_cols_after(executors[ei + 1:], len(fts) + len(bfts), out_offsets)
+                if res.probe_identity:
+                    p_g = cols  # unique-build layout: slot j == probe row j
+                else:
+                    p_g = _gather_pruned(cols, res.probe_idx, used, 0)
+                b_g = _gather_pruned(bcols, jnp.clip(res.build_idx, 0, nb - 1), used, len(fts))
                 b_g = [CompVal(c.value, c.null | res.build_null, c.ft, raw=c.raw) for c in b_g]
                 cols = p_g + b_g
                 valid = res.out_valid
@@ -201,6 +282,7 @@ def build_program(
     join_capacity: int | None = None,
     topn_full: bool = False,
     small_groups: int | None = None,
+    unique_joins: bool = True,
 ) -> CompiledDAG:
     """Compile the whole DAG tree (probe pipeline + all join build
     pipelines) into one fused XLA program over a tuple of device batches."""
@@ -214,7 +296,7 @@ def build_program(
     def program(*batches):
         state = _TraceState()
         cursor = [0]
-        cols, valid, _ = _run_pipeline(dag.executors, batches, cursor, group_capacity, join_capacity, state, topn_full, small_groups)
+        cols, valid, _ = _run_pipeline(dag.executors, batches, cursor, group_capacity, join_capacity, state, topn_full, small_groups, unique_joins, out_offsets=dag.output_offsets)
         outs = [cols[i] for i in dag.output_offsets]
         packed = []
         for c in outs:
@@ -264,17 +346,18 @@ class ProgramCache:
         join_capacity: int | None = None,
         topn_full: bool = False,
         small_groups: int | None = None,
+        unique_joins: bool = True,
     ) -> CompiledDAG:
         if isinstance(capacities, int):
             capacities = (capacities,)
         capacities = tuple(capacities)
-        key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full, small_groups)
+        key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins)
         prog = self._cache.get(key)
         if prog is None:
             from ..util import metrics
 
             metrics.PROGRAM_COMPILES.inc()
-            prog = build_program(dag, capacities, group_capacity, join_capacity, topn_full, small_groups)
+            prog = build_program(dag, capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins)
             self._cache[key] = prog
         return prog
 

@@ -141,6 +141,12 @@ class Join:
     probe_keys: tuple  # tuple[Expr, ...] over the probe schema
     build_keys: tuple  # tuple[Expr, ...] over the build schema
     join_type: str = "inner"  # inner | left_outer | semi | anti
+    # planner-proven: build keys are unique per build row (PK handle or a
+    # unique index covering exactly the key columns). The kernel then skips
+    # the fan-out expansion pass (output keeps the probe layout); runtime-
+    # verified — a fan-out > 1 raises join overflow and the driver retries
+    # with the general kernel (ref: hash_join_v2.go one-row-per-key layout).
+    build_unique: bool = False
 
     def __post_init__(self):
         if self.join_type not in ("inner", "left_outer", "semi", "anti"):
@@ -150,7 +156,7 @@ class Join:
 
     def fingerprint(self):
         return (
-            ("join", self.join_type)
+            ("join", self.join_type, self.build_unique)
             + tuple(e.fingerprint() for e in self.build)
             + ("pk",) + tuple(k.fingerprint() for k in self.probe_keys)
             + ("bk",) + tuple(k.fingerprint() for k in self.build_keys)

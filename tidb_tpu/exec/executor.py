@@ -94,8 +94,9 @@ def drive_program(cache: ProgramCache, dag: DAGRequest, batches, group_capacity:
     jc = join_capacity or max(caps)
     tf = False
     smg = small_groups
+    uj = True
     for _ in range(max_retries + 1):
-        prog = cache.get(dag, caps, gc, jc, tf, smg)
+        prog = cache.get(dag, caps, gc, jc, tf, smg, uj)
         packed, valid, n, (g_ovf, j_ovf, t_ovf), ex_rows = prog.fn(*batches)
         g_ovf, j_ovf, t_ovf = bool(g_ovf), bool(j_ovf), bool(t_ovf)
         if not g_ovf and not j_ovf and not t_ovf:
@@ -109,6 +110,10 @@ def drive_program(cache: ProgramCache, dag: DAGRequest, batches, group_capacity:
             smg = None
             gc *= 4
         if j_ovf:
+            # join overflow can mean out-capacity, a violated unique-build
+            # hint, or a hash collision: grow capacity (which also re-salts
+            # the hash) AND drop the unique hint in the same retry
+            uj = False
             jc *= 4
         if t_ovf:
             tf = True  # TopN candidate overflow: exact full-sort variant

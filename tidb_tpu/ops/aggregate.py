@@ -386,11 +386,16 @@ def _dense_eligible(aggs, merge) -> bool:
 
 
 def _group_aggregate_dense(group_bys, aggs, row_valid, g_cap: int, merge: bool):
-    """Sort-free small-G aggregation (see seg.DenseCtx): g_cap min-reduction
-    rounds extract the distinct group hashes, g_cap compares assign dense
-    ids, and states are masked full-array reductions. Overflow (more groups
-    than g_cap, or a hash collision caught by the second-hash consistency
-    check) sends the driver to the sort kernel."""
+    """Sort-free small-G aggregation (see seg.DenseCtx).
+
+    The distinct-hash table is extracted from a strided SAMPLE (serial
+    min-extraction over 4M rows costs 2*g_cap full passes; over a 4K sample
+    it is free), then two single-pass checks make the result exact:
+    every valid row's hash must be IN the table (catches groups the sample
+    missed) and the secondary hash must be constant within a slot (catches
+    true hash collisions). Either failure, or more distinct hashes than
+    g_cap, raises the overflow flag and the driver falls back to the sort
+    kernel — the same contract a wrong NDV hint always had."""
     n = row_valid.shape[0]
     keys: list[jax.Array] = []
     for g in group_bys:
@@ -398,7 +403,8 @@ def _group_aggregate_dense(group_bys, aggs, row_valid, g_cap: int, merge: bool):
     hp = group_hash(keys, row_valid, salt=g_cap)
     hv = hash_words(keys, g_cap + 0x9E3779B9)
 
-    cur = hp
+    stride = max(n // 4096, 1)
+    cur = hp[::stride]
     tbl = []
     for _ in range(g_cap):
         m = jnp.min(cur)
@@ -408,23 +414,23 @@ def _group_aggregate_dense(group_bys, aggs, row_valid, g_cap: int, merge: bool):
     tbl_arr = jnp.stack(tbl)
     n_groups = (tbl_arr != I64_MAX).sum().astype(jnp.int32)
 
-    gid = jnp.zeros(n, jnp.int32)
-    for t in tbl:
-        gid = gid + (hp > t).astype(jnp.int32)
+    gid = jnp.sum((hp[:, None] > tbl_arr[None, :]).astype(jnp.int32), axis=1)
     nseg = g_cap + 1
-    masks = [gid == i for i in range(nseg)]
-    ctx = DenseCtx(gid=gid, nseg=nseg, masks=masks)
+    ctx = DenseCtx(gid=gid, nseg=nseg)
 
-    # collision check: the secondary hash must be constant within a group.
-    # Invalid (filtered) rows share the slot right after the last real
-    # group — mask them out, their hv is unrelated.
-    coll = jnp.bool_(False)
-    for i in range(g_cap):
-        vm = masks[i] & row_valid
-        mx = jnp.max(jnp.where(vm, hv, I64_MIN_))
-        mn = jnp.min(jnp.where(vm, hv, I64_MAX))
-        coll = coll | ((vm.sum() > 0) & (mx != mn))
-    overflow = overflow | coll
+    # exactness check 1: every valid row's hash is a table entry (a group
+    # the sample missed would otherwise silently merge into a neighbor slot
+    # or vanish in the invalid slot)
+    in_tbl = jnp.any(hp[:, None] == tbl_arr[None, :], axis=1)
+    overflow = overflow | jnp.any(row_valid & ~in_tbl)
+    # exactness check 2: the secondary hash is constant within each slot
+    # (different keys, equal primary hash)
+    from .seg import _dense_mask
+
+    vm = _dense_mask(ctx) & row_valid[:, None]
+    mx = jnp.max(jnp.where(vm, hv[:, None], I64_MIN_), axis=0)
+    mn = jnp.min(jnp.where(vm, hv[:, None], I64_MAX), axis=0)
+    overflow = overflow | jnp.any((mx != mn) & (mx != I64_MIN_))
 
     group_rep_full, _ = seg_first_match(ctx, row_valid)
     group_rep = group_rep_full[:g_cap]

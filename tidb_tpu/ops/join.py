@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from ..expr.compile import CompVal
 from .keys import lexsort, sort_key_arrays
-from .seg import I64_MAX, MAX63, hash_words, run_head_pos, sort_by_word
+from .seg import I64_MAX, MAX63, hash_words, merge_searchsorted, run_head_pos, sort_by_word
 
 
 @dataclass
@@ -40,7 +40,9 @@ class JoinResult:
 
     build_idx/probe_idx: int32 [out_capacity] row indices into the original
     batches; for outer-join null-extended rows, build_idx slot is -1 and
-    build_null True.
+    build_null True. probe_identity=True means probe_idx is the identity
+    (unique-build layout): the builder skips the probe-side gathers, which
+    at ~16ns/row/column are the dominant join cost on TPU.
     """
 
     probe_idx: jax.Array
@@ -49,6 +51,38 @@ class JoinResult:
     out_valid: jax.Array
     n_out: jax.Array
     overflow: jax.Array
+    probe_identity: bool = False
+
+
+def merge_lo_hi(sorted_hay, hay_counted, queries):
+    """(lo, hi) match extents of every query against the counted hay rows
+    — the equi-join probe — with ZERO [N]-sized random gathers (each costs
+    ~16ns/row on TPU, the dominant join cost before this).
+
+    ONE merged 4-operand sort + cumsum gives the counted-hay prefix; each
+    equal-VALUE block's extents broadcast to all its elements by a forward
+    cummax (block-start prefix; prefixes are nondecreasing) and a reverse
+    cummin (block-end prefix); an inverse 3-operand sort returns (lo, hi)
+    in query order. lo..hi-1 index the counted prefix of the hay order.
+
+    hay_counted MUST occupy a prefix of the hay sort order (callers mask
+    unusable rows to the top sentinel with an unusable-last tiebreak)."""
+    nh, nq = sorted_hay.shape[0], queries.shape[0]
+    vals = jnp.concatenate([sorted_hay, queries])
+    # ties: queries first — a query's exclusive prefix excludes equal hay
+    order = jnp.concatenate([jnp.ones(nh, jnp.int32), jnp.zeros(nq, jnp.int32)])
+    cntf = jnp.concatenate([hay_counted.astype(jnp.int32), jnp.zeros(nq, jnp.int32)])
+    qidx = jnp.concatenate([jnp.full(nh, nq, jnp.int32), jnp.arange(nq, dtype=jnp.int32)])
+    sv, _, scnt, sq = jax.lax.sort((vals, order, cntf, qidx), num_keys=2)
+    cum = jnp.cumsum(scnt)  # counted hay at or before position (inclusive)
+    one = jnp.ones(1, bool)
+    diff = jnp.concatenate([one, sv[1:] != sv[:-1]])
+    lo_b = jax.lax.cummax(jnp.where(diff, cum - scnt, jnp.int32(-1)))
+    emark = jnp.concatenate([diff[1:], one])
+    hi_b = jax.lax.cummin(jnp.where(emark, cum, jnp.int32(nh + nq + 1))[::-1])[::-1]
+    # back to query order (hay rows carry qidx=nq and sort to the tail)
+    _, lo_q, hi_q = jax.lax.sort((sq, lo_b, hi_b), num_keys=1)
+    return lo_q[:nq], hi_q[:nq]
 
 
 def _key_matrix(vals: list[CompVal], valid):
@@ -69,12 +103,19 @@ def hash_join(
     probe_valid,
     out_capacity: int,
     join_type: str = "inner",
+    build_unique: bool = False,
 ):
-    """join_type: inner | left_outer (probe side preserved) | semi | anti."""
+    """join_type: inner | left_outer (probe side preserved) | semi | anti.
+
+    build_unique: planner-proven one-match-per-probe (build keys unique);
+    the output keeps the probe layout and the expansion pass is skipped.
+    Runtime-verified — fan-out > 1 raises the overflow flag."""
     bkeys, b_usable = _key_matrix(build_keys, build_valid)
     pkeys, p_usable = _key_matrix(probe_keys, probe_valid)
     nb = build_valid.shape[0]
+    np_ = probe_valid.shape[0]
     overflow = jnp.bool_(False)
+    nb_usable = b_usable.sum().astype(jnp.int32)
 
     if len(bkeys) == 1:
         # exact single-word path: sort on the key itself. Mask unusable
@@ -89,29 +130,29 @@ def hash_join(
         top = jnp.inf if jnp.issubdtype(bk.dtype, jnp.floating) else I64_MAX
         bk_m = jnp.where(b_usable, bk, top)
         bperm = lexsort([bk_m], extra_key=(~b_usable).astype(jnp.int64))
-        bk_s = bk_m[bperm]
-        nb_usable = b_usable.sum()
-        # method='sort': the merge formulation (sort queries with the
-        # haystack + cumsum) — the default binary search is ~17 serial
-        # gather rounds, ~18ms per 64K queries on TPU; the merge is one
-        # cheap variadic sort
-        lo = jnp.searchsorted(bk_s, pk, side="left", method="sort").astype(jnp.int32)
-        hi = jnp.searchsorted(bk_s, pk, side="right", method="sort").astype(jnp.int32)
-        hi = jnp.minimum(hi, nb_usable.astype(jnp.int32))
-        lo = jnp.minimum(lo, hi)
+        sorted_word = bk_m[bperm]
+        probe_word = pk
     else:
         # multi-word keys: one salted hash word per side; unusable rows pin
         # to the (odd, never-hashable) I64_MAX sentinel and sort last
         salt = out_capacity
         bh = jnp.where(b_usable, hash_words(bkeys, salt) & MAX63, I64_MAX)
         ph = jnp.where(p_usable, hash_words(pkeys, salt) & MAX63, I64_MAX)
-        bh_s, bperm = sort_by_word(bh)
-        lo = jnp.searchsorted(bh_s, ph, side="left", method="sort").astype(jnp.int32)
-        hi = jnp.searchsorted(bh_s, ph, side="right", method="sort").astype(jnp.int32)
-        lo = jnp.minimum(lo, hi)
+        sorted_word, bperm = sort_by_word(bh)
+        probe_word = ph
+
+    # usable rows occupy the sorted prefix (top-sentinel masking +
+    # unusable-last tiebreak), so the counted flag needs no gather
+    usable_sorted = jnp.arange(nb, dtype=jnp.int32) < nb_usable
+    lo, hi = merge_lo_hi(sorted_word, usable_sorted, probe_word)
+    lo_c = jnp.clip(lo, 0, nb - 1)
+    matched = (hi > lo) & p_usable
+    hi = jnp.where(matched, hi, lo)
+
+    if len(bkeys) > 1:
         # exactness check 1: every build hash run is internally uniform
         one = jnp.ones(1, bool)
-        diffb = jnp.concatenate([one, bh_s[1:] != bh_s[:-1]])
+        diffb = jnp.concatenate([one, sorted_word[1:] != sorted_word[:-1]])
         headb = run_head_pos(diffb)
         bcoll = jnp.zeros(nb, bool)
         for w in bkeys:
@@ -119,12 +160,11 @@ def hash_join(
             bcoll = bcoll | (ws != ws[headb])
         overflow = overflow | jnp.any(bcoll & b_usable[bperm])
         # exactness check 2: every hash-hit probe word-matches its run head
-        head_idx = bperm[jnp.clip(lo, 0, nb - 1)]
-        pmism = jnp.zeros(p_usable.shape[0], bool)
+        head_idx = bperm[lo_c]
+        pmism = jnp.zeros(np_, bool)
         for bw, pw in zip(bkeys, pkeys):
             pmism = pmism | (bw[head_idx] != pw)
-        hash_hit = p_usable & (hi > lo)
-        overflow = overflow | jnp.any(pmism & hash_hit)
+        overflow = overflow | jnp.any(pmism & matched)
 
     counts = jnp.where(p_usable, hi - lo, 0)
     matched = counts > 0
@@ -149,6 +189,26 @@ def hash_join(
             overflow=overflow,
         )
 
+    if build_unique and join_type in ("inner", "left_outer"):
+        # one-match-per-probe: output slot j IS probe row j — no prefix-sum
+        # expansion, no out-capacity searchsorted pass. Verified here: any
+        # run longer than one build row flips overflow and the driver
+        # recompiles with the general kernel.
+        overflow = overflow | jnp.any(counts > 1)
+        build_idx = bperm[lo_c].astype(jnp.int32)
+        out_valid = (probe_valid & matched) if join_type == "inner" else probe_valid
+        build_null = ~matched
+        build_idx = jnp.where(build_null, -1, build_idx)
+        return JoinResult(
+            probe_idx=jnp.arange(np_, dtype=jnp.int32),
+            build_idx=build_idx,
+            build_null=build_null & out_valid,
+            out_valid=out_valid,
+            n_out=out_valid.sum(),
+            overflow=overflow,
+            probe_identity=True,
+        )
+
     if join_type == "left_outer":
         counts = jnp.where(probe_valid, jnp.maximum(counts, 1), 0)
 
@@ -158,7 +218,7 @@ def hash_join(
 
     slot = jnp.arange(out_capacity)
     # which probe row does each output slot belong to
-    probe_of = jnp.searchsorted(offsets + counts, slot, side="right", method="sort").astype(jnp.int32)
+    probe_of = merge_searchsorted((offsets + counts).astype(jnp.int64), slot.astype(jnp.int64), side="right")
     probe_of = jnp.minimum(probe_of, probe_valid.shape[0] - 1)
     nth = slot - offsets[probe_of]
     b_sorted_pos = lo[probe_of] + nth.astype(jnp.int32)

@@ -259,6 +259,10 @@ class Join:
 class SelectField:
     expr: ExprNode
     alias: str = ""
+    # verbatim source text of the expression — MySQL titles unaliased
+    # expression columns with the text as written (ref: the reference's
+    # field name derivation in planner buildProjectionField)
+    source: str = ""
 
 
 @dataclass
@@ -613,6 +617,7 @@ class CreateViewStmt:
     columns: list
     select: object
     or_replace: bool = False
+    source: str = ""  # verbatim SELECT text (persisted as the view body)
 
 
 @dataclass

@@ -488,7 +488,10 @@ class Parser:
                 self.next()
                 return A.SelectField(A.Star(table=name), "")
             self.i = j
+        src_start = self.peek().pos
         e = self.expr()
+        src_end = self.peek().pos if self.peek().kind is not T.EOF else len(self.sql)
+        source = self.sql[src_start:src_end].strip()
         alias = ""
         if self.eat_kw("AS"):
             t = self.next()
@@ -498,7 +501,7 @@ class Parser:
                 raise ParseError(f"bad alias at {self._where()}")
         elif self.peek().kind in (T.IDENT, T.QIDENT) and self.peek().upper not in _RESERVED_AFTER_EXPR:
             alias = self.next().text
-        return A.SelectField(e, alias)
+        return A.SelectField(e, alias, source)
 
     def by_list(self) -> list:
         out = []
@@ -1432,12 +1435,15 @@ class Parser:
                     self.eat_op(",")
                 self.expect_op(")")
             self.expect_kw("AS")
+            sel_start = self.peek().pos
             sel = self.select_or_union()
+            sel_end = self.peek().pos if self.peek().kind is not T.EOF else len(self.sql)
+            source = self.sql[sel_start:sel_end].strip().rstrip(";").strip()
             if self.eat_kw("WITH"):
                 self.eat_kw("CASCADED") or self.eat_kw("LOCAL")
                 self.expect_kw("CHECK")
                 self.expect_kw("OPTION")
-            return A.CreateViewStmt(name, cols, sel, or_replace)
+            return A.CreateViewStmt(name, cols, sel, or_replace, source)
         if self.eat_kw("SEQUENCE"):
             ine = False
             if self.eat_kw("IF"):

@@ -148,6 +148,17 @@ class TableMeta:
             return v
 
 
+@dataclass
+class ViewMeta:
+    """A stored view: the SELECT text re-plans at every use (ref:
+    meta/model ViewInfo; expansion in logical_plan_builder.go's
+    buildDataSource view branch)."""
+
+    name: str
+    columns: list  # explicit column-name list ([] = from the SELECT)
+    select_sql: str
+
+
 class Catalog:
     """name -> TableMeta, with monotonically increasing table/index ids
     (ref: infoschema; ids from meta's global id allocator)."""
@@ -158,6 +169,7 @@ class Catalog:
         self._lock = threading.Lock()
         self.version = 0  # schema version (ref: domain schema lease)
         self.stats: dict[int, object] = {}  # table_id -> TableStats (ANALYZE)
+        self.views: dict[str, ViewMeta] = {}  # name -> view definition
         from .privilege import PrivilegeStore
 
         self.privileges = PrivilegeStore()  # domain-level user/priv cache
@@ -180,6 +192,8 @@ class Catalog:
     def create_table(self, stmt: A.CreateTableStmt) -> TableMeta:
         name = stmt.table.name.lower()
         with self._lock:
+            if name in self.views:
+                raise CatalogError(f"view {name!r} already exists")
             if name in self._tables:
                 if stmt.if_not_exists:
                     return self._tables[name]
@@ -241,11 +255,32 @@ class Catalog:
     def drop_table(self, name: str, if_exists: bool = False):
         with self._lock:
             if name.lower() not in self._tables:
+                if name.lower() in self.views:
+                    raise CatalogError(f"{name!r} is a VIEW (use DROP VIEW)")
                 if if_exists:
                     return
                 raise CatalogError(f"unknown table {name!r}")
             meta = self._tables.pop(name.lower())
             self.stats.pop(meta.table_id, None)
+            self.version += 1
+
+    def create_view(self, name: str, columns: list, select_sql: str, or_replace: bool = False):
+        n = name.lower()
+        with self._lock:
+            if n in self._tables:
+                raise CatalogError(f"table {name!r} already exists")
+            if n in self.views and not or_replace:
+                raise CatalogError(f"view {name!r} already exists")
+            self.views[n] = ViewMeta(n, [c.lower() for c in columns], select_sql)
+            self.version += 1
+
+    def drop_view(self, name: str, if_exists: bool = False):
+        with self._lock:
+            if name.lower() not in self.views:
+                if if_exists:
+                    return
+                raise CatalogError(f"unknown view {name!r}")
+            del self.views[name.lower()]
             self.version += 1
 
     def table_by_id(self, table_id: int) -> TableMeta | None:

@@ -175,7 +175,14 @@ def persist_catalog(store, catalog: Catalog) -> None:
         tid = struct.unpack(">q", k[len(M_TABLE_PREFIX):])[0]
         if tid not in live:
             store.kv.put(k, None, ts)
-    state = {"version": catalog.version, "next_id": catalog._next_id}
+    state = {
+        "version": catalog.version,
+        "next_id": catalog._next_id,
+        "views": {
+            v.name: {"columns": v.columns, "select": v.select_sql}
+            for v in catalog.views.values()
+        },
+    }
     store.kv.put(M_STATE_KEY, json.dumps(state).encode(), ts)
 
 
@@ -220,4 +227,8 @@ def load_catalog(store) -> Catalog | None:
         cat._tables[meta.name] = meta
     cat._next_id = max(state["next_id"], cat._next_id)
     cat.version = state["version"]
+    from .catalog import ViewMeta
+
+    for vn, vd in state.get("views", {}).items():
+        cat.views[vn] = ViewMeta(vn, vd["columns"], vd["select"])
     return cat

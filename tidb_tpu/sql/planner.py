@@ -904,13 +904,61 @@ def _referenced_columns(stmt: A.SelectStmt, meta: TableMeta) -> set:
 
 
 def _field_label(f: A.SelectField) -> str:
+    """MySQL column titles: alias > column name as written (unqualified,
+    quotes stripped) > the expression's verbatim source text (ref: field
+    name derivation in the reference's buildProjectionField)."""
     if f.alias:
         return f.alias
+    src = getattr(f, "source", "") or ""
     if isinstance(f.expr, A.ColumnName):
+        if src and "(" not in src:
+            if "`" in src:
+                # backquoted identifiers may CONTAIN dots: take the last
+                # quoted segment verbatim (`t`.`a.b` titles as a.b)
+                import re as _re
+
+                parts = _re.findall(r"`((?:[^`]|``)*)`", src)
+                if parts:
+                    return parts[-1].replace("``", "`")
+            return src.split(".")[-1].strip().strip("`") or f.expr.name
         return f.expr.name
+    if isinstance(f.expr, A.Literal) and f.expr.kind == "str" and src[:1] in ("'", '"'):
+        # MySQL titles a bare string literal with its VALUE, quotes gone
+        return str(f.expr.value)
+    if src:
+        # MySQL folds no-op unary + out of titles ('+1' -> '1',
+        # '+ "x"' -> 'x') but keeps mixed-sign prefixes ('+ - 1', '+-+1')
+        rest = src
+        while rest[:1] == "+":
+            rest = rest[1:].lstrip()
+        if rest != src and rest[:1] != "-":
+            if rest[:1] in ("'", '"') and len(rest) >= 2 and rest[-1] == rest[0]:
+                return rest[1:-1]
+            return rest
+        return src
     if isinstance(f.expr, A.AggFunc):
         return f"{f.expr.name}(...)"
     return "expr"
+
+
+def _build_keys_unique(meta, build_keys) -> bool:
+    """True when the build-side join keys are provably unique per build row
+    — the table's integer PK handle or a unique index covering exactly the
+    key columns. The kernel then skips the join fan-out expansion (dag.py
+    Join.build_unique; ref: hash_join_v2.go one-row-per-key row table).
+    Build pipelines here are scan[+selection], so key ColumnRef indexes map
+    straight onto meta.columns; filtering only removes rows, never breaks
+    uniqueness. Conservative: any non-bare-column key disqualifies."""
+    from ..expr.ir import ColumnRef
+
+    names = set()
+    for k in build_keys:
+        if not isinstance(k, ColumnRef) or k.index >= len(meta.columns):
+            return False
+        names.add(meta.columns[k.index].name)
+    if meta.handle_col is not None and names == {meta.handle_col}:
+        return True
+    return any(im.unique and set(im.col_names) == names for im in meta.indices)
 
 
 def _unify_join_key(pk: Expr, bk: Expr):
@@ -1218,6 +1266,7 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
                 probe_keys=tuple(probe_keys),
                 build_keys=tuple(build_keys),
                 join_type="left_outer" if kind == "left" else "inner",
+                build_unique=_build_keys_unique(meta, build_keys),
             )
         )
         placed.add(alias)

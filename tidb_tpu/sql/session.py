@@ -94,6 +94,28 @@ class SQLError(ValueError):
     pass
 
 
+def _show_like(stmt, name: str) -> bool:
+    """SHOW ... LIKE 'pattern' filter (MySQL LIKE: % any run, _ one char,
+    case-insensitive on identifier-ish names)."""
+    pat = getattr(stmt, "pattern", None)
+    if not pat:
+        return True
+    import re
+
+    rx = []
+    i = 0
+    while i < len(pat):
+        c = pat[i]
+        if c == "\\" and i + 1 < len(pat):
+            # MySQL LIKE escape: \% \_ \\ match the literal character
+            rx.append(re.escape(pat[i + 1]))
+            i += 2
+            continue
+        rx.append(".*" if c == "%" else "." if c == "_" else re.escape(c))
+        i += 1
+    return re.fullmatch("".join(rx), name, re.I) is not None
+
+
 def _referenced_tables(stmt) -> set:
     """Table names referenced anywhere in a statement (conservative walk:
     CTE names that shadow real catalog tables still show up and still get
@@ -159,9 +181,22 @@ class Session:
         return self.store.next_ts()
 
     def _read_ts(self) -> int:
-        """Snapshot ts: the open txn's start_ts (repeatable read), else a
-        fresh TSO tick (ref: sessiontxn isolation providers)."""
-        return self.txn.start_ts if self.txn is not None else self.store.next_ts()
+        """Snapshot ts: the open txn's start_ts (repeatable read), else
+        the tidb_snapshot stale-read ts when set (ref: sessiontxn/staleread
+        — reads rewind to a historical version), else a fresh TSO tick."""
+        if self.txn is not None:
+            return self.txn.start_ts
+        snap = self.sysvars.get("tidb_snapshot")
+        if snap:
+            ts = int(snap)
+            if ts <= getattr(self.store, "gc_safepoint", -1):
+                # ref: TiDB "snapshot is older than GC safe point" — GC may
+                # have collected the versions this read would need
+                raise SQLError(
+                    f"snapshot {ts} is older than GC safe point {self.store.gc_safepoint}"
+                )
+            return ts
+        return self.store.next_ts()
 
     def _pin_read_ts(self) -> int:
         """_read_ts, registered against GC for the statement's duration so a
@@ -180,6 +215,10 @@ class Session:
 
     # ---------------------------------------------------------------- txn
     def _begin(self, explicit: bool = True):
+        if self.sysvars.get("tidb_snapshot"):
+            # ref: TiDB rejects BEGIN in stale-read mode rather than let a
+            # fresh txn ts silently override the historical snapshot
+            raise SQLError("can not execute BEGIN when 'tidb_snapshot' is set")
         self.txn = TxnState(
             start_ts=self.store.next_ts(),
             mode=self.sysvars.get("tidb_txn_mode") or "pessimistic",
@@ -235,6 +274,10 @@ class Session:
         """Run a DML statement inside the open txn (with a statement
         savepoint: a failed statement buffers nothing), or wrap it in an
         implicit single-statement txn (autocommit -> immediate 2PC)."""
+        if self.sysvars.get("tidb_snapshot"):
+            # ref: sessiontxn/staleread — a historical read session is
+            # read-only until tidb_snapshot is cleared
+            raise SQLError("can not execute write statement when 'tidb_snapshot' is set")
         if self.txn is not None:
             sp = self.txn.savepoint()
             try:
@@ -252,7 +295,11 @@ class Session:
         return res
 
     def _implicit_commit(self):
-        """DDL commits any open transaction first (MySQL semantics)."""
+        """DDL commits any open transaction first (MySQL semantics); a
+        stale-read session (tidb_snapshot set) is read-only — DDL is
+        rejected like DML (ref: sessiontxn/staleread restrictions)."""
+        if self.sysvars.get("tidb_snapshot"):
+            raise SQLError("can not execute DDL when 'tidb_snapshot' is set")
         if self.txn is not None:
             self._commit()
 
@@ -341,7 +388,7 @@ class Session:
             return self._select(stmt)
         if isinstance(stmt, A.SetOprStmt):
             names, fts, rows = self._set_opr(stmt, None)
-            return Result(columns=names, rows=rows, fts=fts)
+            return Result(columns=names, rows=self._apply_select_limit(stmt, rows), fts=fts)
         if isinstance(stmt, A.CreateTableStmt):
             self._implicit_commit()
             self.catalog.create_table(stmt)
@@ -351,6 +398,44 @@ class Session:
             self._implicit_commit()
             for t in stmt.tables:
                 self.catalog.drop_table(t.name, stmt.if_exists)
+            self._persist_schema()
+            return Result()
+        if isinstance(stmt, A.CreateViewStmt):
+            self._implicit_commit()
+            if not stmt.source:
+                raise SQLError("CREATE VIEW requires a SELECT body")
+            # validate: the body must plan against the current schema, and
+            # an explicit column list must match the select-list arity
+            # (ref: ddl CreateView checking the underlying plan). Plan-only
+            # when possible — MySQL validates without executing; bodies the
+            # bare planner can't take (views/CTEs/subqueries inside) fall
+            # back to executing a LIMIT-0 wrapper.
+            names = None
+            body = parse_one(stmt.source)
+            if isinstance(body, A.SelectStmt):
+                try:
+                    from .planner import plan_select
+
+                    names = plan_select(body, self.catalog).column_names
+                except Exception:  # noqa: BLE001 — rewriter-dependent body
+                    names = None
+            if names is None:
+                inner = parse_one(stmt.source)
+                if getattr(inner, "limit", None) is None:
+                    inner.limit = A.Limit(A.Literal(0, "int"))
+                names, _, _ = self._run_select(inner, None) if isinstance(inner, A.SelectStmt) \
+                    else self._set_opr(inner, None)
+            if stmt.columns and len(stmt.columns) != len(names):
+                raise SQLError(
+                    f"view column list arity {len(stmt.columns)} != select list {len(names)}"
+                )
+            self.catalog.create_view(stmt.name.name, stmt.columns, stmt.source, stmt.or_replace)
+            self._persist_schema()
+            return Result()
+        if isinstance(stmt, A.DropViewStmt):
+            self._implicit_commit()
+            for t in stmt.names:
+                self.catalog.drop_view(t.name if hasattr(t, "name") else t, stmt.if_exists)
             self._persist_schema()
             return Result()
         if isinstance(stmt, A.TruncateTableStmt):
@@ -600,6 +685,12 @@ class Session:
         def to_literal(v: A.Variable) -> A.Literal:
             if v.system:
                 val = self.sysvars.get(v.name)
+                from .sysvar import is_bool
+
+                if is_bool(v.name):
+                    # SELECT @@x prints booleans numerically (SHOW keeps
+                    # ON/OFF) — MySQL/reference behavior
+                    val = 1 if val == "ON" else 0
             else:
                 val = self.user_vars.get(v.name.lower())
             return self._value_literal(val)
@@ -625,9 +716,17 @@ class Session:
                                 self._substitute_vars(x)
 
     # ------------------------------------------------------------------
+    def _apply_select_limit(self, stmt, rows):
+        """MySQL sql_select_limit caps TOP-LEVEL result sets only — never
+        subqueries/CTEs/views (those share _run_select recursively)."""
+        if getattr(stmt, "limit", None) is not None:
+            return rows
+        ssl = self.sysvars.get_int("sql_select_limit")
+        return rows[:ssl] if ssl < (1 << 64) - 1 else rows
+
     def _select(self, stmt: A.SelectStmt) -> Result:
         names, fts, rows = self._run_select(stmt, None)
-        return Result(columns=names, rows=rows, fts=fts)
+        return Result(columns=names, rows=self._apply_select_limit(stmt, rows), fts=fts)
 
     def _persist_schema(self) -> None:
         """Write the catalog into the store's m-prefix keyspace after a
@@ -673,7 +772,9 @@ class Session:
                 ev = RefEvaluator()
                 exprs = [lw.lower_base(f.expr) for f in stmt.fields]
                 row = [ev.eval(e, []) for e in exprs]
-                names = [f.alias or "expr" for f in stmt.fields]
+                from .planner import _field_label
+
+                names = [_field_label(f) for f in stmt.fields]
                 return names, [e.ft for e in exprs], [row]
             rw.rewrite_select(stmt)
         except SubqueryError as exc:
@@ -759,11 +860,15 @@ class Session:
                         # (ref: fragment.go GenerateRootMPPTasks gate)
                         from ..parallel.sql import try_mesh_select
 
-                        chunk = try_mesh_select(self.store, plan.dag, ranges, ts)
+                        chunk = try_mesh_select(
+                            self.store, plan.dag, ranges, ts,
+                            group_capacity=self.sysvars.get_int("tidb_tpu_group_capacity"),
+                        )
                     if chunk is None:
                         kwargs = dict(
                             start_ts=ts,
                             aux_chunks=aux,
+                            group_capacity=self.sysvars.get_int("tidb_tpu_group_capacity"),
                             concurrency=self.sysvars.get_int("tidb_distsql_scan_concurrency"),
                             paging_size=(
                                 self.sysvars.get_int("tidb_max_chunk_size")
@@ -1624,7 +1729,17 @@ class Session:
     # ------------------------------------------------------------------
     def _show(self, stmt) -> Result:
         kind = getattr(stmt, "kind", "")
-        if kind == "create_table":
+        if kind in ("create_table", "create_view"):
+            vm = self.catalog.views.get(stmt.table.name.lower())
+            if kind == "create_view" and vm is None:
+                raise SQLError(f"unknown view {stmt.table.name!r}")
+            if vm is not None:
+                cols = f" ({', '.join(vm.columns)})" if vm.columns else ""
+                return Result(
+                    columns=["View", "Create View"],
+                    rows=[[Datum.string(vm.name),
+                           Datum.string(f"CREATE VIEW `{vm.name}`{cols} AS {vm.select_sql}")]],
+                )
             from ..tools.dump import schema_sql
 
             meta = self.catalog.table(stmt.table.name)
@@ -1657,13 +1772,19 @@ class Session:
                 rows.append([Datum.string(name), Datum.string(value)])
             return Result(columns=["Variable_name", "Value"], rows=rows)
         if kind == "tables":
-            return Result(columns=["Tables"], rows=[[Datum.string(t)] for t in self.catalog.tables()])
+            names = sorted(set(self.catalog.tables()) | set(self.catalog.views))
+            names = [t for t in names if _show_like(stmt, t)]
+            return Result(columns=["Tables"], rows=[[Datum.string(t)] for t in names])
         if kind == "databases":
             return Result(columns=["Database"], rows=[[Datum.string("test")]])
         if kind == "variables":
             return Result(
                 columns=["Variable_name", "Value"],
-                rows=[[Datum.string(k), Datum.string(v)] for k, v in self.sysvars.items()],
+                rows=[
+                    [Datum.string(k), Datum.string(v)]
+                    for k, v in self.sysvars.items()
+                    if _show_like(stmt, k)
+                ],
             )
         return Result()
 

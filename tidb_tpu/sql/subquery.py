@@ -334,8 +334,57 @@ class SubqueryRewriter:
             b.expr = self._rewrite_expr(b.expr, schema, stmt)
 
     # ------------------------------------------------------------- pieces
+    def _view_of(self, name: str):
+        """ViewMeta for a FROM reference, unless a CTE binding in any
+        enclosing scope shadows it (MySQL: CTE names win inside the query,
+        ref: logical_plan_builder.go buildDataSource CTE-before-view)."""
+        n = name.lower()
+        p = self
+        while p is not None:
+            if n in p.bindings:
+                return None
+            p = p.parent
+        return getattr(self.catalog, "views", {}).get(n)
+
+    def _expand_view(self, node: A.TableName):
+        """TableName over a view -> SubqueryTable over its stored SELECT
+        (re-parsed each use: the view sees the CURRENT schema, ref:
+        ViewInfo expansion in buildDataSource)."""
+        vm = self._view_of(node.name)
+        if vm is None:
+            return None
+        depth = 0
+        p = self
+        while p is not None:
+            depth += 1
+            p = p.parent
+        if depth > 24:
+            raise SubqueryError(f"view nesting too deep expanding {node.name!r}")
+        from ..parser import parse_one
+
+        sel = parse_one(vm.select_sql)
+        if vm.columns:
+            if not isinstance(sel, A.SelectStmt):
+                raise SubqueryError("view column list over a UNION body is not supported yet")
+            fields = sel.fields
+            if any(isinstance(getattr(f, "expr", f), A.Star) for f in fields):
+                raise SubqueryError("view column list with SELECT * is not supported yet")
+            if len(fields) != len(vm.columns):
+                raise SubqueryError(
+                    f"view {vm.name!r}: column list arity {len(vm.columns)} != select list {len(fields)}"
+                )
+            for f, cn in zip(fields, vm.columns):
+                f.alias = cn
+        return A.SubqueryTable(sel, node.alias or node.name)
+
     def _rewrite_from(self, node):
-        if node is None or isinstance(node, A.TableName):
+        if isinstance(node, A.TableName):
+            expanded = self._expand_view(node)
+            if expanded is not None:
+                node = expanded  # falls through to the SubqueryTable branch
+            else:
+                return node
+        if node is None:
             return node
         if isinstance(node, A.SubqueryTable):
             names, fts, rows = self.exec_query(node.subquery)

@@ -31,6 +31,13 @@ def _enum_validator(*allowed: str):
     return check
 
 
+def _snapshot_validator(v: str) -> str:
+    t = v.strip()
+    if t and not t.isdigit():
+        raise SysVarError("tidb_snapshot expects a TSO timestamp (or '' to clear)")
+    return t
+
+
 def _int_validator(lo: int, hi: int):
     def check(v: str) -> str:
         try:
@@ -80,8 +87,88 @@ DEFINITIONS = {
         SysVar("cte_max_recursion_depth", "1000", "both", _int_validator(0, 1 << 20)),
         SysVar("sql_mode", "STRICT_TRANS_TABLES", "both"),
         SysVar("time_zone", "UTC", "both"),
+        # ---- engine knobs wired into real code paths -------------------
+        # starting group-table capacity for device group-by (the overflow
+        # retry quadruples from here; exec/builder.py DEFAULT_GROUP_CAPACITY)
+        SysVar("tidb_tpu_group_capacity", "4096", "both", _int_validator(16, 1 << 24)),
+        # MySQL: implicit LIMIT on top-level SELECT results (sql_select_limit)
+        SysVar("sql_select_limit", str((1 << 64) - 1), "both", _int_validator(0, (1 << 64) - 1)),
+        # ref: sysvar.go TiDBSnapshot — stale read: session reads rewind to
+        # this TSO (session.py _read_ts) and writes are rejected while set
+        SysVar("tidb_snapshot", "", "session", _snapshot_validator),
+        # ---- planner/executor toggles the reference exposes ------------
+        # (ref: pkg/sessionctx/variable/sysvar.go — same names; accepted
+        # and visible via SELECT @@/SHOW VARIABLES; ones without a matching
+        # code path here validate + round-trip but do not change behavior,
+        # exactly like the reference's noop-sysvars list sysvar.go's
+        # SetNoopVars)
+        SysVar("tidb_cost_model_version", "2", "both", _int_validator(1, 2)),
+        # MySQL: group_concat result truncation length
+        SysVar("group_concat_max_len", "1024", "both", _int_validator(4, 1 << 30)),
+        # MySQL: decimal division scale increment (ref: cop_handler.go:350;
+        # the expression compiler currently fixes the increment at 4)
+        SysVar("div_precision_increment", "4", "both", _int_validator(0, 30)),
+        SysVar("tidb_enable_vectorized_expression", "ON", "both", _bool_validator),
+        SysVar("tidb_opt_insubq_to_join_and_agg", "ON", "both", _bool_validator),
+        SysVar("tidb_partition_prune_mode", "dynamic", "both", _enum_validator("static", "dynamic")),
+        SysVar("tidb_hashagg_partial_concurrency", "-1", "both", _int_validator(-1, 256)),
+        SysVar("tidb_hashagg_final_concurrency", "-1", "both", _int_validator(-1, 256)),
+        SysVar("tidb_hash_join_concurrency", "-1", "both", _int_validator(-1, 256)),
+        SysVar("tidb_projection_concurrency", "-1", "both", _int_validator(-1, 256)),
+        SysVar("tidb_window_concurrency", "-1", "both", _int_validator(-1, 256)),
+        SysVar("tidb_executor_concurrency", "5", "both", _int_validator(1, 256)),
+        SysVar("tidb_index_lookup_concurrency", "-1", "both", _int_validator(-1, 256)),
+        SysVar("tidb_index_serial_scan_concurrency", "1", "both", _int_validator(1, 256)),
+        SysVar("tidb_build_stats_concurrency", "4", "both", _int_validator(1, 256)),
+        SysVar("tidb_enable_outer_join_reorder", "ON", "both", _bool_validator),
+        SysVar("tidb_enable_index_merge", "ON", "both", _bool_validator),
+        SysVar("tidb_enable_window_function", "ON", "both", _bool_validator),
+        SysVar("tidb_enable_null_aware_anti_join", "ON", "both", _bool_validator),
+        SysVar("tidb_enable_unsafe_substitute", "OFF", "both", _bool_validator),
+        SysVar("tidb_enable_clustered_index", "ON", "both"),
+        SysVar("tidb_analyze_version", "2", "both", _int_validator(1, 2)),
+        SysVar("tidb_enable_chunk_rpc", "ON", "session", _bool_validator),
+        SysVar("tidb_isolation_read_engines", "tikv,tiflash,tidb,tpu", "session"),
+        SysVar("tidb_opt_correlation_threshold", "0.9", "both"),
+        SysVar("tidb_opt_limit_push_down_threshold", "100", "both", _int_validator(0, 1 << 30)),
+        SysVar("tidb_opt_distinct_agg_push_down", "OFF", "both", _bool_validator),
+        SysVar("tidb_retry_limit", "10", "both", _int_validator(0, 1 << 20)),
+        SysVar("tidb_backoff_weight", "2", "both", _int_validator(0, 1 << 20)),
+        SysVar("tidb_row_format_version", "2", "global", _int_validator(1, 2)),
+        SysVar("tidb_slow_log_threshold", "300", "both", _int_validator(-1, 1 << 30)),
+        SysVar("tidb_enable_slow_log", "ON", "both", _bool_validator),
+        SysVar("tidb_stmt_summary_max_stmt_count", "3000", "global", _int_validator(1, 1 << 20)),
+        SysVar("tidb_enable_stmt_summary", "ON", "both", _bool_validator),
+        # ---- MySQL-compatibility variables -----------------------------
+        SysVar("transaction_isolation", "REPEATABLE-READ", "both",
+               _enum_validator("read-uncommitted", "read-committed", "repeatable-read", "serializable")),
+        SysVar("tx_isolation", "REPEATABLE-READ", "both"),
+        SysVar("character_set_client", "utf8mb4", "both"),
+        SysVar("character_set_connection", "utf8mb4", "both"),
+        SysVar("character_set_results", "utf8mb4", "both"),
+        SysVar("character_set_database", "utf8mb4", "both"),
+        SysVar("collation_connection", "utf8mb4_bin", "both"),
+        SysVar("collation_database", "utf8mb4_bin", "both"),
+        SysVar("default_collation_for_utf8mb4", "utf8mb4_bin", "both"),
+        SysVar("foreign_key_checks", "ON", "both", _bool_validator),
+        SysVar("block_encryption_mode", "aes-128-ecb", "both"),
+        SysVar("max_execution_time", "0", "both", _int_validator(0, 1 << 31)),
+        SysVar("wait_timeout", "28800", "both", _int_validator(0, 1 << 31)),
+        SysVar("interactive_timeout", "28800", "both", _int_validator(1, 1 << 31)),
+        SysVar("max_allowed_packet", str(64 << 20), "both", _int_validator(1024, 1 << 30)),
+        SysVar("sql_safe_updates", "OFF", "both", _bool_validator),
+        SysVar("innodb_lock_wait_timeout", "50", "both", _int_validator(1, 3600)),
+        SysVar("version_comment", "TiDB-TPU", "global"),
+        SysVar("last_insert_id", "0", "session", _int_validator(0, (1 << 64) - 1)),
     ]
 }
+
+
+def is_bool(name: str) -> bool:
+    """Boolean-typed sysvars render 1/0 under SELECT @@x (MySQL prints the
+    numeric form there; SHOW VARIABLES keeps ON/OFF)."""
+    d = DEFINITIONS.get(name.lower())
+    return d is not None and d.validator is _bool_validator
 
 
 class SysVarStore:

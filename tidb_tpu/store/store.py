@@ -153,6 +153,7 @@ class TPUStore:
         with self.txn._mu:
             for l in self.txn.locks.values():
                 sp = min(sp, l.start_ts - 1)
+        self.gc_safepoint = max(getattr(self, "gc_safepoint", -1), sp)
         return self.kv.gc(sp)
 
     def _bump_write_ver(self):
