@@ -140,8 +140,11 @@ def test_join_max_key_vs_null_collision():
 
 
 def test_overflow_oracle_fallback():
-    """Degenerate fan-out (all keys equal) exhausts capacity retries and
-    transparently falls back to the row-at-a-time oracle."""
+    """Degenerate fan-out (all keys equal) exhausts the capacity retries;
+    the spill analog (probe halving, exec/executor.py _spill_partitioned)
+    then resolves it with device kernels only — no oracle needed."""
+    from tidb_tpu.util import metrics
+
     n = 64
     fts = [new_longlong()]
     pch = Chunk.from_rows(fts, [[Datum.i64(1)] for _ in range(n)])
@@ -152,8 +155,10 @@ def test_overflow_oracle_fallback():
     dag = DAGRequest((ps, join), output_offsets=(0, 1))
     out = run_dag_on_chunks(dag, [pch, bch], max_retries=0)  # 64*64 out rows >> 64 capacity
     assert out.num_rows() == n * n
-    with pytest.raises(RuntimeError):
-        run_dag_on_chunks(dag, [pch, bch], max_retries=0, oracle_fallback=False)
+    before = metrics.SPILL_PARTITIONS.value
+    out2 = run_dag_on_chunks(dag, [pch, bch], max_retries=0, oracle_fallback=False)
+    assert out2.num_rows() == n * n
+    assert metrics.SPILL_PARTITIONS.value > before
 
 
 def test_store_overflow_fallback_partial_agg():

@@ -450,6 +450,35 @@ class TestDenseSmallG:
         res = group_aggregate([g], [(AggDesc("count", ()), [])], db.row_valid, 64, small_groups=8)
         assert bool(res.overflow)
 
+    def test_dense_mxu_sum_exactness_at_scale(self):
+        """The MXU limb-matmul sum path (seg.DenseSumBatch) must be EXACT
+        for large signed int64 values across many 256-row chunks."""
+        import numpy as np
+
+        from tidb_tpu.expr.compile import CompVal
+        from tidb_tpu.ops.aggregate import group_aggregate
+
+        N = 1 << 14
+        rng = np.random.default_rng(9)
+        g = rng.integers(0, 6, N)
+        v = rng.integers(-(1 << 45), 1 << 45, N)
+        LL = new_longlong()
+        gv = CompVal(jnp.asarray(g, jnp.int64), jnp.zeros(N, bool), LL)
+        vv = CompVal(jnp.asarray(v, jnp.int64), jnp.zeros(N, bool), LL)
+        valid = jnp.ones(N, bool)
+        res = group_aggregate(
+            [gv], [(AggDesc("count", ()), []), (AggDesc("sum", (col(1, LL),)), [vv])],
+            valid, 64, small_groups=8,
+        )
+        assert not bool(res.overflow)
+        ng = int(res.n_groups)
+        rep = np.asarray(res.group_rep[:ng])
+        for i in range(ng):
+            k = int(g[rep[i]])
+            m = g == k
+            assert int(res.states[0][0][0][i]) == int(m.sum())
+            assert int(res.states[1][0][0][i]) == int(v[m].sum())
+
     def test_dense_overflow_when_hint_wrong(self):
         """More groups than the hint -> overflow flag (driver falls back)."""
         from tidb_tpu.expr import col

@@ -1,0 +1,179 @@
+"""Partitioned tables end-to-end (VERDICT r3 missing #4): RANGE/HASH
+partitions with their own physical key spaces, partition pruning visible
+in EXPLAIN, row movement on partition-column updates
+(ref: pkg/planner/core/rule_partition_processor.go, meta/model
+PartitionInfo, tablecodec per-partition IDs)."""
+
+import pytest
+
+from tidb_tpu.sql import Session
+
+
+def _range_session():
+    s = Session()
+    s.execute(
+        "create table r (amt bigint primary key, note varchar(16)) "
+        "partition by range (amt) ("
+        " partition p0 values less than (100),"
+        " partition p1 values less than (200),"
+        " partition p2 values less than maxvalue)"
+    )
+    s.execute("insert into r values " + ",".join(f"({v}, 'n{v}')" for v in (5, 50, 150, 199, 250, 1000)))
+    return s
+
+
+class TestRangePartition:
+    def test_rows_land_in_partition_keyspaces(self):
+        from tidb_tpu.codec import tablecodec
+
+        s = _range_session()
+        meta = s.catalog.table("r")
+        pids = meta.physical_ids()
+        assert len(pids) == 3 and meta.table_id not in pids
+        # physical placement: amt=5 under p0, amt=150 under p1, amt=250 under p2
+        ts = s.store.next_ts()
+        assert s.store.kv.get(tablecodec.encode_row_key(pids[0], 5), ts) is not None
+        assert s.store.kv.get(tablecodec.encode_row_key(pids[1], 150), ts) is not None
+        assert s.store.kv.get(tablecodec.encode_row_key(pids[2], 250), ts) is not None
+        assert s.store.kv.get(tablecodec.encode_row_key(pids[0], 150), ts) is None
+
+    def test_select_scans_all_partitions(self):
+        s = _range_session()
+        r = s.execute("select amt from r order by amt")
+        assert [int(x[0].val) for x in r.rows] == [5, 50, 150, 199, 250, 1000]
+        assert int(s.execute("select count(*) from r").rows[0][0].val) == 6
+
+    def test_pruning_visible_in_explain(self):
+        s = _range_session()
+        txt = "\n".join(str(d.val) for row in s.execute(
+            "explain select * from r where amt >= 150 and amt < 210").rows for d in row)
+        assert "partitions(p1,p2)" in txt, txt
+        txt = "\n".join(str(d.val) for row in s.execute(
+            "explain select * from r where amt = 50").rows for d in row)
+        assert "partitions(p0)" in txt, txt
+        # unconstrained: all partitions
+        txt = "\n".join(str(d.val) for row in s.execute(
+            "explain select * from r").rows for d in row)
+        assert "partitions(p0,p1,p2)" in txt, txt
+
+    def test_pruned_select_results(self):
+        s = _range_session()
+        r = s.execute("select amt from r where amt >= 150 and amt < 260 order by amt")
+        assert [int(x[0].val) for x in r.rows] == [150, 199, 250]
+        r = s.execute("select sum(amt) from r where amt < 100")
+        assert int(str(r.rows[0][0].val)) == 55
+
+    def test_update_moves_row_across_partitions(self):
+        from tidb_tpu.codec import tablecodec
+
+        s = _range_session()
+        meta = s.catalog.table("r")
+        pids = meta.physical_ids()
+        s.execute("update r set amt = 120 where amt = 5")
+        ts = s.store.next_ts()
+        assert s.store.kv.get(tablecodec.encode_row_key(pids[0], 5), ts) is None
+        assert s.store.kv.get(tablecodec.encode_row_key(pids[1], 120), ts) is not None
+        r = s.execute("select amt from r where amt >= 100 and amt < 200 order by amt")
+        assert [int(x[0].val) for x in r.rows] == [120, 150, 199]
+
+    def test_delete_and_out_of_range_insert(self):
+        s = _range_session()
+        s.execute("delete from r where amt >= 200")
+        assert int(s.execute("select count(*) from r").rows[0][0].val) == 4
+        s2 = Session()
+        s2.execute(
+            "create table b (v bigint) partition by range (v) "
+            "(partition p0 values less than (10))"
+        )
+        with pytest.raises(Exception, match="no partition"):
+            s2.execute("insert into b values (99)")
+
+    def test_partition_survives_restart(self):
+        s = _range_session()
+        s2 = Session(store=s.store)
+        meta = s2.catalog.table("r")
+        assert meta.partition is not None and len(meta.partition.parts) == 3
+        r = s2.execute("select count(*) from r where amt < 100")
+        assert int(r.rows[0][0].val) == 2
+        s2.execute("insert into r values (60, 'new')")
+        assert int(s2.execute("select count(*) from r where amt < 100").rows[0][0].val) == 3
+
+
+class TestHashPartition:
+    def test_hash_routing_and_point_prune(self):
+        from tidb_tpu.codec import tablecodec
+
+        s = Session()
+        s.execute("create table h (k bigint primary key, v bigint) partition by hash (k) partitions 4")
+        s.execute("insert into h values " + ",".join(f"({i}, {i * 10})" for i in range(20)))
+        meta = s.catalog.table("h")
+        pids = meta.physical_ids()
+        assert len(pids) == 4
+        ts = s.store.next_ts()
+        assert s.store.kv.get(tablecodec.encode_row_key(pids[7 % 4], 7), ts) is not None
+        r = s.execute("select v from h where k = 7")
+        assert int(r.rows[0][0].val) == 70
+        txt = "\n".join(str(d.val) for row in s.execute(
+            "explain select * from h where k = 7").rows for d in row)
+        assert "partitions(p3)" in txt, txt
+        assert int(s.execute("select count(*) from h").rows[0][0].val) == 20
+
+
+class TestPartitionRestrictions:
+    def test_pk_must_cover_partition_column(self):
+        s = Session()
+        with pytest.raises(Exception, match="PRIMARY KEY must include"):
+            s.execute(
+                "create table bad (id bigint primary key, amt bigint) "
+                "partition by range (amt) (partition p0 values less than (10))"
+            )
+
+    def test_no_secondary_indexes(self):
+        s = Session()
+        s.execute(
+            "create table p (amt bigint primary key, v bigint) "
+            "partition by range (amt) (partition p0 values less than maxvalue)"
+        )
+        with pytest.raises(Exception, match="partitioned"):
+            s.execute("create index iv on p (v)")
+
+    def test_txn_rollback_and_partitioned_dml(self):
+        s = Session()
+        s.execute(
+            "create table p (amt bigint primary key) "
+            "partition by range (amt) (partition p0 values less than (100),"
+            " partition p1 values less than maxvalue)"
+        )
+        s.execute("insert into p values (1), (150)")
+        s.execute("begin")
+        s.execute("insert into p values (2), (160)")
+        s.execute("update p set amt = 120 where amt = 1")
+        r = s.execute("select amt from p order by amt")
+        assert [int(x[0].val) for x in r.rows] == [2, 120, 150, 160]
+        s.execute("rollback")
+        r = s.execute("select amt from p order by amt")
+        assert [int(x[0].val) for x in r.rows] == [1, 150]
+
+
+class TestPartitionReviewRegressions:
+    def test_inline_key_rejected(self):
+        """code-review r4: an inline KEY must not bypass the no-secondary-
+        index rule for partitioned tables."""
+        import pytest
+
+        s = Session()
+        with pytest.raises(Exception, match="partitioned"):
+            s.execute(
+                "create table bad (a bigint primary key, b bigint, key ib (b)) "
+                "partition by hash (a) partitions 2"
+            )
+
+    def test_set_snapshot_in_txn_rejected(self):
+        import pytest
+
+        s = Session()
+        s.execute("create table st (a bigint primary key)")
+        s.execute("begin")
+        with pytest.raises(Exception, match="tidb_snapshot"):
+            s.execute("set tidb_snapshot = 123")
+        s.execute("rollback")

@@ -119,3 +119,66 @@ def test_estimate_drives_probe_choice():
         "SELECT count(*) FROM big JOIN small ON big.k = small.k WHERE big.id < 8"
     ).values()
     assert got == [[3]]  # ids 1..7, k in {1..6,0}: k=1,2,3 match
+
+
+class TestStatsDepth:
+    """CMSketch + NDV consumers (VERDICT r3 missing #7 / next #10)."""
+
+    def test_cmsketch_point_frequency(self):
+        from tidb_tpu.sql.stats import CMSketch
+        from tidb_tpu.types import Datum
+
+        cm = CMSketch()
+        for v, c in ((5, 40), (9, 7), (123456, 1)):
+            cm.insert(Datum.i64(v), c)
+        # count-min never underestimates
+        assert cm.query(Datum.i64(5)) >= 40
+        assert cm.query(Datum.i64(9)) >= 7
+        # sketch answers a non-TopN point much closer than a uniform guess
+        assert cm.query(Datum.i64(123456)) < 40
+
+    def test_analyze_builds_sketch_and_est_uses_it(self):
+        import numpy as np
+
+        from tidb_tpu.sql import Session
+        from tidb_tpu.sql.ranger import Interval
+        from tidb_tpu.sql.stats import est_interval_rows
+        from tidb_tpu.types import Datum
+
+        s = Session()
+        s.execute("create table cs (v bigint)")
+        rng = np.random.default_rng(1)
+        # 200 distinct singletons + no repeats -> all non-TopN, sketch-backed
+        vals = rng.permutation(5000)[:200]
+        s.execute("insert into cs values " + ",".join(f"({int(v)})" for v in vals))
+        s.execute("analyze table cs")
+        cst = s.catalog.stats[s.catalog.table("cs").table_id].columns["v"]
+        assert cst.cmsketch is not None and cst.ndv == 200
+        d = Datum.i64(int(vals[0]))
+        est = est_interval_rows(cst, Interval(low=d, high=d))
+        assert 1 <= est <= 4  # sketch-exact-ish, not bucket-smeared
+
+    def test_ndv_hint_reaches_plan_and_wrong_hint_stays_correct(self):
+        """ANALYZE-derived NDV produces the few-groups hint; STALE stats
+        (NDV exploded after ANALYZE) must still give exact results via the
+        overflow fallback — the mis-estimation regression."""
+        from tidb_tpu.parser import parse_one
+        from tidb_tpu.sql import Session
+        from tidb_tpu.sql.planner import plan_select
+
+        s = Session()
+        s.execute("create table g (k bigint, v bigint)")
+        s.execute("insert into g values " + ",".join(f"({i % 4}, {i})" for i in range(64)))
+        s.execute("analyze table g")
+        plan = plan_select(parse_one("select k, count(*) from g group by k"), s.catalog)
+        assert plan.small_groups == 16  # NDV 4 (+pow2 floor 16)
+        # no stats on expression keys
+        plan2 = plan_select(parse_one("select k + 1, count(*) from g group by k + 1"), s.catalog)
+        assert plan2.small_groups is None
+        # stats go stale: 3000 distinct keys appear AFTER the ANALYZE
+        s.execute("insert into g values " + ",".join(f"({i}, {i})" for i in range(100, 3100)))
+        r = s.execute("select count(*) from (select k, count(*) as c from g group by k) d")
+        assert int(r.rows[0][0].val) == 3004
+        r = s.execute("select k, count(*) from g where k < 4 group by k order by k")
+        assert [(int(x[0].val), int(x[1].val)) for x in r.rows] == [
+            (0, 16), (1, 16), (2, 16), (3, 16)]

@@ -465,6 +465,7 @@ def encode_cop_request(req) -> bytes:
     for c in req.aux_chunks:
         w.blob(encode_chunk(c))
     w.i32(-1 if req.paging_size is None else req.paging_size)
+    w.i32(-1 if req.small_groups is None else req.small_groups)
     return w.done()
 
 
@@ -479,7 +480,10 @@ def decode_cop_request(b: bytes):
     epoch = r.i64()
     aux = [decode_chunk(r.blob()) for _ in range(r.i32())]
     paging = r.i32()
-    return CopRequest(dag, ranges, start_ts, region_id, epoch, aux, None if paging < 0 else paging)
+    smg = r.i32()
+    return CopRequest(dag, ranges, start_ts, region_id, epoch, aux,
+                      None if paging < 0 else paging,
+                      None if smg < 0 else smg)
 
 
 def encode_cop_response(resp) -> bytes:

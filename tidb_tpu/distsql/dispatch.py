@@ -58,6 +58,7 @@ class KVRequest:
     batch_cop: bool = False  # group region tasks per store/chip into one
     # worker's batch (ref: copr/batch_coprocessor.go — all regions of a
     # TiFlash store travel in one request)
+    small_groups: int | None = None  # planner NDV hint -> dense agg kernel
 
 
 @dataclass
@@ -122,6 +123,7 @@ def _run_one_task(store, req, i, task, out_chunks, summaries, retries=MAX_RETRY)
         creq = CopRequest(
             req.dag, ranges, req.start_ts, task.region_id, task.epoch,
             aux_chunks=req.aux_chunks, paging_size=req.paging_size,
+            small_groups=req.small_groups,
         )
         resp = store.coprocessor(creq)
         if resp.region_error is not None:
@@ -158,6 +160,7 @@ def select(store: TPUStore, req: KVRequest) -> SelectResult:
             creq = CopRequest(
                 req.dag, ranges, req.start_ts, task.region_id, task.epoch,
                 aux_chunks=req.aux_chunks, paging_size=req.paging_size,
+                small_groups=req.small_groups,
             )
             if req.use_wire:
                 from ..codec.wire import decode_cop_response, encode_cop_request

@@ -156,6 +156,7 @@ def execute_root(
     summary_sink: list | None = None,
     tracker=None,
     low_memory: bool = False,
+    small_groups: int | None = None,
 ) -> Chunk:
     """Run a logical (Complete-mode) DAG over the store: split, dispatch the
     pushdown half per region, merge at root. The caller-visible result is
@@ -184,7 +185,7 @@ def execute_root(
         KVRequest(
             plan.push_dag, ranges, start_ts, concurrency=concurrency,
             aux_chunks=aux_chunks or [], paging_size=paging_size,
-            batch_cop=batch_cop,
+            batch_cop=batch_cop, small_groups=small_groups,
         ),
     )
     if summary_sink is not None:
@@ -202,7 +203,8 @@ def execute_root(
     if plan.root_dag is not None:
         # run_dag_on_chunks has the oracle fallback — a root merge whose
         # group count outgrows every capacity retry degrades, not crashes
-        out = run_dag_on_chunks(plan.root_dag, [merged], cache=cache, group_capacity=group_capacity)
+        out = run_dag_on_chunks(plan.root_dag, [merged], cache=cache, group_capacity=group_capacity,
+                                small_groups=small_groups)
     if tracker is not None:
         for c in res.chunks:
             if c is not None:

@@ -125,6 +125,105 @@ class OverflowRetryError(RuntimeError):
     row-at-a-time oracle (the host fallback SURVEY §7 promises)."""
 
 
+def _group_key_partition(chunk: Chunk, key_cols: list[int], n_parts: int) -> list[Chunk]:
+    """Split rows by a host-side hash of the named columns: equal keys land
+    in the same part, so per-part aggregation results are disjoint."""
+    import numpy as np
+
+    n = chunk.num_rows()
+    h = np.full(n, 1469598103934665603, np.uint64)  # FNV offset
+    prime = np.uint64(1099511628211)
+    for ci in key_cols:
+        col = chunk.columns[ci]
+        if col.is_varlen():
+            w = np.fromiter(
+                (0 if col.null[i] else hash(col.get_bytes(i)) & 0xFFFFFFFFFFFFFFFF
+                 for i in range(n)),
+                np.uint64, count=n,
+            )
+        else:
+            w = np.where(col.null, 0, col.data).astype(np.uint64)
+        h = (h ^ w) * prime
+    part = (h % np.uint64(n_parts)).astype(np.int64)
+    return [chunk.take(np.nonzero(part == p)[0]) for p in range(n_parts)]
+
+
+def _spill_partitioned(dag: DAGRequest, chunks, cache, group_capacity, small_groups, depth=0) -> Chunk:
+    """Out-of-capacity execution — the spill analog (ref:
+    pkg/executor/aggregate/agg_spill.go, join/hash_join_spill.go,
+    sortexec/sort_spill.go): when device capacity retries exhaust, the
+    input partitions on the HOST and the same fused program runs once per
+    partition — device kernels only, never the row-at-a-time oracle.
+
+      * Partial-mode aggregation: ANY row split works (the downstream
+        Final merge combines duplicate groups), so halve the probe chunk.
+      * Complete/Final aggregation over bare column group keys: partition
+        rows by a host hash of the key columns — per-part group sets are
+        disjoint and results concatenate.
+      * Join/Selection/Projection-terminal DAGs: halve the probe side
+        (each probe row's matches are independent); output order is
+        preserved by concatenating slices in order.
+
+    Raises OverflowRetryError when no safe decomposition exists."""
+    if depth >= 4:
+        raise OverflowRetryError("spill partitioning depth exhausted")
+    probe = chunks[0]
+    n = probe.num_rows()
+    if n < 2:
+        raise OverflowRetryError("cannot partition a <2-row input")
+    last = dag.executors[-1]
+
+    def run_parts(parts: list) -> Chunk:
+        outs = []
+        for p in parts:
+            if p.num_rows() == 0:
+                continue
+            outs.append(
+                run_dag_on_chunks(
+                    dag, [p] + list(chunks[1:]), cache=cache,
+                    group_capacity=group_capacity, oracle_fallback=False,
+                    small_groups=small_groups, _spill_depth=depth + 1,
+                )
+            )
+        if not outs:
+            return Chunk.empty(dag.output_fts())
+        return Chunk.concat(outs)
+
+    if isinstance(last, Aggregation):
+        simple_pipeline = all(
+            isinstance(e, (TableScan, Selection)) for e in dag.executors[:-1]
+        )
+        if last.partial and simple_pipeline:
+            from ..util import metrics
+
+            metrics.SPILL_PARTITIONS.inc()
+            return run_parts([probe.slice(0, n // 2), probe.slice(n // 2, n)])
+        from ..expr.ir import ColumnRef
+
+        if simple_pipeline and last.group_by and all(
+            isinstance(g, ColumnRef) for g in last.group_by
+        ):
+            from ..util import metrics
+
+            metrics.SPILL_PARTITIONS.inc()
+            keys = [g.index for g in last.group_by]
+            return run_parts(_group_key_partition(probe, keys, 4))
+        raise OverflowRetryError("no safe spill decomposition for this aggregation")
+    row_local = all(
+        isinstance(e, (TableScan, Selection, Projection, Join)) for e in dag.executors
+    )
+    if row_local and isinstance(last, (Join, Selection, Projection)):
+        # probe-halving is only sound when EVERY main-pipeline executor is
+        # row-local: a mid-pipeline Aggregation/TopN/Limit/Window would
+        # make per-half results non-concatenable (e.g. the root DAG
+        # [scan, Aggregation(merge), Selection] from a HAVING plan)
+        from ..util import metrics
+
+        metrics.SPILL_PARTITIONS.inc()
+        return run_parts([probe.slice(0, n // 2), probe.slice(n // 2, n)])
+    raise OverflowRetryError(f"no spill decomposition for {type(last).__name__}")
+
+
 def run_dag_on_chunks(
     dag: DAGRequest,
     chunks: list,
@@ -133,16 +232,26 @@ def run_dag_on_chunks(
     max_retries: int = 3,
     oracle_fallback: bool = True,
     small_groups: int | None = None,
+    _spill_depth: int = 0,
 ) -> Chunk:
-    """Device path over one chunk per scan; falls back to the reference
-    evaluator when capacity retries are exhausted (degenerate fan-out)."""
+    """Device path over one chunk per scan. Capacity-retry exhaustion first
+    tries host-partitioned multi-pass device execution (the spill analog);
+    the reference evaluator is the last resort (host-only operators)."""
     cache = cache or DEFAULT_PROGRAM_CACHE
     batches = [to_device_batch(c, capacity=_pow2(max(c.num_rows(), 1))) for c in chunks]
     try:
         return drive_program(cache, dag, batches, group_capacity, max_retries, small_groups=small_groups)[0]
-    except (OverflowRetryError, NotImplementedError):
-        # capacity exhaustion OR a host-only operator (replace,
-        # group_concat): the row-at-a-time oracle is the documented fallback
+    except OverflowRetryError:
+        try:
+            return _spill_partitioned(dag, chunks, cache, group_capacity, small_groups, _spill_depth)
+        except OverflowRetryError:
+            if not oracle_fallback:
+                raise
+        rows = run_dag_reference(dag, chunks)
+        return Chunk.from_rows(dag.output_fts(), rows)
+    except NotImplementedError:
+        # a host-only operator (replace, group_concat): the row-at-a-time
+        # oracle is the documented fallback
         if not oracle_fallback:
             raise
         rows = run_dag_reference(dag, chunks)

@@ -437,6 +437,18 @@ def _group_aggregate_dense(group_bys, aggs, row_valid, g_cap: int, merge: bool):
     gids = jnp.arange(g_cap, dtype=jnp.int32)
     group_valid = gids < n_groups
 
+    # batch every integer per-group sum into ONE MXU matmul (record pass
+    # -> resolve -> replay; see seg.DenseSumBatch)
+    from .seg import DenseSumBatch
+
+    ctx.sums = DenseSumBatch(ctx)
+    for desc, arg_vals in aggs:
+        if _needs_gather_state(desc, arg_vals):
+            continue
+        fn = _agg_states_merge if merge else _agg_states_raw
+        fn(desc, arg_vals, row_valid, ctx)
+    ctx.sums.resolve()
+
     states = []
     for desc, arg_vals in aggs:
         if _needs_gather_state(desc, arg_vals):

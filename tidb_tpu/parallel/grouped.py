@@ -66,6 +66,97 @@ def _materialize_gather(desc, arg_vals, st: GatherState, final: bool = False):
     return [(val, null)]
 
 
+def agg_exchange_phases(agg, schema_fts, cvals, valid, n_parts: int, group_capacity: int, bcap: int, extra_overflow=None):
+    """The MPP partial/exchange/final pipeline given the pre-agg schema —
+    phases 1-3 of the module docstring. Called inside shard_map by both the
+    scan+sel path (run_sharded_grouped_agg) and the hash-shuffle join path
+    (joinmesh.run_sharded_join_agg). Returns the flat output tuple
+    [group_valid, (value, null)*, overflow]."""
+    comp = ExprCompiler(schema_fts)
+    gvals = comp.run(list(agg.group_by), cvals)
+    arg_exprs = [a for d in agg.aggs for a in d.args]
+    avals = comp.run(arg_exprs, cvals) if arg_exprs else []
+    aggs = []
+    k = 0
+    for d in agg.aggs:
+        aggs.append((d, avals[k : k + len(d.args)]))
+        k += len(d.args)
+
+    # -- phase 1: local Partial1 ------------------------------------
+    res = group_aggregate(gvals, aggs, valid, group_capacity, merge=False)
+    p1_overflow = res.overflow
+    state_cols: list[tuple] = []  # flat (value, null) per state column
+    state_fts: list = []
+    for (d, av), st in zip(aggs, res.states):
+        if isinstance(st, GatherState):
+            mat = _materialize_gather(d, av, st)
+        else:
+            mat = st
+        state_cols.extend(mat)
+        state_fts.extend(d.partial_fts())
+    gkey_cols = []
+    for gv in gvals:
+        if gv.value.ndim == 2:
+            gkey_cols.append((gv.value[res.group_rep, :], gv.null[res.group_rep]))
+        else:
+            gkey_cols.append((gv.value[res.group_rep], gv.null[res.group_rep]))
+    gvalid = res.group_valid
+
+    # -- phase 2: hash-exchange the group-state rows -----------------
+    key_cvs = [
+        CompVal(v, nl, g.ft) for (v, nl), g in zip(gkey_cols, agg.group_by)
+    ]
+    part = hash_partition_ids(key_cvs, n_parts)
+    flat_arrays = [a for v, nl in state_cols + gkey_cols for a in (v, nl)]
+    bufs, bvalid, ex_overflow = scatter_to_buckets(flat_arrays, gvalid, part, n_parts, bcap)
+    recv = [jax.lax.all_to_all(b, REGION_AXIS, 0, 0, tiled=False) for b in bufs]
+    rvalid = jax.lax.all_to_all(bvalid, REGION_AXIS, 0, 0, tiled=False)
+    flat = [r.reshape((-1,) + r.shape[2:]) for r in recv]
+    fvalid = rvalid.reshape(-1)
+
+    # -- phase 3: merge-mode aggregation on the owned partition ------
+    n_state = len(state_cols)
+    it = iter(range(0, 2 * n_state, 2))
+    owned_states = [(flat[i], flat[i + 1].astype(bool)) for i in it]
+    base = 2 * n_state
+    owned_gkeys = [
+        CompVal(flat[base + 2 * j], flat[base + 2 * j + 1].astype(bool), g.ft)
+        for j, g in enumerate(agg.group_by)
+    ]
+    merge_aggs = []
+    si = 0
+    for d, _ in aggs:
+        n = len(d.partial_fts())
+        args = [
+            CompVal(owned_states[si + i][0], owned_states[si + i][1], state_fts[si + i])
+            for i in range(n)
+        ]
+        merge_aggs.append((d, args))
+        si += n
+    fin = group_aggregate(owned_gkeys, merge_aggs, fvalid, group_capacity, merge=True)
+    f_overflow = fin.overflow
+
+    out_cols = []
+    for (d, av), st in zip(merge_aggs, fin.states):
+        if isinstance(st, GatherState):
+            st = GatherState(st.idx, st.has & fin.group_valid)
+            out_cols.extend(_materialize_gather(d, av, st, final=True))
+        else:
+            v, nl = finalize_agg(d, st, fin.group_valid)
+            out_cols.append((v, nl))
+    for gk in owned_gkeys:
+        if gk.value.ndim == 2:
+            out_cols.append((gk.value[fin.group_rep, :], gk.null[fin.group_rep] | ~fin.group_valid))
+        else:
+            out_cols.append((gk.value[fin.group_rep], gk.null[fin.group_rep] | ~fin.group_valid))
+    local_ovf = p1_overflow | ex_overflow | f_overflow
+    if extra_overflow is not None:
+        local_ovf = local_ovf | extra_overflow
+    overflow = jax.lax.pmax(local_ovf.astype(jnp.int32), REGION_AXIS) > 0
+    flat_out = [a for v, nl in out_cols for a in (v, nl)]
+    return tuple([fin.group_valid] + flat_out + [overflow])
+
+
 def run_sharded_grouped_agg(
     dag: DAGRequest,
     stacked: DeviceBatch,
@@ -98,90 +189,7 @@ def run_sharded_grouped_agg(
                 valid = apply_selection(valid, conds)
             else:
                 raise TypeError(f"mesh pipeline supports scan+selection+agg, got {ex}")
-        comp = ExprCompiler(input_fts)
-        gvals = comp.run(list(agg.group_by), cvals)
-        arg_exprs = [a for d in agg.aggs for a in d.args]
-        avals = comp.run(arg_exprs, cvals) if arg_exprs else []
-        aggs = []
-        k = 0
-        for d in agg.aggs:
-            aggs.append((d, avals[k : k + len(d.args)]))
-            k += len(d.args)
-
-        # -- phase 1: local Partial1 ------------------------------------
-        res = group_aggregate(gvals, aggs, valid, group_capacity, merge=False)
-        p1_overflow = res.overflow
-        state_cols: list[tuple] = []  # flat (value, null) per state column
-        state_fts: list = []
-        for (d, av), st in zip(aggs, res.states):
-            if isinstance(st, GatherState):
-                mat = _materialize_gather(d, av, st)
-            else:
-                mat = st
-            state_cols.extend(mat)
-            state_fts.extend(d.partial_fts())
-        gkey_cols = []
-        for gv in gvals:
-            if gv.value.ndim == 2:
-                gkey_cols.append((gv.value[res.group_rep, :], gv.null[res.group_rep]))
-            else:
-                gkey_cols.append((gv.value[res.group_rep], gv.null[res.group_rep]))
-        gvalid = res.group_valid
-
-        # -- phase 2: hash-exchange the group-state rows -----------------
-        key_cvs = [
-            CompVal(v, nl, g.ft) for (v, nl), g in zip(gkey_cols, agg.group_by)
-        ]
-        part = hash_partition_ids(key_cvs, n_parts)
-        flat_arrays = [a for v, nl in state_cols + gkey_cols for a in (v, nl)]
-        bufs, bvalid, ex_overflow = scatter_to_buckets(flat_arrays, gvalid, part, n_parts, bcap)
-        recv = [jax.lax.all_to_all(b, REGION_AXIS, 0, 0, tiled=False) for b in bufs]
-        rvalid = jax.lax.all_to_all(bvalid, REGION_AXIS, 0, 0, tiled=False)
-        flat = [r.reshape((-1,) + r.shape[2:]) for r in recv]
-        fvalid = rvalid.reshape(-1)
-
-        # -- phase 3: merge-mode aggregation on the owned partition ------
-        n_state = len(state_cols)
-        it = iter(range(0, 2 * n_state, 2))
-        owned_states = [(flat[i], flat[i + 1].astype(bool)) for i in it]
-        base = 2 * n_state
-        owned_gkeys = [
-            CompVal(flat[base + 2 * j], flat[base + 2 * j + 1].astype(bool), g.ft)
-            for j, g in enumerate(agg.group_by)
-        ]
-        merge_aggs = []
-        si = 0
-        for d, _ in aggs:
-            n = len(d.partial_fts())
-            args = [
-                CompVal(owned_states[si + i][0], owned_states[si + i][1], state_fts[si + i])
-                for i in range(n)
-            ]
-            merge_aggs.append((d, args))
-            si += n
-        fin = group_aggregate(owned_gkeys, merge_aggs, fvalid, group_capacity, merge=True)
-        f_overflow = fin.overflow
-
-        out_cols = []
-        for (d, av), st in zip(merge_aggs, fin.states):
-            if isinstance(st, GatherState):
-                st = GatherState(st.idx, st.has & fin.group_valid)
-                out_cols.extend(_materialize_gather(d, av, st, final=True))
-            else:
-                v, nl = finalize_agg(d, st, fin.group_valid)
-                out_cols.append((v, nl))
-        for gk in owned_gkeys:
-            if gk.value.ndim == 2:
-                out_cols.append((gk.value[fin.group_rep, :], gk.null[fin.group_rep] | ~fin.group_valid))
-            else:
-                out_cols.append((gk.value[fin.group_rep], gk.null[fin.group_rep] | ~fin.group_valid))
-        overflow = (
-            jax.lax.pmax(p1_overflow.astype(jnp.int32), REGION_AXIS)
-            | jax.lax.pmax(ex_overflow.astype(jnp.int32), REGION_AXIS)
-            | jax.lax.pmax(f_overflow.astype(jnp.int32), REGION_AXIS)
-        ) > 0
-        flat_out = [a for v, nl in out_cols for a in (v, nl)]
-        return tuple([fin.group_valid] + flat_out + [overflow])
+        return agg_exchange_phases(agg, input_fts, cvals, valid, n_parts, group_capacity, bcap)
 
     spec_batch = jax.tree.map(lambda _: P(REGION_AXIS), stacked)
     n_group = len(agg.group_by)

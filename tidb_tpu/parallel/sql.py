@@ -37,31 +37,49 @@ def _chunks_exchange_safe(chunks) -> bool:
     return True
 
 
-def mesh_eligible(dag: DAGRequest) -> bool:
-    """Shape gate: TableScan [Selection]* Aggregation(GROUP BY) with
-    exchange-safe aggregates and key types (ref: the reference's
-    per-operator CanPushToTiFlash checks in exhaust_physical_plans)."""
+def _agg_mesh_ok(agg) -> bool:
+    if not isinstance(agg, Aggregation) or not agg.group_by or agg.merge:
+        return False
+    return not any(d.distinct or d.name == "group_concat" for d in agg.aggs)
+
+
+def mesh_eligible(dag: DAGRequest) -> str | None:
+    """Shape gate (ref: the reference's per-operator CanPushToTiFlash
+    checks in exhaust_physical_plans). Returns the mesh plan kind:
+
+      "agg"  — TableScan [Selection]* Aggregation(GROUP BY)
+      "join" — TableScan [Sel]* Join(scan [Sel]*) [Sel]* Aggregation(...)
+               (the hash-shuffle repartition join, joinmesh.py)
+      None   — ineligible (host-only exprs, DISTINCT, merge mode, ...)
+    """
     from ..distsql.root import host_only_exprs
 
     exs = dag.executors
     if len(exs) < 2 or not isinstance(exs[0], TableScan):
-        return False
-    if not all(isinstance(e, Selection) for e in exs[1:-1]):
-        return False
+        return None
     agg = exs[-1]
-    if not isinstance(agg, Aggregation) or not agg.group_by or agg.merge:
-        return False
-    for d in agg.aggs:
-        if d.distinct or d.name == "group_concat":
-            return False
-    # the device ExprCompiler cannot trace host-only ops (json_*, regexp,
-    # extensions) — the thread-pool path keeps them at root, so the mesh
-    # path must refuse them too rather than fail inside the shard_map trace
-    exprs = [c for e in exs[1:-1] for c in e.conditions]
-    exprs += list(agg.group_by) + [a for d in agg.aggs for a in d.args]
+    if not _agg_mesh_ok(agg):
+        return None
+    agg_exprs = list(agg.group_by) + [a for d in agg.aggs for a in d.args]
+
+    if all(isinstance(e, Selection) for e in exs[1:-1]):
+        exprs = [c for e in exs[1:-1] for c in e.conditions] + agg_exprs
+        # the device ExprCompiler cannot trace host-only ops (json_*,
+        # regexp, extensions) — the thread-pool path keeps them at root, so
+        # the mesh path must refuse them rather than fail inside the trace
+        return None if host_only_exprs(exprs) else "agg"
+
+    from .joinmesh import split_join_dag
+
+    parts = split_join_dag(dag)
+    if parts is None:
+        return None
+    _, pre, join, post, _ = parts
+    exprs = [c for e in pre + post + list(join.build[1:]) for c in e.conditions]
+    exprs += list(join.probe_keys) + list(join.build_keys) + agg_exprs
     if host_only_exprs(exprs):
-        return False
-    return True
+        return None
+    return "join"
 
 
 def try_mesh_select(
@@ -71,14 +89,21 @@ def try_mesh_select(
     start_ts: int,
     group_capacity: int = 1024,
     min_devices: int = 2,
+    aux_chunks: list | None = None,
 ) -> Chunk | None:
     """Execute an eligible plan over the region mesh; None = not taken.
 
     Region rows reach the devices through the same scan pushdown
-    (paging/retry preserved) as the thread-pool path; the grouped
-    aggregation then runs as ONE shard_map program: per-device Partial1 ->
-    all_to_all hash exchange -> Final merge (parallel/grouped.py)."""
-    if not mesh_eligible(dag):
+    (paging/retry preserved) as the thread-pool path; the plan then runs
+    as ONE shard_map program: either Partial1 -> all_to_all hash exchange
+    -> Final (parallel/grouped.py) or the hash-shuffle repartition join
+    feeding the same phases (parallel/joinmesh.py). aux_chunks carries the
+    materialized build table for join plans (sliced across devices — each
+    slice plays a region shard)."""
+    kind = mesh_eligible(dag)
+    if kind is None:
+        return None
+    if kind == "join" and not aux_chunks:
         return None
     import jax
 
@@ -104,13 +129,38 @@ def try_mesh_select(
     n_total = ((len(chunks) + n - 1) // n) * n
     stacked = stack_region_batches(chunks, n_total=n_total)
     mesh = region_mesh(n)
-    # overflow (too many groups / hash collision): retry with 4x capacity —
-    # the capacity also salts the hash, mirroring drive_program's contract —
-    # reusing the already-scanned chunks rather than rescanning
+
+    stacked_build = None
+    if kind == "join":
+        build = aux_chunks[0]
+        if not _chunks_exchange_safe([build]):
+            return None
+        if build.num_rows() == 0:
+            bslices = [build]
+        else:
+            step = (build.num_rows() + n - 1) // n
+            bslices = [
+                build.slice(i * step, min((i + 1) * step, build.num_rows()))
+                for i in range(n)
+                if i * step < build.num_rows()
+            ]
+        stacked_build = stack_region_batches(bslices, n_total=n)
+
+    # overflow (too many groups / join fan-out / hash collision): retry
+    # with 4x capacity — the capacity also salts the hash, mirroring
+    # drive_program's contract — reusing the scanned chunks, not rescanning
     gc = group_capacity
+    scale = 1
     for _ in range(3):
         try:
-            chunk, overflow = run_sharded_grouped_agg(dag, stacked, mesh, group_capacity=gc)
+            if kind == "join":
+                from .joinmesh import run_sharded_join_agg
+
+                chunk, overflow = run_sharded_join_agg(
+                    dag, stacked, stacked_build, mesh, group_capacity=gc, scale=scale
+                )
+            else:
+                chunk, overflow = run_sharded_grouped_agg(dag, stacked, mesh, group_capacity=gc)
         except NotImplementedError:
             # an op the device compiler refuses slipped past the static
             # gate: fall back to the per-region thread-pool path, which
@@ -123,5 +173,8 @@ def try_mesh_select(
             metrics.MESH_SELECTS.inc()
             cols = [chunk.columns[i] for i in dag.output_offsets]
             return Chunk(cols)
+        # one overflow flag covers groups, exchange buckets, and join
+        # fan-out: grow every data-dependent capacity together
         gc *= 4
+        scale *= 4
     return None  # caller falls back to the per-region path

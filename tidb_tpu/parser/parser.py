@@ -1714,6 +1714,7 @@ class Parser:
         if self.eat_kw("PARTITIONS"):
             n_parts = self.expect_number()
         parts = []
+        part_exprs = exprs
         if self.eat_op("("):
             while True:
                 self.expect_kw("PARTITION")
@@ -1764,7 +1765,7 @@ class Parser:
                 if not self.eat_op(","):
                     break
             self.expect_op(")")
-        return {"method": method, "columns": columns, "n": n_parts, "parts": parts}
+        return {"method": method, "columns": columns, "n": n_parts, "parts": parts, "exprs": part_exprs}
 
     def _index_cols(self) -> list:
         self.expect_op("(")

@@ -83,12 +83,16 @@ class ColumnMeta:
 
 @dataclass
 class IndexMeta:
-    """(ref: meta/model IndexInfo)."""
+    """(ref: meta/model IndexInfo). `state` walks the F1 online-schema
+    states during ADD INDEX (ddl.py): delete_only -> write_only ->
+    write_reorg -> public. Readers use public indexes only; DML writes
+    entries from write_only on and honors deletes in every state."""
 
     name: str
     index_id: int
     col_names: list
     unique: bool = False
+    state: str = "public"
 
 
 @dataclass
@@ -102,6 +106,7 @@ class TableMeta:
     row_count: int = 0  # maintained by DML; the planner's only "statistic"
     next_col_id: int = 0  # max-ever col id + 1: DROP COLUMN must never free
     # its id for reuse (old rows still hold bytes under it)
+    partition: "PartitionInfo | None" = None  # RANGE/HASH partitioning
 
     def __post_init__(self):
         if self.next_col_id <= 0:
@@ -121,6 +126,22 @@ class TableMeta:
 
     def col_ids(self) -> list:
         return [c.col_id for c in self.columns]
+
+    def physical_ids(self) -> list:
+        """Key-space ids rows live under: per-partition pids, or the table
+        id itself (ref: PartitionDefinition.ID vs TableInfo.ID)."""
+        if self.partition is not None:
+            return [p.pid for p in self.partition.parts]
+        return [self.table_id]
+
+    def pid_for_row(self, datums: list) -> int:
+        """Physical id the row belongs to (partition routing by the
+        partition column's value; unpartitioned -> table_id)."""
+        if self.partition is None:
+            return self.table_id
+        i = next(j for j, c in enumerate(self.columns) if c.name == self.partition.col)
+        d = datums[i]
+        return self.partition.route(None if d.is_null() else int(d.val))
 
     def fts(self) -> list:
         return [c.ft for c in self.columns]
@@ -146,6 +167,79 @@ class TableMeta:
             v = self.next_col_id
             self.next_col_id += 1
             return v
+
+
+@dataclass
+class PartitionDef:
+    """One physical partition: its own key space under `pid`
+    (ref: meta/model PartitionDefinition — per-partition physical IDs)."""
+
+    name: str
+    pid: int
+    upper: int | None = None  # RANGE: exclusive upper bound; None = MAXVALUE
+
+
+@dataclass
+class PartitionInfo:
+    """RANGE/HASH partitioning over one integer column (ref: meta/model
+    PartitionInfo; pruning rule_partition_processor.go). Each partition is
+    a separate physical key space; the logical table routes rows by the
+    partition column's value."""
+
+    method: str  # "range" | "hash"
+    col: str
+    parts: list  # [PartitionDef]
+
+    def route(self, val) -> int:
+        """Partition id for a column value (None = NULL).
+
+        NULL routes to the FIRST partition (MySQL: NULL is less than any
+        non-NULL for RANGE; hashes as 0 for HASH)."""
+        if self.method == "hash":
+            if val is None:
+                return self.parts[0].pid
+            return self.parts[int(val) % len(self.parts)].pid
+        if val is None:
+            return self.parts[0].pid
+        v = int(val)
+        for p in self.parts:
+            if p.upper is None or v < p.upper:
+                return p.pid
+        raise CatalogError(f"Table has no partition for value {v}")
+
+    def prune(self, intervals) -> list:
+        """PartitionDefs whose value range intersects the ranger intervals
+        (None = no constraint -> all). RANGE prunes by bound overlap; HASH
+        prunes only point intervals (ref: rule_partition_processor.go)."""
+        if intervals is None:
+            return list(self.parts)
+        if self.method == "hash":
+            pids = []
+            for iv in intervals:
+                lo, hi = iv.low, iv.high
+                if lo is None or hi is None or lo.is_null() or hi.is_null():
+                    return list(self.parts)
+                if int(lo.val) != int(hi.val) or not (iv.low_inc and iv.high_inc):
+                    return list(self.parts)  # only point lookups prune hash
+                p = self.parts[int(lo.val) % len(self.parts)]
+                if p not in pids:
+                    pids.append(p)
+            return pids
+        out = []
+        prev_upper = None
+        for p in self.parts:
+            lo_b = prev_upper  # inclusive lower bound (None = -inf)
+            hi_b = p.upper  # exclusive upper (None = +inf)
+            prev_upper = p.upper
+            for iv in intervals:
+                iv_lo = None if iv.low is None or iv.low.is_null() else int(iv.low.val)
+                iv_hi = None if iv.high is None or iv.high.is_null() else int(iv.high.val)
+                below = hi_b is not None and iv_lo is not None and iv_lo >= hi_b
+                above = lo_b is not None and iv_hi is not None and iv_hi < lo_b
+                if not below and not above:
+                    out.append(p)
+                    break
+        return out
 
 
 @dataclass
@@ -223,21 +317,86 @@ class Catalog:
                         "non-integer/composite PRIMARY KEY not supported yet (integer handle columns only)"
                     )
                 indices.append(IndexMeta(iname, self._alloc_id(), icols, getattr(idx, "unique", False)))
-            tbl = TableMeta(name, self._alloc_id(), cols, indices, handle_col)
+            part = None
+            pdict = (stmt.options or {}).get("partition_by")
+            if pdict is not None:
+                part = self._build_partition(pdict, cols, handle_col, indices)
+            tbl = TableMeta(name, self._alloc_id(), cols, indices, handle_col, partition=part)
             self._tables[name] = tbl
             self.version += 1
             return tbl
 
-    def add_index(self, table: str, index_name: str, col_names: list, unique: bool = False) -> IndexMeta:
+    def _build_partition(self, pdict: dict, cols, handle_col, indices) -> "PartitionInfo":
+        """options['partition_by'] -> PartitionInfo (RANGE / HASH over one
+        integer column; ref: ddl partition checks + meta/model
+        PartitionInfo). MySQL's unique-key rule is enforced: the partition
+        column must be part of the PK / every unique key."""
+        method = pdict["method"].lower()
+        if method == "key":
+            method = "hash"  # KEY(col) hashes the column too
+        if method not in ("range", "hash"):
+            raise CatalogError(f"PARTITION BY {pdict['method']} not supported yet")
+        exprs = pdict.get("exprs") or []
+        if len(exprs) != 1 or not isinstance(exprs[0], A.ColumnName):
+            raise CatalogError("partitioning supports a single bare column only")
+        pcol = exprs[0].name.lower()
+        cm = next((c for c in cols if c.name == pcol), None)
+        if cm is None:
+            raise CatalogError(f"unknown partition column {pcol!r}")
+        if not cm.ft.is_int():
+            raise CatalogError("partition column must be an integer column")
+        # ref: MySQL "A PRIMARY KEY must include all columns in the
+        # table's partitioning function" (same for unique keys)
+        if handle_col is not None and handle_col != pcol:
+            raise CatalogError(
+                "a PRIMARY KEY must include the table's partitioning column"
+            )
+        if indices:
+            # same restriction add_index enforces — an inline KEY in the
+            # CREATE TABLE must not bypass it (per-partition local indexes
+            # are not implemented yet)
+            raise CatalogError(
+                "secondary indexes on partitioned tables are not supported yet"
+            )
+        parts = []
+        if method == "hash":
+            n = int(pdict.get("n") or 0)
+            if n <= 0:
+                raise CatalogError("PARTITION BY HASH requires PARTITIONS n")
+            for i in range(n):
+                parts.append(PartitionDef(f"p{i}", self._alloc_id()))
+            return PartitionInfo("hash", pcol, parts)
+        prev = None
+        for pd in pdict.get("parts") or []:
+            lt = pd.get("less_than")
+            if lt == "MAXVALUE" or (isinstance(lt, list) and lt and lt[0] == "MAXVALUE"):
+                upper = None
+            else:
+                if not (isinstance(lt, list) and len(lt) == 1 and isinstance(lt[0], A.Literal)):
+                    raise CatalogError("RANGE partition bounds must be integer literals")
+                upper = int(lt[0].value)
+                if prev is not None and upper <= prev:
+                    raise CatalogError("RANGE partition bounds must be ascending")
+                prev = upper
+            parts.append(PartitionDef(pd["name"].lower(), self._alloc_id(), upper))
+        if not parts:
+            raise CatalogError("RANGE partitioning requires a partition list")
+        return PartitionInfo("range", pcol, parts)
+
+    def add_index(self, table: str, index_name: str, col_names: list, unique: bool = False, state: str = "public") -> IndexMeta:
         """CREATE INDEX metadata step (the backfill is the session's job —
         ref: pkg/ddl add-index schema change + backfill)."""
         with self._lock:
             tbl = self.table(table)
+            if tbl.partition is not None:
+                raise CatalogError(
+                    "secondary indexes on partitioned tables are not supported yet"
+                )
             if any(i.name == index_name for i in tbl.indices):
                 raise CatalogError(f"index {index_name!r} already exists")
             for cn in col_names:
                 tbl.col(cn)  # validates
-            im = IndexMeta(index_name, self._alloc_id(), [c.lower() for c in col_names], unique)
+            im = IndexMeta(index_name, self._alloc_id(), [c.lower() for c in col_names], unique, state)
             tbl.indices.append(im)
             self.version += 1
             return im

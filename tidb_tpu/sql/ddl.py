@@ -27,9 +27,6 @@ from ..parser import ast as A
 from ..types import Datum
 from .catalog import Catalog, CatalogError, ColumnMeta, field_type_from_spec
 
-INDEX_STATES = ("delete_only", "write_only", "write_reorg", "public")
-
-
 class DDLError(ValueError):
     pass
 
@@ -77,16 +74,18 @@ class DDLJobLog:
 
 
 def run_job(catalog: Catalog, job_type: str, table: str, query: str, fn, index_states: bool = False):
-    """Execute one schema change as a recorded job; index builds walk the
-    four online states (each would be a schema-version bump cluster-wide)."""
+    """Execute one schema change as a recorded job. Index builds receive a
+    `step` callback and drive the four online states THEMSELVES (the
+    IndexMeta.state walk in session._build_index — each transition is a
+    real visibility change for concurrent DML, and each records here as a
+    schema-state step, ref: pkg/ddl job.SchemaState)."""
     log = catalog.ddl_jobs
     job = log.begin(job_type, table, query)
     try:
         if index_states:
-            for st in INDEX_STATES[:-1]:
-                log.step(job, st)
-                catalog.version += 1
-        result = fn()
+            result = fn(lambda st: log.step(job, st))
+        else:
+            result = fn()
         log.step(job, "public")
         log.finish(job)
         return result
@@ -122,7 +121,7 @@ def alter_table(session, stmt: A.AlterTableStmt):
             cols = [c[0] if isinstance(c, tuple) else str(c) for c in idx.columns]
             name = idx.name or f"idx_{len(meta.indices)}"
             run_job(session.catalog, "add index", meta.name, query,
-                    lambda n=name, cs=cols, u=idx.unique: session._build_index(meta, n, cs, u),
+                    lambda step, n=name, cs=cols, u=idx.unique: session._build_index(meta, n, cs, u, step=step),
                     index_states=True)
         elif action == "drop_index":
             run_job(session.catalog, "drop index", meta.name, query,

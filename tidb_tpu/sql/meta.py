@@ -128,9 +128,15 @@ def table_to_dict(m: TableMeta) -> dict:
         ],
         "indices": [
             {"name": i.name, "index_id": i.index_id, "col_names": i.col_names,
-             "unique": i.unique}
+             "unique": i.unique, "state": i.state}
             for i in m.indices
         ],
+        "partition": None if m.partition is None else {
+            "method": m.partition.method,
+            "col": m.partition.col,
+            "parts": [{"name": p.name, "pid": p.pid, "upper": p.upper}
+                      for p in m.partition.parts],
+        },
     }
 
 
@@ -144,8 +150,17 @@ def table_from_dict(t: dict) -> TableMeta:
         )
         for c in t["columns"]
     ]
-    idxs = [IndexMeta(i["name"], i["index_id"], list(i["col_names"]), i["unique"]) for i in t["indices"]]
+    idxs = [IndexMeta(i["name"], i["index_id"], list(i["col_names"]), i["unique"],
+                      i.get("state", "public")) for i in t["indices"]]
     meta = TableMeta(t["name"], t["table_id"], cols, idxs, t["handle_col"])
+    pd = t.get("partition")
+    if pd is not None:
+        from .catalog import PartitionDef, PartitionInfo
+
+        meta.partition = PartitionInfo(
+            pd["method"], pd["col"],
+            [PartitionDef(p["name"], p["pid"], p["upper"]) for p in pd["parts"]],
+        )
     meta.row_count = t["row_count"]
     meta._next_handle = t["next_handle"]
     if t.get("next_col_id"):
@@ -221,9 +236,10 @@ def load_catalog(store) -> Catalog | None:
     cat = Catalog()
     for _, v in store.kv.scan(M_TABLE_PREFIX, M_TABLE_END, ts):
         meta = table_from_dict(json.loads(v))
-        mh = _max_row_handle(store, meta.table_id)
-        if mh is not None:
-            meta.observe_handle(mh)
+        for pid in meta.physical_ids():
+            mh = _max_row_handle(store, pid)
+            if mh is not None:
+                meta.observe_handle(mh)
         cat._tables[meta.name] = meta
     cat._next_id = max(state["next_id"], cat._next_id)
     cat.version = state["version"]

@@ -51,6 +51,11 @@ class PlannedQuery:
     # session runs the double-read (index scan -> handles -> table read,
     # ref: pkg/executor/distsql.go IndexLookUpExecutor)
     lookup: tuple | None = None
+    # statistics-driven few-groups hint: NDV product of the group-by
+    # columns when ANALYZE stats promise a small group count — routes the
+    # aggregation onto the sort-free dense kernel (ops/aggregate.py);
+    # a wrong promise overflows and falls back, never corrupts
+    small_groups: int | None = None
 
 
 # --------------------------------------------------------------------------
@@ -1139,6 +1144,8 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
 
         referenced = _referenced_columns(stmt, probe_meta)
         for idx in probe_meta.indices:
+            if idx.state != "public":
+                continue  # building indexes are invisible to readers (F1)
             covered = set(idx.col_names) | ({probe_meta.handle_col} if probe_meta.handle_col else set())
             if not referenced <= covered:
                 continue
@@ -1165,12 +1172,30 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
             scope = _Scope(trefs)
             low = _Lowerer(scope, aliases)
             break
-    if access_path == "table" and probe_meta.handle_col is not None:
+    if access_path == "table" and probe_meta.handle_col is not None and probe_meta.partition is None:
         hcol = probe_meta.col(probe_meta.handle_col)
         ivs = intervals_for_column(local[probe_alias], hcol.name, range_const_of(hcol.ft))
         if ivs is not None:
             scan_ranges = handle_ranges_from_intervals(probe_meta.table_id, ivs)
             access_path = "table-range"
+
+    if probe_meta.partition is not None and access_path in ("table", "table-range"):
+        # partition pruning (ref: rule_partition_processor.go): intervals
+        # on the partition column choose the physical partitions to scan;
+        # each pruned partition contributes its own key-space ranges (and
+        # its handle ranges when the PK is the partition column)
+        from ..distsql.dispatch import full_table_ranges
+
+        pcm = probe_meta.col(probe_meta.partition.col)
+        pivs = intervals_for_column(local[probe_alias], pcm.name, range_const_of(pcm.ft))
+        pruned = probe_meta.partition.prune(pivs)
+        if pivs is not None and probe_meta.handle_col == probe_meta.partition.col:
+            scan_ranges = [
+                r for p in pruned for r in handle_ranges_from_intervals(p.pid, pivs)
+            ]
+        else:
+            scan_ranges = [r for p in pruned for r in full_table_ranges(p.pid)]
+        access_path += f" partitions({','.join(p.name for p in pruned)})"
 
     lookup = None
     if access_path == "table" and len(trefs) == 1 and probe_meta.indices:
@@ -1183,6 +1208,8 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
         tstats = catalog.stats.get(probe_meta.table_id)
         best = None
         for idx in probe_meta.indices:
+            if idx.state != "public":
+                continue  # building indexes are invisible to readers (F1)
             first = probe_meta.col(idx.col_names[0])
             ivs = intervals_for_column(local[probe_alias], first.name, range_const_of(first.ft))
             if ivs is None:
@@ -1438,4 +1465,39 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
         dag, probe_meta, build_tables, names,
         offset=offset_n or 0, ranges=scan_ranges, access_path=access_path,
         lookup=lookup,
+        small_groups=_ndv_group_hint(dag, trefs, catalog),
     )
+
+
+def _ndv_group_hint(dag: DAGRequest, trefs: list, catalog: Catalog, cap: int = 512) -> int | None:
+    """NDV-product few-groups hint (ref: the reference's stats-driven agg
+    mode choice; cmsketch.go/histogram NDV feeding cardinality): when every
+    GROUP BY key is a bare column with ANALYZE stats, the product of the
+    column NDVs bounds the group count."""
+    from ..expr.ir import ColumnRef
+
+    agg = dag.executors[-1] if dag.executors else None
+    if not isinstance(agg, Aggregation) or not agg.group_by:
+        return None
+    product = 1
+    for g in agg.group_by:
+        if not isinstance(g, ColumnRef):
+            return None
+        cm = None
+        for tr in trefs:
+            if tr.offset <= g.index < tr.offset + len(tr.meta.columns):
+                cm = tr.meta.columns[g.index - tr.offset]
+                tstats = catalog.stats.get(tr.meta.table_id)
+                break
+        else:
+            return None
+        cs = tstats.columns.get(cm.name) if tstats is not None else None
+        if cs is None or cs.ndv <= 0:
+            return None
+        product *= cs.ndv + (1 if cs.null_count else 0)
+        if product > cap:
+            return None
+    c = 16
+    while c < product:
+        c *= 2
+    return c

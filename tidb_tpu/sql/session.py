@@ -306,12 +306,18 @@ class Session:
     def _lock_rows(self, meta: TableMeta, handles):
         """Pessimistic intention locks at DML/SELECT-FOR-UPDATE time
         (explicit pessimistic txns only; autocommit statements commit
-        immediately so prewrite conflict checks suffice)."""
+        immediately so prewrite conflict checks suffice). Partitioned
+        tables lock the handle's key in EVERY partition — over-locking is
+        sound, and the row's partition is value-dependent."""
         from ..store.txn import TxnError
 
         if self.txn is None or not self.txn.explicit or self.txn.mode != "pessimistic":
             return
-        keys = [tablecodec.encode_row_key(meta.table_id, h) for h in handles]
+        keys = [
+            tablecodec.encode_row_key(pid, h)
+            for h in handles
+            for pid in meta.physical_ids()
+        ]
         if not keys:
             return
         # conflict bound = the txn's snapshot ts: a commit that landed after
@@ -325,14 +331,23 @@ class Session:
         self.txn.locked |= set(keys)
 
     # ------------------------------------------------- buffered write path
+    # row_ops stays keyed by the LOGICAL table id (handles are unique
+    # across partitions — one shared allocator); only the kv key routes
+    # to the row's physical partition (ref: tablecodec keys carry the
+    # PartitionDefinition.ID for partitioned tables)
     def _buf_put_row(self, meta: TableMeta, handle: int, datums: list):
-        key = tablecodec.encode_row_key(meta.table_id, handle)
+        key = tablecodec.encode_row_key(meta.pid_for_row(datums), handle)
         self.txn.mutations[key] = self.store._row_encoder.encode(meta.col_ids(), datums)
         self.txn.row_ops.setdefault(meta.table_id, {})[handle] = list(datums)
 
-    def _buf_delete_row(self, meta: TableMeta, handle: int):
-        key = tablecodec.encode_row_key(meta.table_id, handle)
-        self.txn.mutations[key] = None
+    def _buf_delete_row(self, meta: TableMeta, handle: int, row: list | None = None):
+        pid = meta.pid_for_row(row) if (meta.partition is not None and row is not None) else meta.table_id
+        if meta.partition is not None and row is None:
+            # partition unknown: tombstone the handle in every partition
+            for p in meta.physical_ids():
+                self.txn.mutations[tablecodec.encode_row_key(p, handle)] = None
+        else:
+            self.txn.mutations[tablecodec.encode_row_key(pid, handle)] = None
         self.txn.row_ops.setdefault(meta.table_id, {})[handle] = None
 
     # ------------------------------------------------------------------
@@ -485,6 +500,12 @@ class Session:
                 if scope == "user":
                     self.user_vars[name.lower()] = str(val.value)
                 else:
+                    if name.lower() == "tidb_snapshot" and self.txn is not None:
+                        # ref: TiDB rejects flipping stale-read mode inside
+                        # an open txn (it would take effect only at COMMIT)
+                        raise SQLError(
+                            "can not set 'tidb_snapshot' inside a transaction"
+                        )
                     try:
                         self.sysvars.set(name, str(val.value))
                     except SysVarError as exc:
@@ -840,7 +861,13 @@ class Session:
                 # empty ranges (ranger proved the predicate unsatisfiable)
                 # flow through: execute_root dispatches zero tasks and the
                 # root merge still produces scalar-agg rows
-                ranges = plan.ranges if plan.ranges is not None else full_table_ranges(plan.probe_table.table_id)
+                if plan.ranges is not None:
+                    ranges = plan.ranges
+                else:
+                    ranges = [
+                        r for pid in plan.probe_table.physical_ids()
+                        for r in full_table_ranges(pid)
+                    ]
                 if plan.lookup is not None:
                     # index-lookup double-read phase 1: index scan -> row
                     # handles -> coalesced table ranges (ref:
@@ -852,7 +879,7 @@ class Session:
                     chunk = self._select_via_oracle(plan, ranges, aux, ts)
                 else:
                     chunk = None
-                    if not aux and self._explain_sink is None and self.sysvars.get_bool("tidb_enable_tpu_mesh"):
+                    if self._explain_sink is None and self.sysvars.get_bool("tidb_enable_tpu_mesh"):
                         # EXPLAIN ANALYZE wants per-executor summaries,
                         # which only the per-region path produces
                         # MPP analog: eligible GROUP BY plans run as ONE
@@ -863,12 +890,14 @@ class Session:
                         chunk = try_mesh_select(
                             self.store, plan.dag, ranges, ts,
                             group_capacity=self.sysvars.get_int("tidb_tpu_group_capacity"),
+                            aux_chunks=aux,
                         )
                     if chunk is None:
                         kwargs = dict(
                             start_ts=ts,
                             aux_chunks=aux,
                             group_capacity=self.sysvars.get_int("tidb_tpu_group_capacity"),
+                            small_groups=plan.small_groups,
                             concurrency=self.sysvars.get_int("tidb_distsql_scan_concurrency"),
                             paging_size=(
                                 self.sysvars.get_int("tidb_max_chunk_size")
@@ -1178,7 +1207,8 @@ class Session:
     def _fetch_table_chunk(self, meta: TableMeta, ts: int) -> Chunk:
         scan = TableScan(meta.table_id, meta.scan_columns())
         dag = DAGRequest((scan,), output_offsets=tuple(range(len(meta.columns))))
-        return execute_root(self.store, dag, full_table_ranges(meta.table_id), start_ts=ts)
+        ranges = [r for pid in meta.physical_ids() for r in full_table_ranges(pid)]
+        return execute_root(self.store, dag, ranges, start_ts=ts)
 
     # ------------------------------------------------------------------
     def _eval_const(self, node: A.ExprNode, ft: FieldType) -> Datum:
@@ -1197,33 +1227,78 @@ class Session:
         cols = [c[0] if isinstance(c, tuple) else str(c) for c in stmt.columns]
         n = run_job(self.catalog, "add index", meta.name,
                     f"CREATE INDEX {stmt.index_name} ON {meta.name}",
-                    lambda: self._build_index(meta, stmt.index_name, cols, stmt.unique),
+                    lambda step: self._build_index(meta, stmt.index_name, cols, stmt.unique, step=step),
                     index_states=True)
         return Result(affected=n)
 
-    def _build_index(self, meta: TableMeta, index_name: str, cols: list, unique: bool) -> int:
-        """Metadata + backfill (shared by CREATE INDEX and ALTER ADD INDEX)."""
-        im = self.catalog.add_index(meta.name, index_name, cols, unique)
-        ts = self._next_ts()
-        rows = self._scan_rows_with_handles(meta, None, ts)
-        wts = self._next_ts()
-        pos = {c.name: i for i, c in enumerate(meta.columns)}
-        # validate the WHOLE backfill before writing anything: a duplicate
-        # found mid-write would leave dead index entries in the store
-        seen: dict = {}
-        entries = []
-        for handle, row in rows:
-            vals = [row[pos[cn]] for cn in im.col_names]
-            if im.unique and not any(d.is_null() for d in vals):
-                k = tuple(str(d) for d in vals)
-                if k in seen:
-                    self.catalog.drop_index(meta.name, im.name)  # roll back
-                    raise SQLError(f"duplicate entry for unique key {im.name!r} during backfill")
-                seen[k] = handle
-            entries.append(tablecodec.encode_index_key(meta.table_id, im.index_id, vals + [Datum.i64(handle)]))
-        for key in entries:
-            self.store.put_index(key, b"\x00", wts)
-        return len(rows)
+    def _build_index(self, meta: TableMeta, index_name: str, cols: list, unique: bool, step=None) -> int:
+        """ONLINE index build (shared by CREATE INDEX and ALTER ADD INDEX):
+        the real F1 state walk (ref: pkg/ddl/index.go) — the IndexMeta's
+        `state` drives concurrent DML's behavior at every step, not just a
+        recorded list:
+
+          delete_only   registered; DML honors deletes, adds no entries
+          write_only    DML double-writes entries; readers still ignore it
+          write_reorg   backfill scans a snapshot and writes every entry;
+                        a verify pass tombstones entries whose row vanished
+                        between the scan and the writes (concurrent DELETE)
+          public        readers may use it
+
+        `step` (from run_job) records each transition as a schema-version
+        bump; failpoints let tests pause between states while writer
+        threads run DML."""
+        from ..util import failpoint
+
+        step = step or (lambda st: None)
+        im = self.catalog.add_index(meta.name, index_name, cols, unique, state="delete_only")
+        try:
+            step("delete_only")
+            failpoint.eval("ddl_index_delete_only")
+            im.state = "write_only"
+            self.catalog.version += 1
+            step("write_only")
+            failpoint.eval("ddl_index_write_only")
+            im.state = "write_reorg"
+            self.catalog.version += 1
+            step("write_reorg")
+            failpoint.eval("ddl_index_write_reorg")
+            ts = self._next_ts()
+            rows = self._scan_rows_with_handles(meta, None, ts)
+            wts = self._next_ts()
+            pos = {c.name: i for i, c in enumerate(meta.columns)}
+            # validate the WHOLE backfill before writing anything: a
+            # duplicate found mid-write would leave dead index entries
+            seen: dict = {}
+            entries = []
+            for handle, row in rows:
+                vals = [row[pos[cn]] for cn in im.col_names]
+                if im.unique and not any(d.is_null() for d in vals):
+                    k = tuple(str(d) for d in vals)
+                    if k in seen:
+                        raise SQLError(f"duplicate entry for unique key {im.name!r} during backfill")
+                    seen[k] = handle
+                entries.append(tablecodec.encode_index_key(meta.table_id, im.index_id, vals + [Datum.i64(handle)]))
+            for key in entries:
+                self.store.put_index(key, b"\x00", wts)
+            # verify pass: a row DELETEd between the scan snapshot and wts
+            # would be resurrected by the backfill write — tombstone every
+            # backfilled entry whose row no longer exists (ref: the
+            # reference merges delete markers during reorg)
+            vts = self._next_ts()
+            live = set()
+            for handle, row in self._scan_rows_with_handles(meta, None, vts):
+                vals = [row[pos[cn]] for cn in im.col_names]
+                live.add(tablecodec.encode_index_key(meta.table_id, im.index_id, vals + [Datum.i64(handle)]))
+            dts = self._next_ts()
+            for key in entries:
+                if key not in live:
+                    self.store.put_index(key, None, dts)
+            im.state = "public"
+            self.catalog.version += 1
+            return len(rows)
+        except Exception:
+            self.catalog.drop_index(meta.name, im.name)  # roll back metadata
+            raise
 
     def _drop_index(self, stmt: A.DropIndexStmt) -> Result:
         from .ddl import run_job
@@ -1267,6 +1342,10 @@ class Session:
         own = {handle, old_handle if old_handle is not None else handle}
         pos = {c.name: i for i, c in enumerate(meta.columns)}
         for idx in meta.indices:
+            if idx.state == "delete_only":
+                # not yet double-written: probing it would miss real rows;
+                # pre-existing duplicates are caught by the reorg backfill
+                continue
             if not idx.unique:
                 continue
             vals = [datums[pos[cn]] for cn in idx.col_names]
@@ -1308,7 +1387,15 @@ class Session:
         return out
 
     def _write_indexes(self, meta, datums, handle, delete=False):
-        for key in self._index_keys(meta, datums, handle):
+        pos = {c.name: i for i, c in enumerate(meta.columns)}
+        for idx in meta.indices:
+            if not delete and idx.state == "delete_only":
+                # F1 delete-only: concurrent DML removes entries but must
+                # not ADD ones the backfill has not reached yet
+                # (ref: pkg/ddl/index.go state semantics)
+                continue
+            vals = [datums[pos[cn]] for cn in idx.col_names] + [Datum.i64(handle)]
+            key = tablecodec.encode_index_key(meta.table_id, idx.index_id, vals)
             val = None if delete else b"\x00"
             self.txn.mutations[key] = val
             self.txn.index_muts[key] = val
@@ -1351,7 +1438,6 @@ class Session:
                 if meta.handle_col is not None:
                     i = [c.name for c in meta.columns].index(meta.handle_col)
                     datums[i] = Datum.i64(handle)
-            key = tablecodec.encode_row_key(meta.table_id, handle)
             exists = self._read_row(meta, handle, ts) is not None
             if exists:
                 # duplicate primary key (ref: ER_DUP_ENTRY / REPLACE / IGNORE)
@@ -1373,7 +1459,7 @@ class Session:
                 old_row = self._read_row(meta, c_handle, ts)
                 if old_row is not None:
                     self._write_indexes(meta, old_row, c_handle, delete=True)
-                    self._buf_delete_row(meta, c_handle)
+                    self._buf_delete_row(meta, c_handle, old_row)
                     self.txn.row_delta[meta.table_id] = self.txn.row_delta.get(meta.table_id, 0) - 1
                     n += 1  # MySQL counts each replaced row
                 conflict = self._find_unique_conflict(meta, datums, handle, ts)
@@ -1403,7 +1489,17 @@ class Session:
             if handle in ops:
                 row = ops[handle]
                 return list(row) if row is not None else None
-        val = self.store.kv.get(tablecodec.encode_row_key(meta.table_id, handle), ts)
+        val = None
+        if meta.partition is not None and meta.handle_col == meta.partition.col:
+            # PK == partition column: the handle VALUE routes directly
+            val = self.store.kv.get(
+                tablecodec.encode_row_key(meta.partition.route(handle), handle), ts
+            )
+        else:
+            for pid in meta.physical_ids():
+                val = self.store.kv.get(tablecodec.encode_row_key(pid, handle), ts)
+                if val is not None:
+                    break
         if val is None:
             return None
         dmap = decode_row_to_datum_map(val, {c.col_id: c.ft for c in meta.columns})
@@ -1423,7 +1519,8 @@ class Session:
         cols = [ColumnInfo(-1, HANDLE_FT)] + list(meta.scan_columns())
         scan = TableScan(meta.table_id, tuple(cols))
         dag = DAGRequest((scan,), output_offsets=tuple(range(len(cols))))
-        chunk = execute_root(self.store, dag, full_table_ranges(meta.table_id), start_ts=ts)
+        ranges = [r for pid in meta.physical_ids() for r in full_table_ranges(pid)]
+        chunk = execute_root(self.store, dag, ranges, start_ts=ts)
         by_handle = {int(r[0].val): r[1:] for r in chunk.rows()}
         if self.txn is not None:
             # read-your-writes overlay (the UnionScan analog)
@@ -1497,8 +1594,12 @@ class Session:
             if new_handle != handle:
                 # PK change moves the row to a new key (ref: updateRecord's
                 # remove+add when the handle changes)
-                self._buf_delete_row(meta, handle)
+                self._buf_delete_row(meta, handle, row)
                 self._lock_rows(meta, [new_handle])
+            elif meta.partition is not None and meta.pid_for_row(row) != meta.pid_for_row(new_row):
+                # partition-column change moves the row across partitions
+                # (MySQL row movement): drop the old physical key
+                self._buf_delete_row(meta, handle, row)
             self._write_indexes(meta, row, handle, delete=True)
             self._buf_put_row(meta, new_handle, new_row)
             self._write_indexes(meta, new_row, new_handle)
@@ -1512,7 +1613,7 @@ class Session:
         matched = self._scan_rows_with_handles(meta, stmt.where, ts, stmt.order_by, stmt.limit)
         self._lock_rows(meta, [h for h, _ in matched])
         for handle, row in matched:
-            self._buf_delete_row(meta, handle)
+            self._buf_delete_row(meta, handle, row)
             self._write_indexes(meta, row, handle, delete=True)
         self.txn.row_delta[meta.table_id] = self.txn.row_delta.get(meta.table_id, 0) - len(matched)
         return Result(affected=len(matched))
@@ -1522,7 +1623,7 @@ class Session:
         ts = self.txn.start_ts
         matched = self._scan_rows_with_handles(meta, None, ts)
         for handle, row in matched:
-            self._buf_delete_row(meta, handle)
+            self._buf_delete_row(meta, handle, row)
             self._write_indexes(meta, row, handle, delete=True)
         self.txn.row_delta[meta.table_id] = -meta.row_count
         return Result(affected=len(matched))
@@ -1710,6 +1811,8 @@ class Session:
                 rows = self._scan_rows_with_handles(meta, None, ts)
                 pos = {c.name: i for i, c in enumerate(meta.columns)}
                 for idx in meta.indices:
+                    if idx.state != "public":
+                        continue  # a building index is legitimately partial
                     live = set()
                     for handle, row in rows:
                         vals = [row[pos[cn]] for cn in idx.col_names] + [Datum.i64(handle)]

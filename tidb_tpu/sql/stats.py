@@ -19,6 +19,41 @@ from .ranger import Interval
 
 DEFAULT_BUCKETS = 64
 DEFAULT_TOPN = 16
+CM_DEPTH = 5
+CM_WIDTH = 2048
+
+
+class CMSketch:
+    """Count-Min sketch over datum group keys (ref: pkg/statistics/
+    cmsketch.go — d x w counters, point frequency = min over rows; TopN
+    values are kept OUT of the sketch, exactly like the reference splits
+    CMSketchAndTopN)."""
+
+    __slots__ = ("depth", "width", "rows")
+
+    def __init__(self, depth: int = CM_DEPTH, width: int = CM_WIDTH):
+        self.depth = depth
+        self.width = width
+        self.rows = [[0] * width for _ in range(depth)]
+
+    @staticmethod
+    def _key(d: Datum):
+        from ..exec.executor import datum_group_key
+
+        return datum_group_key(d)
+
+    def insert(self, d: Datum, count: int = 1):
+        k = hash(self._key(d))
+        for i in range(self.depth):
+            h = hash((i * 0x9E3779B97F4A7C15, k)) % self.width
+            self.rows[i][h] += count
+
+    def query(self, d: Datum) -> int:
+        k = hash(self._key(d))
+        return min(
+            self.rows[i][hash((i * 0x9E3779B97F4A7C15, k)) % self.width]
+            for i in range(self.depth)
+        )
 
 
 @dataclass
@@ -39,6 +74,7 @@ class ColumnStats:
     total: int = 0  # non-null rows
     topn: list = field(default_factory=list)  # [(Datum, count)] most frequent
     buckets: list = field(default_factory=list)  # [Bucket] ascending
+    cmsketch: CMSketch | None = None  # point frequencies for non-TopN values
 
 
 @dataclass
@@ -73,6 +109,9 @@ def build_column_stats(values: list, n_buckets: int = DEFAULT_BUCKETS,
     rest = [g for g in groups if id(g[0]) not in topn_vals]
     if not rest:
         return cs
+    cs.cmsketch = CMSketch()
+    for d, c in rest:
+        cs.cmsketch.insert(d, c)
     depth = max(sum(c for _, c in rest) // n_buckets + 1, 1)
     cur: Bucket | None = None
     for d, c in rest:
@@ -123,8 +162,11 @@ def est_interval_rows(cs: ColumnStats, iv: Interval) -> float:
     if is_point:
         if any(compare(d, iv.low) == 0 for d, _ in cs.topn):
             return hit  # TopN answers exactly; buckets exclude TopN values
-        # equality not answered by TopN: avg rows-per-distinct of the
-        # containing bucket (ref: histogram.go equalRowCount)
+        # equality not answered by TopN: the CM sketch answers point
+        # frequency (ref: cmsketch.go queryValue); the bucket average is
+        # the no-sketch fallback (histogram.go equalRowCount)
+        if cs.cmsketch is not None:
+            return hit + cs.cmsketch.query(iv.low)
         for b in cs.buckets:
             if compare(iv.low, b.lower) >= 0 and compare(iv.low, b.upper) <= 0:
                 if compare(iv.low, b.upper) == 0:

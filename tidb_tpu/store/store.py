@@ -62,6 +62,7 @@ class CopRequest:
     region_epoch: int = 0
     aux_chunks: list = field(default_factory=list)
     paging_size: int | None = None
+    small_groups: int | None = None  # planner NDV hint (stats-driven)
 
 
 @dataclass
@@ -413,7 +414,8 @@ class TPUStore:
             else:
                 batch = self.region_device_batch(region, req.ranges, req.dag, req.start_ts)
             batches = [batch] + [self._aux_batch(c) for c in req.aux_chunks]
-            chunk, ex_rows = drive_program(self.programs, req.dag, batches, group_capacity)
+            chunk, ex_rows = drive_program(self.programs, req.dag, batches, group_capacity,
+                                           small_groups=req.small_groups)
         except (OverflowRetryError, NotImplementedError):
             # degenerate fan-out OR an op the device cannot express (JSON,
             # regexp, host-only funcs reaching a pushed executor): fall back

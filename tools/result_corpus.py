@@ -184,13 +184,16 @@ def run_file(name: str, test_dir: str = TEST_DIR, result_dir: str = RESULT_DIR):
         return None
 
     n_stmt = sum(1 for it in items if it[0] == "stmt")
+    stmts = [it for it in items if it[0] == "stmt"]
     seen = 0
+    si = -1
     for it in items:
         if it[0] == "echo":
             if cur < len(rlines) and rlines[cur] == it[1]:
                 cur += 1
             continue
         _, stmt_lines, mods = it
+        si += 1
         seen += 1
         if not mods["qlog"]:
             counts["desync"] += 1  # unecho'd statements can't be aligned
@@ -200,10 +203,18 @@ def run_file(name: str, test_dir: str = TEST_DIR, result_dir: str = RESULT_DIR):
             # lost alignment: count the rest of the file as desync
             counts["desync"] += n_stmt - seen + 1
             break
-        # expected output = lines until the next statement/echo anchor;
-        # we can't know the next anchor cheaply, so execute first and
-        # consume greedily by comparing
         cur = after
+        # the recorded output block is EVERYTHING up to the next
+        # statement's echo (or EOF) — comparing the full block means a
+        # strict-prefix engine result (missing rows) is a MISMATCH, not a
+        # match (code-review r4: length-sliced compare inflated the rate)
+        block_end = len(rlines)
+        if si + 1 < len(stmts):
+            nxt_first = stmts[si + 1][1][0].strip()
+            for j in range(cur, min(cur + 400, len(rlines))):
+                if rlines[j].strip() == nxt_first:
+                    block_end = j
+                    break
         sql = "\n".join(stmt_lines).strip().rstrip(";")
         expect_error = mods["error"]
         try:
@@ -214,10 +225,13 @@ def run_file(name: str, test_dir: str = TEST_DIR, result_dir: str = RESULT_DIR):
                 counts["mismatch"] += 1
                 continue
             got = ([] if header is None else [header] + rows)
-            want = rlines[cur:cur + len(got)]
-            if mods["sorted"] and header is not None:
+            # ALWAYS compare the full recorded block (to the next echo or
+            # EOF): a truncated `want` would count missing trailing rows
+            # as a match (code-review r4, twice)
+            want = rlines[cur:block_end]
+            if mods["sorted"] and header is not None and want:
                 got = [got[0]] + sorted(got[1:])
-                want = [want[0]] + sorted(want[1:]) if want else want
+                want = [want[0]] + sorted(want[1:])
             if got == want:
                 counts["match"] += 1
                 cur += len(got)
