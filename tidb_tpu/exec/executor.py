@@ -125,13 +125,15 @@ class OverflowRetryError(RuntimeError):
     row-at-a-time oracle (the host fallback SURVEY §7 promises)."""
 
 
-def _group_key_partition(chunk: Chunk, key_cols: list[int], n_parts: int) -> list[Chunk]:
+def _group_key_partition(chunk: Chunk, key_cols: list[int], n_parts: int, salt: int = 0) -> list[Chunk]:
     """Split rows by a host-side hash of the named columns: equal keys land
-    in the same part, so per-part aggregation results are disjoint."""
+    in the same part, so per-part aggregation results are disjoint. `salt`
+    varies per recursion depth — an unsalted re-partition of one part maps
+    every row back into a single bucket (code-review r4)."""
     import numpy as np
 
     n = chunk.num_rows()
-    h = np.full(n, 1469598103934665603, np.uint64)  # FNV offset
+    h = np.full(n, 1469598103934665603 ^ (salt * 0x9E3779B97F4A7C15 & 0xFFFFFFFFFFFFFFFF), np.uint64)
     prime = np.uint64(1099511628211)
     for ci in key_cols:
         col = chunk.columns[ci]
@@ -207,7 +209,7 @@ def _spill_partitioned(dag: DAGRequest, chunks, cache, group_capacity, small_gro
 
             metrics.SPILL_PARTITIONS.inc()
             keys = [g.index for g in last.group_by]
-            return run_parts(_group_key_partition(probe, keys, 4))
+            return run_parts(_group_key_partition(probe, keys, 4, salt=depth + 1))
         raise OverflowRetryError("no safe spill decomposition for this aggregation")
     row_local = all(
         isinstance(e, (TableScan, Selection, Projection, Join)) for e in dag.executors

@@ -270,6 +270,10 @@ class Catalog:
         from .ddl import DDLJobLog
 
         self.ddl_jobs = DDLJobLog()  # schema-change job history
+        from ..util.stmtlog import StmtLog
+
+        self.stmtlog = StmtLog()  # slow-query log + statement summary
+        # (domain-level: shared by every session of this catalog)
 
     def _alloc_id(self) -> int:
         v = self._next_id

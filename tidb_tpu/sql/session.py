@@ -352,8 +352,35 @@ class Session:
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> Result:
-        stmt = parse_one(sql)
-        return self.execute_stmt(stmt)
+        """Parse + execute one statement, feeding the slow-query log and
+        statement summary (ref: ExecStmt.Exec wrapping + LogSlowQuery,
+        adapter.go:458/1580; pkg/util/stmtsummary Add)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            stmt = parse_one(sql)
+            res = self.execute_stmt(stmt)
+        except Exception as exc:
+            self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, 0, False, str(exc))
+            raise
+        rows = len(res.rows) if getattr(res, "rows", None) else getattr(res, "affected", 0)
+        self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, rows, True)
+        return res
+
+    def _record_stmt(self, sql: str, dur_ms: float, rows: int, ok: bool, err: str = ""):
+        try:
+            thr = None
+            if self.sysvars.get_bool("tidb_enable_slow_log"):
+                t = self.sysvars.get_int("tidb_slow_log_threshold")
+                thr = float(t) if t >= 0 else None
+            self.catalog.stmtlog.record(
+                sql, dur_ms, rows, ok, err,
+                slow_threshold_ms=thr,
+                summary_enabled=self.sysvars.get_bool("tidb_enable_stmt_summary"),
+            )
+        except Exception:  # noqa: BLE001 — observability must never fail a query
+            pass
 
     def execute_stmt(self, stmt) -> Result:
         self._check_privileges(stmt)
@@ -1118,6 +1145,40 @@ class Session:
                         Datum.i64(nu), Datum.string(iname),
                         Datum.i64(seq), Datum.string(cn),
                     ])
+        elif kind == "slow_query":
+            # ref: infoschema slow_query memtable fed by the slow log
+            from ..types import new_double
+
+            D = new_double()
+            names = ["time", "query_time", "digest", "query", "success"]
+            fts = [S, D, S, new_varchar(4096), I]
+            rows = []
+            import datetime as _dt
+
+            for e in self.catalog.stmtlog.slow_entries():
+                rows.append([
+                    Datum.string(_dt.datetime.utcfromtimestamp(e.ts).strftime("%Y-%m-%d %H:%M:%S")),
+                    Datum.f64(e.duration_ms / 1e3),
+                    Datum.string(e.digest), Datum.string(e.sql),
+                    Datum.i64(1 if e.success else 0),
+                ])
+        elif kind == "statements_summary":
+            # ref: pkg/util/stmtsummary -> information_schema.statements_summary
+            from ..types import new_double
+
+            D = new_double()
+            names = ["digest", "digest_text", "exec_count", "sum_latency",
+                     "max_latency", "avg_latency", "sum_rows", "errors", "sample_sql"]
+            fts = [S, new_varchar(1024), I, D, D, D, I, I, new_varchar(256)]
+            rows = []
+            for sm in self.catalog.stmtlog.summary_rows():
+                rows.append([
+                    Datum.string(sm.digest), Datum.string(sm.normalized),
+                    Datum.i64(sm.exec_count), Datum.f64(sm.sum_latency_ms),
+                    Datum.f64(sm.max_latency_ms), Datum.f64(sm.avg_latency_ms),
+                    Datum.i64(sm.sum_rows), Datum.i64(sm.errors),
+                    Datum.string(sm.sample_sql),
+                ])
         else:
             raise SQLError(f"information_schema.{kind} not supported yet")
         meta = rw.registry.register(names, fts, rows)
@@ -1375,17 +1436,6 @@ class Session:
         except Exception:
             return None
 
-    def _index_keys(self, meta: TableMeta, datums: list, handle: int) -> list:
-        """Index entry keys for one row: t{tid}_i{iid}{vals...}{handle}
-        (ref: tablecodec index layout; non-unique style — the handle rides
-        in the key, the value is a placeholder)."""
-        pos = {c.name: i for i, c in enumerate(meta.columns)}
-        out = []
-        for idx in meta.indices:
-            vals = [datums[pos[cn]] for cn in idx.col_names] + [Datum.i64(handle)]
-            out.append(tablecodec.encode_index_key(meta.table_id, idx.index_id, vals))
-        return out
-
     def _write_indexes(self, meta, datums, handle, delete=False):
         pos = {c.name: i for i, c in enumerate(meta.columns)}
         for idx in meta.indices:
@@ -1491,10 +1541,16 @@ class Session:
                 return list(row) if row is not None else None
         val = None
         if meta.partition is not None and meta.handle_col == meta.partition.col:
-            # PK == partition column: the handle VALUE routes directly
-            val = self.store.kv.get(
-                tablecodec.encode_row_key(meta.partition.route(handle), handle), ts
-            )
+            # PK == partition column: the handle VALUE routes directly; a
+            # value beyond the last RANGE bound simply has no row (MySQL
+            # returns the empty set — the route() raise is for INSERT)
+            from .catalog import CatalogError as _CE
+
+            try:
+                pid = meta.partition.route(handle)
+            except _CE:
+                return None
+            val = self.store.kv.get(tablecodec.encode_row_key(pid, handle), ts)
         else:
             for pid in meta.physical_ids():
                 val = self.store.kv.get(tablecodec.encode_row_key(pid, handle), ts)

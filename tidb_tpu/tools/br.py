@@ -96,9 +96,15 @@ def _schema_dict(catalog) -> list:
             ],
             "indices": [
                 {"name": i.name, "index_id": i.index_id, "col_names": i.col_names,
-                 "unique": i.unique}
+                 "unique": i.unique, "state": i.state}
                 for i in m.indices
             ],
+            "partition": None if m.partition is None else {
+                "method": m.partition.method,
+                "col": m.partition.col,
+                "parts": [{"name": p.name, "pid": p.pid, "upper": p.upper}
+                          for p in m.partition.parts],
+            },
         })
     return out
 
@@ -179,8 +185,17 @@ def restore(store, catalog, src_dir: str) -> dict:
             )
             for c in t["columns"]
         ]
-        idxs = [IndexMeta(i["name"], i["index_id"], list(i["col_names"]), i["unique"]) for i in t["indices"]]
+        idxs = [IndexMeta(i["name"], i["index_id"], list(i["col_names"]), i["unique"],
+                          i.get("state", "public")) for i in t["indices"]]
         meta = TableMeta(t["name"], t["table_id"], cols, idxs, t["handle_col"])
+        pd = t.get("partition")
+        if pd is not None:
+            from ..sql.catalog import PartitionDef, PartitionInfo
+
+            meta.partition = PartitionInfo(
+                pd["method"], pd["col"],
+                [PartitionDef(p["name"], p["pid"], p["upper"]) for p in pd["parts"]],
+            )
         meta.row_count = t["row_count"]
         meta._next_handle = t["next_handle"]
         if t.get("next_col_id"):
@@ -190,7 +205,9 @@ def restore(store, catalog, src_dir: str) -> dict:
             catalog.version += 1
     max_id = 0
     for t in manifest["schema"]:
-        max_id = max(max_id, t["table_id"], *[i["index_id"] for i in t["indices"]] or [0])
+        ids = [t["table_id"]] + [i["index_id"] for i in t["indices"]]
+        ids += [p["pid"] for p in (t.get("partition") or {}).get("parts", [])]
+        max_id = max(max_id, *ids)
     catalog.ensure_id_above(max_id)
     ts = store.next_ts()
     n = 0

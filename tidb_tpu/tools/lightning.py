@@ -107,7 +107,7 @@ def load_data(session, stmt) -> int:
                 if handle in seen_pk:
                     raise SQLError(f"LOAD DATA: duplicate primary key {handle} within the file")
                 seen_pk.add(handle)
-                key = tablecodec.encode_row_key(meta.table_id, handle)
+                key = tablecodec.encode_row_key(meta.pid_for_row(datums), handle)
                 if session.store.kv.get(key, read_ts) is not None:
                     raise SQLError(f"LOAD DATA: duplicate primary key {handle}")
                 for idx in uniq_idxs:
@@ -123,7 +123,9 @@ def load_data(session, stmt) -> int:
             items = []
             for handle, datums in batch_rows:
                 items.append((
-                    tablecodec.encode_row_key(meta.table_id, handle),
+                    # partition-aware key routing (partitioned tables store
+                    # rows under their PartitionDef pid)
+                    tablecodec.encode_row_key(meta.pid_for_row(datums), handle),
                     session.store._row_encoder.encode(meta.col_ids(), datums),
                 ))
                 for idx in meta.indices:

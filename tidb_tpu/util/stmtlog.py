@@ -1,0 +1,138 @@
+"""Slow-query log + statement summary (ref: pkg/executor/adapter.go:1580
+ExecStmt.LogSlowQuery and pkg/util/stmtsummary — the reference writes slow
+entries to the slow log file and aggregates per SQL digest into
+`information_schema.statements_summary`; here both live in one in-process
+registry shared by every session of a catalog (the domain analog) and are
+served as information_schema memtables).
+
+Digests normalize the SQL through the real lexer: literals become '?', so
+`select * from t where a = 5` and `... a = 7` share one summary row, the
+same way the reference's parser.NormalizeDigest works."""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+def normalize_sql(sql: str) -> tuple[str, str]:
+    """(normalized text, hex digest). Literals -> '?', idents lowered —
+    the parser.Normalize/Digest analog."""
+    from ..parser.lexer import T, tokenize
+
+    try:
+        toks = tokenize(sql)
+    except Exception:  # noqa: BLE001 — unlexable SQL still gets a digest
+        norm = " ".join(sql.split()).lower()
+        return norm, hashlib.sha256(norm.encode()).hexdigest()[:32]
+    parts = []
+    for t in toks:
+        if t.kind is T.EOF:
+            break
+        if t.kind in (T.NUMBER, T.STRING):
+            parts.append("?")
+        elif t.kind is T.IDENT:
+            parts.append(t.text.lower())
+        else:
+            parts.append(t.text)
+    norm = " ".join(parts)
+    return norm, hashlib.sha256(norm.encode()).hexdigest()[:32]
+
+
+@dataclass
+class SlowLogEntry:
+    """(ref: the slow-log fields adapter.go writes: Time, Query_time, SQL,
+    digest, result rows, success)."""
+
+    ts: float
+    duration_ms: float
+    sql: str
+    digest: str
+    rows: int
+    success: bool
+    error: str = ""
+
+
+@dataclass
+class StmtSummary:
+    """(ref: stmtsummary.stmtSummaryByDigest)."""
+
+    digest: str
+    normalized: str
+    sample_sql: str
+    exec_count: int = 0
+    sum_latency_ms: float = 0.0
+    max_latency_ms: float = 0.0
+    min_latency_ms: float = float("inf")
+    sum_rows: int = 0
+    errors: int = 0
+    last_seen: float = 0.0
+
+    @property
+    def avg_latency_ms(self) -> float:
+        return self.sum_latency_ms / self.exec_count if self.exec_count else 0.0
+
+
+class StmtLog:
+    """Shared per-catalog registry: bounded slow-query ring + per-digest
+    summaries (LRU-bounded like tidb_stmt_summary_max_stmt_count)."""
+
+    def __init__(self, slow_capacity: int = 512, max_digests: int = 3000):
+        self._lock = threading.Lock()
+        self.slow: list[SlowLogEntry] = []
+        self.slow_capacity = slow_capacity
+        self.summaries: dict[str, StmtSummary] = {}
+        self.max_digests = max_digests
+
+    def record(
+        self,
+        sql: str,
+        duration_ms: float,
+        rows: int,
+        success: bool,
+        error: str = "",
+        slow_threshold_ms: float | None = 300.0,
+        summary_enabled: bool = True,
+    ):
+        if not summary_enabled and slow_threshold_ms is None:
+            return  # observability fully off: skip the lexer+digest pass
+        norm, digest = normalize_sql(sql)
+        now = time.time()
+        with self._lock:
+            if summary_enabled:
+                s = self.summaries.get(digest)
+                if s is None:
+                    if len(self.summaries) >= self.max_digests:
+                        # evict the least-recently-seen digest
+                        victim = min(self.summaries.values(), key=lambda x: x.last_seen)
+                        del self.summaries[victim.digest]
+                    s = StmtSummary(digest, norm, sql[:256])
+                    self.summaries[digest] = s
+                s.exec_count += 1
+                s.sum_latency_ms += duration_ms
+                s.max_latency_ms = max(s.max_latency_ms, duration_ms)
+                s.min_latency_ms = min(s.min_latency_ms, duration_ms)
+                s.sum_rows += rows
+                s.errors += 0 if success else 1
+                s.last_seen = now
+            if slow_threshold_ms is not None and duration_ms > slow_threshold_ms:
+                self.slow.append(
+                    SlowLogEntry(now, duration_ms, sql[:4096], digest, rows, success, error)
+                )
+                if len(self.slow) > self.slow_capacity:
+                    del self.slow[: len(self.slow) - self.slow_capacity]
+
+    def slow_entries(self) -> list[SlowLogEntry]:
+        with self._lock:
+            return list(self.slow)
+
+    def summary_rows(self) -> list[StmtSummary]:
+        with self._lock:
+            return sorted(self.summaries.values(), key=lambda s: -s.sum_latency_ms)
+
+    def clear(self):
+        with self._lock:
+            self.slow.clear()
+            self.summaries.clear()
