@@ -91,16 +91,43 @@ def topn(by: list, row_valid, k: int, full_sort: bool = False):
     cnt = cand.sum().astype(jnp.int32)
     overflow = (cnt < jnp.minimum(jnp.int32(k), n_valid.astype(jnp.int32))) | (cnt > cap)
 
-    # compact first `cap` candidate positions: cumsum + searchsorted
-    # (ascending by construction — stability preserved)
-    c = jnp.cumsum(cand.astype(jnp.int32))
-    cpos = jnp.searchsorted(c, jnp.arange(1, cap + 1, dtype=jnp.int32), side="left").astype(jnp.int32)
+    # compact first `cap` candidate positions (ascending by construction —
+    # stability preserved)
+    cpos = _first_set_positions(cand, cap)
     cvalid = jnp.arange(cap, dtype=jnp.int32) < cnt
     cpos_c = jnp.clip(cpos, 0, n - 1)
     small_keys = [jnp.where(cvalid, jnp.int64(0), jnp.int64(1))] + [kk[cpos_c] for kk in keys]
     perm_s = lexsort(small_keys, extra_key=cpos_c.astype(jnp.int64))
     fast_idx = cpos_c[perm_s[:k]].astype(jnp.int32)
     return fast_idx, out_valid, overflow
+
+
+def _first_set_positions(cand, cap: int, block: int = 256):
+    """Positions of the first `cap` set bits of cand [N], ascending.
+
+    Two-level: per-block counts locate each rank's block (binary search
+    over a tiny VMEM-resident haystack), then a [cap, block] contiguous
+    row-gather + intra-block cumsum finds the bit. ~2x the flat
+    cumsum+searchsorted formulation on TPU (the flat variant's binary
+    search runs ~log2(N) serial gather rounds over an HBM haystack;
+    measured 1.8ms vs 0.9ms at N=4M, cap=4096)."""
+    n = cand.shape[0]
+    if n % block or n <= block:
+        c = jnp.cumsum(cand.astype(jnp.int32))
+        return jnp.searchsorted(c, jnp.arange(1, cap + 1, dtype=jnp.int32), side="left").astype(jnp.int32)
+    nb = n // block
+    blocks = cand.reshape(nb, block)
+    cum_b = jnp.cumsum(blocks.sum(axis=1, dtype=jnp.int32))
+    ranks = jnp.arange(1, cap + 1, dtype=jnp.int32)
+    blk = jnp.minimum(
+        jnp.searchsorted(cum_b, ranks, side="left").astype(jnp.int32), nb - 1
+    )
+    rows = blocks[blk]  # [cap, block] contiguous row gather
+    prev = jnp.where(blk > 0, cum_b[jnp.maximum(blk - 1, 0)], 0)
+    need = (ranks - prev).astype(jnp.int32)
+    ccum = jnp.cumsum(rows.astype(jnp.int32), axis=1)
+    intra = jnp.argmax((ccum >= need[:, None]) & rows, axis=1).astype(jnp.int32)
+    return blk * block + intra
 
 
 def _order_keys(by: list, row_valid):

@@ -1157,7 +1157,7 @@ class Session:
 
             for e in self.catalog.stmtlog.slow_entries():
                 rows.append([
-                    Datum.string(_dt.datetime.utcfromtimestamp(e.ts).strftime("%Y-%m-%d %H:%M:%S")),
+                    Datum.string(_dt.datetime.fromtimestamp(e.ts, _dt.timezone.utc).strftime("%Y-%m-%d %H:%M:%S")),
                     Datum.f64(e.duration_ms / 1e3),
                     Datum.string(e.digest), Datum.string(e.sql),
                     Datum.i64(1 if e.success else 0),

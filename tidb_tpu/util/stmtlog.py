@@ -33,7 +33,9 @@ def normalize_sql(sql: str) -> tuple[str, str]:
             break
         if t.kind in (T.NUMBER, T.STRING):
             parts.append("?")
-        elif t.kind is T.IDENT:
+        elif t.kind in (T.IDENT, T.QIDENT):
+            # quoted and bare identifiers normalize identically (lookups
+            # are case-insensitive, so `T` and t are one statement)
             parts.append(t.text.lower())
         else:
             parts.append(t.text)
@@ -96,8 +98,9 @@ class StmtLog:
         slow_threshold_ms: float | None = 300.0,
         summary_enabled: bool = True,
     ):
-        if not summary_enabled and slow_threshold_ms is None:
-            return  # observability fully off: skip the lexer+digest pass
+        is_slow = slow_threshold_ms is not None and duration_ms > slow_threshold_ms
+        if not summary_enabled and not is_slow:
+            return  # neither sink wants it: skip the lexer+digest pass
         norm, digest = normalize_sql(sql)
         now = time.time()
         with self._lock:
@@ -117,7 +120,7 @@ class StmtLog:
                 s.sum_rows += rows
                 s.errors += 0 if success else 1
                 s.last_seen = now
-            if slow_threshold_ms is not None and duration_ms > slow_threshold_ms:
+            if is_slow:
                 self.slow.append(
                     SlowLogEntry(now, duration_ms, sql[:4096], digest, rows, success, error)
                 )
