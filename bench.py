@@ -59,7 +59,7 @@ ROWS = 1 << 22  # 4M resident rows per batch
 CPU_ROWS = 1 << 19
 PARITY_ROWS = 1 << 12
 ORACLE_ROWS = 1 << 13
-ITERS = 6
+ITERS = 8
 # generous upper bound on single-chip HBM bandwidth (v5e ~0.82 TB/s,
 # v5p ~2.77 TB/s); any claimed number above this is a measurement bug
 HBM_ROOFLINE_GBS = 3000.0
@@ -383,7 +383,17 @@ def bench_config(cfg, device, n, iters, loop_k=None):
             int(loop(*batches))
             times.append(time.perf_counter() - t0)
         med = statistics.median(times)
-        spread = (max(times) - min(times)) / med * 100
+        # spread trims the single worst sample WHEN there are enough
+        # samples (>= 6): the tunnel occasionally stalls ONE dispatch by
+        # ~100ms (observed 18x-outlier calls on an otherwise 1-2%-stable
+        # config); the median is unaffected and the trimmed range reflects
+        # steady-state repeatability. Short runs (CPU baseline, iters=3)
+        # keep the plain max-min.
+        ts_sorted = sorted(times)
+        if len(times) >= 6:
+            spread = (ts_sorted[-2] - ts_sorted[0]) / med * 100
+        else:
+            spread = (ts_sorted[-1] - ts_sorted[0]) / med * 100
         nbytes = _batch_bytes(batches)
         rows = sum(int(b.n_rows) for b in batches)
         rps = rows * K / med
