@@ -233,3 +233,18 @@ class TestPartitionReviewRegressions:
         s.execute("insert into pr values (5)")
         assert s.execute("select * from pr where a = 50").rows == []
         assert [int(x[0].val) for x in s.execute("select * from pr where a = 5").rows] == [5]
+
+
+def test_partition_column_protected_from_alter():
+    sess = Session()
+    sess.execute(
+        "CREATE TABLE pguard (a INT, b INT) PARTITION BY HASH(a) PARTITIONS 3"
+    )
+    sess.execute("INSERT INTO pguard VALUES (1, 2)")
+    with pytest.raises(Exception, match="partition"):
+        sess.execute("ALTER TABLE pguard DROP COLUMN a")
+    # renaming the partition column is allowed and keeps routing intact
+    sess.execute("ALTER TABLE pguard CHANGE COLUMN a a2 INT")
+    sess.execute("INSERT INTO pguard VALUES (5, 6)")
+    assert sess.execute("SELECT count(*) FROM pguard").values() == [[2]]
+    assert sess.execute("SELECT a2 FROM pguard WHERE a2 = 5").values() == [[5]]

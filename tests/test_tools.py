@@ -154,3 +154,12 @@ def test_brie_requires_super(sess, tmp_path):
     u.user = "u"
     with pytest.raises(SQLError, match="SUPER"):
         u.execute(f"BACKUP DATABASE * TO '{tmp_path}/x'")
+
+
+def test_backup_restore_views(sess, tmp_path):
+    sess.execute("CREATE VIEW v_hi AS SELECT id, v FROM t WHERE v >= 20")
+    bdir = str(tmp_path / "bk")
+    sess.execute(f"BACKUP DATABASE * TO '{bdir}'")
+    s2 = Session(TPUStore(), Catalog())
+    s2.execute(f"RESTORE DATABASE * FROM '{bdir}'")
+    assert s2.execute("SELECT id FROM v_hi ORDER BY id").values() == [[2]]

@@ -167,6 +167,8 @@ def _drop_column(session, meta, name: str):
     name = name.lower()
     if meta.handle_col == name:
         raise DDLError("cannot drop the PRIMARY KEY handle column")
+    if meta.partition is not None and meta.partition.col == name:
+        raise DDLError(f"cannot drop partitioning column {name!r}")
     if len(meta.columns) == 1:
         raise DDLError("cannot drop the last column")
     for idx in meta.indices:
@@ -214,6 +216,8 @@ def _rename_column(session, meta, old: str, new: str):
         idx.col_names = [new if c == old else c for c in idx.col_names]
     if meta.handle_col == old:
         meta.handle_col = new
+    if meta.partition is not None and meta.partition.col == old:
+        meta.partition.col = new
     session.catalog.version += 1
 
 

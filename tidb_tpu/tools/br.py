@@ -109,6 +109,13 @@ def _schema_dict(catalog) -> list:
     return out
 
 
+def _views_dict(catalog) -> dict:
+    return {
+        v.name: {"columns": v.columns, "select": v.select_sql}
+        for v in catalog.views.values()
+    }
+
+
 def backup(store, catalog, dest_dir: str) -> dict:
     """Full backup; returns the manifest. Resumable: re-running skips
     segments whose files already verify."""
@@ -159,6 +166,7 @@ def backup(store, catalog, dest_dir: str) -> dict:
         "snapshot_ts": ts,
         "total_keys": n_keys,
         "schema": _schema_dict(catalog),
+        "views": _views_dict(catalog),
         "segments": segments,
     }
     with open(manifest_path + ".tmp", "w") as f:
@@ -202,6 +210,15 @@ def restore(store, catalog, src_dir: str) -> dict:
             meta.next_col_id = t["next_col_id"]
         with catalog._lock:
             catalog._tables[t["name"]] = meta
+            catalog.version += 1
+    from ..sql.catalog import ViewMeta
+
+    for vn in manifest.get("views", {}):
+        if vn in existing or vn in catalog.views:
+            raise ValueError(f"restore: view {vn!r} already exists")
+    for vn, vd in manifest.get("views", {}).items():
+        with catalog._lock:
+            catalog.views[vn] = ViewMeta(vn, vd["columns"], vd["select"])
             catalog.version += 1
     max_id = 0
     for t in manifest["schema"]:
