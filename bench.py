@@ -108,12 +108,17 @@ def _str_col(codes: np.ndarray, alphabet: bytes):
 
 
 class Config:
-    def __init__(self, name, build, small_groups=None):
+    def __init__(self, name, build, small_groups=None, group_cap=None):
         self.name = name
         self.build = build  # n -> (dag, [DeviceBatch]) device-resident
         # stats-driven small-G hint (planner NDV product analog): q1 groups
         # by (returnflag, linestatus) -> <= 6 groups, dense kernel
         self.small_groups = small_groups
+        # stats-driven group-capacity seed (NDV of the group keys, the same
+        # number the planner reads from stats.py): skips the 4x retry
+        # ladder's recompiles when the group count is known large (q3 has
+        # ~n/8 distinct order keys)
+        self.group_cap = group_cap
 
 
 def _configs():
@@ -230,7 +235,7 @@ def _configs():
         Config("scalar_agg", scalar_agg),
         Config("q1", q1, small_groups=16),
         Config("topn", topn),
-        Config("q3", q3),
+        Config("q3", q3, group_cap=lambda n: max(n // 4, 128)),
     ]
 
 
@@ -342,7 +347,8 @@ def bench_config(cfg, device, n, iters, loop_k=None):
         dag, batches = cfg.build(n)
         batches = [jax.device_put(b, device) for b in batches]
         caps = tuple(b.capacity for b in batches)
-        gc, jc, tf, smg, uj = 4096, max(caps), False, cfg.small_groups, True
+        gc = cfg.group_cap(n) if cfg.group_cap else 4096
+        jc, tf, smg, uj = max(caps), False, cfg.small_groups, True
         for attempt in range(5):
             prog = build_program(
                 dag, caps, group_capacity=gc, join_capacity=jc,

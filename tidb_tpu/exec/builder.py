@@ -158,7 +158,8 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
     # exec summaries — ref: tipb.ExecutorExecutionSummary NumProducedRows)
     state.ex_rows.append(batch.n_rows.astype(jnp.int64))
 
-    for ei in range(1, len(executors)):
+    ei = 1
+    while ei < len(executors):
         ex = executors[ei]
         comp = ExprCompiler(fts)
         if isinstance(ex, Selection):
@@ -191,6 +192,21 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
             bkeys = bcomp.run(list(ex.build_keys), bcols)
             pkeys = comp.run(list(ex.probe_keys), cols)
             _check_join_key_types(pkeys, bkeys)
+            nxt = executors[ei + 1] if ei + 1 < len(executors) else None
+            if (
+                isinstance(nxt, Aggregation)
+                and _joinagg_pattern(ex, nxt, len(fts), unique_joins)
+                and _single_word(pkeys[0]) and _single_word(bkeys[0])
+            ):
+                fused = _trace_joinagg(
+                    nxt, comp, cols, bkeys, pkeys, bvalid, valid,
+                    group_capacity, state,
+                )
+                if fused is not None:
+                    cols, valid, fts = fused
+                    state.ex_rows.append(valid.sum().astype(jnp.int64))
+                    ei += 2
+                    continue
             res = hash_join(bkeys, pkeys, bvalid, valid, join_capacity, ex.join_type,
                             build_unique=ex.build_unique and unique_joins)
             state.join_overflow = state.join_overflow | res.overflow
@@ -256,8 +272,76 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
         else:
             raise TypeError(f"unsupported executor {ex}")
         state.ex_rows.append(valid.sum().astype(jnp.int64))
+        ei += 1
 
     return cols, valid, fts
+
+
+def _single_word(k: CompVal) -> bool:
+    """True when the key normalizes to exactly one sort word (ops/keys.py
+    layout: [null_flag, word]) — the joinagg kernel's key contract."""
+    from ..ops.keys import sort_key_arrays
+
+    return len(sort_key_arrays(k)) == 2
+
+
+def _joinagg_pattern(ex, agg, n_probe_cols: int, unique_joins: bool) -> bool:
+    """Join(unique build, inner) immediately under GROUP BY probe-key with
+    probe-only aggregate arguments — the shape ops/joinagg.py fuses."""
+    from ..expr.ir import ColumnRef, ScalarFunc
+    from ..ops.joinagg import FUSABLE_AGGS
+
+    if not (ex.join_type == "inner" and ex.build_unique and unique_joins):
+        return False
+    if len(ex.probe_keys) != 1 or len(ex.build_keys) != 1:
+        return False
+    if len(agg.group_by) != 1 or agg.group_by[0] != ex.probe_keys[0]:
+        return False
+    if agg.merge:
+        return False
+
+    def probe_only(e) -> bool:
+        if isinstance(e, ColumnRef):
+            return e.index < n_probe_cols
+        if isinstance(e, ScalarFunc):
+            return all(probe_only(a) for a in e.args)
+        return True
+
+    for d in agg.aggs:
+        if d.distinct or d.name not in FUSABLE_AGGS:
+            return False
+        if not all(probe_only(a) for a in d.args):
+            return False
+    return True
+
+
+def _trace_joinagg(agg, comp, cols, bkeys, pkeys, bvalid, valid, group_capacity, state: _TraceState):
+    """Trace the fused join+agg kernel; None when a compiled arg shape is
+    ineligible (multi-word value or raw string bytes riding the column)."""
+    from ..ops.joinagg import join_stream_agg
+
+    garg_exprs = []
+    for a in agg.aggs:
+        garg_exprs.extend(a.args)
+    avals = comp.run(list(garg_exprs), cols) if garg_exprs else []
+    if any(a.value.ndim != 1 or a.raw is not None for a in avals):
+        return None
+    aggs = []
+    k = 0
+    for a in agg.aggs:
+        aggs.append((a, avals[k : k + len(a.args)]))
+        k += len(a.args)
+    res, sorted_aggs, group_out, j_ovf, join_rows = join_stream_agg(
+        bkeys, pkeys, bvalid, valid, aggs, group_capacity,
+    )
+    state.join_overflow = state.join_overflow | j_ovf
+    state.group_overflow = state.group_overflow | res.overflow
+    state.ex_rows.append(join_rows)
+    new_cols: list[CompVal] = []
+    for (a, av_s), st in zip(sorted_aggs, res.states):
+        new_cols.extend(_agg_result_cols(a, av_s, st, res.group_valid, agg.partial))
+    new_cols.extend(_gather([group_out], res.group_rep))
+    return new_cols, res.group_valid, agg.output_fts()
 
 
 def _check_join_key_types(pkeys: list[CompVal], bkeys: list[CompVal]):

@@ -476,7 +476,7 @@ def _group_aggregate_dense(group_bys, aggs, row_valid, g_cap: int, merge: bool):
     return GroupAggResult(group_rep, group_valid, jnp.minimum(n_groups, g_cap), overflow, out_states)
 
 
-def _group_aggregate_stream(group_bys, aggs, row_valid, group_capacity: int, merge: bool):
+def _group_aggregate_stream(group_bys, aggs, row_valid, group_capacity: int, merge: bool, compact: bool = True):
     """StreamAgg kernel (ref: pkg/executor/aggregate/agg_stream_executor.go,
     cophandler's sorted-input aggregation): the input arrives ALREADY sorted
     on the group keys (index order, or below a Sort), so group boundaries
@@ -497,8 +497,11 @@ def _group_aggregate_stream(group_bys, aggs, row_valid, group_capacity: int, mer
     if diff is one:
         diff = jnp.ones(n, bool)
     seg = jnp.cumsum(diff.astype(jnp.int32)) - 1
-    raw_groups = seg[-1] + 1
-    overflow = raw_groups > group_capacity
+    # overflow only when a SURVIVING row lands past the capacity: key runs
+    # whose rows are all filtered may overflow the raw run count without
+    # affecting any output (ops/joinagg.py feeds build∪probe key runs here,
+    # where most runs contribute nothing)
+    overflow = jnp.any(row_valid & (seg >= group_capacity))
     nseg = group_capacity + 1
     seg = jnp.minimum(seg, nseg - 1)
     ctx = make_segctx(seg, nseg)
@@ -526,6 +529,11 @@ def _group_aggregate_stream(group_bys, aggs, row_valid, group_capacity: int, mer
         st = [(v[:group_capacity], nl[:group_capacity]) for v, nl in st]
         st = [(v, nl | ~has_g) for v, nl in st]
         states.append(st)
+
+    if not compact:
+        # caller reorders/compacts itself (ops/joinagg.py rides its own
+        # original-row argsort) — group_valid is the raw has-flags here
+        return GroupAggResult(group_rep, has_g, n_groups, overflow, states)
 
     # compact: runs with >=1 surviving row first, in first-encounter order
     order = jnp.argsort(jnp.where(has_g, group_rep, jnp.int32(n)))

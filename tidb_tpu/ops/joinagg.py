@@ -1,0 +1,190 @@
+"""Fused sort-merge join + stream aggregation — the TPC-H Q3 shape.
+
+When a unique-build inner join feeds a GROUP BY on exactly the probe-side
+join key, the join's merge sort already clusters rows by the group key, so
+ONE variadic sort (build and probe key words interleaved, agg arguments
+riding as payload operands) performs the probe AND the grouping. The
+general pipeline pays three more full-size sorts on top of that one — the
+inverse permutation back to probe order, the aggregation's hash-cluster
+sort, and the segment-boundary construction — and this kernel skips all of
+them: a stream-agg boundary scan runs directly on the merge order.
+
+On TPU the sort IS the unit of cost for join/group plans (every other pass
+is a cumsum-class scan), so sharing one sort between the two operators is
+the whole win — the analog of the reference handing hash-join output
+straight to a stream aggregate when orders match (ref:
+pkg/executor/join/hash_join_v2.go build/probe,
+pkg/executor/aggregate/agg_stream_executor.go sorted-input contract).
+
+Matching mirrors ops/join.py's unique-build inner-join semantics exactly:
+NULL keys never match, a build fan-out > 1 raises the join-overflow flag
+(the driver retries on the general kernel), and group capacity overflow
+raises the group flag. Output group order is the oracle's first-encounter
+order (earliest contributing probe row), recovered by riding the original
+probe index through the sort.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..expr.compile import CompVal
+from .aggregate import GatherState, _group_aggregate_stream
+from .join import _key_matrix
+from .seg import I64_MAX
+
+# aggregate names the stream kernel evaluates without raw-byte payloads or
+# the DISTINCT hash machinery (ops/aggregate.py _agg_states_raw coverage)
+FUSABLE_AGGS = frozenset({
+    "count", "sum", "avg", "min", "max", "first_row",
+    "bit_and", "bit_or", "bit_xor",
+    "stddev_pop", "stddev_samp", "var_pop", "var_samp",
+})
+
+
+def join_stream_agg(
+    build_keys: list[CompVal],
+    probe_keys: list[CompVal],
+    build_valid,
+    probe_valid,
+    aggs: list,
+    group_capacity: int,
+):
+    """One-sort unique-build inner join + GROUP BY probe key.
+
+    aggs: list of (AggDesc, [probe-row-order arg CompVals]); every arg must
+    be single-word (ndim 1, no raw bytes) — the caller checks eligibility.
+    Returns (GroupAggResult, sorted_arg_lists, group_out CompVal,
+    join_overflow, join_rows); res.group_rep indexes the SORTED row space,
+    aligned with sorted_arg_lists and group_out; join_rows is the joined
+    row count for the exec summaries.
+    """
+    bw_l, b_usable = _key_matrix(build_keys, build_valid)
+    pw_l, p_usable = _key_matrix(probe_keys, probe_valid)
+    assert len(bw_l) == 1 and len(pw_l) == 1, "joinagg needs single-word keys"
+    bw, pw = bw_l[0], pw_l[0]
+    nb, np_ = bw.shape[0], pw.shape[0]
+    n = nb + np_
+    top = jnp.inf if jnp.issubdtype(bw.dtype, jnp.floating) else I64_MAX
+    vals = jnp.concatenate([
+        jnp.where(b_usable, bw, top), jnp.where(p_usable, pw, top),
+    ])
+    # sort key 2: build rows first within an equal-key run, so a probe row's
+    # cumulative hay count already includes its whole run; lax.sort is
+    # stable, so probe rows keep original ascending order inside a run
+    side = jnp.concatenate([jnp.zeros(nb, jnp.int8), jnp.ones(np_, jnp.int8)])
+
+    payload: list = []
+    slot_of: dict = {}
+
+    def carry(hay_fill, arr) -> int:
+        key = (id(arr), repr(hay_fill))
+        if key not in slot_of:
+            slot_of[key] = len(payload)
+            payload.append(jnp.concatenate([
+                jnp.full((nb,), hay_fill, arr.dtype), arr,
+            ]))
+        return slot_of[key]
+
+    # original probe index (first-encounter output order + group_rep remap)
+    iota_slot = len(payload)
+    payload.append(jnp.concatenate([
+        jnp.full(nb, n, jnp.int32), jnp.arange(np_, dtype=jnp.int32),
+    ]))
+    # group-by output value = the probe key's original value lane
+    gkey_slot = carry(0, probe_keys[0].value)
+
+    bool_arrs: list = [jnp.concatenate([b_usable, p_usable])]
+    bool_ix: dict = {}
+
+    def carry_bool(hay_fill: bool, arr) -> int:
+        key = (id(arr), hay_fill)
+        if key not in bool_ix:
+            bool_ix[key] = len(bool_arrs)
+            bool_arrs.append(jnp.concatenate([
+                jnp.full(nb, hay_fill, bool), arr,
+            ]))
+        return bool_ix[key]
+
+    plans = []  # per agg: [(value_slot, null_bit)] per arg
+    for desc, avs in aggs:
+        slots = []
+        for a in avs:
+            slots.append((carry(0, a.value), carry_bool(True, a.null)))
+        plans.append(slots)
+
+    nwords = []
+    for w0 in range(0, len(bool_arrs), 8):
+        grp = bool_arrs[w0 : w0 + 8]
+        word = grp[0].astype(jnp.uint8)
+        for k, a in enumerate(grp[1:], start=1):
+            word = word | (a.astype(jnp.uint8) << k)
+        nwords.append(word)
+
+    sorted_ops = jax.lax.sort(tuple([vals, side] + payload + nwords), num_keys=2)
+    sv, ss = sorted_ops[0], sorted_ops[1]
+    pay_s = list(sorted_ops[2 : 2 + len(payload)])
+    nw_s = list(sorted_ops[2 + len(payload) :])
+    usable_s = ((nw_s[0] >> 0) & 1).astype(bool)
+    is_hay = ss == 0
+    hay_u = is_hay & usable_s
+
+    one = jnp.ones(1, bool)
+    diff = jnp.concatenate([one, sv[1:] != sv[:-1]])
+    hcnt = jnp.cumsum(hay_u.astype(jnp.int32))
+    # usable-hay count strictly before my run (run-start propagation; the
+    # marked values are nondecreasing, so a forward cummax broadcasts each
+    # run head's value across its run — the merge_lo_hi trick)
+    base = jax.lax.cummax(jnp.where(diff, hcnt - hay_u, jnp.int32(-1)))
+    matched = (hcnt - base) > 0
+    # run's total usable hay: hcnt at the run END, propagated backward
+    # (ends carry nondecreasing hcnt, so reverse cummin finds MY run's end)
+    emark = jnp.concatenate([diff[1:], one])
+    endv = jax.lax.cummin(
+        jnp.where(emark, hcnt, jnp.iinfo(jnp.int32).max), reverse=True
+    )
+    run_hay = endv - base
+    contrib = (~is_hay) & usable_s & matched
+    # unique-build contract: any probe matching a >1-row build run
+    join_overflow = jnp.any((run_hay > 1) & contrib)
+
+    def resort(a: CompVal, slots) -> CompVal:
+        vslot, nbit = slots
+        null = ((nw_s[nbit // 8] >> (nbit % 8)) & 1).astype(bool)
+        return CompVal(pay_s[vslot], null, a.ft)
+
+    key_ft = probe_keys[0].ft
+    sorted_aggs = [
+        (desc, [resort(a, sl) for a, sl in zip(avs, plan)])
+        for (desc, avs), plan in zip(aggs, plans)
+    ]
+    res = _group_aggregate_stream(
+        [CompVal(sv, jnp.zeros(n, bool), key_ft)],
+        sorted_aggs, contrib, group_capacity, merge=False, compact=False,
+    )
+
+    # compact=False: res.group_valid is raw has-flags in key order. ONE
+    # argsort on the earliest ORIGINAL probe index (ridden through the
+    # sort) both compacts contributing groups to the front and restores
+    # the oracle's first-encounter output order.
+    orig_s = pay_s[iota_slot]
+    gc = res.group_rep.shape[0]
+    orig_first = jnp.where(
+        res.group_valid, orig_s[jnp.clip(res.group_rep, 0, n - 1)], jnp.int32(n)
+    )
+    order = jnp.argsort(orig_first)
+    res.group_rep = res.group_rep[order]
+    gids = jnp.arange(gc, dtype=jnp.int32)
+    res.group_valid = gids < res.n_groups
+    states2 = []
+    for st in res.states:
+        if isinstance(st, GatherState):
+            states2.append(GatherState(st.idx[order], st.has[order]))
+        else:
+            states2.append([(v[order], nl[order]) for v, nl in st])
+    res.states = states2
+
+    group_out = CompVal(pay_s[gkey_slot], jnp.zeros(n, bool), key_ft)
+    join_rows = contrib.sum().astype(jnp.int64)
+    return res, sorted_aggs, group_out, join_overflow, join_rows

@@ -271,9 +271,28 @@ def sorted_positions(sorted_hay, queries, side: str = "left"):
 
 
 def make_segctx(seg: jax.Array, nseg: int) -> SegCtx:
-    g = jnp.arange(nseg, dtype=seg.dtype)
-    starts = sorted_positions(seg, g, side="left")
-    ends = sorted_positions(seg, g, side="right") - 1
+    """seg must be DENSE ascending (consecutive ids 0..K then constant, as
+    segments_from_sorted and the stream/cap paths produce): run k then
+    starts segment k, so `starts` is ONE stream-compaction sort (boundary
+    rows first, stable by position) instead of two merge_searchsorted
+    passes (4 sorts of N+nseg each — this function used to be half the
+    sort count of a whole group-by program). ends fall out of starts:
+    dense ids leave no gaps below the last run. Small nseg keeps the
+    sort-free binary searchsorted (log2(N) gather rounds of nseg lanes)."""
+    n = seg.shape[0]
+    if nseg <= 2048 or nseg < n // 64:
+        starts = jnp.searchsorted(seg, jnp.arange(nseg, dtype=seg.dtype)).astype(jnp.int32)
+    else:
+        one = jnp.ones(1, bool)
+        bnd = jnp.concatenate([one, seg[1:] != seg[:-1]])
+        iota = jnp.arange(n, dtype=jnp.int32)
+        _, pos = jax.lax.sort(((~bnd).astype(jnp.int8), iota), num_keys=2)
+        n_runs = seg[-1].astype(jnp.int32) + 1
+        if nseg > n:
+            pos = jnp.concatenate([pos, jnp.full(nseg - n, n, jnp.int32)])
+        g = jnp.arange(nseg, dtype=jnp.int32)
+        starts = jnp.where(g < n_runs, pos[:nseg], jnp.int32(n))
+    ends = jnp.concatenate([starts[1:], jnp.full(1, n, jnp.int32)]) - 1
     counts = jnp.maximum((ends - starts + 1).astype(jnp.int64), 0)
     return SegCtx(seg, nseg, starts, ends, counts)
 
@@ -358,7 +377,11 @@ def _seg_scan_reduce(ctx: SegCtx, vals: jax.Array, combine, neutral, empty_fill)
 
 def seg_first_match(ctx, mask_s: jax.Array):
     """Per-segment sorted position of the FIRST mask row (int32 [nseg]),
-    plus a has-any flag. One cumsum + one searchsorted — no scan tricks.
+    plus a has-any flag. A reverse cummin over (mask ? position : n) gives
+    every position its nearest masked position at-or-after; reading it at
+    the segment start yields the first masked row IN the segment — or a
+    leak into a later segment, rejected by the extent check. No sort, no
+    searchsorted.
 
     With the stable sort_by_word order, the first masked sorted position in
     a segment is also the masked row with the smallest original index.
@@ -366,13 +389,10 @@ def seg_first_match(ctx, mask_s: jax.Array):
     if isinstance(ctx, DenseCtx):
         return dense_first_match(ctx, mask_s)
     n = mask_s.shape[0]
-    c = jnp.cumsum(mask_s.astype(jnp.int32))
-    lo = jnp.clip(ctx.starts, 0, n - 1)
-    hi = jnp.clip(ctx.ends, 0, n - 1)
-    base = c[lo] - mask_s[lo].astype(jnp.int32)  # masked rows strictly before
-    first = sorted_positions(c, base + 1, side="left")
-    incount = c[hi] - base
-    has = (ctx.counts > 0) & (incount > 0)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    rcm = jax.lax.cummin(jnp.where(mask_s, iota, jnp.int32(n)), reverse=True)
+    first = rcm[jnp.clip(ctx.starts, 0, n - 1)]
+    has = (ctx.counts > 0) & (first <= ctx.ends)
     return jnp.where(has, jnp.clip(first, 0, n - 1), 0), has
 
 
