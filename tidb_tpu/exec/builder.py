@@ -58,13 +58,30 @@ class _TraceState:
 
     Group and join overflow are SEPARATE flags so the retry driver grows
     only the capacity that actually overflowed (a 4x-per-retry growth on
-    the wrong knob wastes HBM and compile time)."""
+    the wrong knob wastes HBM and compile time).
 
-    def __init__(self):
+    summaries=False drops the per-executor produced-row counts: each one
+    is a full-array reduce with a ~1.5-3ms dispatch floor on the tunneled
+    v5e, which for a 9-executor join plan is more than the sorts cost —
+    the bench path runs without them, production keeps them (EXPLAIN
+    ANALYZE needs the numbers)."""
+
+    def __init__(self, summaries: bool = True):
         self.group_overflow = jnp.bool_(False)
         self.join_overflow = jnp.bool_(False)
         self.topn_overflow = jnp.bool_(False)
+        self.summaries = summaries
         self.ex_rows: list = []
+
+    def rows(self, arr_or_scalar):
+        """Record a produced-row count (lazy: no-op when summaries off).
+        Accepts a precomputed scalar or a bool/int mask to sum."""
+        if not self.summaries:
+            return
+        v = arr_or_scalar
+        if getattr(v, "ndim", 0) > 0:
+            v = v.sum()
+        self.ex_rows.append(v.astype(jnp.int64))
 
 
 def _used_cols_after(rest, width: int, out_offsets):
@@ -156,7 +173,7 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
     valid = batch.row_valid
     # per-executor produced-row counts, scan first (real numbers for the
     # exec summaries — ref: tipb.ExecutorExecutionSummary NumProducedRows)
-    state.ex_rows.append(batch.n_rows.astype(jnp.int64))
+    state.rows(batch.n_rows)
 
     ei = 1
     while ei < len(executors):
@@ -187,24 +204,32 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
             cols = _gather(cols, idx)
             valid = out_valid
         elif isinstance(ex, Join):
+            nxt = executors[ei + 1] if ei + 1 < len(executors) else None
+            fused_ok = isinstance(nxt, Aggregation) and _joinagg_pattern(ex, nxt, len(fts), unique_joins)
+            if fused_ok:
+                fused = _trace_packed_chain(
+                    ex, nxt, comp, cols, valid, batches, cursor,
+                    group_capacity, join_capacity, state, topn_full,
+                    small_groups, unique_joins,
+                )
+                if fused is not None:
+                    cols, valid, fts = fused
+                    state.rows(valid)
+                    ei += 2
+                    continue
             bcols, bvalid, bfts = _run_pipeline(ex.build, batches, cursor, group_capacity, join_capacity, state, topn_full, small_groups, unique_joins)
             bcomp = ExprCompiler(bfts)
             bkeys = bcomp.run(list(ex.build_keys), bcols)
             pkeys = comp.run(list(ex.probe_keys), cols)
             _check_join_key_types(pkeys, bkeys)
-            nxt = executors[ei + 1] if ei + 1 < len(executors) else None
-            if (
-                isinstance(nxt, Aggregation)
-                and _joinagg_pattern(ex, nxt, len(fts), unique_joins)
-                and _single_word(pkeys[0]) and _single_word(bkeys[0])
-            ):
+            if fused_ok and _single_word(pkeys[0]) and _single_word(bkeys[0]):
                 fused = _trace_joinagg(
                     nxt, comp, cols, bkeys, pkeys, bvalid, valid,
                     group_capacity, state,
                 )
                 if fused is not None:
                     cols, valid, fts = fused
-                    state.ex_rows.append(valid.sum().astype(jnp.int64))
+                    state.rows(valid)
                     ei += 2
                     continue
             res = hash_join(bkeys, pkeys, bvalid, valid, join_capacity, ex.join_type,
@@ -271,7 +296,7 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
             fts = ex.output_fts()
         else:
             raise TypeError(f"unsupported executor {ex}")
-        state.ex_rows.append(valid.sum().astype(jnp.int64))
+        state.rows(valid)
         ei += 1
 
     return cols, valid, fts
@@ -315,6 +340,127 @@ def _joinagg_pattern(ex, agg, n_probe_cols: int, unique_joins: bool) -> bool:
     return True
 
 
+def _chain_shape(build):
+    """[scan, Sel*, Join(inner, unique, single-key, build=[scan, Sel*])]
+    -> (outer_execs, inner_join) or None — the 3-table membership shape
+    ops/joinagg.py's packed chain collapses (TPC-H Q3)."""
+    if not build or not isinstance(build[0], (TableScan, IndexScan)):
+        return None
+    i = 1
+    while i < len(build) and isinstance(build[i], Selection):
+        i += 1
+    if i != len(build) - 1 or not isinstance(build[i], Join):
+        return None
+    j = build[i]
+    if j.join_type != "inner" or not j.build_unique:
+        return None
+    if len(j.probe_keys) != 1 or len(j.build_keys) != 1:
+        return None
+    inner = j.build
+    if not inner or not isinstance(inner[0], (TableScan, IndexScan)):
+        return None
+    if not all(isinstance(e, Selection) for e in inner[1:]):
+        return None
+    return list(build[:i]), j
+
+
+def _int_expr(e) -> bool:
+    return e.ft.eval_type() == "int"
+
+
+def _trace_packed_chain(ex, agg, comp, cols, valid, batches, cursor, group_capacity, join_capacity, state: _TraceState, topn_full, small_groups, unique_joins):
+    """Packed-int fast path (ops/joinagg.py packed_join_groupsum): all
+    eligibility is checked STATICALLY (expr FieldTypes) before any batch is
+    consumed, so returning None never double-consumes a scan."""
+    from ..ops.joinagg import _PACKED_AGGS, membership_chain, packed_join_groupsum
+
+    for d in agg.aggs:
+        if d.name not in _PACKED_AGGS or d.distinct:
+            return None
+        for a in d.args:
+            if a.ft.eval_type() not in ("int", "decimal"):
+                return None
+    pk_e, bk_e = ex.probe_keys[0], ex.build_keys[0]
+    if not _int_expr(pk_e) or not _int_expr(bk_e):
+        return None
+    if pk_e.ft.is_unsigned() != bk_e.ft.is_unsigned():
+        raise TypeError("join key signedness mismatch (insert casts)")
+    chain = _chain_shape(ex.build)
+    simple = all(isinstance(e, Selection) for e in ex.build[1:]) and isinstance(ex.build[0], (TableScan, IndexScan))
+    if chain is not None:
+        outer_execs, ij = chain
+        if not (_int_expr(ij.probe_keys[0]) and _int_expr(ij.build_keys[0])):
+            return None
+        if ij.probe_keys[0].ft.is_unsigned() != ij.build_keys[0].ft.is_unsigned():
+            raise TypeError("join key signedness mismatch (insert casts)")
+        # the next join's key must come from the OUTER scan's schema
+        from ..expr.ir import ColumnRef, ScalarFunc
+
+        outer_w = len(outer_execs[0].columns)
+
+        def within(e, w):
+            if isinstance(e, ColumnRef):
+                return e.index < w
+            if isinstance(e, ScalarFunc):
+                return all(within(x, w) for x in e.args)
+            return True
+
+        if not within(bk_e, outer_w) or not within(ij.probe_keys[0], outer_w):
+            return None
+    elif not simple:
+        return None
+
+    # compile probe-side agg args (probe cols only — no consumption)
+    garg_exprs = []
+    for a in agg.aggs:
+        garg_exprs.extend(a.args)
+    avals = comp.run(list(garg_exprs), cols) if garg_exprs else []
+    if any(a.value.ndim != 1 or a.raw is not None for a in avals):
+        return None
+    if len({id(a.null) for a in avals}) > 8:
+        return None
+    pkv = comp.run([pk_e], cols)[0]
+    probe_ok = valid & ~pkv.null
+
+    if chain is not None:
+        outer_execs, ij = chain
+        ocols, ovalid, ofts = _run_pipeline(outer_execs, batches, cursor, group_capacity, join_capacity, state, topn_full, small_groups, unique_joins)
+        icols, ivalid, ifts = _run_pipeline(list(ij.build), batches, cursor, group_capacity, join_capacity, state, topn_full, small_groups, unique_joins)
+        ocomp, icomp = ExprCompiler(ofts), ExprCompiler(ifts)
+        okey = ocomp.run([ij.probe_keys[0]], ocols)[0]
+        ckey = icomp.run([ij.build_keys[0]], icols)[0]
+        payload = ocomp.run([bk_e], ocols)[0]
+        o_ok = ovalid & ~okey.null & ~payload.null
+        i_ok = ivalid & ~ckey.null
+        hay_key, hay_ok, ovf = membership_chain(
+            okey.value, o_ok, ckey.value, i_ok, payload.value,
+        )
+        state.join_overflow = state.join_overflow | ovf
+        state.rows(hay_ok)  # inner join rows
+    else:
+        bcols, bvalid, bfts = _run_pipeline(list(ex.build), batches, cursor, group_capacity, join_capacity, state, topn_full, small_groups, unique_joins)
+        bcomp = ExprCompiler(bfts)
+        bkv = bcomp.run([bk_e], bcols)[0]
+        hay_key = bkv.value
+        hay_ok = bvalid & ~bkv.null
+
+    aggs = []
+    k = 0
+    for a in agg.aggs:
+        aggs.append((a, avals[k : k + len(a.args)]))
+        k += len(a.args)
+    states, group_valid, key_out, ovf, extent_cnt = packed_join_groupsum(
+        hay_key, hay_ok, pkv, probe_ok, aggs,
+    )
+    state.join_overflow = state.join_overflow | ovf
+    state.rows(jnp.where(group_valid, extent_cnt, jnp.int64(0)))
+    new_cols: list[CompVal] = []
+    for (a, av), st in zip(aggs, states):
+        new_cols.extend(_agg_result_cols(a, av, st, group_valid, agg.partial))
+    new_cols.append(key_out)
+    return new_cols, group_valid, agg.output_fts()
+
+
 def _trace_joinagg(agg, comp, cols, bkeys, pkeys, bvalid, valid, group_capacity, state: _TraceState):
     """Trace the fused join+agg kernel; None when a compiled arg shape is
     ineligible (multi-word value or raw string bytes riding the column)."""
@@ -336,7 +482,7 @@ def _trace_joinagg(agg, comp, cols, bkeys, pkeys, bvalid, valid, group_capacity,
     )
     state.join_overflow = state.join_overflow | j_ovf
     state.group_overflow = state.group_overflow | res.overflow
-    state.ex_rows.append(join_rows)
+    state.rows(join_rows)
     new_cols: list[CompVal] = []
     for (a, av_s), st in zip(sorted_aggs, res.states):
         new_cols.extend(_agg_result_cols(a, av_s, st, res.group_valid, agg.partial))
@@ -367,6 +513,7 @@ def build_program(
     topn_full: bool = False,
     small_groups: int | None = None,
     unique_joins: bool = True,
+    summaries: bool = True,
 ) -> CompiledDAG:
     """Compile the whole DAG tree (probe pipeline + all join build
     pipelines) into one fused XLA program over a tuple of device batches."""
@@ -378,7 +525,7 @@ def build_program(
     join_capacity = join_capacity or max(capacities)
 
     def program(*batches):
-        state = _TraceState()
+        state = _TraceState(summaries)
         cursor = [0]
         cols, valid, _ = _run_pipeline(dag.executors, batches, cursor, group_capacity, join_capacity, state, topn_full, small_groups, unique_joins, out_offsets=dag.output_offsets)
         outs = [cols[i] for i in dag.output_offsets]
@@ -388,7 +535,12 @@ def build_program(
                 packed.append((c.value, c.null, c.raw[0], c.raw[1]))
             else:
                 packed.append((c.value, c.null))
-        return packed, valid, valid.sum(), (state.group_overflow, state.join_overflow, state.topn_overflow), jnp.stack(state.ex_rows)
+        n_out = valid.sum()
+        # summaries off: no constant/empty-shaped stand-in — both a
+        # 0-length output and a folded-constant output have SIGSEGV'd the
+        # tunneled TPU compiler; reuse the (data-dependent) row count
+        ex = jnp.stack(state.ex_rows) if state.ex_rows else n_out[None].astype(jnp.int64)
+        return packed, valid, n_out, (state.group_overflow, state.join_overflow, state.topn_overflow), ex
 
     jit_fn = jax.jit(program)
     return CompiledDAG(jit_fn, dag.output_fts(), capacities, group_capacity, join_capacity)
