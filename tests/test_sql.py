@@ -245,11 +245,21 @@ class TestReviewRegressions:
         with pytest.raises(SQLError, match="duplicate entry"):
             sess.execute("UPDATE pk SET id = 5 WHERE id = 1")
 
-    def test_non_int_pk_rejected(self, sess):
-        with pytest.raises(CatalogError, match="PRIMARY KEY"):
-            sess.execute("CREATE TABLE sp (a VARCHAR(10) PRIMARY KEY)")
-        with pytest.raises(CatalogError, match="PRIMARY KEY"):
-            sess.execute("CREATE TABLE cp (a INT, b INT, PRIMARY KEY (a, b))")
+    def test_non_int_pk_nonclustered(self, sess):
+        """Non-int / composite PRIMARY KEY now lands as the reference's
+        NONCLUSTERED layout: implicit rowid handle + unique PRIMARY index
+        with enforced uniqueness and NOT NULL."""
+        sess.execute("CREATE TABLE sp (a VARCHAR(10) PRIMARY KEY)")
+        sess.execute("INSERT INTO sp VALUES ('x')")
+        with pytest.raises(Exception, match="duplicate"):
+            sess.execute("INSERT INTO sp VALUES ('x')")
+        with pytest.raises(Exception, match="null"):
+            sess.execute("INSERT INTO sp VALUES (NULL)")
+        sess.execute("CREATE TABLE cp (a INT, b INT, PRIMARY KEY (a, b))")
+        sess.execute("INSERT INTO cp VALUES (1, 2)")
+        with pytest.raises(Exception, match="duplicate"):
+            sess.execute("INSERT INTO cp VALUES (1, 2)")
+        assert sess.execute("SELECT a FROM sp").values() == [["x"]]
 
     def test_star_textual_order_after_reorder(self, sess):
         sess.execute("CREATE TABLE small (k BIGINT PRIMARY KEY, s VARCHAR(4))")

@@ -82,13 +82,29 @@ def host_only_exprs(exprs) -> bool:
 
 
 def _has_host_only_op(ex) -> bool:
-    """Executor-level screen: keep Selection/Projection with host-only
-    expressions at root where the oracle fallback can evaluate them."""
-    exprs = []
+    """Executor-level screen: keep any executor whose expressions use
+    host-only ops at root where the oracle fallback can evaluate them
+    (extension functions — incl. the subquery Apply fallback — and the
+    JSON/regexp set)."""
+    exprs: list = []
     if isinstance(ex, Selection):
-        exprs = ex.conditions
+        exprs = list(ex.conditions)
     elif isinstance(ex, Projection):
-        exprs = ex.exprs
+        exprs = list(ex.exprs)
+    elif isinstance(ex, Aggregation):
+        exprs = list(ex.group_by)
+        for d in ex.aggs:
+            exprs.extend(d.args)
+    elif isinstance(ex, (TopN, Sort)):
+        exprs = [e for e, _ in ex.order_by]
+    elif isinstance(ex, Join):
+        exprs = list(ex.probe_keys) + list(ex.build_keys)
+        if any(_has_host_only_op(b) for b in ex.build):
+            return True
+    elif isinstance(ex, Window):
+        exprs = list(ex.partition_by) + [e for e, _ in ex.order_by]
+        for w in ex.funcs:
+            exprs.extend(w.args)
     return host_only_exprs(exprs)
 
 
@@ -99,10 +115,10 @@ def split_dag(dag: DAGRequest) -> RootPlan:
     i = 0
     while i < len(executors):
         ex = executors[i]
+        if not isinstance(ex, (TableScan, IndexScan)) and _has_host_only_op(ex):
+            root = list(executors[i:])
+            break
         if isinstance(ex, (TableScan, IndexScan, Selection, Projection, Join)):
-            if isinstance(ex, (Selection, Projection)) and _has_host_only_op(ex):
-                root = list(executors[i:])
-                break
             push.append(ex)
             i += 1
             continue

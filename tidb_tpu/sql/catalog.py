@@ -24,6 +24,30 @@ class CatalogError(ValueError):
     pass
 
 
+def decl_text(ts: A.TypeSpec) -> str:
+    """Declared type spelling for SHOW CREATE TABLE (ref: the reference
+    round-trips meta/model FieldType through types.StrFor SHOW; here the
+    storage types are normalized so the spelling must be kept)."""
+    name = ts.name
+    out = name
+    if ts.length > 0 and ts.decimal >= 0 and name == "decimal":
+        out = f"decimal({ts.length},{ts.decimal})"
+    elif name == "decimal":
+        out = "decimal(10,0)"
+    elif ts.length > 0 and name in ("char", "varchar", "binary", "varbinary", "bit"):
+        out = f"{name}({ts.length})"
+    elif ts.decimal > 0 and name in ("datetime", "timestamp", "time"):
+        out = f"{name}({ts.decimal})"
+    elif ts.elems:
+        vals = ",".join("'" + e.replace("'", "''") + "'" for e in ts.elems)
+        out = f"{name}({vals})"
+    if ts.unsigned:
+        out += " unsigned"
+    if ts.zerofill:
+        out += " zerofill"
+    return out
+
+
 def field_type_from_spec(ts: A.TypeSpec, not_null: bool = False) -> FieldType:
     """TypeSpec (DDL/CAST AST) -> FieldType (ref: pkg/parser/types -> tipb
     ColumnInfo mapping in pkg/tablecodec)."""
@@ -79,6 +103,13 @@ class ColumnMeta:
     auto_increment: bool = False
     origin_default: object = None  # Datum filled for rows older than an
     # ADD COLUMN (ref: meta/model ColumnInfo.OriginDefaultValue)
+    generated: object = None  # GENERATED ALWAYS AS expr AST (ref:
+    # meta/model ColumnInfo.GeneratedExprString; executor computes at
+    # write, pkg/table/column.go CastValue + BuildRowcodecColInfo)
+    generated_stored: bool = False
+    decl: str | None = None  # declared SQL type text ("int", "char(20)")
+    # — the engine normalizes storage types (all ints -> int64 lanes), so
+    # SHOW CREATE TABLE needs the original spelling preserved
 
 
 @dataclass
@@ -300,14 +331,25 @@ class Catalog:
             handle_col = None
             for i, cd in enumerate(stmt.columns):
                 ft = field_type_from_spec(cd.type, cd.not_null or cd.primary_key)
-                cols.append(ColumnMeta(cd.name.lower(), i + 1, ft, cd.default, cd.auto_increment))
+                cols.append(ColumnMeta(
+                    cd.name.lower(), i + 1, ft, cd.default, cd.auto_increment,
+                    generated=cd.generated,
+                    generated_stored=getattr(cd, "generated_stored", False),
+                    decl=decl_text(cd.type),
+                ))
+            pk_cols: list[str] = []
+            for cd in stmt.columns:
                 if cd.primary_key:
-                    if not ft.is_int():
-                        # uniqueness would be silently unenforced otherwise
-                        raise CatalogError(
-                            "non-integer PRIMARY KEY not supported yet (integer handle columns only)"
-                        )
-                    handle_col = cd.name.lower()
+                    ft = next(c for c in cols if c.name == cd.name.lower()).ft
+                    if ft.is_int():
+                        handle_col = cd.name.lower()
+                    else:
+                        # NONCLUSTERED primary key: implicit _tidb_rowid
+                        # handle + unique PRIMARY index — the reference's
+                        # own layout when the PK cannot be the row key
+                        # (ref: pkg/meta/model/table.go IsCommonHandle
+                        # false path, tables.go AllocHandle)
+                        pk_cols = [cd.name.lower()]
             indices = []
             for j, idx in enumerate(getattr(stmt, "indexes", []) or []):
                 iname = getattr(idx, "name", "") or f"idx_{j}"
@@ -317,10 +359,16 @@ class Catalog:
                     if len(icols) == 1 and c is not None and c.ft.is_int():
                         handle_col = icols[0]
                         continue
-                    raise CatalogError(
-                        "non-integer/composite PRIMARY KEY not supported yet (integer handle columns only)"
-                    )
+                    pk_cols = icols
+                    continue
                 indices.append(IndexMeta(iname, self._alloc_id(), icols, getattr(idx, "unique", False)))
+            if pk_cols and handle_col is None:
+                for cn in pk_cols:
+                    cm = next((c for c in cols if c.name == cn), None)
+                    if cm is None:
+                        raise CatalogError(f"unknown PRIMARY KEY column {cn!r}")
+                    cm.ft.flag |= Flag.NotNull | Flag.PriKey
+                indices.insert(0, IndexMeta("PRIMARY", self._alloc_id(), pk_cols, True))
             part = None
             pdict = (stmt.options or {}).get("partition_by")
             if pdict is not None:

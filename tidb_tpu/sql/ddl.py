@@ -158,7 +158,12 @@ def _add_column(session, meta, spec: A.AlterTableSpec):
             raise DDLError(f"unknown column {target!r} in AFTER")
         pos = names.index(target) + 1
     new_id = meta.alloc_col_id()
-    cm = ColumnMeta(name, new_id, ft, cd.default, cd.auto_increment, origin_default=origin)
+    from .catalog import decl_text
+
+    cm = ColumnMeta(name, new_id, ft, cd.default, cd.auto_increment, origin_default=origin,
+                    generated=cd.generated,
+                    generated_stored=getattr(cd, "generated_stored", False),
+                    decl=decl_text(cd.type))
     meta.columns.insert(pos, cm)
     session.catalog.version += 1
 

@@ -23,22 +23,38 @@ class CustomFunction:
     name: str
     fn: object  # (*python values | None) -> python value | None
     ft: FieldType
+    raw: bool = False  # fn takes the Datum list and returns a Datum
+    # (internal consumers like the subquery Apply fallback need exact
+    # types for bindings; user extensions keep the plain-value contract)
+
+
+_APPLY_CAP = 256  # FIFO bound on internal __apply_* registrations
 
 
 class ExtensionRegistry:
     def __init__(self):
         self.functions: dict[str, CustomFunction] = {}
+        self._apply_fifo: list[str] = []
 
-    def register_function(self, name: str, fn, result_ft: FieldType | None = None):
+    def register_function(self, name: str, fn, result_ft: FieldType | None = None, raw: bool = False):
         """Register a host-evaluated scalar function usable from SQL.
         `fn` receives plain Python values (None for NULL) and returns one;
-        the result type defaults to VARCHAR unless given."""
+        the result type defaults to VARCHAR unless given. raw=True passes
+        and returns Datums verbatim (internal use)."""
         name = name.lower()
         if name in ir.SCALAR_OPS:
             raise ValueError(f"{name!r} is a builtin and cannot be overridden")
-        cf = CustomFunction(name, fn, result_ft or new_varchar(255))
+        cf = CustomFunction(name, fn, result_ft or new_varchar(255), raw)
         self.functions[name] = cf
         ir.EXTENSION_OPS.add(name)
+        if name.startswith("__apply_"):
+            # the subquery Apply fallback registers one closure per
+            # rewritten statement (it pins the sub-AST + result cache);
+            # statements re-rewrite on every execution, so old entries are
+            # dead — a FIFO cap keeps the registry bounded
+            self._apply_fifo.append(name)
+            if len(self._apply_fifo) > _APPLY_CAP:
+                self.unregister_function(self._apply_fifo.pop(0))
         return cf
 
     def register_sysvar(self, name: str, default: str, validator=None, scope: str = "both"):
@@ -58,6 +74,8 @@ class ExtensionRegistry:
 
     def call(self, name: str, datums: list) -> Datum:
         cf = self.functions[name.lower()]
+        if cf.raw:
+            return cf.fn(list(datums))
         args = [None if d.is_null() else d.val for d in datums]
         out = cf.fn(*args)
         return _to_datum(out, cf.ft)

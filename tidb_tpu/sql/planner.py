@@ -607,6 +607,22 @@ def _coerce_datum(d: Datum, ft: FieldType) -> Datum:
         mask = int(d.val)
         return Datum.set_from(ft.elems, mask)
     et = ft.eval_type()
+    if d.kind == DatumKind.MysqlJSON and et != "json":
+        # JSON scalar -> SQL value (generated columns over JSON_EXTRACT,
+        # CAST(json AS ...); ref: pkg/expression/builtin_cast.go json paths)
+        from ..types import json_binary as _jb
+
+        v = _jb.decode(bytes(d.val))
+        if v is None:
+            return Datum.NULL
+        if isinstance(v, bool):
+            d = Datum.i64(1 if v else 0)
+        elif isinstance(v, (int, float)):
+            d = Datum.i64(v) if isinstance(v, int) else Datum.f64(v)
+        elif isinstance(v, str):
+            d = Datum.string(v)
+        else:
+            d = Datum.string(_jb.to_text(v))
     if et == "decimal":
         if d.kind == DatumKind.MysqlDecimal:
             return Datum.dec(d.val.round(max(ft.decimal, 0)))

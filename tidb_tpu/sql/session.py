@@ -1065,21 +1065,25 @@ class Session:
         shared by SHOW COLUMNS and information_schema.columns."""
         from ..tools.dump import _type_sql
 
+        pri_cols = set()
+        for idx in meta.indices:
+            if idx.name == "PRIMARY":
+                pri_cols.update(idx.col_names)
         out = []
         for c in meta.columns:
-            dflt = ""
+            dflt = "NULL" if not c.ft.not_null() else ""
             if c.default is not None:
                 try:
                     d = self._eval_const(c.default, c.ft)
-                    dflt = "" if d.is_null() else str(d.val)
+                    dflt = "NULL" if d.is_null() else str(d.val)
                 except Exception:  # noqa: BLE001 — display only
-                    dflt = ""
+                    pass
             elif c.origin_default is not None and not c.origin_default.is_null():
                 dflt = str(c.origin_default.val)
             out.append((
-                c.name, _type_sql(c.ft).lower(),
+                c.name, (c.decl or _type_sql(c.ft).lower()),
                 "NO" if c.ft.not_null() else "YES",
-                "PRI" if c.name == meta.handle_col else "",
+                "PRI" if (c.name == meta.handle_col or c.name in pri_cols) else "",
                 dflt,
                 "auto_increment" if c.auto_increment else "",
             ))
@@ -1467,7 +1471,14 @@ class Session:
             for vals in stmt.values:
                 if len(vals) != len(cols):
                     raise SQLError("column count does not match value count")
-                rows.append({cols[i]: self._eval_const(v, meta.col(cols[i]).ft) for i, v in enumerate(vals)})
+                # a DEFAULT literal behaves as if the column were omitted
+                # (column default / generated recompute; ref: ast.Default
+                # handling in executor/insert_common.go)
+                rows.append({
+                    cols[i]: self._eval_const(v, meta.col(cols[i]).ft)
+                    for i, v in enumerate(vals)
+                    if not isinstance(v, A.Default)
+                })
         if stmt.on_duplicate:
             raise SQLError("ON DUPLICATE KEY UPDATE not supported yet")
         n = 0
@@ -1476,13 +1487,24 @@ class Session:
             handle = None
             for c in meta.columns:
                 if c.name in r:
+                    if c.generated is not None:
+                        # MySQL 3105: only DEFAULT may target a generated
+                        # column (DEFAULT literals never land in `r`)
+                        raise SQLError(
+                            f"the value specified for generated column {c.name!r} "
+                            f"in table {meta.name!r} is not allowed"
+                        )
                     d = _coerce_datum(r[c.name], c.ft) if not isinstance(r[c.name], A.ExprNode) else r[c.name]
                 else:
                     d = self._eval_const(c.default, c.ft) if c.default is not None else Datum.NULL
+                if c.generated is not None:
+                    d = Datum.NULL  # recomputed below, never user-supplied
                 if meta.handle_col == c.name and not d.is_null():
                     handle = int(d.val)
                     meta.observe_handle(handle)
                 datums.append(d)
+            self._apply_generated(meta, datums)
+            self._check_not_null(meta, datums)
             if handle is None:
                 handle = meta.alloc_handle()
                 if meta.handle_col is not None:
@@ -1528,6 +1550,37 @@ class Session:
             elif stmt.replace:
                 n += 2  # replaced in place: MySQL counts delete AND insert
         return Result(affected=n)
+
+    def _check_not_null(self, meta: TableMeta, datums: list) -> None:
+        """NOT NULL (incl. implicit PK not-null) enforcement at write
+        (ref: table/column.go CheckNotNull)."""
+        from ..types import Flag
+
+        for c, d in zip(meta.columns, datums):
+            if d.is_null() and bool(c.ft.flag & Flag.NotNull) and not c.auto_increment \
+                    and c.name != meta.handle_col:
+                raise SQLError(f"column {c.name!r} cannot be null")
+
+    def _apply_generated(self, meta: TableMeta, datums: list) -> None:
+        """Materialize GENERATED ALWAYS AS columns from the row, in column
+        order (later generated columns may reference earlier ones — the
+        reference evaluates in dependency order, pkg/table/column.go
+        CalcOnce ordering; column order subsumes it for valid schemas)."""
+        if not any(c.generated is not None for c in meta.columns):
+            return
+        scope = _Scope([_TableRef(meta, meta.name, 0)])
+        lw = _Lowerer(scope)
+        ev = RefEvaluator()
+        for i, c in enumerate(meta.columns):
+            if c.generated is None:
+                continue
+            try:
+                e = lw.lower_base(c.generated)
+                datums[i] = _coerce_datum(ev.eval(e, datums), c.ft)
+            except SQLError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — surface as SQL error
+                raise SQLError(f"generated column {c.name!r}: {exc}") from exc
 
     def _read_row(self, meta: TableMeta, handle: int, ts: int) -> list | None:
         """Point read of one row by handle with txn-buffer overlay
@@ -1628,6 +1681,11 @@ class Session:
         assigns = []
         for a in stmt.assignments:
             cm = meta.col(a.column.name if isinstance(a.column, A.ColumnName) else str(a.column))
+            if cm.generated is not None:
+                raise SQLError(
+                    f"the value specified for generated column {cm.name!r} "
+                    f"in table {meta.name!r} is not allowed"
+                )
             assigns.append((cm, lw.lower_base(a.expr)))
         ev = RefEvaluator()
         moves_handle = meta.handle_col is not None and any(cm.name == meta.handle_col for cm, _ in assigns)
@@ -1636,6 +1694,8 @@ class Session:
             for cm, e in assigns:
                 # MySQL applies SET left-to-right over already-updated values
                 new_row[col_pos[cm.name]] = _coerce_datum(ev.eval(e, new_row), cm.ft)
+            self._apply_generated(meta, new_row)
+            self._check_not_null(meta, new_row)
             new_handle = handle
             if moves_handle:
                 d = new_row[col_pos[meta.handle_col]]
@@ -1899,12 +1959,12 @@ class Session:
                     rows=[[Datum.string(vm.name),
                            Datum.string(f"CREATE VIEW `{vm.name}`{cols} AS {vm.select_sql}")]],
                 )
-            from ..tools.dump import schema_sql
+            from .showddl import show_create_table
 
             meta = self.catalog.table(stmt.table.name)
             return Result(
                 columns=["Table", "Create Table"],
-                rows=[[Datum.string(meta.name), Datum.string(schema_sql(meta).rstrip("\n"))]],
+                rows=[[Datum.string(meta.name), Datum.string(show_create_table(meta))]],
             )
         if kind == "columns":
             meta = self.catalog.table(stmt.table.name)
@@ -1933,9 +1993,16 @@ class Session:
         if kind == "tables":
             names = sorted(set(self.catalog.tables()) | set(self.catalog.views))
             names = [t for t in names if _show_like(stmt, t)]
-            return Result(columns=["Tables"], rows=[[Datum.string(t)] for t in names])
+            hdr = f"Tables_in_{self.db}"
+            pat = getattr(stmt, "pattern", None)
+            if pat:
+                hdr += f" ({pat})"
+            return Result(columns=[hdr], rows=[[Datum.string(t)] for t in names])
         if kind == "databases":
-            return Result(columns=["Database"], rows=[[Datum.string("test")]])
+            pat = getattr(stmt, "pattern", None)
+            hdr = "Database" + (f" ({pat})" if pat else "")
+            dbs = [d for d in [self.db] if _show_like(stmt, d)]
+            return Result(columns=[hdr], rows=[[Datum.string(d)] for d in dbs])
         if kind == "variables":
             return Result(
                 columns=["Variable_name", "Value"],

@@ -18,6 +18,15 @@ namespace, and the outer AST is rewritten to reference it:
   correlated [NOT] IN / EXISTS -> SemiJoinCond (semi/anti join in the DAG)
   correlated scalar (agg)      -> LEFT JOIN of the inner re-grouped by its
                                   correlation keys + column reference
+  anything decorrelation can't -> Apply fallback: a host-evaluated function
+                                  re-executes the subquery per outer row
+                                  with the outer references bound — the
+                                  analog of the LogicalApply operator the
+                                  reference keeps when pull-up fails
+                                  (rule_decorrelate.go); exact 3VL for
+                                  (NOT) IN incl. row-value probes (the
+                                  null-aware anti-join semantics,
+                                  ref: pkg/planner/core/exhaust_physical_plans.go NAAJ)
 
 CTEs (including recursive ones) materialize here too and shadow catalog
 tables by name (ref: pkg/planner/core/logical_plan_builder.go buildWith).
@@ -38,6 +47,11 @@ from .catalog import Catalog, ColumnMeta, TableMeta
 # IN-lists up to this size inline as literals (one fused compare chain on
 # device); larger sets become semi joins against the materialized rows
 MAX_IN_LITERALS = 64
+
+
+def _probe_items(expr) -> list:
+    """IN-probe component expressions: (a, b) row values flatten."""
+    return list(expr.items) if isinstance(expr, A.RowExpr) else [expr]
 
 
 class SubqueryError(ValueError):
@@ -248,6 +262,13 @@ class SubqueryRewriter:
         seed part, then the recursive part iterates over the previous
         iteration's rows until a fixpoint or the depth cap)."""
         sets = cte.subquery
+        # a WITH clause on the CTE's own body (nested CTEs) materializes
+        # first so the seed/recursive parts can read it; the binding lands
+        # in this scope (slightly wider than MySQL's body-only scope, but
+        # later same-name definitions simply rebind)
+        if getattr(sets, "ctes", None):
+            self.process_ctes(sets.ctes)
+            sets.ctes = []
 
         def refs_cte(sel) -> bool:
             def in_from(fr):
@@ -417,13 +438,24 @@ class SubqueryRewriter:
             negated = node.negated ^ neg
             if not self._is_correlated(node.subquery, schema):
                 return self._uncorrelated_exists(node.subquery, negated)
-            return self._correlated_semi(node.subquery, schema, None, negated)
+            try:
+                return self._correlated_semi(node.subquery, schema, None, negated)
+            except SubqueryError:
+                return self._apply_fallback("exists", node.subquery, schema, stmt, negated=negated)
         if isinstance(node, A.InSubquery):
             negated = node.negated ^ neg
             if not self._is_correlated(node.subquery, schema):
                 return self._uncorrelated_in(node, schema, stmt, negated)
-            x = self._rewrite_expr(node.expr, schema, stmt)
-            return self._correlated_semi(node.subquery, schema, x, negated)
+            if not isinstance(node.expr, A.RowExpr):
+                try:
+                    x = self._rewrite_expr(copy.deepcopy(node.expr), schema, stmt)
+                    return self._correlated_semi(node.subquery, schema, x, negated)
+                except SubqueryError:
+                    pass
+            return self._apply_fallback(
+                "in", node.subquery, schema, stmt,
+                probe_exprs=_probe_items(node.expr), negated=negated,
+            )
         return self._rewrite_expr(c, schema, stmt)
 
     def _rewrite_expr(self, n, schema, stmt):
@@ -434,14 +466,13 @@ class SubqueryRewriter:
             return self._scalar(n.subquery, schema, stmt)
         if isinstance(n, A.Exists):
             if self._is_correlated(n.subquery, schema):
-                raise SubqueryError(
-                    "correlated EXISTS is only supported as a top-level WHERE conjunct"
-                )
+                return self._apply_fallback("exists", n.subquery, schema, stmt, negated=n.negated)
             return self._uncorrelated_exists(n.subquery, n.negated)
         if isinstance(n, A.InSubquery):
             if self._is_correlated(n.subquery, schema):
-                raise SubqueryError(
-                    "correlated IN is only supported as a top-level WHERE conjunct"
+                return self._apply_fallback(
+                    "in", n.subquery, schema, stmt,
+                    probe_exprs=_probe_items(n.expr), negated=n.negated,
                 )
             return self._uncorrelated_in(n, schema, stmt, n.negated, conjunct=False)
         if isinstance(n, A.CompareSubquery):
@@ -478,6 +509,8 @@ class SubqueryRewriter:
 
     def _uncorrelated_in(self, node, schema, stmt, negated, conjunct=True):
         sub = node.subquery
+        if isinstance(node.expr, A.RowExpr):
+            return self._uncorrelated_tuple_in(node, schema, stmt, negated)
         fields = (sub.selects[0] if isinstance(sub, A.SetOprStmt) else sub).fields
         if len(fields) != 1 or isinstance(fields[0].expr if isinstance(fields[0], A.SelectField) else fields[0], A.Star):
             raise SubqueryError("IN subquery must select exactly one column")
@@ -514,11 +547,42 @@ class SubqueryRewriter:
             return A.BinaryOp("and", marker, A.IsNull(copy.deepcopy(x), negated=True))
         return marker
 
+    def _uncorrelated_tuple_in(self, node, schema, stmt, negated):
+        """(a, b) [NOT] IN (select x, y ...): fold the materialized rows
+        into OR-of-row-equalities — SQL's own AND/OR/= three-valued logic
+        makes the NULL semantics exact (row comparison decomposes to
+        component conjunction, ref: expression_rewriter.go buildRowExpr +
+        the NAAJ semantics it feeds)."""
+        fts, rows = self._exec_values(node.subquery)
+        xs = [self._rewrite_expr(copy.deepcopy(p), schema, stmt) for p in node.expr.items]
+        if rows and len(rows[0]) != len(xs):
+            raise SubqueryError("IN row-value arity mismatch")
+        if len(rows) > MAX_IN_LITERALS:
+            raise SubqueryError(
+                f"row-value IN subquery with >{MAX_IN_LITERALS} rows not supported"
+            )
+        if not rows:
+            return TRUE_LIT() if negated else FALSE_LIT()
+        disj = None
+        for r in rows:
+            eqs = [
+                A.BinaryOp("eq", copy.deepcopy(x), _dlit(d))
+                for x, d in zip(xs, r)
+            ]
+            conj = eqs[0]
+            for e in eqs[1:]:
+                conj = A.BinaryOp("and", conj, e)
+            disj = conj if disj is None else A.BinaryOp("or", disj, conj)
+        return A.UnaryOp("not", disj) if negated else disj
+
     def _compare_subquery(self, n: A.CompareSubquery, schema, stmt):
         """cmp ANY/ALL folding over the materialized value set
         (ref: expression_rewriter.go handleCompareSubquery min/max rewrite)."""
         if self._is_correlated(n.subquery, schema):
-            raise SubqueryError("correlated ANY/ALL subqueries not supported")
+            return self._apply_fallback(
+                "cmp", n.subquery, schema, stmt,
+                probe_exprs=[n.expr], cmp_op=n.op, cmp_all=n.all,
+            )
         fts, rows = self._exec_values(n.subquery)
         x = self._rewrite_expr(n.expr, schema, stmt)
         values = [r[0] for r in rows]
@@ -574,6 +638,216 @@ class SubqueryRewriter:
         return A.InList(x, [_dlit(d) for d in uniq], negated=negated)
 
     # --------------------------------------------------- correlated forms
+    # ----------------------------------------------------- apply fallback
+    def _walk_outer_cols(self, node, schema, visit):
+        """Walk `node` (a subquery AST) visiting every ColumnName that
+        resolves ONLY in the enclosing `schema` (not in its local scope
+        chain). `visit(parent, field, index_or_None, colname)` may return a
+        replacement node. Mirrors _refs_outer's scope-stack walk."""
+
+        def outer_only(n, schemas) -> bool:
+            return (
+                isinstance(n, A.ColumnName)
+                and not any(self._resolves(n, s) for s in schemas[1:])
+                and self._resolves(n, schemas[0])
+            )
+
+        def maybe(parent, f_, i, n, schemas):
+            if isinstance(n, A.ColumnName):
+                if outer_only(n, schemas):
+                    rep = visit(n)
+                    if rep is not None:
+                        if i is None:
+                            setattr(parent, f_, rep)
+                        else:
+                            getattr(parent, f_)[i] = rep
+                return
+            walk(n, schemas)
+
+        def walk(n, schemas):
+            if not hasattr(n, "__dataclass_fields__"):
+                return
+            sub = getattr(n, "subquery", None)
+            if sub is not None and not isinstance(n, A.SubqueryTable):
+                for sel in (sub.selects if isinstance(sub, A.SetOprStmt) else [sub]):
+                    walk_stmt(sel, schemas + [self._from_schema(sel.from_clause)])
+            for f_ in n.__dataclass_fields__:
+                if f_ == "subquery":
+                    continue
+                v = getattr(n, f_)
+                if isinstance(v, list):
+                    for i, it in enumerate(v):
+                        if isinstance(it, tuple):
+                            # tuple elements (CASE when/then pairs) may BE
+                            # bare outer columns: rebuild the tuple
+                            newt, changed = [], False
+                            for x in it:
+                                if outer_only(x, schemas):
+                                    rep = visit(x)
+                                    if rep is not None:
+                                        x, changed = rep, True
+                                else:
+                                    walk(x, schemas)
+                                newt.append(x)
+                            if changed:
+                                v[i] = tuple(newt)
+                        elif hasattr(it, "__dataclass_fields__"):
+                            maybe(n, f_, i, it, schemas)
+                elif hasattr(v, "__dataclass_fields__"):
+                    maybe(n, f_, None, v, schemas)
+
+        def walk_stmt(sel, schemas):
+            if isinstance(sel, A.SetOprStmt):
+                for s in sel.selects:
+                    walk_stmt(s, schemas)
+                return
+            for f in sel.fields:
+                walk(f, schemas)
+            for f_ in ("where", "having"):
+                part = getattr(sel, f_)
+                if part is not None:
+                    maybe(sel, f_, None, part, schemas)
+            for b in list(sel.group_by) + list(sel.order_by):
+                maybe(b, "expr", None, b.expr, schemas)
+
+            def walk_from(fr):
+                if isinstance(fr, A.Join):
+                    walk_from(fr.left)
+                    walk_from(fr.right)
+                    if fr.on is not None:
+                        walk(fr.on, schemas)
+            walk_from(sel.from_clause)
+
+        sels = node.selects if isinstance(node, A.SetOprStmt) else [node]
+        for sel in sels:
+            walk_stmt(sel, [schema, self._from_schema(sel.from_clause)])
+
+    def _apply_fallback(self, kind, sub, schema, stmt, probe_exprs=(), negated=False, cmp_op=None, cmp_all=False):
+        """Correlated subquery the decorrelator can't handle -> register a
+        host-evaluated function that re-executes the inner per outer row
+        (deduplicated by binding), and rewrite to a call on the outer refs.
+        kind: exists | in | scalar | cmp."""
+        from ..exec.executor import datum_group_key as _gk
+        from ..types import new_longlong
+        from .extension import EXTENSIONS
+        from .planner import datum_ft
+
+        refs: list = []
+        ref_keys: dict = {}
+
+        def collect(c: A.ColumnName):
+            k = (c.db.lower(), c.table.lower(), c.name.lower())
+            if k not in ref_keys:
+                ref_keys[k] = len(refs)
+                refs.append(A.ColumnName(c.name, c.table, c.db))
+            return None
+
+        self._walk_outer_cols(sub, schema, collect)
+        if not refs:
+            raise SubqueryError("correlated subquery has no resolvable outer references")
+        probes = [self._rewrite_expr(copy.deepcopy(p), schema, stmt) for p in probe_exprs]
+        np_ = len(probes)
+        cache: dict = {}
+        exec_query = self.exec_query
+        resolves = self._resolves
+        from_schema = self._from_schema
+        walker = self._walk_outer_cols
+
+        def tuple_in_3vl(xs, rows):
+            if rows and len(rows[0]) != len(xs):
+                from .session import SQLError
+
+                raise SQLError(f"Operand should contain {len(xs)} column(s)")
+            any_unknown = False
+            for r in rows:
+                all_true, unknown = True, False
+                for x, s in zip(xs, r):
+                    if x.is_null() or s.is_null():
+                        unknown = True
+                        continue
+                    if compare(x, s) != 0:
+                        all_true = False
+                        unknown = False
+                        break
+                if all_true and not unknown:
+                    return Datum.i64(0) if negated else Datum.i64(1)
+                if unknown:
+                    any_unknown = True
+            if any_unknown:
+                return Datum.NULL
+            return Datum.i64(1) if negated else Datum.i64(0)
+
+        def run(datums):
+            key = tuple(_gk(d) for d in datums)
+            if key in cache:
+                return cache[key]
+            bind = datums[np_:]
+            sub2 = copy.deepcopy(sub)
+
+            def subst(c: A.ColumnName):
+                i = ref_keys.get((c.db.lower(), c.table.lower(), c.name.lower()))
+                return _dlit(bind[i]) if i is not None else None
+
+            walker(sub2, schema, subst)
+            names, fts, rows = exec_query(sub2)
+            if kind == "exists":
+                out = Datum.i64(1 if bool(rows) ^ negated else 0)
+            elif kind == "in":
+                out = tuple_in_3vl(datums[:np_], rows)
+            elif kind == "scalar":
+                if len(rows) > 1:
+                    # runtime (not rewrite-time) error: surface as SQLError
+                    # so the session reports it like any statement error
+                    from .session import SQLError
+
+                    raise SQLError("Subquery returns more than 1 row")
+                out = rows[0][0] if rows else Datum.NULL
+            else:  # cmp ANY/ALL
+                x = datums[0]
+                vals = [r[0] for r in rows]
+                if not vals:
+                    out = Datum.i64(1 if cmp_all else 0)
+                elif x.is_null():
+                    out = Datum.NULL
+                else:
+                    import operator
+
+                    opf = {"lt": operator.lt, "le": operator.le, "gt": operator.gt,
+                           "ge": operator.ge, "eq": operator.eq, "ne": operator.ne}[cmp_op]
+                    res, unknown = (True if cmp_all else False), False
+                    for v in vals:
+                        if v.is_null():
+                            unknown = True
+                            continue
+                        ok = opf(compare(x, v), 0)
+                        if cmp_all and not ok:
+                            res = False
+                            unknown = False
+                            break
+                        if not cmp_all and ok:
+                            res = True
+                            unknown = False
+                            break
+                    out = Datum.NULL if unknown else Datum.i64(1 if res else 0)
+            cache[key] = out
+            return out
+
+        fname = f"__apply_{id(sub):x}_{len(EXTENSIONS.functions)}"
+        if kind == "scalar":
+            # discover the result type from one NULL-bound probe run; on
+            # any failure surface the original unsupported-shape error
+            try:
+                sub_t = copy.deepcopy(sub)
+                walker(sub_t, schema, lambda c: A.Literal(None, "null"))
+                _, t_fts, _ = exec_query(sub_t)
+                ft = t_fts[0] if t_fts else new_longlong()
+            except Exception as exc:  # noqa: BLE001
+                raise SubqueryError(f"correlated scalar subquery not supported: {exc}") from exc
+        else:
+            ft = new_longlong()
+        EXTENSIONS.register_function(fname, run, ft, raw=True)
+        return A.FuncCall(fname, probes + refs)
+
     def _extract_corr(self, sub: A.SelectStmt, schema):
         """Split the inner WHERE into local conjuncts and correlation pairs
         (inner_expr, outer_expr). Raises unless every correlated conjunct
@@ -687,8 +961,11 @@ class SubqueryRewriter:
                 raise SubqueryError("Subquery returns more than 1 row")
             return _dlit(rows[0][0]) if rows else NULL_LIT()
         if isinstance(sub, A.SetOprStmt):
-            raise SubqueryError("correlated UNION subqueries not supported")
-        return self._scalar_corr(sub, schema, stmt)
+            return self._apply_fallback("scalar", sub, schema, stmt)
+        try:
+            return self._scalar_corr(copy.deepcopy(sub), schema, stmt)
+        except SubqueryError:
+            return self._apply_fallback("scalar", sub, schema, stmt)
 
     def _scalar_corr(self, sub: A.SelectStmt, schema, stmt):
         """Correlated scalar subquery -> LEFT JOIN against the inner
