@@ -546,27 +546,43 @@ def _cpu_only_main():
 
 
 def _config_rows(name: str) -> int:
-    # sort-heavy programs (group-by / topn / join) compile 10-100x slower on
-    # the tunneled backend; smaller resident batches keep first-run compile
-    # bounded while the K-deep loop preserves steady-state signal.
-    # q1/q3 (multi-agg group-by, 3-table join) get the smallest batches:
-    # at ROWS//16 q1's compile exceeds 25 minutes and q3's fused join
-    # faults the tunneled device; ROWS//64 compiles and runs.
-    if name in ("q6", "scalar_agg"):
-        return ROWS
-    if name == "q1":
-        return ROWS  # dense small-G kernel: no sort, full-size batch
-    if name == "topn":
-        return ROWS  # sampled-threshold kernel: no full sort, full batch
-    return ROWS // 16  # q3: 3-table join pipeline
+    # every config now runs the full 4M-row resident batch: q3's packed
+    # join+groupsum kernel (r5) compiles in ~75s warm-cache at 4M — the
+    # old fused mega-program needed ROWS//16 to compile at all
+    return ROWS
+
+
+def _parity_only_main(name: str):
+    """Grandchild process: the small-N parity diff on hermetic CPU."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    cfg = next(c for c in _configs() if c.name == name)
+    parity_gate(cfg)
+    print("PARITY_OK")
 
 
 def _one_config_main(name: str):
-    """Child process: parity + accel measurement for one config."""
+    """Child process: parity (isolated CPU subprocess — running it on the
+    in-process TPU backend left the device in a state where the subsequent
+    4M-row loop failed with INVALID_ARGUMENT) + accel measurement."""
+    import subprocess
+
     import jax
 
     cfg = next(c for c in _configs() if c.name == name)
-    parity_gate(cfg)
+    env = dict(os.environ, BENCH_PARITY=name, JAX_PLATFORMS="cpu")
+    env.pop("BENCH_ONE", None)
+    out = subprocess.run([sys.executable, __file__], env=env, capture_output=True, text=True, timeout=900)
+    if "PARITY_OK" not in out.stdout:
+        sys.stderr.write(out.stderr[-3000:])
+        raise RuntimeError(f"{name}: parity gate failed")
     log(f"  [{name}] parity gate vs oracle: OK")
     rps, gbs, spread, csum = bench_config(cfg, jax.devices()[0], _config_rows(name), ITERS)
     print(json.dumps({
@@ -602,6 +618,9 @@ def main():
 
     if os.environ.get("BENCH_CPU_ONLY"):
         _cpu_only_main()
+        return
+    if os.environ.get("BENCH_PARITY"):
+        _parity_only_main(os.environ["BENCH_PARITY"])
         return
     if os.environ.get("BENCH_ONE"):
         _one_config_main(os.environ["BENCH_ONE"])

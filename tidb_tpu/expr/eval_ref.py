@@ -21,6 +21,24 @@ from .ir import ColumnRef, Const, Expr, ScalarFunc
 _NUM_PREFIX = re.compile(r"^\s*[+-]?(\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?")
 
 
+_CHARSET_CODEC = {"gbk": "gbk", "gb2312": "gb2312", "gb18030": "gb18030",
+                  "latin1": "latin-1", "ascii": "ascii", "utf8": "utf-8",
+                  "utf8mb4": "utf-8", "big5": "big5"}
+
+
+def charset_bytes(v, ft) -> bytes:
+    """Value -> the bytes MySQL's byte-semantics functions (LENGTH, HEX,
+    ASCII, OCTET_LENGTH) see: the column's declared charset encoding, with
+    BINARY(n) zero-padding to the declared width (ref:
+    pkg/expression/builtin_string.go Length over the stored bytes)."""
+    if isinstance(v, (bytes, bytearray)):
+        b = bytes(v)
+    else:
+        codec = _CHARSET_CODEC.get(getattr(ft, "charset", "") or "", "utf-8")
+        b = str(v).encode(codec, "replace")
+    return b
+
+
 def _ascii_upper(s: str) -> str:
     """ASCII-only case fold (the general_ci subset every engine path uses)."""
     return "".join(chr(ord(c) - 32) if "a" <= c <= "z" else c for c in s)
@@ -748,8 +766,42 @@ class RefEvaluator:
         (a,) = self._args(e, row)
         if a.is_null():
             return Datum.NULL
-        b = a.val.encode() if isinstance(a.val, str) else bytes(a.val)
-        return Datum.i64(len(b))
+        return Datum.i64(len(charset_bytes(a.val, e.args[0].ft)))
+
+    def _op_octet_length(self, e, row):
+        return self._op_length(e, row)
+
+    def _op_hex(self, e, row):
+        (a,) = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        if isinstance(a.val, (int,)) or a.kind in (DatumKind.Int64, DatumKind.Uint64):
+            return Datum.string(format(int(a.val), "X"))
+        return Datum.string(charset_bytes(a.val, e.args[0].ft).hex().upper())
+
+    def _op_ascii(self, e, row):
+        (a,) = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        b = charset_bytes(a.val, e.args[0].ft)
+        return Datum.i64(b[0] if b else 0)
+
+    def _op_ord(self, e, row):
+        # ORD: leading multi-byte character folded big-endian (MySQL docs)
+        (a,) = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        b = charset_bytes(a.val, e.args[0].ft)
+        if not b:
+            return Datum.i64(0)
+        s = a.val if isinstance(a.val, str) else None
+        if s:
+            cb = charset_bytes(s[0], e.args[0].ft)
+            n = 0
+            for x in cb:
+                n = n * 256 + x
+            return Datum.i64(n)
+        return Datum.i64(b[0])
 
     def _op_strcmp(self, e, row):
         a, b = self._args(e, row)

@@ -82,6 +82,16 @@ def agg_exchange_phases(agg, schema_fts, cvals, valid, n_parts: int, group_capac
         aggs.append((d, avals[k : k + len(d.args)]))
         k += len(d.args)
 
+    if any(d.distinct for d in agg.aggs):
+        # DISTINCT is not state-decomposable, but it IS local-exact after
+        # the group-key shuffle: every group lands whole on one device
+        # (the reference's MPP plan for distinct aggs shuffles raw rows by
+        # group key then aggregates Complete-mode on the owner —
+        # planner/core/task.go agg-over-exchange with one phase)
+        return _distinct_exchange_phases(
+            agg, gvals, aggs, valid, n_parts, group_capacity, bcap, extra_overflow
+        )
+
     # -- phase 1: local Partial1 ------------------------------------
     res = group_aggregate(gvals, aggs, valid, group_capacity, merge=False)
     p1_overflow = res.overflow
@@ -157,6 +167,57 @@ def agg_exchange_phases(agg, schema_fts, cvals, valid, n_parts: int, group_capac
     return tuple([fin.group_valid] + flat_out + [overflow])
 
 
+def _distinct_exchange_phases(agg, gvals, aggs, valid, n_parts: int, group_capacity: int, bcap: int, extra_overflow=None):
+    """Raw-row exchange + Complete-mode owner aggregation (DISTINCT path).
+
+    Exchanges (group keys ++ agg args) row-wise instead of partial states;
+    the owner runs the single-device group kernel in Complete mode, whose
+    hash-distinct machinery (ops/aggregate.py _distinct_states) is exact.
+    Output layout matches agg_exchange_phases."""
+    part = hash_partition_ids(gvals, n_parts)
+    row_cvs = list(gvals) + [a for _, avs in aggs for a in avs]
+    flat_arrays = [a for cv in row_cvs for a in (cv.value, cv.null)]
+    bufs, bvalid, ex_overflow = scatter_to_buckets(flat_arrays, valid, part, n_parts, bcap)
+    recv = [jax.lax.all_to_all(b, REGION_AXIS, 0, 0, tiled=False) for b in bufs]
+    rvalid = jax.lax.all_to_all(bvalid, REGION_AXIS, 0, 0, tiled=False)
+    flat = [r.reshape((-1,) + r.shape[2:]) for r in recv]
+    fvalid = rvalid.reshape(-1)
+
+    k = 0
+    owned: list[CompVal] = []
+    for cv in row_cvs:
+        owned.append(CompVal(flat[k], flat[k + 1].astype(bool), cv.ft))
+        k += 2
+    o_gvals = owned[: len(gvals)]
+    o_args = owned[len(gvals):]
+    o_aggs = []
+    ai = 0
+    for d, avs in aggs:
+        o_aggs.append((d, o_args[ai : ai + len(avs)]))
+        ai += len(avs)
+    fin = group_aggregate(o_gvals, o_aggs, fvalid, group_capacity, merge=False)
+
+    out_cols = []
+    for (d, av), st in zip(o_aggs, fin.states):
+        if isinstance(st, GatherState):
+            st = GatherState(st.idx, st.has & fin.group_valid)
+            out_cols.extend(_materialize_gather(d, av, st, final=True))
+        else:
+            v, nl = finalize_agg(d, st, fin.group_valid)
+            out_cols.append((v, nl))
+    for gk in o_gvals:
+        if gk.value.ndim == 2:
+            out_cols.append((gk.value[fin.group_rep, :], gk.null[fin.group_rep] | ~fin.group_valid))
+        else:
+            out_cols.append((gk.value[fin.group_rep], gk.null[fin.group_rep] | ~fin.group_valid))
+    local_ovf = ex_overflow | fin.overflow
+    if extra_overflow is not None:
+        local_ovf = local_ovf | extra_overflow
+    overflow = jax.lax.pmax(local_ovf.astype(jnp.int32), REGION_AXIS) > 0
+    flat_out = [a for v, nl in out_cols for a in (v, nl)]
+    return tuple([fin.group_valid] + flat_out + [overflow])
+
+
 def run_sharded_grouped_agg(
     dag: DAGRequest,
     stacked: DeviceBatch,
@@ -173,8 +234,8 @@ def run_sharded_grouped_agg(
     executors = dag.executors
     agg = executors[-1]
     assert isinstance(agg, Aggregation) and agg.group_by, "grouped mesh agg needs GROUP BY"
-    if any(d.distinct for d in agg.aggs):
-        raise NotImplementedError("DISTINCT aggregates are not mesh-decomposable")
+    if any(d.name == "group_concat" for d in agg.aggs):
+        raise NotImplementedError("group_concat on mesh (root-only, oracle-evaluated)")
     input_fts = [c.ft for c in dag.scan().columns]
     n_parts = mesh.devices.size
     bcap = bucket_cap or group_capacity

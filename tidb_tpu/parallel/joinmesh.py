@@ -36,8 +36,14 @@ from .mesh import REGION_AXIS
 
 
 def split_join_dag(dag: DAGRequest):
-    """-> (probe_scan, pre_sels, join, post_sels, agg) or None if the DAG
-    is not the single-shuffle-join shape."""
+    """-> (probe_scan, pre_sels, [(join, post_sels), ...], agg) or None.
+
+    A CHAIN of shuffle joins is eligible (TPC-H Q3's 3-table shape:
+    lineitem ⋈ orders ⋈ customer — each stage re-exchanges the widened
+    schema by the next join key, ref: fragment.go stacking ExchangeSender
+    under each HashJoin). Build sides must be scan [selection]* — a join
+    nested INSIDE a build side still stays off-mesh; the planner
+    right-deepens chains so that shape is the common one."""
     exs = dag.executors
     if not exs or not isinstance(exs[0], TableScan):
         return None
@@ -46,23 +52,22 @@ def split_join_dag(dag: DAGRequest):
     while i < len(exs) and isinstance(exs[i], Selection):
         pre.append(exs[i])
         i += 1
-    if i >= len(exs) or not isinstance(exs[i], Join):
-        return None
-    join = exs[i]
-    i += 1
-    post = []
-    while i < len(exs) and isinstance(exs[i], Selection):
-        post.append(exs[i])
+    stages = []
+    while i < len(exs) and isinstance(exs[i], Join):
+        join = exs[i]
         i += 1
-    if i != len(exs) - 1 or not isinstance(exs[i], Aggregation):
+        post = []
+        while i < len(exs) and isinstance(exs[i], Selection):
+            post.append(exs[i])
+            i += 1
+        if not join.build or not isinstance(join.build[0], TableScan):
+            return None
+        if not all(isinstance(e, Selection) for e in join.build[1:]):
+            return None
+        stages.append((join, post))
+    if not stages or i != len(exs) - 1 or not isinstance(exs[i], Aggregation):
         return None
-    agg = exs[i]
-    # build side: scan [selection]* only (nested joins stay off-mesh)
-    if not join.build or not isinstance(join.build[0], TableScan):
-        return None
-    if not all(isinstance(e, Selection) for e in join.build[1:]):
-        return None
-    return exs[0], pre, join, post, agg
+    return exs[0], pre, stages, exs[i]
 
 
 def _exchange_side(cvals: list[CompVal], valid, part, n_parts: int, bucket_cap: int):
@@ -93,14 +98,16 @@ def _gather_cv(cols: list[CompVal], idx) -> list[CompVal]:
 def run_sharded_join_agg(
     dag: DAGRequest,
     stacked_probe: DeviceBatch,
-    stacked_build: DeviceBatch,
+    stacked_builds: list,
     mesh,
     group_capacity: int = 1024,
     scale: int = 1,
 ):
-    """Execute scan [sel] JOIN(scan [sel]) [sel] GROUP BY over the mesh;
+    """Execute scan [sel] (JOIN(scan [sel]) [sel])+ GROUP BY over the mesh;
     returns (chunk, overflow flag). Output layout matches the single-chip
-    executor: [agg results..., group keys...].
+    executor: [agg results..., group keys...]. Multi-join chains (TPC-H
+    Q3) re-exchange the widened probe schema at every stage by that
+    stage's join key.
 
     Exchange buckets are sized ~2x the per-device fair share (total/n) so
     per-device post-exchange work stays ~1/n of the table — the point of
@@ -109,69 +116,73 @@ def run_sharded_join_agg(
     keys and the join out-capacity for fan-out > 1."""
     parts = split_join_dag(dag)
     assert parts is not None, "not a shuffle-join DAG shape"
-    probe_scan, pre_sels, join, post_sels, agg = parts
-    if any(d.distinct for d in agg.aggs):
-        raise NotImplementedError("DISTINCT aggregates are not mesh-decomposable")
+    probe_scan, pre_sels, stages, agg = parts
+    if not isinstance(stacked_builds, (list, tuple)):
+        stacked_builds = [stacked_builds]
+    assert len(stacked_builds) == len(stages), "one build batch per join stage"
     pfts = [c.ft for c in probe_scan.columns]
-    bfts = [c.ft for c in join.build[0].columns]
-    post_fts = pfts + (
-        [f.clone_nullable() for f in bfts] if join.join_type == "left_outer" else bfts
-    )
     n_parts = mesh.devices.size
 
-    def device_fn(lp: DeviceBatch, lb: DeviceBatch):
+    def device_fn(lp: DeviceBatch, *lbs):
         pcols, pvalid = _flatten_local(lp)
         pc = [normalize_device_column(c) for c in pcols]
         for ex in pre_sels:
             conds = ExprCompiler(pfts).run(list(ex.conditions), pc)
             pvalid = apply_selection(pvalid, conds)
-        bcols, bvalid = _flatten_local(lb)
-        bc = [normalize_device_column(c) for c in bcols]
-        for ex in join.build[1:]:
-            conds = ExprCompiler(bfts).run(list(ex.conditions), bc)
-            bvalid = apply_selection(bvalid, conds)
-
         # drop raw string bytes: only packed words cross the exchange
         pc = [CompVal(c.value, c.null, c.ft) for c in pc]
-        bc = [CompVal(c.value, c.null, c.ft) for c in bc]
+        schema = list(pfts)
+        valid = pvalid
+        cols = pc
+        extra = jnp.bool_(False)
 
-        # hash-partition both sides by join key (ExchangeSender Hash mode)
-        pkeys = ExprCompiler(pfts).run(list(join.probe_keys), pc)
-        bkeys = ExprCompiler(bfts).run(list(join.build_keys), bc)
-        pcap = max(64, 2 * scale * pvalid.shape[0] // n_parts)
-        bcap_ = max(64, 2 * scale * bvalid.shape[0] // n_parts)
-        pp = hash_partition_ids(pkeys, n_parts)
-        bp = hash_partition_ids(bkeys, n_parts)
-        pc2, pvalid2, povf = _exchange_side(pc, pvalid, pp, n_parts, pcap)
-        bc2, bvalid2, bovf = _exchange_side(bc, bvalid, bp, n_parts, bcap_)
+        for (join, post_sels), lb in zip(stages, lbs):
+            bfts = [c.ft for c in join.build[0].columns]
+            bcols, bvalid = _flatten_local(lb)
+            bc = [normalize_device_column(c) for c in bcols]
+            for ex in join.build[1:]:
+                conds = ExprCompiler(bfts).run(list(ex.conditions), bc)
+                bvalid = apply_selection(bvalid, conds)
+            bc = [CompVal(c.value, c.null, c.ft) for c in bc]
 
-        # local join on the owned partition (ref: joinExec above receivers)
-        pkeys2 = ExprCompiler(pfts).run(list(join.probe_keys), pc2)
-        bkeys2 = ExprCompiler(bfts).run(list(join.build_keys), bc2)
-        res = hash_join(
-            bkeys2, pkeys2, bvalid2, pvalid2,
-            out_capacity=scale * pvalid2.shape[0],
-            join_type=join.join_type,
-            build_unique=join.build_unique,
-        )
-        j_ovf = res.overflow
-        if join.join_type in ("semi", "anti"):
-            cols = pc2
-            valid = res.out_valid
-            schema = pfts
-        else:
-            nb = bvalid2.shape[0]
-            p_g = pc2 if res.probe_identity else _gather_cv(pc2, res.probe_idx)
-            b_g = _gather_cv(bc2, jnp.clip(res.build_idx, 0, nb - 1))
-            b_g = [CompVal(c.value, c.null | res.build_null, c.ft) for c in b_g]
-            cols = p_g + b_g
-            valid = res.out_valid
-            schema = post_fts
-        for ex in post_sels:
-            conds = ExprCompiler(schema).run(list(ex.conditions), cols)
-            valid = apply_selection(valid, conds)
+            # hash-partition both sides by THIS stage's join key
+            pkeys = ExprCompiler(schema).run(list(join.probe_keys), cols)
+            bkeys = ExprCompiler(bfts).run(list(join.build_keys), bc)
+            pcap = max(64, 2 * scale * valid.shape[0] // n_parts)
+            bcap_ = max(64, 2 * scale * bvalid.shape[0] // n_parts)
+            pp = hash_partition_ids(pkeys, n_parts)
+            bp = hash_partition_ids(bkeys, n_parts)
+            pc2, pvalid2, povf = _exchange_side(cols, valid, pp, n_parts, pcap)
+            bc2, bvalid2, bovf = _exchange_side(bc, bvalid, bp, n_parts, bcap_)
 
-        extra = povf | bovf | j_ovf
+            # local join on the owned partition (ref: joinExec above receivers)
+            pkeys2 = ExprCompiler(schema).run(list(join.probe_keys), pc2)
+            bkeys2 = ExprCompiler(bfts).run(list(join.build_keys), bc2)
+            res = hash_join(
+                bkeys2, pkeys2, bvalid2, pvalid2,
+                out_capacity=scale * pvalid2.shape[0],
+                join_type=join.join_type,
+                build_unique=join.build_unique,
+            )
+            extra = extra | povf | bovf | res.overflow
+            if join.join_type in ("semi", "anti"):
+                cols = pc2
+                valid = res.out_valid
+            else:
+                nb = bvalid2.shape[0]
+                p_g = pc2 if res.probe_identity else _gather_cv(pc2, res.probe_idx)
+                b_g = _gather_cv(bc2, jnp.clip(res.build_idx, 0, nb - 1))
+                b_g = [CompVal(c.value, c.null | res.build_null, c.ft) for c in b_g]
+                cols = p_g + b_g
+                valid = res.out_valid
+                schema = schema + (
+                    [f.clone_nullable() for f in bfts]
+                    if join.join_type == "left_outer" else bfts
+                )
+            for ex in post_sels:
+                conds = ExprCompiler(schema).run(list(ex.conditions), cols)
+                valid = apply_selection(valid, conds)
+
         return agg_exchange_phases(
             agg, schema, cols, valid, n_parts, group_capacity,
             group_capacity, extra_overflow=extra,
@@ -181,11 +192,11 @@ def run_sharded_join_agg(
     from jax.sharding import PartitionSpec as P
 
     spec_p = jax.tree.map(lambda _: P(REGION_AXIS), stacked_probe)
-    spec_b = jax.tree.map(lambda _: P(REGION_AXIS), stacked_build)
+    spec_bs = tuple(jax.tree.map(lambda _: P(REGION_AXIS), sb) for sb in stacked_builds)
     n_out_cols = len(agg.aggs) + len(agg.group_by)
     out_spec = [P(REGION_AXIS)] * (1 + 2 * n_out_cols) + [P()]
-    fn = shard_map(device_fn, mesh=mesh, in_specs=(spec_p, spec_b), out_specs=tuple(out_spec), check_vma=False)
-    outs = jax.jit(fn)(stacked_probe, stacked_build)
+    fn = shard_map(device_fn, mesh=mesh, in_specs=(spec_p, *spec_bs), out_specs=tuple(out_spec), check_vma=False)
+    outs = jax.jit(fn)(stacked_probe, *stacked_builds)
 
     import numpy as np
 

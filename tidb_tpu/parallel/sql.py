@@ -40,7 +40,9 @@ def _chunks_exchange_safe(chunks) -> bool:
 def _agg_mesh_ok(agg) -> bool:
     if not isinstance(agg, Aggregation) or not agg.group_by or agg.merge:
         return False
-    return not any(d.distinct or d.name == "group_concat" for d in agg.aggs)
+    # DISTINCT rides the raw-row exchange (grouped.py
+    # _distinct_exchange_phases); group_concat stays root-only
+    return not any(d.name == "group_concat" for d in agg.aggs)
 
 
 def mesh_eligible(dag: DAGRequest) -> str | None:
@@ -74,9 +76,11 @@ def mesh_eligible(dag: DAGRequest) -> str | None:
     parts = split_join_dag(dag)
     if parts is None:
         return None
-    _, pre, join, post, _ = parts
-    exprs = [c for e in pre + post + list(join.build[1:]) for c in e.conditions]
-    exprs += list(join.probe_keys) + list(join.build_keys) + agg_exprs
+    _, pre, stages, _ = parts
+    exprs = [c for e in pre for c in e.conditions] + agg_exprs
+    for join, post in stages:
+        exprs += [c for e in list(join.build[1:]) + post for c in e.conditions]
+        exprs += list(join.probe_keys) + list(join.build_keys)
     if host_only_exprs(exprs):
         return None
     return "join"
@@ -130,21 +134,27 @@ def try_mesh_select(
     stacked = stack_region_batches(chunks, n_total=n_total)
     mesh = region_mesh(n)
 
-    stacked_build = None
+    stacked_builds = None
     if kind == "join":
-        build = aux_chunks[0]
-        if not _chunks_exchange_safe([build]):
+        from .joinmesh import split_join_dag
+
+        n_stages = len(split_join_dag(dag)[2])
+        if len(aux_chunks) < n_stages:
             return None
-        if build.num_rows() == 0:
-            bslices = [build]
-        else:
-            step = (build.num_rows() + n - 1) // n
-            bslices = [
-                build.slice(i * step, min((i + 1) * step, build.num_rows()))
-                for i in range(n)
-                if i * step < build.num_rows()
-            ]
-        stacked_build = stack_region_batches(bslices, n_total=n)
+        stacked_builds = []
+        for build in aux_chunks[:n_stages]:
+            if not _chunks_exchange_safe([build]):
+                return None
+            if build.num_rows() == 0:
+                bslices = [build]
+            else:
+                step = (build.num_rows() + n - 1) // n
+                bslices = [
+                    build.slice(i * step, min((i + 1) * step, build.num_rows()))
+                    for i in range(n)
+                    if i * step < build.num_rows()
+                ]
+            stacked_builds.append(stack_region_batches(bslices, n_total=n))
 
     # overflow (too many groups / join fan-out / hash collision): retry
     # with 4x capacity — the capacity also salts the hash, mirroring
@@ -157,7 +167,7 @@ def try_mesh_select(
                 from .joinmesh import run_sharded_join_agg
 
                 chunk, overflow = run_sharded_join_agg(
-                    dag, stacked, stacked_build, mesh, group_capacity=gc, scale=scale
+                    dag, stacked, stacked_builds, mesh, group_capacity=gc, scale=scale
                 )
             else:
                 chunk, overflow = run_sharded_grouped_agg(dag, stacked, mesh, group_capacity=gc)

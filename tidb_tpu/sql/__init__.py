@@ -14,3 +14,4 @@ __all__ = [
     "Session",
     "SQLError",
 ]
+from . import builtins_host  # noqa: E402,F401 — registers the host builtin batch

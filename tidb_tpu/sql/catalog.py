@@ -80,6 +80,13 @@ def field_type_from_spec(ts: A.TypeSpec, not_null: bool = False) -> FieldType:
                 "blob", "tinyblob", "mediumblob", "longblob"):
         flen = ts.length if ts.length > 0 else 255
         ft = new_varchar(flen)
+        # byte-semantics functions (LENGTH/HEX/ASCII) consult the declared
+        # charset (ref: types.FieldType.GetCharset feeding builtin_string);
+        # binary types carry "binary" + the BINARY(n) zero-pad width
+        if name in ("binary", "varbinary", "blob", "tinyblob", "mediumblob", "longblob"):
+            ft.charset = "binary"
+        elif ts.charset:
+            ft.charset = ts.charset.lower()
         if not_null:
             ft = FieldType(ft.tp, ft.flag | Flag.NotNull, ft.flen, ft.decimal, ft.charset, ft.collate)
         return ft
@@ -293,6 +300,7 @@ class Catalog:
         self._next_id = 1001
         self._lock = threading.Lock()
         self.version = 0  # schema version (ref: domain schema lease)
+        self.databases: set[str] = {"test", "mysql"}  # CREATE/DROP DATABASE
         self.stats: dict[int, object] = {}  # table_id -> TableStats (ANALYZE)
         self.views: dict[str, ViewMeta] = {}  # name -> view definition
         from .privilege import PrivilegeStore

@@ -193,6 +193,7 @@ def persist_catalog(store, catalog: Catalog) -> None:
     state = {
         "version": catalog.version,
         "next_id": catalog._next_id,
+        "databases": sorted(catalog.databases),
         "views": {
             v.name: {"columns": v.columns, "select": v.select_sql}
             for v in catalog.views.values()
@@ -247,4 +248,5 @@ def load_catalog(store) -> Catalog | None:
 
     for vn, vd in state.get("views", {}).items():
         cat.views[vn] = ViewMeta(vn, vd["columns"], vd["select"])
+    cat.databases |= set(state.get("databases", []))
     return cat

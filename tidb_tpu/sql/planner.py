@@ -198,6 +198,49 @@ _FUNC_FTS = {
 _FUNC_RENAME = {"ceiling": "ceil", "power": "pow", "dayofmonth": "day", "substring": "substr", "log": "ln"}
 
 
+def _expand_row_cmp(n: A.BinaryOp) -> A.ExprNode:
+    """Row-value comparison -> component expansion with SQL's own
+    three-valued AND/OR semantics (ref: expression_rewriter.go
+    constructBinaryOpFunction row decomposition):
+      (a,b) =  (c,d)  ->  a=c AND b=d
+      (a,b) <> (c,d)  ->  a<>c OR b<>d
+      (a,b) <  (c,d)  ->  a<c OR (a=c AND b<d)     (lexicographic)
+    """
+    lt = n.left.items if isinstance(n.left, A.RowExpr) else [n.left]
+    rt = n.right.items if isinstance(n.right, A.RowExpr) else [n.right]
+    if len(lt) != len(rt):
+        raise PlanError(f"Operand should contain {len(lt)} column(s)")
+    import copy as _c
+
+    def conj(op):
+        out = None
+        for a, b in zip(lt, rt):
+            e = A.BinaryOp(op, _c.deepcopy(a), _c.deepcopy(b))
+            out = e if out is None else A.BinaryOp("and", out, e)
+        return out
+
+    if n.op in ("eq", "nulleq"):
+        return conj(n.op)
+    if n.op == "ne":
+        out = None
+        for a, b in zip(lt, rt):
+            e = A.BinaryOp("ne", _c.deepcopy(a), _c.deepcopy(b))
+            out = e if out is None else A.BinaryOp("or", out, e)
+        return out
+    if n.op in ("lt", "le", "gt", "ge"):
+        strict = {"lt": "lt", "le": "lt", "gt": "gt", "ge": "gt"}[n.op]
+        out = None
+        for i in range(len(lt)):
+            last = i == len(lt) - 1
+            op_i = n.op if last else strict
+            e = A.BinaryOp(op_i, _c.deepcopy(lt[i]), _c.deepcopy(rt[i]))
+            for j in range(i):
+                e = A.BinaryOp("and", A.BinaryOp("eq", _c.deepcopy(lt[j]), _c.deepcopy(rt[j])), e)
+            out = e if out is None else A.BinaryOp("or", out, e)
+        return out
+    raise PlanError(f"row-value comparison {n.op!r} not supported")
+
+
 class _Lowerer:
     """AST expression -> ir.Expr against a base scope, optionally through an
     aggregation output schema (agg scope)."""
@@ -299,6 +342,8 @@ class _Lowerer:
                 )
             return slot
         if isinstance(n, A.BinaryOp):
+            if isinstance(n.left, A.RowExpr) or isinstance(n.right, A.RowExpr):
+                return rec(_expand_row_cmp(n))
             l, r = rec(n.left), rec(n.right)
             return self._binary(n.op, l, r)
         if isinstance(n, A.UnaryOp):
@@ -742,7 +787,9 @@ def _flatten_from(node, catalog: Catalog, mat: dict | None = None) -> list:
     JOIN ... USING(cols) desugars to ON equality conjuncts."""
     if isinstance(node, A.TableName):
         meta = _resolve_table(node.name, catalog, mat, getattr(node, "db", ""))
-        return [(meta, (node.alias or node.name).lower(), "inner", None)]
+        # an unaliased multi-db table is qualified by its SHORT name
+        # (MySQL: the db prefix is not part of the column qualifier)
+        return [(meta, (node.alias or node.name.rsplit(".", 1)[-1]).lower(), "inner", None)]
     if isinstance(node, A.Join):
         left = _flatten_from(node.left, catalog, mat)
         right = _flatten_from(node.right, catalog, mat)

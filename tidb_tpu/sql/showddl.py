@@ -95,7 +95,8 @@ def _default_sql(cm) -> str:
 
 
 def show_create_table(meta) -> str:
-    lines = [f"CREATE TABLE `{meta.name}` ("]
+    short = meta.name.rsplit(".", 1)[-1]  # strip any database prefix
+    lines = [f"CREATE TABLE `{short}` ("]
     body = []
     from ..types import Flag
 

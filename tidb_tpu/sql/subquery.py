@@ -156,7 +156,7 @@ class SubqueryRewriter:
             return []
         if isinstance(node, A.TableName):
             cols = self._table_cols(node.name) or []
-            return [((node.alias or node.name).lower(), cols)]
+            return [((node.alias or node.name.rsplit(".", 1)[-1]).lower(), cols)]
         if isinstance(node, A.SubqueryTable):
             sel = node.subquery
             labels = []
@@ -384,6 +384,13 @@ class SubqueryRewriter:
         from ..parser import parse_one
 
         sel = parse_one(vm.select_sql)
+        # the stored SELECT resolves against the view's DEFINING database
+        # (derived from the catalog key prefix), not the session's current
+        # one (ref: ViewInfo security/definer db in buildDataSource)
+        from .session import qualify_tables_ast
+
+        vdb = vm.name.rsplit(".", 1)[0] if "." in vm.name else "test"
+        qualify_tables_ast(sel, vdb)
         if vm.columns:
             if not isinstance(sel, A.SelectStmt):
                 raise SubqueryError("view column list over a UNION body is not supported yet")

@@ -80,6 +80,8 @@ def _datum_from_dict(d: dict | None):
 def _schema_dict(catalog) -> list:
     out = []
     for name in catalog.tables():
+        if name.startswith("mysql."):
+            continue  # system schema excluded, like BR's default filter
         m = catalog.table(name)
         out.append({
             "name": m.name,

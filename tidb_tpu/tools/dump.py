@@ -90,5 +90,7 @@ def dump_all(session, out_dir: str, fmt: str = "csv") -> dict:
     ts = session.store.next_ts()
     out = {}
     for name in session.catalog.tables():
+        if name.startswith("mysql."):
+            continue  # system schema excluded (Dumpling's default filter)
         out[name] = dump_table(session, name, out_dir, fmt, snapshot_ts=ts)
     return out

@@ -181,8 +181,9 @@ def run_file(name: str, test_dir: str = TEST_DIR, result_dir: str = RESULT_DIR):
     # oracle path: semantics-parity run, no per-shape XLA compiles
     s.sysvars.set("tidb_enable_tpu_coprocessor", "OFF")
     # the reference harness runs each file in a database named after it
-    # (run-tests.sh creates DATABASE `$file`); SHOW output embeds the name
-    s.db = name
+    # (run-tests.sh creates DATABASE `$file` and connects to it)
+    s.execute(f"create database if not exists `{name}`")
+    s.execute(f"use `{name}`")
 
     counts = {"match": 0, "mismatch": 0, "explain_diff": 0, "error_ok": 0,
               "unsupported": 0, "exec_error": 0, "desync": 0}
