@@ -1,0 +1,69 @@
+"""Runaway-query control (VERDICT r4 next #10): max_execution_time checked
+at every coprocessor dispatch boundary (the BeforeCopRequest hook point,
+ref: pkg/resourcegroup/runaway/checker.go:27), KILL QUERY via the same
+checker."""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.distsql.runaway import QueryKilledError, RunawayChecker
+from tidb_tpu.sql import Session, SQLError
+from tidb_tpu.util import failpoint
+
+
+def _multi_region_session(rows=400, regions=12):
+    from tidb_tpu.codec import tablecodec
+
+    s = Session()
+    s.execute("create table big (id bigint primary key, v bigint)")
+    s.execute("insert into big values " + ",".join(f"({i}, {i})" for i in range(rows)))
+    meta = s.catalog.table("big")
+    for r in range(1, regions):
+        s.store.cluster.split(tablecodec.encode_row_key(meta.table_id, r * rows // regions))
+    return s
+
+
+def test_checker_deadline_fake_clock():
+    now = [0.0]
+    c = RunawayChecker(50, now_fn=lambda: now[0])
+    c.before_cop_request()  # within budget
+    now[0] = 0.051
+    with pytest.raises(QueryKilledError, match="maximum statement execution time"):
+        c.before_cop_request()
+
+
+def test_max_execution_time_kills_slow_scan():
+    s = _multi_region_session()
+    s.execute("set max_execution_time = 30")
+    # each region task sleeps past the budget: the second dispatch
+    # boundary must abort the statement
+    with failpoint.enabled("distsql.before_task", lambda: time.sleep(0.04)):
+        with pytest.raises(SQLError, match="maximum statement execution time"):
+            s.execute("select sum(v) from big")
+    # budget back to unlimited: the same query runs
+    s.execute("set max_execution_time = 0")
+    assert s.execute("select count(*) from big").values() == [[400]]
+
+
+def test_kill_query_aborts():
+    s = _multi_region_session()
+    errs = []
+
+    def stall():
+        time.sleep(0.05)
+
+    def run():
+        try:
+            with failpoint.enabled("distsql.before_task", stall):
+                s.execute("select sum(v) from big")
+        except SQLError as exc:
+            errs.append(str(exc))
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.02)
+    s.kill_query()
+    t.join(timeout=10)
+    assert errs and "interrupted" in errs[0]

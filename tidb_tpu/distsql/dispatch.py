@@ -59,6 +59,8 @@ class KVRequest:
     # worker's batch (ref: copr/batch_coprocessor.go — all regions of a
     # TiFlash store travel in one request)
     small_groups: int | None = None  # planner NDV hint -> dense agg kernel
+    checker: object = None  # RunawayChecker — before_cop_request() raises
+    # past the deadline / after KILL (ref: resourcegroup checker.go:27)
 
 
 @dataclass
@@ -119,6 +121,8 @@ def _run_one_task(store, req, i, task, out_chunks, summaries, retries=MAX_RETRY)
     while True:
         from ..util import metrics
 
+        if req.checker is not None:
+            req.checker.before_cop_request()
         metrics.DISTSQL_TASKS.inc()
         creq = CopRequest(
             req.dag, ranges, req.start_ts, task.region_id, task.epoch,
@@ -156,6 +160,11 @@ def select(store: TPUStore, req: KVRequest) -> SelectResult:
         out_chunks: list = []
         ranges = task.ranges
         while True:
+            if req.checker is not None:
+                req.checker.before_cop_request()
+            from ..util import failpoint as _fp
+
+            _fp.eval("distsql.before_task")
             metrics.DISTSQL_TASKS.inc()
             creq = CopRequest(
                 req.dag, ranges, req.start_ts, task.region_id, task.epoch,

@@ -173,6 +173,7 @@ def execute_root(
     tracker=None,
     low_memory: bool = False,
     small_groups: int | None = None,
+    checker=None,
 ) -> Chunk:
     """Run a logical (Complete-mode) DAG over the store: split, dispatch the
     pushdown half per region, merge at root. The caller-visible result is
@@ -201,7 +202,7 @@ def execute_root(
         KVRequest(
             plan.push_dag, ranges, start_ts, concurrency=concurrency,
             aux_chunks=aux_chunks or [], paging_size=paging_size,
-            batch_cop=batch_cop, small_groups=small_groups,
+            batch_cop=batch_cop, small_groups=small_groups, checker=checker,
         ),
     )
     if summary_sink is not None:

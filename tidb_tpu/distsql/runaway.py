@@ -1,0 +1,41 @@
+"""Runaway-query control (ref: pkg/resourcegroup/runaway/checker.go — the
+RunawayChecker whose BeforeCopRequest hook the coprocessor client calls
+before every request, checker.go:27; TiDB's own MAX_EXECUTION_TIME
+enforcement rides the same mechanism).
+
+A checker is created per statement from `max_execution_time` (ms, 0 =
+unlimited) plus an explicit kill flag (KILL QUERY). The dispatch loop asks
+it before every coprocessor task AND every paging round, so a scan that
+fans out over many regions dies at the first boundary past the deadline —
+the same granularity the reference gets from its per-request hook."""
+
+from __future__ import annotations
+
+import time
+
+
+class QueryKilledError(Exception):
+    """Surfaced as MySQL error 3024 (ER_QUERY_TIMEOUT) or 1317
+    (ER_QUERY_INTERRUPTED) by the session."""
+
+
+class RunawayChecker:
+    def __init__(self, max_execution_ms: int = 0, now_fn=time.monotonic):
+        self._now = now_fn
+        self._deadline = (
+            self._now() + max_execution_ms / 1000.0 if max_execution_ms > 0 else None
+        )
+        self._killed = False
+
+    def kill(self):
+        """KILL QUERY: the next dispatch boundary aborts the statement."""
+        self._killed = True
+
+    def before_cop_request(self):
+        """The BeforeCopRequest hook: raise when over budget or killed."""
+        if self._killed:
+            raise QueryKilledError("Query execution was interrupted")
+        if self._deadline is not None and self._now() > self._deadline:
+            raise QueryKilledError(
+                "Query execution was interrupted, maximum statement execution time exceeded"
+            )

@@ -288,7 +288,7 @@ class CTE:
 
 
 @dataclass
-class SelectStmt:
+class SelectStmt:  # noqa: PLR0902
     fields: list  # [SelectField|Star]
     from_clause: object = None  # TableName | SubqueryTable | Join | None
     where: Optional[ExprNode] = None
@@ -299,6 +299,7 @@ class SelectStmt:
     distinct: bool = False
     for_update: bool = False
     ctes: list = field(default_factory=list)  # [CTE]
+    hints: list = field(default_factory=list)  # [(name, [args])] from /*+ */
 
 
 @dataclass
@@ -395,6 +396,8 @@ class ForeignKeyDef:
     columns: list
     ref_table: TableName
     ref_columns: list
+    on_delete: str = "restrict"  # restrict | cascade | set_null | no_action
+    on_update: str = "restrict"
 
 
 @dataclass
@@ -690,6 +693,8 @@ class BindingStmt:
     scope: str  # global | session
     target: object  # bound statement AST
     hinted: object = None  # USING statement AST (create only)
+    target_sql: str = ""  # display text (SHOW BINDINGS)
+    hinted_sql: str = ""
 
 
 @dataclass

@@ -23,6 +23,7 @@ class T(enum.Enum):
     HEX = "hex"
     PARAM = "param"  # ?
     OP = "op"
+    HINT = "hint"  # /*+ ... */ optimizer hint body (ref: parser hintparser)
     EOF = "eof"
 
 
@@ -64,6 +65,17 @@ def tokenize(sql: str) -> list[Token]:
             j = sql.find("*/", i + 2)
             if j < 0:
                 raise LexError(f"unterminated comment at {i}")
+            # optimizer hint /*+ ... */ — one token carrying the body
+            # (ref: pkg/parser hint comments -> hintparser)
+            if sql[i + 2 : i + 3] == "+":
+                # only a hint right after SELECT reaches the parser; in
+                # every other position it degrades to a comment (matching
+                # the pre-hint behavior for UPDATE/INSERT/DELETE, whose
+                # grammars do not consume hint tokens yet)
+                if toks and toks[-1].kind is T.IDENT and toks[-1].upper == "SELECT":
+                    toks.append(Token(T.HINT, sql[i + 3 : j].strip(), i))
+                i = j + 2
+                continue
             # executable comment /*! ... */ — strip markers, lex body
             if sql[i + 2 : i + 3] == "!":
                 body = sql[i + 3 : j]

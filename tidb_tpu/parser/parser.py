@@ -76,6 +76,21 @@ def parse_expr(text: str) -> A.ExprNode:
     return stmt.fields[0].expr
 
 
+def _parse_hints(text: str) -> list:
+    """/*+ NAME(args), NAME2() */ body -> [(name_lower, [arg strings])]
+    (ref: pkg/util/hint hintparser — the subset the planner consumes;
+    unknown hints pass through and are ignored there)."""
+    import re as _re
+
+    out = []
+    for m in _re.finditer(r"([A-Za-z_][A-Za-z0-9_]*)\s*(?:\(([^()]*)\))?", text):
+        name = m.group(1).lower()
+        raw = (m.group(2) or "").strip()
+        args = [a.strip().strip("`'\"") for a in _re.split(r"[,\s]+", raw) if a.strip()] if raw else []
+        out.append((name, args))
+    return out
+
+
 class Parser:
     def __init__(self, sql: str):
         self._named_window_refs: list = []
@@ -401,6 +416,9 @@ class Parser:
             self.expect_op(")")
             return s
         self.expect_kw("SELECT")
+        hints = []
+        if self.peek().kind is T.HINT:
+            hints = _parse_hints(self.next().text)
         distinct = False
         while True:
             if self.eat_kw("DISTINCT", "DISTINCTROW"):
@@ -467,7 +485,7 @@ class Parser:
             self.expect_kw("IN")
             self.expect_kw("SHARE")
             self.expect_kw("MODE")
-        return A.SelectStmt(fields, frm, where, group_by, having, order_by, limit, distinct, for_update)
+        return A.SelectStmt(fields, frm, where, group_by, having, order_by, limit, distinct, for_update, hints=hints)
 
     def select_field(self):
         if self.at_op("*"):
@@ -1471,16 +1489,30 @@ class Parser:
             scope = self.next().upper.lower()
             self.next()
             self.expect_kw("FOR")
+            t0 = self.peek().pos
             target = self.statement()
+            t1 = self.peek().pos
             self.expect_kw("USING")
+            h0 = self.peek().pos
             hinted = self.statement()
-            return A.BindingStmt("create", scope, target, hinted)
+            h1 = self.peek().pos if self.peek().kind is not T.EOF else len(self.sql)
+            st = A.BindingStmt("create", scope, target, hinted)
+            st.target_sql = self.sql[t0:t1].strip().rstrip(";")
+            st.hinted_sql = self.sql[h0:h1].strip().rstrip(";")
+            return st
         if self.eat_kw("BINDING"):
             self.expect_kw("FOR")
+            t0 = self.peek().pos
             target = self.statement()
+            t1 = self.peek().pos
             self.expect_kw("USING")
+            h0 = self.peek().pos
             hinted = self.statement()
-            return A.BindingStmt("create", "session", target, hinted)
+            h1 = self.peek().pos if self.peek().kind is not T.EOF else len(self.sql)
+            st = A.BindingStmt("create", "session", target, hinted)
+            st.target_sql = self.sql[t0:t1].strip().rstrip(";")
+            st.hinted_sql = self.sql[h0:h1].strip().rstrip(";")
+            return st
         self.eat_kw("GLOBAL")  # global temporary table
         self.eat_kw("TEMPORARY")
         if self.eat_kw("ROLE"):
@@ -1620,10 +1652,24 @@ class Parser:
                     self.expect_kw("REFERENCES")
                     rt = self.table_name()
                     rcols = self._index_cols()
+                    on_delete = on_update = "restrict"
                     while self.eat_kw("ON"):
-                        self.eat_kw("DELETE") or self.eat_kw("UPDATE")
-                        self.eat_kw("CASCADE") or self.eat_kw("RESTRICT") or (self.eat_kw("SET") and self.eat_kw("NULL")) or (self.eat_kw("NO") and self.eat_kw("ACTION"))
-                    fks.append(A.ForeignKeyDef(fk_name, [c for c, _ in cols], rt, [c for c, _ in rcols]))
+                        which = "delete" if self.eat_kw("DELETE") else ("update" if self.eat_kw("UPDATE") else "")
+                        if self.eat_kw("CASCADE"):
+                            act = "cascade"
+                        elif self.eat_kw("RESTRICT"):
+                            act = "restrict"
+                        elif self.eat_kw("SET") and self.eat_kw("NULL"):
+                            act = "set_null"
+                        elif self.eat_kw("NO") and self.eat_kw("ACTION"):
+                            act = "no_action"
+                        else:
+                            act = "restrict"
+                        if which == "delete":
+                            on_delete = act
+                        elif which == "update":
+                            on_update = act
+                    fks.append(A.ForeignKeyDef(fk_name, [c for c, _ in cols], rt, [c for c, _ in rcols], on_delete, on_update))
                 elif self.eat_kw("UNIQUE"):
                     self.eat_kw("KEY") or self.eat_kw("INDEX")
                     name = fk_name
@@ -1986,6 +2032,13 @@ class Parser:
                 self.next()
                 self.eat_op(",")
             return A.SetStmt([])
+        if self.at_kw("GLOBAL", "SESSION") and self.peek(1).upper == "BINDING":
+            scope = self.next().upper.lower()
+            self.next()
+            self.expect_kw("FOR")
+            target = self.statement()
+            hinted = self.statement() if self.eat_kw("USING") else None
+            return A.BindingStmt("drop", scope, target, hinted)
         self.eat_kw("GLOBAL")
         self.eat_kw("TEMPORARY")
         if self.eat_kw("SEQUENCE"):
@@ -1997,13 +2050,6 @@ class Parser:
             while self.eat_op(","):
                 names.append(self.table_name())
             return A.DropSequenceStmt(names, ie)
-        if self.at_kw("GLOBAL", "SESSION") and self.peek(1).upper == "BINDING":
-            scope = self.next().upper.lower()
-            self.next()
-            self.expect_kw("FOR")
-            target = self.statement()
-            hinted = self.statement() if self.eat_kw("USING") else None
-            return A.BindingStmt("drop", scope, target, hinted)
         if self.eat_kw("BINDING"):
             self.expect_kw("FOR")
             target = self.statement()
@@ -2419,6 +2465,8 @@ class Parser:
                 self.user_spec()
                 if self.eat_kw("USING"):
                     self.user_spec()
+        elif self.eat_kw("BINDINGS"):
+            s.kind = "bindings"
         elif self.eat_kw("VARIABLES"):
             s.kind = "variables"
         elif self.eat_kw("STATUS"):

@@ -24,6 +24,18 @@ class CatalogError(ValueError):
     pass
 
 
+@dataclass
+class FKMeta:
+    """(ref: pkg/meta/model FKInfo)."""
+
+    name: str
+    cols: list  # child column names
+    ref_table: str  # catalog key of the parent
+    ref_cols: list
+    on_delete: str = "restrict"
+    on_update: str = "restrict"
+
+
 def decl_text(ts: A.TypeSpec) -> str:
     """Declared type spelling for SHOW CREATE TABLE (ref: the reference
     round-trips meta/model FieldType through types.StrFor SHOW; here the
@@ -145,6 +157,8 @@ class TableMeta:
     next_col_id: int = 0  # max-ever col id + 1: DROP COLUMN must never free
     # its id for reuse (old rows still hold bytes under it)
     partition: "PartitionInfo | None" = None  # RANGE/HASH partitioning
+    foreign_keys: list = field(default_factory=list)  # [FKMeta] (ref:
+    # meta/model FKInfo; checked at DML by executor/foreign_key.go analog)
 
     def __post_init__(self):
         if self.next_col_id <= 0:
@@ -301,6 +315,7 @@ class Catalog:
         self._lock = threading.Lock()
         self.version = 0  # schema version (ref: domain schema lease)
         self.databases: set[str] = {"test", "mysql"}  # CREATE/DROP DATABASE
+        self.bindings: dict = {}  # GLOBAL plan bindings: digest -> record
         self.stats: dict[int, object] = {}  # table_id -> TableStats (ANALYZE)
         self.views: dict[str, ViewMeta] = {}  # name -> view definition
         from .privilege import PrivilegeStore
@@ -381,7 +396,16 @@ class Catalog:
             pdict = (stmt.options or {}).get("partition_by")
             if pdict is not None:
                 part = self._build_partition(pdict, cols, handle_col, indices)
-            tbl = TableMeta(name, self._alloc_id(), cols, indices, handle_col, partition=part)
+            fks = []
+            for j, fk in enumerate(getattr(stmt, "foreign_keys", []) or []):
+                fks.append(FKMeta(
+                    fk.name or f"fk_{j + 1}",
+                    [c.lower() for c in fk.columns],
+                    fk.ref_table.name.lower(),
+                    [c.lower() for c in fk.ref_columns],
+                    fk.on_delete, fk.on_update,
+                ))
+            tbl = TableMeta(name, self._alloc_id(), cols, indices, handle_col, partition=part, foreign_keys=fks)
             self._tables[name] = tbl
             self.version += 1
             return tbl

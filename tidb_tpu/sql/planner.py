@@ -51,6 +51,10 @@ class PlannedQuery:
     # session runs the double-read (index scan -> handles -> table read,
     # ref: pkg/executor/distsql.go IndexLookUpExecutor)
     lookup: tuple | None = None
+    # index merge (union): [(index_id, index key ranges), ...] — handles
+    # from every member index union before the table read (ref:
+    # pkg/executor/index_merge_reader.go IndexMergeReaderExecutor)
+    lookup_merge: list | None = None
     # statistics-driven few-groups hint: NDV product of the group-by
     # columns when ANALYZE stats promise a small group count — routes the
     # aggregation onto the sort-free dense kernel (ops/aggregate.py);
@@ -1106,12 +1110,78 @@ def estimate_table_rows(meta: TableMeta, conjuncts: list, catalog: Catalog) -> f
     return base * sel
 
 
-def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -> PlannedQuery:
+class _HintSet:
+    """Parsed /*+ ... */ hints the planner consumes (ref: pkg/util/hint
+    TableHintInfo): USE_INDEX / FORCE_INDEX / IGNORE_INDEX,
+    HASH_JOIN_PROBE / HASH_JOIN_BUILD. Unknown hints are ignored, like the
+    reference's warning-only handling."""
+
+    def __init__(self, raw):
+        self.use_index: dict = {}
+        self.ignore_index: dict = {}
+        self._probe: list = []
+        self._build: list = []
+        self.use_index_merge = False
+        self.no_index_merge = False
+        for name, args in raw or []:
+            if name in ("use_index", "force_index") and args:
+                self.use_index.setdefault(args[0].lower(), set()).update(a.lower() for a in args[1:])
+            elif name == "ignore_index" and args:
+                self.ignore_index.setdefault(args[0].lower(), set()).update(a.lower() for a in args[1:])
+            elif name in ("hash_join_probe", "hash_join") and args:
+                self._probe.append(args[0].lower())
+            elif name == "hash_join_build" and args:
+                self._build.append(args[0].lower())
+            elif name == "use_index_merge":
+                self.use_index_merge = True
+            elif name == "no_index_merge":
+                self.no_index_merge = True
+
+    def index_allowed(self, alias: str, idx_name: str) -> bool:
+        if idx_name.lower() in self.ignore_index.get(alias, ()):  # noqa: SIM103
+            return False
+        use = self.use_index.get(alias)
+        if use is not None and use and idx_name.lower() not in use:
+            return False
+        return True
+
+    def index_forced(self, alias: str, idx_name: str) -> bool:
+        return idx_name.lower() in self.use_index.get(alias, set())
+
+    def probe_alias(self, aliases):
+        for a in self._probe:
+            if a in aliases:
+                return a
+        return None
+
+    def build_alias(self, aliases):
+        for a in self._build:
+            if a in aliases:
+                return a
+        return None
+
+
+def _split_disjuncts(e):
+    out = []
+
+    def walk(x):
+        if isinstance(x, A.BinaryOp) and x.op == "or":
+            walk(x.left)
+            walk(x.right)
+        else:
+            out.append(x)
+
+    walk(e)
+    return out
+
+
+def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None, enable_index_merge: bool = False) -> PlannedQuery:
     if stmt.from_clause is None:
         raise PlanError("SELECT without FROM is evaluated by the session")
     if stmt.ctes:
         raise PlanError("CTEs are materialized by the session before planning")
     flat = _flatten_from(stmt.from_clause, catalog, mat)
+    hints = _HintSet(getattr(stmt, "hints", []))
 
     # ---- join order: probe = largest table (row-count stat); LEFT JOIN
     # pins the textual order (outer semantics are order-sensitive)
@@ -1141,6 +1211,18 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
             for m_, a_, _, _ in flat
         ]
         probe_i = max(range(len(flat)), key=lambda i: est[i])
+        # /*+ HASH_JOIN_PROBE(t) / HASH_JOIN_BUILD(t) */ override the
+        # cardinality choice (ref: pkg/util/hint HintHJProbe/HintHJBuild
+        # consumed in exhaust_physical_plans)
+        aliases_flat = [a_ for _, a_, _, _ in flat]
+        hp = hints.probe_alias(aliases_flat)
+        if hp is not None:
+            probe_i = aliases_flat.index(hp)
+        else:
+            hb = hints.build_alias(aliases_flat)
+            if hb is not None and len(flat) > 1:
+                others = [i for i in range(len(flat)) if aliases_flat[i] != hb]
+                probe_i = max(others, key=lambda i: est[i])
         flat = [flat[probe_i]] + flat[:probe_i] + flat[probe_i + 1 :]
 
     # ---- scope over the combined schema in placement order
@@ -1209,6 +1291,8 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
         for idx in probe_meta.indices:
             if idx.state != "public":
                 continue  # building indexes are invisible to readers (F1)
+            if not hints.index_allowed(probe_alias, idx.name):
+                continue
             covered = set(idx.col_names) | ({probe_meta.handle_col} if probe_meta.handle_col else set())
             if not referenced <= covered:
                 continue
@@ -1273,10 +1357,15 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
         for idx in probe_meta.indices:
             if idx.state != "public":
                 continue  # building indexes are invisible to readers (F1)
+            if not hints.index_allowed(probe_alias, idx.name):
+                continue
             first = probe_meta.col(idx.col_names[0])
             ivs = intervals_for_column(local[probe_alias], first.name, range_const_of(first.ft))
             if ivs is None:
                 continue
+            if hints.index_forced(probe_alias, idx.name):
+                best = (-1.0, idx, ivs)  # forced: beats any selectivity
+                break
             cs = tstats.columns.get(first.name) if tstats is not None else None
             if cs is not None:
                 sel = est_selectivity(cs, ivs) if ivs else 0.0
@@ -1296,6 +1385,46 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
             _, idx, ivs = best
             lookup = (idx.index_id, index_ranges_from_intervals(probe_meta.table_id, idx.index_id, ivs))
             access_path = f"index_lookup({idx.name})"
+
+    lookup_merge = None
+    if (
+        access_path == "table" and len(trefs) == 1 and probe_meta.indices
+        and (enable_index_merge or hints.use_index_merge) and not hints.no_index_merge
+    ):
+        # index merge (UNION): one top-level OR-disjunction whose every
+        # disjunct range-constrains some index's first column — handles
+        # union before the table read; the retained Selection re-applies
+        # the full predicate, so the union is a safe over-approximation
+        # (ref: planner index-merge path generation + index_merge_reader.go)
+        for c in local[probe_alias]:
+            disj = _split_disjuncts(c)
+            if len(disj) < 2:
+                continue
+            parts = []
+            for d in disj:
+                found = None
+                for idx in probe_meta.indices:
+                    if idx.state != "public":
+                        continue
+                    if not hints.index_allowed(probe_alias, idx.name):
+                        continue
+                    first = probe_meta.col(idx.col_names[0])
+                    ivs = intervals_for_column([d], first.name, range_const_of(first.ft))
+                    if ivs is not None:
+                        found = (idx, ivs)
+                        break
+                if found is None:
+                    parts = None
+                    break
+                parts.append(found)
+            if parts:
+                lookup_merge = [
+                    (i.index_id, index_ranges_from_intervals(probe_meta.table_id, i.index_id, iv))
+                    for i, iv in parts
+                ]
+                names_ = ",".join(i.name for i, _ in parts)
+                access_path = f"index_merge(union:{names_})"
+                break
 
     # ---- probe pipeline
     executors: list = [probe_scan]
@@ -1528,6 +1657,7 @@ def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None) -
         dag, probe_meta, build_tables, names,
         offset=offset_n or 0, ranges=scan_ranges, access_path=access_path,
         lookup=lookup,
+        lookup_merge=lookup_merge,
         small_groups=_ndv_group_hint(dag, trefs, catalog),
     )
 

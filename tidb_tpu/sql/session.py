@@ -130,6 +130,8 @@ def qualify_tables_ast(stmt, cur_db: str) -> None:
             if db in virtual:
                 return
             nm = n.name.lower()
+            if "." in nm:
+                return  # already a qualified catalog key (idempotent)
             if db and db != "test":
                 n.name = f"{db}.{nm}"
                 n.db = ""
@@ -142,6 +144,43 @@ def qualify_tables_ast(stmt, cur_db: str) -> None:
             walk(getattr(n, f_))
 
     walk(stmt)
+
+
+def ast_digest(stmt) -> str:
+    """Literal-masked structural digest of a statement AST (ref: the
+    normalized-SQL digest pkg/parser/digester.go feeds to bindinfo and
+    Top SQL): constants become '?', identifiers keep case-folded names,
+    hints are EXCLUDED so a hinted statement digests equal to its
+    original."""
+    import hashlib
+
+    parts: list = []
+
+    def walk(n):
+        if isinstance(n, (list, tuple)):
+            for x in n:
+                walk(x)
+            return
+        if isinstance(n, A.Literal):
+            parts.append("?")
+            return
+        if isinstance(n, A.ParamMarker):
+            parts.append("?")
+            return
+        if not hasattr(n, "__dataclass_fields__"):
+            if isinstance(n, str):
+                parts.append(n.lower())
+            elif n is not None:
+                parts.append(str(n))
+            return
+        parts.append(type(n).__name__)
+        for f_ in n.__dataclass_fields__:
+            if f_ == "hints":
+                continue
+            walk(getattr(n, f_))
+
+    walk(stmt)
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:32]
 
 
 class SQLError(ValueError):
@@ -255,6 +294,85 @@ class Session:
                 self.execute_stmt(parse_one(ddl))
             except Exception:  # noqa: BLE001 — one bad table must not
                 pass  # block login or the remaining bootstrap tables
+
+    # ------------------------------------------------ plan bindings
+    def _binding(self, stmt: A.BindingStmt) -> Result:
+        """CREATE/DROP [GLOBAL|SESSION] BINDING (ref: pkg/bindinfo
+        binding.go; match-at-optimize pkg/planner/optimize.go:135). The
+        digest is literal-masked and structural — the same statement shape
+        with different constants matches, like the reference's normalized
+        SQL digest."""
+        digest = ast_digest(stmt.target)
+        store = self.catalog.bindings if stmt.scope == "global" else self._session_bindings()
+        if stmt.action == "drop":
+            store.pop(digest, None)
+            if stmt.scope == "global":
+                try:
+                    self.execute(
+                        "delete from mysql.bind_info where sql_digest = "
+                        f"'{digest}'"
+                    )
+                except SQLError:
+                    pass
+            return Result()
+        if type(stmt.hinted) is not type(stmt.target):
+            raise SQLError("binding: the USING statement must match the bound statement's type")
+        if ast_digest(stmt.hinted) != digest:
+            raise SQLError("binding: the USING statement differs structurally from the bound one")
+        store[digest] = {
+            "original": stmt.target_sql, "bind": stmt.hinted_sql,
+            "ast": stmt.hinted, "scope": stmt.scope, "db": self.db,
+        }
+        if stmt.scope == "global":
+            try:
+                o = stmt.target_sql.replace("'", "''")
+                b = stmt.hinted_sql.replace("'", "''")
+                self.execute(
+                    "insert into mysql.bind_info (original_sql, bind_sql, default_db, "
+                    f"status, source, sql_digest) values ('{o}', '{b}', '{self.db}', "
+                    f"'enabled', 'manual', '{digest}')"
+                )
+            except SQLError:
+                pass
+        return Result()
+
+    def _session_bindings(self) -> dict:
+        if not hasattr(self, "_bindings"):
+            self._bindings = {}
+        return self._bindings
+
+    def _match_binding(self, stmt):
+        """Graft a matching binding's HINTS onto the incoming statement —
+        never its literals: the digest is literal-masked, so the incoming
+        query keeps its own constants and only the optimizer directives
+        transfer (ref: bindinfo BindSQL = normalized SQL + hint set).
+        Returns the (mutated) statement or None."""
+        if not isinstance(stmt, A.SelectStmt):
+            return None
+        digest = ast_digest(stmt)
+        rec = self._session_bindings().get(digest) or self.catalog.bindings.get(digest)
+        if rec is None or not isinstance(rec["ast"], A.SelectStmt):
+            return None
+        stmt.hints = list(rec["ast"].hints)
+        return stmt
+
+    def _runaway_checker(self):
+        """Per-statement RunawayChecker from max_execution_time (ms, 0 =
+        unlimited) — the BeforeCopRequest hook the dispatch loop consults
+        (ref: resourcegroup/runaway checker.go:27). Stored on the session
+        so KILL QUERY from another session can flip its kill flag."""
+        from ..distsql.runaway import RunawayChecker
+
+        c = RunawayChecker(self.sysvars.get_int("max_execution_time"))
+        self._active_checker = c
+        return c
+
+    def kill_query(self):
+        """KILL QUERY analog: abort the statement at its next dispatch
+        boundary (ref: server kill handling -> sessVars.Killed)."""
+        c = getattr(self, "_active_checker", None)
+        if c is not None:
+            c.kill()
 
     def _next_ts(self) -> int:
         return self.store.next_ts()
@@ -441,7 +559,11 @@ class Session:
             stmt = parse_one(sql)
             res = self.execute_stmt(stmt)
         except Exception as exc:
+            from ..distsql.runaway import QueryKilledError
+
             self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, 0, False, str(exc))
+            if isinstance(exc, QueryKilledError):
+                raise SQLError(str(exc)) from exc
             raise
         rows = len(res.rows) if getattr(res, "rows", None) else getattr(res, "affected", 0)
         self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, rows, True)
@@ -466,6 +588,10 @@ class Session:
         self._check_privileges(stmt)
         if isinstance(stmt, (A.SelectStmt, A.SetOprStmt, A.UpdateStmt, A.DeleteStmt, A.InsertStmt)):
             self._substitute_vars(stmt)
+        if isinstance(stmt, A.SelectStmt):
+            bound = self._match_binding(stmt)
+            if bound is not None:
+                stmt = bound  # same statement, binding hints grafted on
         if isinstance(stmt, A.PrepareStmt):
             # validate now; EXECUTE deep-copies the template per run (the
             # rewrite passes mutate ASTs; ref: plan_cache.go prepared-stmt
@@ -702,6 +828,8 @@ class Session:
                 raise SQLError(str(exc)) from exc
             self._persist_schema()
             return Result()
+        if isinstance(stmt, A.BindingStmt):
+            return self._binding(stmt)
         if isinstance(stmt, A.LoadStatsStmt):
             # LOAD STATS json (ref: pkg/statistics/handle LoadStatsFromJSON):
             # loads the dump when the file exists; the integration corpus'
@@ -967,7 +1095,10 @@ class Session:
             self._shadow_dirty_tables(stmt.from_clause, rw)
         from ..util.memory import MemTracker, QuotaExceeded
 
-        plan = plan_select(stmt, self.catalog, mat=rw.mat_dict())
+        plan = plan_select(
+            stmt, self.catalog, mat=rw.mat_dict(),
+            enable_index_merge=self.sysvars.get_bool("tidb_enable_index_merge"),
+        )
         ts = self._pin_read_ts()
         # OOM action chain (ref: util/memory tracker actions): first evict
         # the store's reclaimable chunk/batch caches; a second breach is
@@ -1022,10 +1153,11 @@ class Session:
                         r for pid in plan.probe_table.physical_ids()
                         for r in full_table_ranges(pid)
                     ]
-                if plan.lookup is not None:
+                if plan.lookup is not None or plan.lookup_merge:
                     # index-lookup double-read phase 1: index scan -> row
                     # handles -> coalesced table ranges (ref:
-                    # pkg/executor/distsql.go IndexLookUpExecutor)
+                    # pkg/executor/distsql.go IndexLookUpExecutor /
+                    # index_merge_reader.go for the union form)
                     ranges = self._lookup_handle_ranges(plan, ts)
                 if not gate_on:
                     # feature gate OFF (ref: TiDBAllowMPPExecution pattern):
@@ -1060,6 +1192,7 @@ class Session:
                             ),
                             batch_cop=self.sysvars.get_bool("tidb_allow_batch_cop"),
                             summary_sink=self._explain_sink,
+                            checker=self._runaway_checker(),
                         )
                         try:
                             chunk = execute_root(
@@ -1377,17 +1510,20 @@ class Session:
         from ..distsql import handle_ranges
         from ..exec.dag import IndexScan
 
-        index_id, iranges = plan.lookup
         meta = plan.probe_table
-        idx = next(i for i in meta.indices if i.index_id == index_id)
-        vcols = [meta.col(cn) for cn in idx.col_names]
-        icols = tuple(ColumnInfo(c.col_id, c.ft) for c in vcols) + (ColumnInfo(-1, HANDLE_FT),)
-        hdag = DAGRequest(
-            (IndexScan(meta.table_id, index_id, icols),),
-            output_offsets=(len(icols) - 1,),
-        )
-        chunk = execute_root(self.store, hdag, iranges, start_ts=ts)
-        handles = sorted({int(r[0].val) for r in chunk.rows()})
+        lookups = plan.lookup_merge if plan.lookup_merge else [plan.lookup]
+        handles_set: set = set()
+        for index_id, iranges in lookups:
+            idx = next(i for i in meta.indices if i.index_id == index_id)
+            vcols = [meta.col(cn) for cn in idx.col_names]
+            icols = tuple(ColumnInfo(c.col_id, c.ft) for c in vcols) + (ColumnInfo(-1, HANDLE_FT),)
+            hdag = DAGRequest(
+                (IndexScan(meta.table_id, index_id, icols),),
+                output_offsets=(len(icols) - 1,),
+            )
+            chunk = execute_root(self.store, hdag, iranges, start_ts=ts)
+            handles_set |= {int(r[0].val) for r in chunk.rows()}
+        handles = sorted(handles_set)
         pairs: list[list[int]] = []
         for h in handles:
             if pairs and h == pairs[-1][1] + 1:
@@ -1641,6 +1777,7 @@ class Session:
                 datums.append(d)
             self._apply_generated(meta, datums)
             self._check_not_null(meta, datums)
+            self._fk_check_child(meta, datums, ts)
             if handle is None:
                 handle = meta.alloc_handle()
                 if meta.handle_col is not None:
@@ -1689,6 +1826,146 @@ class Session:
 
     def _qualify_tables(self, stmt) -> None:
         qualify_tables_ast(stmt, self.db)
+
+    # ------------------------------------------------ foreign keys
+    def _fk_on(self) -> bool:
+        return self.sysvars.get_bool("foreign_key_checks")
+
+    def _fk_check_child(self, meta: TableMeta, datums: list, ts: int) -> None:
+        """Referential check for an inserted/updated child row (ref:
+        pkg/executor/foreign_key.go FKCheckExec on INSERT/UPDATE)."""
+        if not self._fk_on() or not meta.foreign_keys:
+            return
+        pos = {c.name: i for i, c in enumerate(meta.columns)}
+        for fk in meta.foreign_keys:
+            vals = [datums[pos[c]] for c in fk.cols]
+            if any(v.is_null() for v in vals):
+                continue  # NULL components never violate (MATCH SIMPLE)
+            try:
+                parent = self.catalog.table(fk.ref_table)
+            except CatalogError:
+                continue
+            if not self._fk_parent_exists(parent, fk.ref_cols, vals, ts):
+                raise SQLError(
+                    f"cannot add or update a child row: a foreign key "
+                    f"constraint fails ({meta.name}.{fk.name})"
+                )
+
+    def _fk_parent_exists(self, parent: TableMeta, cols: list, vals: list, ts: int) -> bool:
+        if (
+            len(cols) == 1 and parent.handle_col == cols[0]
+            and not vals[0].is_null()
+        ):
+            # referenced column IS the parent's int handle: point read
+            # (ref: FK check via the reference's index/PK point lookup)
+            try:
+                return self._read_row(parent, int(vals[0].val), ts) is not None
+            except (TypeError, ValueError):
+                return False
+        where = None
+        for c, v in zip(cols, vals):
+            e = A.BinaryOp("eq", A.ColumnName(c), A.Literal(v, "datum"))
+            where = e if where is None else A.BinaryOp("and", where, e)
+        return bool(self._scan_rows_with_handles(parent, where, ts, None, A.Limit(A.Literal(1, "int"))))
+
+    def _fk_referencing(self, parent: TableMeta):
+        """[(child_meta, FKMeta)] of every FK pointing at `parent` —
+        memoized per schema version (DML loops ask once per row)."""
+        cache = getattr(self, "_fk_ref_cache", None)
+        if cache is None or cache[0] != self.catalog.version:
+            refmap: dict = {}
+            for name in self.catalog.tables():
+                m = self.catalog.table(name)
+                for fk in m.foreign_keys:
+                    refmap.setdefault(fk.ref_table, []).append((m, fk))
+            cache = (self.catalog.version, refmap)
+            self._fk_ref_cache = cache
+        return cache[1].get(parent.name, [])
+
+    def _fk_on_parent_delete(self, meta: TableMeta, rows: list, ts: int, depth: int = 0) -> int:
+        from ..exec.executor import datum_group_key  # noqa: PLC0415
+        """RESTRICT / CASCADE / SET NULL on deleting parent rows (ref:
+        pkg/executor/foreign_key.go FKCascadeExec). Returns cascaded-row
+        count. `rows` are the parent row datum lists."""
+        if not self._fk_on() or not rows:
+            return 0
+        if depth > 15:
+            raise SQLError("foreign key cascade depth exceeded")
+        n = 0
+        for child, fk in self._fk_referencing(meta):
+            ppos = {c.name: i for i, c in enumerate(meta.columns)}
+            keysets = {
+                tuple(datum_group_key(r[ppos[c]]) for c in fk.ref_cols)
+                for r in rows
+            }
+            cpos = {c.name: i for i, c in enumerate(child.columns)}
+            matched = [
+                (h, r) for h, r in self._scan_rows_with_handles(child, None, ts)
+                if not any(r[cpos[c]].is_null() for c in fk.cols)
+                and tuple(datum_group_key(r[cpos[c]]) for c in fk.cols) in keysets
+            ]
+            if not matched:
+                continue
+            if fk.on_delete in ("restrict", "no_action"):
+                raise SQLError(
+                    f"cannot delete or update a parent row: a foreign key "
+                    f"constraint fails ({child.name}.{fk.name})"
+                )
+            self._lock_rows(child, [h for h, _ in matched])
+            if fk.on_delete == "cascade":
+                n += self._fk_on_parent_delete(child, [r for _, r in matched], ts, depth + 1)
+                for handle, row in matched:
+                    self._buf_delete_row(child, handle, row)
+                    self._write_indexes(child, row, handle, delete=True)
+                self.txn.row_delta[child.table_id] = self.txn.row_delta.get(child.table_id, 0) - len(matched)
+                n += len(matched)
+            else:  # set_null
+                for handle, row in matched:
+                    new_row = list(row)
+                    for c in fk.cols:
+                        new_row[cpos[c]] = Datum.NULL
+                    self._write_indexes(child, row, handle, delete=True)
+                    self._buf_put_row(child, handle, new_row)
+                    self._write_indexes(child, new_row, handle)
+        return n
+
+    def _fk_on_parent_update(self, meta: TableMeta, old_row: list, new_row: list, ts: int) -> None:
+        """ON UPDATE actions when a referenced key changes (ref:
+        executor/foreign_key.go onUpdate handling)."""
+        if not self._fk_on():
+            return
+        from ..exec.executor import datum_group_key
+
+        refs = self._fk_referencing(meta)
+        if not refs:
+            return
+        ppos = {c.name: i for i, c in enumerate(meta.columns)}
+        for child, fk in refs:
+            old_key = tuple(datum_group_key(old_row[ppos[c]]) for c in fk.ref_cols)
+            new_key = tuple(datum_group_key(new_row[ppos[c]]) for c in fk.ref_cols)
+            if old_key == new_key:
+                continue
+            cpos = {c.name: i for i, c in enumerate(child.columns)}
+            matched = [
+                (h, r) for h, r in self._scan_rows_with_handles(child, None, ts)
+                if not any(r[cpos[c]].is_null() for c in fk.cols)
+                and tuple(datum_group_key(r[cpos[c]]) for c in fk.cols) == old_key
+            ]
+            if not matched:
+                continue
+            if fk.on_update in ("restrict", "no_action"):
+                raise SQLError(
+                    f"cannot delete or update a parent row: a foreign key "
+                    f"constraint fails ({child.name}.{fk.name})"
+                )
+            self._lock_rows(child, [h for h, _ in matched])
+            for handle, row in matched:
+                nrow = list(row)
+                for ci, pc in zip(fk.cols, fk.ref_cols):
+                    nrow[cpos[ci]] = Datum.NULL if fk.on_update == "set_null" else new_row[ppos[pc]]
+                self._write_indexes(child, row, handle, delete=True)
+                self._buf_put_row(child, handle, nrow)
+                self._write_indexes(child, nrow, handle)
 
     def _check_not_null(self, meta: TableMeta, datums: list) -> None:
         """NOT NULL (incl. implicit PK not-null) enforcement at write
@@ -1840,6 +2117,8 @@ class Session:
                 new_row[col_pos[cm.name]] = _coerce_datum(ev.eval(e, new_row), cm.ft)
             self._apply_generated(meta, new_row)
             self._check_not_null(meta, new_row)
+            self._fk_check_child(meta, new_row, ts)
+            self._fk_on_parent_update(meta, row, new_row, ts)
             new_handle = handle
             if moves_handle:
                 d = new_row[col_pos[meta.handle_col]]
@@ -1872,6 +2151,7 @@ class Session:
         ts = self.txn.start_ts
         matched = self._scan_rows_with_handles(meta, stmt.where, ts, stmt.order_by, stmt.limit)
         self._lock_rows(meta, [h for h, _ in matched])
+        self._fk_on_parent_delete(meta, [r for _, r in matched], ts)
         for handle, row in matched:
             self._buf_delete_row(meta, handle, row)
             self._write_indexes(meta, row, handle, delete=True)
@@ -2150,6 +2430,16 @@ class Session:
                 for nu, iname, seq, cn in self._index_descs(meta)
             ]
             return Result(columns=["Table", "Non_unique", "Key_name", "Seq_in_index", "Column_name"], rows=rows)
+        if kind == "bindings":
+            cols = ["Original_sql", "Bind_sql", "Default_db", "Status", "Source", "Sql_digest"]
+            store = self.catalog.bindings if stmt.global_scope else self._session_bindings()
+            rows = [
+                [Datum.string(r["original"]), Datum.string(r["bind"]),
+                 Datum.string(r.get("db", "")), Datum.string("enabled"),
+                 Datum.string("manual"), Datum.string(d)]
+                for d, r in store.items()
+            ]
+            return Result(columns=cols, rows=rows)
         if kind == "status":
             from ..util import metrics
 
@@ -2192,6 +2482,10 @@ class Session:
 
     def _explain(self, stmt) -> Result:
         inner = stmt.target
+        if isinstance(inner, A.SelectStmt):
+            bound = self._match_binding(inner)
+            if bound is not None:
+                inner = bound  # binding hints grafted on
         if not isinstance(inner, A.SelectStmt):
             return Result()
         import copy
@@ -2207,7 +2501,10 @@ class Session:
                 return Result(columns=["plan"], rows=[[Datum.string("constant select")]])
             rw.rewrite_select(inner)
             self._bind_information_schema(inner.from_clause, rw)
-            plan = plan_select(inner, self.catalog, mat=rw.mat_dict())
+            plan = plan_select(
+                inner, self.catalog, mat=rw.mat_dict(),
+                enable_index_merge=self.sysvars.get_bool("tidb_enable_index_merge"),
+            )
         except (SubqueryError, PlanError, CatalogError) as exc:
             raise SQLError(str(exc)) from exc
         from ..distsql import split_dag

@@ -127,6 +127,16 @@ def show_create_table(meta) -> str:
             continue
         kind = "UNIQUE KEY" if idx.unique else "KEY"
         body.append(f"  {kind} `{idx.name}` ({cols})")
+    for fk in getattr(meta, "foreign_keys", []):
+        cols = ",".join(f"`{c}`" for c in fk.cols)
+        rcols = ",".join(f"`{c}`" for c in fk.ref_cols)
+        rt = fk.ref_table.rsplit(".", 1)[-1]
+        line = f"  CONSTRAINT `{fk.name}` FOREIGN KEY ({cols}) REFERENCES `{rt}` ({rcols})"
+        if fk.on_delete != "restrict":
+            line += f" ON DELETE {fk.on_delete.replace('_', ' ').upper()}"
+        if fk.on_update != "restrict":
+            line += f" ON UPDATE {fk.on_update.replace('_', ' ').upper()}"
+        body.append(line)
     out = lines[0] + "\n" + ",\n".join(body) + "\n"
     out += ") ENGINE=InnoDB DEFAULT CHARSET=utf8mb4 COLLATE=utf8mb4_bin"
     return out
