@@ -1,9 +1,9 @@
-"""Result-corpus ratchet (VERDICT r3 missing #3): a pinned set of the
-reference's integration files EXECUTES through the session and the
-recorded-result match rate may only go UP. Skips cleanly when the
-reference tree is absent. The full sweep (all files) runs via
-`python tools/result_corpus.py`; this test pins a fast, stable subset so
-the suite stays quick and the signal deterministic."""
+"""Result-corpus ratchet (VERDICT r3 missing #3, r4 next #2): the FULL
+37-file reference integration corpus EXECUTES through the session and the
+recorded-result match rate may only go UP. The sweep runs hermetic-CPU in
+~25s (tools/result_corpus.py pops the axon TPU factory — it used to
+round-trip the tunnel), so the whole corpus ratchets, not a pinned subset.
+Skips cleanly when the reference tree is absent."""
 
 import os
 import sys
@@ -11,11 +11,15 @@ import sys
 import pytest
 
 CORPUS = "/root/reference/tests/integrationtest/t"
-# small, fast files with solid current rates (full-run numbers 2026-07-30:
-# overall match_rate 0.54, data_match_rate 0.64 over 2191 stmts/37 files)
-PINNED = ["select", "agg_predicate_pushdown", "access_path_selection", "cte"]
-# measured 2026-07-30 on the pinned set; raise when it improves, never lower
-RATCHET_DATA = 0.70
+# measured 2026-07-31 (round 5): overall data_match_rate 0.7522 over
+# 2191 statements / 37 files. Raise when it improves, never lower.
+RATCHET_DATA = 0.74
+RATCHET_EXEC = 2100  # executed statements (desync guard)
+
+# per-file floors for the former pinned set (these carried the round-4
+# ratchet; keep them from silently regressing inside a passing aggregate)
+PER_FILE = {"select": 0.80, "agg_predicate_pushdown": 0.70,
+            "access_path_selection": 0.50, "cte": 0.75}
 
 
 @pytest.mark.skipif(not os.path.isdir(CORPUS), reason="reference corpus not present")
@@ -23,8 +27,14 @@ def test_result_corpus_ratchet():
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
     from result_corpus import run_corpus
 
-    r = run_corpus(PINNED)
-    assert r["executed"] > 250, f"corpus execution collapsed: {r}"
+    r = run_corpus(per_file=True)
+    details = r.pop("details")
+    assert r["executed"] >= RATCHET_EXEC, f"corpus execution collapsed: {r}"
     assert r["data_match_rate"] >= RATCHET_DATA, (
         f"result-corpus data match rate regressed: {r}"
     )
+    for name, floor in PER_FILE.items():
+        c = details[name]["counts"]
+        ex = sum(c.values()) - c["desync"] - c["explain_diff"]
+        rate = (c["match"] + c["error_ok"]) / ex if ex else 0.0
+        assert rate >= floor, f"{name} data-match regressed to {rate:.3f}: {c}"

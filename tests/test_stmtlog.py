@@ -75,3 +75,26 @@ class TestSlowLog:
         s.execute("set tidb_slow_log_threshold = 300")
         s.execute("set tidb_enable_slow_log = ON")
         assert s.catalog.stmtlog.slow_entries() == []
+
+
+def test_top_sql_cpu_attribution():
+    """Top SQL (VERDICT r4 missing #8; ref: pkg/util/topsql): per-digest
+    CPU time accumulates and information_schema.tidb_top_sql ranks by it."""
+    from tidb_tpu.sql import Session
+
+    s = Session()
+    s.execute("create table t (a bigint primary key, b bigint)")
+    s.execute("insert into t values " + ",".join(f"({i},{i})" for i in range(300)))
+    for i in range(5):
+        s.execute(f"select sum(b) from t where a > {i}")
+    s.execute("select 1")
+    rows = s.execute(
+        "select digest_text, exec_count, sum_cpu_time from information_schema.tidb_top_sql "
+        "where digest_text like '%sum%'"
+    ).values()
+    assert rows and rows[0][1] == 5 and rows[0][2] > 0.0
+    # ranked by cumulative CPU: the repeated aggregation outranks select 1
+    top = s.execute(
+        "select digest_text from information_schema.tidb_top_sql limit 3"
+    ).values()
+    assert any("sum" in r[0] for r in top)

@@ -240,8 +240,8 @@ def run_dag_on_chunks(
     tries host-partitioned multi-pass device execution (the spill analog);
     the reference evaluator is the last resort (host-only operators)."""
     cache = cache or DEFAULT_PROGRAM_CACHE
-    batches = [to_device_batch(c, capacity=_pow2(max(c.num_rows(), 1))) for c in chunks]
     try:
+        batches = [to_device_batch(c, capacity=_pow2(max(c.num_rows(), 1))) for c in chunks]
         return drive_program(cache, dag, batches, group_capacity, max_retries, small_groups=small_groups)[0]
     except OverflowRetryError:
         try:
@@ -288,9 +288,13 @@ def datum_group_key(d: Datum, ft: FieldType | None = None):
     if d.kind == DatumKind.MysqlDecimal:
         return (1, str(d.val.d.normalize()))
     if d.kind in (DatumKind.String, DatumKind.Bytes):
-        v = d.val.encode() if isinstance(d.val, str) else bytes(d.val)
         if ft is not None and ft.is_ci():
-            v = v.upper()  # general_ci: one group per case-folded key
+            # one group per collation WEIGHT key (full Unicode,
+            # types/collate.py — é and É and e share a unicode_ci group)
+            from ..types.collate import weight_bytes
+
+            return (1, weight_bytes(d.val, ft.collate))
+        v = d.val.encode() if isinstance(d.val, str) else bytes(d.val)
         return (1, v)
     if d.kind == DatumKind.MysqlTime:
         return (1, d.val.packed)
@@ -327,7 +331,10 @@ class _RefAgg:
             # arg tuple contributes once
             if any(a.is_null() for a in args):
                 return
-            key = tuple(datum_group_key(a) for a in args)
+            key = tuple(
+                datum_group_key(a, ae.ft)
+                for a, ae in zip(args, self.d.args)
+            )
             if key in self.seen:
                 return
             self.seen.add(key)
@@ -573,7 +580,10 @@ def _order_by_sorted(rows, order_by, ev) -> list:
             a, b = ev.eval(e, r1), ev.eval(e, r2)
             if a.is_null() and b.is_null():
                 continue
-            c = -1 if a.is_null() else (1 if b.is_null() else compare(a, b))
+            ci = e.ft.is_string() and e.ft.is_ci()
+            c = -1 if a.is_null() else (
+                1 if b.is_null() else compare(a, b, ci=ci, collation=e.ft.collate if ci else None)
+            )
             if c:
                 return -c if desc else c
         return 0
@@ -595,7 +605,10 @@ def _ref_window(ex, rows, ev) -> list[list[Datum]]:
             a, b = ev.eval(e, r1), ev.eval(e, r2)
             if a.is_null() and b.is_null():
                 continue
-            c = -1 if a.is_null() else (1 if b.is_null() else compare(a, b))
+            ci = e.ft.is_string() and e.ft.is_ci()
+            c = -1 if a.is_null() else (
+                1 if b.is_null() else compare(a, b, ci=ci, collation=e.ft.collate if ci else None)
+            )
             if c:
                 return -c if desc else c
         return 0

@@ -43,6 +43,8 @@ class CompVal:
     null: jax.Array  # bool [N]
     ft: FieldType
     raw: tuple | None = None  # (data[N,W] uint8, length[N] int32) for strings
+    const_bytes: bytes | None = None  # set for string CONSTANTS: trace-time
+    # values are tracers, so CI guards read the python bytes here
 
     @property
     def eval_type(self) -> str:
@@ -169,6 +171,19 @@ from ..types.mytime import days_from_civil as _days_from_ymd
 from ..types.mytime import days_in_month as _days_in_month_vec
 
 
+def _ci_ascii_guard(*vals):
+    """The device CI kernels fold ASCII only. Column data is screened at
+    to_device_batch; CONSTANTS are concrete at trace time and screened
+    here — a non-ASCII constant routes the plan to the weight-based
+    oracle (NotImplementedError -> the executor's documented fallback)."""
+    for v in vals:
+        if not isinstance(v, CompVal):
+            continue
+        b = v.const_bytes
+        if b is not None and any(x >= 0x80 for x in b):
+            raise NotImplementedError("non-ASCII constant under CI collation (oracle)")
+
+
 def fold_words_ci(words):
     """ASCII-case-fold packed compare words (a-z -> A-Z), keeping the
     length word — general_ci collation compare on device (ref:
@@ -271,7 +286,9 @@ class ExprCompiler:
             data[0, : len(b)] = np.frombuffer(b, np.uint8)
             words = pack_string_words(jnp.asarray(data), jnp.asarray(np.array([len(b)], np.int32)))
             v = jnp.broadcast_to(words, (n, words.shape[1]))
-            return CompVal(v, jnp.zeros(n, bool), e.ft, raw=(jnp.broadcast_to(jnp.asarray(data), (n, w)), jnp.full(n, len(b), jnp.int32)))
+            return CompVal(v, jnp.zeros(n, bool), e.ft,
+                           raw=(jnp.broadcast_to(jnp.asarray(data), (n, w)), jnp.full(n, len(b), jnp.int32)),
+                           const_bytes=b)
         else:
             v = jnp.full(n, int(d.val), jnp.int64)
         return CompVal(v, jnp.zeros(n, bool), e.ft)
@@ -474,6 +491,7 @@ class ExprCompiler:
         if cls == "string":
             av, bv = a.value, b.value
             if a.ft.is_ci() or b.ft.is_ci():
+                _ci_ascii_guard(a, b)
                 av, bv = fold_words_ci(av), fold_words_ci(bv)
             return _words_cmp(av, bv)
         if cls == "real":
@@ -810,6 +828,7 @@ class ExprCompiler:
         a, b = self._eval(e.args[0]), self._eval(e.args[1])
         av, bv = a.value, b.value
         if a.ft.is_ci() or b.ft.is_ci():
+            _ci_ascii_guard(a, b)
             av, bv = fold_words_ci(av), fold_words_ci(bv)
         return CompVal(_words_cmp(av, bv).astype(jnp.int64), a.null | b.null, e.ft)
 
@@ -827,9 +846,12 @@ class ExprCompiler:
         data, length = a.raw
         if a.ft.is_ci() or pat.ft.is_ci():
             # general_ci LIKE: ASCII fold on BOTH sides (matching the
-            # compare()/sort-key fold — full-Unicode upper would diverge)
+            # compare()/sort-key fold); a non-ASCII pattern goes to the
+            # weight-based oracle
             from ..expr.eval_ref import _ascii_upper
 
+            if any(ord(c) >= 0x80 for c in p):
+                raise NotImplementedError("non-ASCII CI LIKE pattern (oracle)")
             hit = (data >= 0x61) & (data <= 0x7A)
             data = jnp.where(hit, data - 0x20, data)
             p = _ascii_upper(p)

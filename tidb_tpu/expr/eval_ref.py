@@ -103,17 +103,24 @@ def _truth(d: Datum) -> bool | None:
     return d.val != 0
 
 
-def compare(a: Datum, b: Datum, ci: bool = False) -> int | None:
-    """3-way semantic compare; None if either side NULL. ci = ASCII
-    case-fold both sides first (general_ci collations)."""
+def compare(a: Datum, b: Datum, ci: bool = False, collation=None) -> int | None:
+    """3-way semantic compare; None if either side NULL. ci compares by
+    collation WEIGHT BYTES (full Unicode, types/collate.py) — general_ci
+    unless a specific collation is given."""
     if a.is_null() or b.is_null():
         return None
     cls = _class2(a, b)
     if cls == "string":
+        if ci or collation is not None:
+            from ..types.collate import weight_bytes
+            from ..types.field_type import Collation
+
+            coll = collation or Collation.Utf8MB4GeneralCI
+            av = weight_bytes(a.val, coll)
+            bv = weight_bytes(b.val, coll)
+            return (av > bv) - (av < bv)
         av = a.val.encode() if isinstance(a.val, str) else bytes(a.val)
         bv = b.val.encode() if isinstance(b.val, str) else bytes(b.val)
-        if ci:
-            av, bv = av.upper(), bv.upper()
         return (av > bv) - (av < bv)
     if cls == "real":
         av, bv = _as_float(a), _as_float(b)
@@ -499,9 +506,16 @@ class RefEvaluator:
     def _ci(e) -> bool:
         return any(a.ft.is_string() and a.ft.is_ci() for a in e.args)
 
+    @staticmethod
+    def _coll(e):
+        for a in e.args:
+            if a.ft.is_string() and a.ft.is_ci():
+                return a.ft.collate
+        return None
+
     def _cmp_op(self, e, row, pred):
         a, b = self._args(e, row)
-        c = compare(a, b, ci=self._ci(e))
+        c = compare(a, b, ci=self._ci(e), collation=self._coll(e))
         if c is None:
             return Datum.NULL
         return Datum.i64(1 if pred(c) else 0)
@@ -538,7 +552,7 @@ class RefEvaluator:
         saw_null = False
         for arg in e.args[1:]:
             b = self.eval(arg, row)
-            c = compare(a, b, ci=self._ci(e))
+            c = compare(a, b, ci=self._ci(e), collation=self._coll(e))
             if c is None:
                 saw_null = True
             elif c == 0:
@@ -548,7 +562,8 @@ class RefEvaluator:
     def _op_between(self, e, row):
         a, lo, hi = self._args(e, row)
         ci = self._ci(e)
-        c1, c2 = compare(a, lo, ci=ci), compare(a, hi, ci=ci)
+        coll = self._coll(e)
+        c1, c2 = compare(a, lo, ci=ci, collation=coll), compare(a, hi, ci=ci, collation=coll)
         if c1 is None or c2 is None:
             return Datum.NULL
         return Datum.i64(1 if c1 >= 0 and c2 <= 0 else 0)
@@ -817,9 +832,13 @@ class RefEvaluator:
         s = a.val if isinstance(a.val, str) else a.val.decode("utf-8", "surrogateescape")
         pat = p.val if isinstance(p.val, str) else p.val.decode()
         if self._ci(e):
-            # ASCII fold only — the engine's documented general_ci subset
-            # (full-Unicode str.upper would disagree with compare()/keys)
-            s, pat = _ascii_upper(s), _ascii_upper(pat)
+            # the SAME per-collation fold weight_bytes uses — '=' and LIKE
+            # must agree (types/collate.py fold_text)
+            from ..types.collate import fold_text
+            from ..types.field_type import Collation
+
+            coll = self._coll(e) or Collation.Utf8MB4GeneralCI
+            s, pat = fold_text(s, coll), fold_text(pat, coll)
         rx = re.escape(pat).replace(re.escape("%"), ".*").replace(re.escape("_"), ".")
         return Datum.i64(1 if re.fullmatch(rx, s, re.S) else 0)
 

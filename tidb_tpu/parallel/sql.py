@@ -131,7 +131,11 @@ def try_mesh_select(
 
     n = len(devs)
     n_total = ((len(chunks) + n - 1) // n) * n
-    stacked = stack_region_batches(chunks, n_total=n_total)
+    try:
+        stacked = stack_region_batches(chunks, n_total=n_total)
+    except NotImplementedError:
+        return None  # e.g. non-ASCII CI data: the per-region path's
+        # oracle fallback owns it (chunk/device.py guard)
     mesh = region_mesh(n)
 
     stacked_builds = None
@@ -154,7 +158,10 @@ def try_mesh_select(
                     for i in range(n)
                     if i * step < build.num_rows()
                 ]
-            stacked_builds.append(stack_region_batches(bslices, n_total=n))
+            try:
+                stacked_builds.append(stack_region_batches(bslices, n_total=n))
+            except NotImplementedError:
+                return None  # non-ASCII CI build data -> per-region path
 
     # overflow (too many groups / join fan-out / hash collision): retry
     # with 4x capacity — the capacity also salts the hash, mirroring

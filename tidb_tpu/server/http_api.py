@@ -1,0 +1,161 @@
+"""HTTP status API (ref: pkg/server/http_status.go + the handler set in
+pkg/server/handler/tikvhandler — docs/tidb_http_api.md):
+
+  GET /status                          server status (version, git hash)
+  GET /schema                          all databases
+  GET /schema/{db}                     tables of a database
+  GET /schema/{db}/{table}             one table's TableInfo
+  GET /ddl/history                     DDL job log (newest first)
+  GET /settings                        config + global sysvars
+  GET /metrics                         prometheus-style counters
+  GET /mvcc/key/{db}/{table}/{handle}  MVCC versions of one row
+  GET /regions/meta                    region/cluster layout
+
+Runs on its own port next to the MySQL protocol listener, like the
+reference's status server. JSON bodies; 404 with a message otherwise."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _table_info(meta) -> dict:
+    return {
+        "id": meta.table_id,
+        "name": {"O": meta.name.rsplit(".", 1)[-1], "L": meta.name.rsplit(".", 1)[-1]},
+        "cols": [
+            {
+                "id": c.col_id,
+                "name": {"O": c.name, "L": c.name},
+                "type": c.decl or c.ft.eval_type(),
+                "nullable": not c.ft.not_null(),
+                "generated": c.generated is not None,
+            }
+            for c in meta.columns
+        ],
+        "index_info": [
+            {"id": i.index_id, "name": i.name, "cols": i.col_names,
+             "unique": i.unique, "state": i.state}
+            for i in meta.indices
+        ],
+        "fk_info": [
+            {"name": fk.name, "cols": fk.cols, "ref_table": fk.ref_table,
+             "ref_cols": fk.ref_cols, "on_delete": fk.on_delete}
+            for fk in getattr(meta, "foreign_keys", [])
+        ],
+        "pk_is_handle": meta.handle_col is not None,
+        "row_count": meta.row_count,
+        "partition": None if meta.partition is None else {
+            "type": meta.partition.method,
+            "expr": meta.partition.col,
+            "definitions": [{"id": p.pid, "name": p.name} for p in meta.partition.parts],
+        },
+    }
+
+
+class StatusServer:
+    """The status endpoint server; `start_background()` + `.port`."""
+
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+        self.session = session
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):  # noqa: N802 (stdlib contract)
+                try:
+                    code, body = outer._route(self.path)
+                except Exception as exc:  # noqa: BLE001 — surface, don't kill the thread
+                    code, body = 500, {"error": str(exc)}
+                data = json.dumps(body, indent=1, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address
+
+    def start_background(self):
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        return self
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ---------------------------------------------------------- routing
+    def _route(self, path: str):
+        s = self.session
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        if parts == ["status"]:
+            return 200, {
+                "connections": 0,
+                "version": "8.0.11-tidb_tpu",
+                "git_hash": "tpu-native",
+                "status_port": self.port,
+            }
+        if parts == ["schema"]:
+            return 200, sorted({"information_schema"} | s.catalog.databases)
+        if len(parts) == 2 and parts[0] == "schema":
+            db = parts[1].lower()
+            pre = "" if db == "test" else db + "."
+            out = []
+            for name in s.catalog.tables():
+                if db == "test" and "." not in name:
+                    out.append(_table_info(s.catalog.table(name)))
+                elif pre and name.startswith(pre):
+                    out.append(_table_info(s.catalog.table(name)))
+            return 200, out
+        if len(parts) == 3 and parts[0] == "schema":
+            key = parts[2].lower() if parts[1].lower() == "test" else f"{parts[1].lower()}.{parts[2].lower()}"
+            try:
+                return 200, _table_info(s.catalog.table(key))
+            except Exception:  # noqa: BLE001
+                return 404, {"error": f"table {parts[1]}.{parts[2]} not found"}
+        if parts == ["ddl", "history"]:
+            return 200, [
+                {"id": j.job_id, "type": j.job_type, "state": j.state,
+                 "schema_state": j.schema_state, "table": j.table,
+                 "query": j.query}
+                for j in reversed(list(s.catalog.ddl_jobs.jobs))
+            ]
+        if parts == ["settings"]:
+            return 200, dict(s.sysvars.items())
+        if parts == ["metrics"]:
+            from ..util import metrics
+
+            return 200, {"prometheus": metrics.REGISTRY.dump()}
+        if parts == ["regions", "meta"]:
+            return 200, [
+                {"region_id": r.region_id, "epoch": r.epoch,
+                 "start_key": r.start_key.hex(), "end_key": r.end_key.hex()}
+                for r in s.store.cluster.regions()
+            ]
+        if len(parts) == 5 and parts[:2] == ["mvcc", "key"]:
+            db, tbl, h = parts[2].lower(), parts[3].lower(), int(parts[4])
+            key = tbl if db == "test" else f"{db}.{tbl}"
+            meta = s.catalog.table(key)
+            from ..codec import tablecodec
+
+            out = []
+            for pid in meta.physical_ids():
+                k = tablecodec.encode_row_key(pid, h)
+                with s.store.kv.lock:
+                    vers = list(s.store.kv._data.get(k, []))
+                for ts, val in vers:
+                    out.append({
+                        "key": k.hex(), "commit_ts": ts,
+                        "deleted": val is None,
+                        "value_len": 0 if val is None else len(val),
+                    })
+            if not out:
+                return 404, {"error": "no MVCC versions for that handle"}
+            return 200, {"handle": h, "versions": out}
+        return 404, {"error": f"unknown path {path!r} (see docs/tidb_http_api.md routes)"}

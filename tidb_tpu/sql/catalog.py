@@ -99,6 +99,14 @@ def field_type_from_spec(ts: A.TypeSpec, not_null: bool = False) -> FieldType:
             ft.charset = "binary"
         elif ts.charset:
             ft.charset = ts.charset.lower()
+        if ts.collate:
+            c = ts.collate.lower()
+            if c.endswith("_general_ci"):
+                ft.collate = Collation.Utf8MB4GeneralCI
+            elif c.endswith(("_unicode_ci", "_0900_ai_ci", "_unicode_520_ci")):
+                ft.collate = Collation.Utf8MB4UnicodeCI
+            elif c.endswith("_bin") or c == "binary":
+                ft.collate = Collation.Utf8MB4Bin
         if not_null:
             ft = FieldType(ft.tp, ft.flag | Flag.NotNull, ft.flen, ft.decimal, ft.charset, ft.collate)
         return ft

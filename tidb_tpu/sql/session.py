@@ -555,21 +555,24 @@ class Session:
         import time as _time
 
         t0 = _time.perf_counter()
+        c0 = _time.thread_time()
         try:
             stmt = parse_one(sql)
             res = self.execute_stmt(stmt)
         except Exception as exc:
             from ..distsql.runaway import QueryKilledError
 
-            self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, 0, False, str(exc))
+            self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, 0, False, str(exc),
+                              cpu_ms=(_time.thread_time() - c0) * 1e3)
             if isinstance(exc, QueryKilledError):
                 raise SQLError(str(exc)) from exc
             raise
         rows = len(res.rows) if getattr(res, "rows", None) else getattr(res, "affected", 0)
-        self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, rows, True)
+        self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, rows, True,
+                          cpu_ms=(_time.thread_time() - c0) * 1e3)
         return res
 
-    def _record_stmt(self, sql: str, dur_ms: float, rows: int, ok: bool, err: str = ""):
+    def _record_stmt(self, sql: str, dur_ms: float, rows: int, ok: bool, err: str = "", cpu_ms: float = 0.0):
         try:
             thr = None
             if self.sysvars.get_bool("tidb_enable_slow_log"):
@@ -579,6 +582,7 @@ class Session:
                 sql, dur_ms, rows, ok, err,
                 slow_threshold_ms=thr,
                 summary_enabled=self.sysvars.get_bool("tidb_enable_stmt_summary"),
+                cpu_ms=cpu_ms,
             )
         except Exception:  # noqa: BLE001 — observability must never fail a query
             pass
@@ -1451,6 +1455,24 @@ class Session:
                     Datum.f64(sm.max_latency_ms), Datum.f64(sm.avg_latency_ms),
                     Datum.i64(sm.sum_rows), Datum.i64(sm.errors),
                     Datum.string(sm.sample_sql),
+                ])
+        elif kind == "tidb_top_sql":
+            # ref: pkg/util/topsql — per-digest CPU attribution, top-N by
+            # cumulative CPU (exact thread-time deltas in-process, where
+            # the reference samples pprof against SQL digests)
+            from ..types import new_double
+
+            D = new_double()
+            names = ["digest", "digest_text", "exec_count", "sum_cpu_time",
+                     "avg_cpu_time", "sum_latency", "sample_sql"]
+            fts = [S, new_varchar(1024), I, D, D, D, new_varchar(256)]
+            rows = []
+            for sm in self.catalog.stmtlog.top_sql():
+                rows.append([
+                    Datum.string(sm.digest), Datum.string(sm.normalized),
+                    Datum.i64(sm.exec_count), Datum.f64(sm.sum_cpu_ms),
+                    Datum.f64(sm.sum_cpu_ms / sm.exec_count if sm.exec_count else 0.0),
+                    Datum.f64(sm.sum_latency_ms), Datum.string(sm.sample_sql),
                 ])
         else:
             raise SQLError(f"information_schema.{kind} not supported yet")

@@ -73,6 +73,7 @@ class Collation(enum.IntEnum):
     Utf8GeneralCI = 33
     Utf8MB4Bin = 46
     Utf8MB4GeneralCI = 45
+    Utf8MB4UnicodeCI = 224
     Utf8MB4_0900AICI = 255
     Latin1Bin = 47
     ASCIIBin = 65
@@ -140,11 +141,13 @@ class FieldType:
         return bool(self.flag & Flag.NotNull)
 
     def is_ci(self) -> bool:
-        """Case-insensitive collation (ref: pkg/util/collate general_ci;
-        ASCII fold — the _general_ci subset this engine implements)."""
+        """Case/accent-insensitive collation (ref: pkg/util/collate):
+        weight-based on the oracle path (types/collate.py); the device
+        ASCII-folds and refuses non-ASCII CI data (oracle fallback)."""
         return self.collate in (
             Collation.Utf8GeneralCI,
             Collation.Utf8MB4GeneralCI,
+            Collation.Utf8MB4UnicodeCI,
             Collation.Utf8MB4_0900AICI,
         )
 

@@ -71,6 +71,9 @@ class StmtSummary:
     sum_rows: int = 0
     errors: int = 0
     last_seen: float = 0.0
+    sum_cpu_ms: float = 0.0  # thread CPU time (the Top SQL attribution,
+    # ref: pkg/util/topsql/collector — per-digest CPU sampling; in-process
+    # the exact thread_time delta replaces statistical sampling)
 
     @property
     def avg_latency_ms(self) -> float:
@@ -97,6 +100,7 @@ class StmtLog:
         error: str = "",
         slow_threshold_ms: float | None = 300.0,
         summary_enabled: bool = True,
+        cpu_ms: float = 0.0,
     ):
         is_slow = slow_threshold_ms is not None and duration_ms > slow_threshold_ms
         if not summary_enabled and not is_slow:
@@ -119,6 +123,7 @@ class StmtLog:
                 s.min_latency_ms = min(s.min_latency_ms, duration_ms)
                 s.sum_rows += rows
                 s.errors += 0 if success else 1
+                s.sum_cpu_ms += cpu_ms
                 s.last_seen = now
             if is_slow:
                 self.slow.append(
@@ -126,6 +131,12 @@ class StmtLog:
                 )
                 if len(self.slow) > self.slow_capacity:
                     del self.slow[: len(self.slow) - self.slow_capacity]
+
+    def top_sql(self, n: int = 30) -> list[StmtSummary]:
+        """Top digests by cumulative CPU time (ref: pkg/util/topsql's
+        top-N reporter over the per-digest CPU attribution)."""
+        with self._lock:
+            return sorted(self.summaries.values(), key=lambda s: -s.sum_cpu_ms)[:n]
 
     def slow_entries(self) -> list[SlowLogEntry]:
         with self._lock:
