@@ -69,6 +69,7 @@ def host_only_exprs(exprs) -> bool:
         "json_extract", "json_unquote", "json_type", "json_valid",
         "json_length", "json_keys", "json_contains", "json_member_of",
         "json_array", "json_object", "json_quote", "regexp", "regexp_like",
+        "convert_using",
     }
 
     def walk(e):

@@ -21,6 +21,22 @@ from .ir import ColumnRef, Const, Expr, ScalarFunc
 _NUM_PREFIX = re.compile(r"^\s*[+-]?(\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?")
 
 
+# host builtins that consume their string arguments as BYTES (encoded in
+# the argument's column charset); everything else gets character semantics
+_BYTE_SEMANTICS_OPS = frozenset({
+    "md5", "sha", "sha1", "sha2", "password", "crc32", "compress",
+    "uncompress", "uncompressed_length", "to_base64", "aes_encrypt",
+    "aes_decrypt", "bit_length",
+})
+
+# character-unit builtins where a BINARY operand first converts into the
+# string operand's charset (then character semantics apply; ref:
+# builtin_string.go convertString on mixed binary/str args)
+_BIN_TO_CHAR_OPS = frozenset({
+    "instr", "position", "locate", "insert", "lpad", "rpad", "elt",
+    "find_in_set", "field", "concat_ws",
+})
+
 _CHARSET_CODEC = {"gbk": "gbk", "gb2312": "gb2312", "gb18030": "gb18030",
                   "latin1": "latin-1", "ascii": "ascii", "utf8": "utf-8",
                   "utf8mb4": "utf-8", "big5": "big5"}
@@ -42,6 +58,10 @@ def charset_bytes(v, ft) -> bytes:
 def _ascii_upper(s: str) -> str:
     """ASCII-only case fold (the general_ci subset every engine path uses)."""
     return "".join(chr(ord(c) - 32) if "a" <= c <= "z" else c for c in s)
+
+
+def _ascii_lower(s: str) -> str:
+    return "".join(chr(ord(c) + 32) if "A" <= c <= "Z" else c for c in s)
 
 
 def str_prefix_f64(s) -> float:
@@ -177,7 +197,36 @@ class RefEvaluator:
             if e.op in EXTENSION_OPS:
                 from ..sql.extension import EXTENSIONS
 
-                return EXTENSIONS.call(e.op, self._args(e, row))
+                ds = self._args(e, row)
+                if e.op in _BIN_TO_CHAR_OPS:
+                    csl = [(getattr(ae.ft, "charset", "") or "").lower()
+                           for ae in e.args]
+                    target = next((c for c in csl if c not in ("", "binary")),
+                                  "utf8mb4")
+                    codec = _CHARSET_CODEC.get(target, "utf-8")
+                    ds = [
+                        Datum.string(bytes(d.val).decode(codec, "replace"))
+                        if (not d.is_null()
+                            and isinstance(d.val, (bytes, bytearray)))
+                        else d
+                        for d in ds
+                    ]
+                if e.op in _BYTE_SEMANTICS_OPS:
+                    # byte-semantics parity: a gbk/latin1/binary argument
+                    # reaches these host builtins as its COLUMN CHARSET
+                    # bytes, not re-encoded utf-8 (ref:
+                    # builtin_encryption.go: args convert via arg charset).
+                    # Character-unit builtins (INSTR, ELT, LPAD...) keep
+                    # their str arguments — byte offsets would be wrong.
+                    ds = [
+                        Datum.bytes_(charset_bytes(d.val, ae.ft))
+                        if (not d.is_null() and isinstance(d.val, str)
+                            and (getattr(ae.ft, "charset", "") or "").lower()
+                            not in ("", "utf8", "utf8mb4"))
+                        else d
+                        for d, ae in zip(ds, e.args)
+                    ]
+                return EXTENSIONS.call(e.op, ds)
             raise NotImplementedError(f"no reference evaluator for {e.op!r}")
         return method(e, row)
 
@@ -515,10 +564,28 @@ class RefEvaluator:
 
     def _cmp_op(self, e, row, pred):
         a, b = self._args(e, row)
+        a, b = self._bin_coerce(e, a, b)
         c = compare(a, b, ci=self._ci(e), collation=self._coll(e))
         if c is None:
             return Datum.NULL
         return Datum.i64(1 if pred(c) else 0)
+
+    @staticmethod
+    def _bin_coerce(e, a, b):
+        """Binary-vs-string comparison compares the string side's COLUMN
+        CHARSET bytes (ref: pkg/expression/builtin_compare.go with a binary
+        collation operand; hex literals are VARBINARY)."""
+        if len(e.args) < 2:
+            return a, b
+        ka = isinstance(a.val, (bytes, bytearray)) and not a.is_null()
+        kb = isinstance(b.val, (bytes, bytearray)) and not b.is_null()
+        if ka == kb:
+            return a, b
+        if ka and isinstance(b.val, str):
+            b = Datum.bytes_(charset_bytes(b.val, e.args[1].ft))
+        elif kb and isinstance(a.val, str):
+            a = Datum.bytes_(charset_bytes(a.val, e.args[0].ft))
+        return a, b
 
     def _op_eq(self, e, row):
         return self._cmp_op(e, row, lambda c: c == 0)
@@ -829,8 +896,16 @@ class RefEvaluator:
         a, p = self._args(e, row)
         if a.is_null() or p.is_null():
             return Datum.NULL
-        s = a.val if isinstance(a.val, str) else a.val.decode("utf-8", "surrogateescape")
-        pat = p.val if isinstance(p.val, str) else p.val.decode()
+        if isinstance(p.val, (bytes, bytearray)) or isinstance(a.val, (bytes, bytearray)):
+            # binary operand: LIKE matches over the string side's COLUMN
+            # CHARSET bytes, latin1-lifted so the regex machinery stays 1:1
+            # with byte positions (same coercion rule as _bin_coerce)
+            a, p = self._bin_coerce(e, a, p)
+            s = bytes(a.val).decode("latin1") if isinstance(a.val, (bytes, bytearray)) else a.val
+            pat = bytes(p.val).decode("latin1") if isinstance(p.val, (bytes, bytearray)) else p.val
+        else:
+            s = a.val
+            pat = p.val
         if self._ci(e):
             # the SAME per-collation fold weight_bytes uses — '=' and LIKE
             # must agree (types/collate.py fold_text)
@@ -872,10 +947,33 @@ class RefEvaluator:
             return str(v)
         return str(v)
 
+    def _op_convert_using(self, e, row):
+        """CONVERT(expr USING cs) (ref: builtin_string.go builtinConvertSig):
+        USING binary yields the source-charset bytes; otherwise the text
+        round-trips through the target codec with '?' for unencodable."""
+        a, csd = self._args(e, row)
+        if a.is_null():
+            return Datum.NULL
+        cs = str(csd.val).lower()
+        if cs == "binary":
+            return Datum.bytes_(charset_bytes(a.val, e.args[0].ft))
+        codec = _CHARSET_CODEC.get(cs, "utf-8")
+        if isinstance(a.val, (bytes, bytearray)):
+            return Datum.string(bytes(a.val).decode(codec, "replace"))
+        s = self._sval(a)
+        return Datum.string(s.encode(codec, "replace").decode(codec, "replace"))
+
     def _op_concat(self, e, row):
         args = self._args(e, row)
         if any(a.is_null() for a in args):
             return Datum.NULL
+        if any(isinstance(a.val, (bytes, bytearray)) for a in args):
+            # a binary operand makes CONCAT binary: every piece contributes
+            # its COLUMN-CHARSET bytes (ref: builtin_string.go concat with
+            # binary collation propagation)
+            return Datum.bytes_(b"".join(
+                charset_bytes(a.val, ae.ft) for a, ae in zip(args, e.args)
+            ))
         return Datum.string("".join(self._sval(a) for a in args))
 
     def _str1(self, e, row, fn):
@@ -884,10 +982,20 @@ class RefEvaluator:
             return Datum.NULL
         return Datum.string(fn(self._sval(a)))
 
+    @staticmethod
+    def _case_cs(e):
+        return (getattr(e.args[0].ft, "charset", "") or "").lower()
+
     def _op_upper(self, e, row):
+        # gbk-class charsets case-map ASCII only (ref:
+        # pkg/util/charset/encoding_gbk.go ToUpper/ToLower special-casing)
+        if self._case_cs(e) in ("gbk", "gb2312", "gb18030", "big5"):
+            return self._str1(e, row, _ascii_upper)
         return self._str1(e, row, str.upper)
 
     def _op_lower(self, e, row):
+        if self._case_cs(e) in ("gbk", "gb2312", "gb18030", "big5"):
+            return self._str1(e, row, _ascii_lower)
         return self._str1(e, row, str.lower)
 
     def _op_trim(self, e, row):

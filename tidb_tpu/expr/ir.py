@@ -35,6 +35,7 @@ SCALAR_OPS = frozenset(
         "json_extract", "json_unquote", "json_type", "json_valid",
         "json_length", "json_keys", "json_contains", "json_member_of",
         "json_array", "json_object", "json_quote", "regexp", "regexp_like",
+        "convert_using",
         # null handling / control
         "isnull", "ifnull", "if", "case", "coalesce",
         # casts (target class from result ft)

@@ -570,6 +570,18 @@ def group_aggregate(
     """
     if stream and group_bys and not any(d.distinct for d, _ in aggs):
         return _group_aggregate_stream(group_bys, aggs, row_valid, group_capacity, merge)
+    if small_groups and group_bys and small_groups <= 32:
+        from .dense_pallas import (
+            dense_pallas_eligible,
+            group_aggregate_dense_pallas,
+            pallas_mode,
+        )
+
+        mode = pallas_mode()
+        if mode and dense_pallas_eligible(group_bys, aggs, merge):
+            return group_aggregate_dense_pallas(
+                group_bys, aggs, row_valid, small_groups, mode
+            )
     if small_groups and group_bys and _dense_eligible(aggs, merge):
         return _group_aggregate_dense(group_bys, aggs, row_valid, small_groups, merge)
     n = row_valid.shape[0]

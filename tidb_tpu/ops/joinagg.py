@@ -195,17 +195,23 @@ def join_stream_agg(
 # --------------------------------------------------------------------------
 #
 # Measured v5e floors (2026-07-31, tunneled chip): a 2-operand int32
-# lax.sort costs ~6ms at 4M rows while the same sort with an int64 operand
-# costs ~16ms; every scan op (cumsum/cummax) has a ~2-3ms floor; random
-# gathers are ~16ns/row and scatter-add ~100ns/row (useless). The packed
-# path is shaped by those numbers: ONE int32-only sort (key+side packed in
-# one word, int64 payloads bit-split into int32 lanes), match/boundary
-# logic that is pure elementwise neighbor algebra, and per-group extents
-# from ONE batched cumsum + ONE batched reverse cummin (every agg lane
-# shifted to non-negative addends so the cumsum is monotone). Outputs live
-# at run-boundary positions of the sorted [nb+np] space under a validity
+# lax.sort costs ~6ms at 4M rows while adding ONE int64 operand takes it
+# to ~16ms and a 3rd int32 operand to ~17.5ms; every scan op has a ~2-3ms
+# floor; random gathers are ~16ns/row and scatter-add ~100ns/row
+# (useless). The packed path is shaped by those numbers: ONE int32-only
+# sort (key+side packed in one word, each agg argument as a SINGLE int32
+# lane), match/boundary logic that is pure elementwise neighbor algebra,
+# and per-group extents from cumsum + reverse-cummin pairs whose addends
+# are statically biased by +2^31 (int32 lanes make the monotonicity
+# precondition free — no runtime shift/bound reduce at all, the [2A+1, N]
+# min-reduce of the old int64 variant is gone). Outputs live at
+# run-boundary positions of the sorted [nb+np] space under a validity
 # mask — no group capacity exists, so the overflow-retry ladder never
 # fires for group count.
+#
+# Values outside int32 raise the join-overflow flag and the driver lands
+# on the general sort kernel — the same contract key ranges over 2^30
+# always had (an opportunistic fast path, never a semantics change).
 
 _PACKED_AGGS = frozenset({"sum", "count", "avg"})
 _PK_RANGE = 1 << 30  # packed (key - kmin) must fit 30 bits (plus side bit)
@@ -213,18 +219,9 @@ _PK_RANGE = 1 << 30  # packed (key - kmin) must fit 30 bits (plus side bit)
 # (odd, = _PIN_HAY|1) pins keep is_hay = ~(pk&1) true even for pins
 _PIN_HAY = jnp.int32((1 << 31) - 4)
 _PIN_PROBE = jnp.int32((1 << 31) - 3)
-
-
-def _split_lanes(v):
-    """int64 -> two int32 sort payload lanes (bit material only)."""
-    v = v.astype(jnp.int64)
-    lo = (v & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32).astype(jnp.int32)
-    hi = (v >> 32).astype(jnp.int32)
-    return hi, lo
-
-
-def _join_lanes(hi, lo):
-    return (hi.astype(jnp.int64) << 32) | (lo.astype(jnp.int64) & jnp.int64(0xFFFFFFFF))
+I32_SHIFT = 1 << 31  # static non-negativity bias per addend (plain int:
+# a module-level jnp expression would leak a tracer when this module is
+# first imported inside a jit trace — the builder imports it lazily)
 
 
 def _pack_keys(both, ok, side):
@@ -257,20 +254,26 @@ def membership_chain(outer_key, outer_ok, inner_key, inner_ok, payload):
     inner-key sort order, which packed_join_groupsum accepts as-is, so NO
     inverse permutation sort is ever paid. payload: int64 per-outer-row
     value carried through (the next join's key); inner slots come back
-    with ok_out False."""
+    with ok_out False. Payloads outside int32 overflow (-> general
+    kernel), keeping the sort at TWO int32 operands."""
     no, nc = outer_key.shape[0], inner_key.shape[0]
     both = jnp.concatenate([inner_key.astype(jnp.int64), outer_key.astype(jnp.int64)])
     ok = jnp.concatenate([inner_ok, outer_ok])
     side = jnp.concatenate([jnp.zeros(nc, jnp.int32), jnp.ones(no, jnp.int32)])
     pk, _, overflow = _pack_keys(both, ok, side)
-    pay = jnp.concatenate([jnp.zeros(nc, jnp.int64), payload.astype(jnp.int64)])
-    phi, plo = _split_lanes(pay)
-    spk, shi, slo = jax.lax.sort((pk, phi, plo), num_keys=1)
+    pay32 = payload.astype(jnp.int32)
+    wbad = outer_ok & (payload.astype(jnp.int64) != pay32.astype(jnp.int64))
+    pay = jnp.concatenate([jnp.zeros(nc, jnp.int32), pay32])
+    spk, spay = jax.lax.sort((pk, pay), num_keys=1)
     is_inner = (spk & 1) == 0
     is_real = spk < _PIN_HAY
     prev_pk = jnp.concatenate([jnp.full(1, -2, jnp.int32), spk[:-1]])
-    # duplicate usable inner keys: adjacent equal pk on the inner side
-    overflow = overflow | jnp.any(is_inner & is_real & (spk == prev_pk))
+    # duplicate usable inner keys (adjacent equal pk on the inner side) and
+    # payload width, batched into ONE any() (reduce floors — see below)
+    overflow = overflow | jnp.any(jnp.stack([
+        is_inner & is_real & (spk == prev_pk),
+        jnp.concatenate([jnp.zeros(nc, bool), wbad]),
+    ]))
     keydiff = (spk | jnp.int32(1)) != (prev_pk | jnp.int32(1))
     # run-head flag ("head is a usable inner row") packed into the LSB of
     # a strictly increasing head marker, so a forward cummax broadcasts
@@ -284,12 +287,12 @@ def membership_chain(outer_key, outer_ok, inner_key, inner_ok, payload):
     )
     head = jax.lax.cummax(marker)
     ok_out = (~is_inner) & is_real & ((head & 1) == 1)
-    return _join_lanes(shi, slo), ok_out, overflow
+    return spay.astype(jnp.int64), ok_out, overflow
 
 
 def packed_join_groupsum(hay_key, hay_ok, probe_key, probe_ok, aggs):
     """Unique-build inner join + GROUP BY probe key (int class), aggregates
-    restricted to sum/count/avg over int/decimal args.
+    restricted to sum/count/avg over int/decimal args that fit int32.
 
     aggs: [(AggDesc, [arg CompVals in probe row order])]. Returns
     (states per agg, group_valid, key_out CompVal, overflow, join_rows);
@@ -297,8 +300,7 @@ def packed_join_groupsum(hay_key, hay_ok, probe_key, probe_ok, aggs):
     each group's first probe row, group_valid masks exactly those rows.
     overflow (-> driver's join-overflow retry, landing on the general
     kernel) fires on: key range over 2^30, duplicate usable hay keys
-    (unique-build violation), or an agg lane whose shifted sum could reach
-    2^63 (the monotone-cumsum precondition)."""
+    (unique-build violation), or an agg argument outside int32."""
     nb, np_ = hay_key.shape[0], probe_key.value.shape[0]
     n = nb + np_
     both = jnp.concatenate([hay_key.astype(jnp.int64), probe_key.value.astype(jnp.int64)])
@@ -306,22 +308,28 @@ def packed_join_groupsum(hay_key, hay_ok, probe_key, probe_ok, aggs):
     side = jnp.concatenate([jnp.zeros(nb, jnp.int32), jnp.ones(np_, jnp.int32)])
     pk, usable_min, overflow = _pack_keys(both, ok, side)
 
-    # one int32 sort: packed key + bit-split value lanes + null-bit word.
-    # NOT NULL args (FieldType flag) skip the null machinery entirely:
-    # their non-null mask IS the contributing mask (lane 0).
+    # one int32 sort: packed key + ONE int32 lane per distinct agg argument
+    # (nulls pre-masked to 0 so only COUNT needs the null-bit word).
+    # NOT NULL args (FieldType flag) skip the null machinery entirely.
     from ..types import Flag
 
     lanes: list = []
-    lane_of: dict = {}
+    combo_of: dict = {}
     nullbit_of: dict = {}
     nbits: list = []
+    width_bad = jnp.zeros(np_, bool)  # batched into the ONE post-sort reduce
     for desc, avs in aggs:
         for a in avs:
-            if id(a.value) not in lane_of:
-                lane_of[id(a.value)] = len(lanes)
-                lanes.append(_split_lanes(jnp.concatenate([
-                    jnp.zeros(nb, jnp.int64), a.value.astype(jnp.int64),
-                ])))
+            key = (id(a.value), id(a.null))
+            if key not in combo_of:
+                combo_of[key] = len(lanes)
+                v32 = a.value.astype(jnp.int32)
+                width_bad = width_bad | (
+                    probe_ok & ~a.null
+                    & (a.value.astype(jnp.int64) != v32.astype(jnp.int64))
+                )
+                vm = jnp.where(a.null, jnp.int32(0), v32)
+                lanes.append(jnp.concatenate([jnp.zeros(nb, jnp.int32), vm]))
             if bool(a.ft.flag & Flag.NotNull):
                 nullbit_of[id(a.null)] = -1  # alias of the contrib mask
             elif id(a.null) not in nullbit_of:
@@ -330,80 +338,60 @@ def packed_join_groupsum(hay_key, hay_ok, probe_key, probe_ok, aggs):
     nword = jnp.zeros(n, jnp.uint8)
     for k, b in enumerate(nbits):
         nword = nword | (b.astype(jnp.uint8) << k)
-    ops = [pk] + [x for hl in lanes for x in hl] + ([nword] if nbits else [])
+    ops = [pk] + lanes + ([nword] if nbits else [])
     sorted_ops = jax.lax.sort(tuple(ops), num_keys=1)
     spk = sorted_ops[0]
-    lanes_s = [(sorted_ops[1 + 2 * i], sorted_ops[2 + 2 * i]) for i in range(len(lanes))]
+    lanes_s = list(sorted_ops[1 : 1 + len(lanes)])
     nw_s = sorted_ops[-1] if nbits else None
 
     is_hay = (spk & 1) == 0
     is_real = spk < _PIN_HAY
     prev_pk = jnp.concatenate([jnp.full(1, -2, jnp.int32), spk[:-1]])
     dup_hay = is_hay & is_real & (spk == prev_pk)
+    # ONE batched any() for every per-row overflow condition (each
+    # standalone reduce costs a ~1.5-3ms dispatch floor on this platform)
+    overflow = overflow | jnp.any(
+        jnp.stack([dup_hay, jnp.concatenate([jnp.zeros(nb, bool), width_bad])])
+    )
     keydiff = (spk | jnp.int32(1)) != (prev_pk | jnp.int32(1))
     # first probe row of its key run (prev is hay, or a different key);
-    # matched iff prev row is the hay of MY key — all neighbor algebra
+    # matched iff prev row is the hay of MY key - all neighbor algebra
     pbnd = (~is_hay) & is_real & (keydiff | ((prev_pk & 1) == 0))
     matched = pbnd & (prev_pk == spk - 1)
     emark = jnp.concatenate([keydiff[1:], jnp.ones(1, bool)])
-    contrib = (~is_hay) & is_real
 
-    # batched extents: lane 0 counts contributing rows; one lane per
-    # distinct (value, null-mask) combo plus (when nullable) its non-null
-    # count. ALL per-lane mins, the addend-bound maxes, and the dup-hay
-    # any() ride ONE [2A+1, N] min-reduce (max via negation).
-    raw = [contrib.astype(jnp.int64)]
-    combo_ix: dict = {}
-    cnt_ix: dict = {}
-    for desc, avs in aggs:
-        for a in avs:
-            key = (lane_of[id(a.value)], nullbit_of[id(a.null)])
-            if key in combo_ix:
-                continue
-            hi, lo = lanes_s[key[0]]
-            v = _join_lanes(hi, lo)
-            if key[1] < 0:
-                nn = contrib
-            else:
-                nn = contrib & (((nw_s >> key[1]) & 1) == 0)
-            combo_ix[key] = len(raw)
-            raw.append(jnp.where(nn, v, jnp.int64(0)))
-            if key[1] < 0:
-                cnt_ix[key] = 0  # non-null count == contributing count
-            else:
-                cnt_ix[key] = len(raw)
-                raw.append(nn.astype(jnp.int64))
-
-    rawstack = jnp.stack(raw, 0)  # [A, N]
-    dup_lane = jnp.where(dup_hay, jnp.int64(-(2**61)), jnp.int64(0))
-    red = jnp.min(
-        jnp.concatenate([rawstack, -rawstack, dup_lane[None, :]], axis=0), axis=1
-    )
-    A = len(raw)
-    mins, maxs = red[:A], -red[A : 2 * A]
-    overflow = overflow | (red[2 * A] < jnp.int64(-(2**60)))
-    shifts = jnp.minimum(mins, 0)
-    # monotone precondition: sum of shifted addends must stay below 2^63
-    overflow = overflow | jnp.any(
-        (maxs - shifts) > jnp.int64((1 << 62) // max(n, 1))
-    )
-    # extents as PER-LANE 1-D scans: a [A, N] axis-1 scan lowers ~6x worse
-    # than A separate 1-D scans on this backend (measured 18.5ms vs 3ms at
-    # 4.7M rows) — and the row count needs no value lane at all: the run
-    # end POSITION comes from one int32 reverse cummin and positions give
-    # the count directly
-    big = jnp.int64(0x7FFFFFFFFFFFFFFF)
+    # run extents: the run end POSITION comes from one int32 reverse
+    # cummin and positions give the contributing count directly
     iota = jnp.arange(n, dtype=jnp.int32)
     end_pos = jax.lax.cummin(
         jnp.where(emark, iota, jnp.int32(n)), reverse=True
     )
     extent_cnt = (end_pos - iota + 1).astype(jnp.int64)  # rows self..run end
-    extent = [extent_cnt]
-    for li in range(1, A):
-        v = rawstack[li] - shifts[li]
-        c = jnp.cumsum(v)
+    big = jnp.int64(0x7FFFFFFFFFFFFFFF)
+
+    def _extent(addends):
+        """Sum of `addends` (int64, non-negative) over [self..run end]."""
+        c = jnp.cumsum(addends)
         ev = jax.lax.cummin(jnp.where(emark, c, big), reverse=True)
-        extent.append(ev - (c - v))
+        return ev - (c - addends)
+
+    combo_sum: dict = {}
+    combo_nn: dict = {}
+    for key, li in combo_of.items():
+        shifted = lanes_s[li].astype(jnp.int64) + I32_SHIFT
+        # every row in the extent carried (vm + 2^31), null rows as 0+2^31
+        combo_sum[key] = _extent(shifted) - extent_cnt * I32_SHIFT
+    for desc, avs in aggs:
+        for a in avs:
+            nb_ = nullbit_of[id(a.null)]
+            key = (id(a.value), id(a.null))
+            if key in combo_nn:
+                continue
+            if nb_ < 0:
+                combo_nn[key] = extent_cnt
+            else:
+                nn = (((nw_s >> nb_) & 1) == 0).astype(jnp.int64)
+                combo_nn[key] = _extent(nn)
 
     group_valid = pbnd & matched
     zeros = jnp.zeros(n, bool)
@@ -411,19 +399,15 @@ def packed_join_groupsum(hay_key, hay_ok, probe_key, probe_ok, aggs):
     for desc, avs in aggs:
         if desc.name == "count":
             if avs:
-                k = (lane_of[id(avs[0].value)], nullbit_of[id(avs[0].null)])
-                cnt = extent[cnt_ix[k]]
+                cnt = combo_nn[(id(avs[0].value), id(avs[0].null))]
             else:
                 cnt = extent_cnt
             states.append([(cnt, zeros)])
             continue
         a = avs[0]
-        k = (lane_of[id(a.value)], nullbit_of[id(a.null)])
-        ci = combo_ix[k]
-        cnt_nn = extent[cnt_ix[k]]
-        # unwind the non-negativity shift: every row in the extent (null or
-        # not) carried (v_masked - shift)
-        s = extent[ci] + shifts[ci] * extent_cnt
+        key = (id(a.value), id(a.null))
+        s = combo_sum[key]
+        cnt_nn = combo_nn[key]
         empty = cnt_nn == 0
         if desc.name == "sum":
             states.append([(s, empty)])

@@ -1019,10 +1019,10 @@ class Parser:
         if kw == "CAST":
             self.expect_kw("AS")
             ts = self.type_spec()
-        elif self.eat_kw("USING"):  # CONVERT(expr USING charset): identity
-            self.ident()
+        elif self.eat_kw("USING"):  # CONVERT(expr USING charset)
+            cs = self.ident().lower()
             self.expect_op(")")
-            return e
+            return A.FuncCall("convert_using", [e, A.Literal(cs, "str")])
         else:  # CONVERT(expr, type)
             self.expect_op(",")
             ts = self.type_spec()
@@ -2388,11 +2388,16 @@ class Parser:
                 self.next()
             return A.SetStmt([])
         if self.eat_kw("NAMES"):
-            cs = self.next().text
-            out = [("session", "character_set_client", A.Literal(cs, "str"))]
+            cs = self.next().text.lower()
+            if cs == "default":
+                cs = "utf8mb4"
+            coll = ""
             if self.eat_kw("COLLATE"):
-                self.next()
-            return A.SetStmt(out)
+                coll = self.next().text.lower()
+            # expanded by the session (pkg/executor/set.go setCharset needs
+            # @@default_collation_for_utf8mb4, which the parser can't read)
+            return A.SetStmt([("session", "__set_names__",
+                               A.Literal(f"{cs}|{coll}", "str"))])
         assigns = []
         while True:
             scope = "session"

@@ -79,6 +79,36 @@ def _sha2(v, bits):
     return getattr(hashlib, algo)(_as_bytes(v)).hexdigest()
 
 
+# @@block_encryption_mode (ref: builtin_encryption.go deriveKeyMySQL +
+# mode dispatch). Module-level because extension builtins get plain
+# values; Session.__init__ resets it and SET updates it.
+BLOCK_ENCRYPTION_MODE = "aes-128-ecb"
+
+
+def _aes_mode(iv):
+    """-> (key_size, mode_factory) per @@block_encryption_mode; ECB ignores
+    the iv argument (MySQL warns), CBC/OFB/CFB require a 16-byte iv."""
+    try:
+        from cryptography.hazmat.primitives.ciphers import modes  # type: ignore
+    except ImportError:
+        return None
+    parts = BLOCK_ENCRYPTION_MODE.lower().split("-")
+    bits = int(parts[1]) if len(parts) == 3 and parts[1].isdigit() else 128
+    mname = parts[2] if len(parts) == 3 else "ecb"
+    if mname == "ecb":
+        return bits // 8, modes.ECB(), False
+    if iv is None or len(_as_bytes(iv)) < 16:
+        raise ValueError("Incorrect initialization vector")
+    ivb = _as_bytes(iv)[:16]
+    if mname == "cbc":
+        return bits // 8, modes.CBC(ivb), False
+    # OFB/CFB are STREAM modes: no PKCS padding, any ciphertext length
+    fac = {"ofb": modes.OFB, "cfb": getattr(modes, "CFB128", modes.CFB)}.get(mname)
+    if fac is None:
+        return bits // 8, modes.ECB(), False
+    return bits // 8, fac(ivb), True
+
+
 def _mysql_aes_key(key: bytes, size: int = 16) -> bytes:
     out = bytearray(size)
     for i, b in enumerate(key):
@@ -86,7 +116,7 @@ def _mysql_aes_key(key: bytes, size: int = 16) -> bytes:
     return bytes(out)
 
 
-def _aes_encrypt(v, key):
+def _aes_encrypt(v, key, iv=None):
     if v is None or key is None:
         return None
     try:
@@ -94,13 +124,18 @@ def _aes_encrypt(v, key):
     except ImportError:
         return None  # no AES backend in this image: NULL like a bad key
     data = _as_bytes(v)
-    pad = 16 - len(data) % 16
-    data += bytes([pad]) * pad
-    enc = Cipher(algorithms.AES(_mysql_aes_key(_as_bytes(key))), modes.ECB()).encryptor()
+    try:
+        ks, mode, stream = _aes_mode(iv)
+    except ValueError:
+        return None
+    if not stream:
+        pad = 16 - len(data) % 16
+        data += bytes([pad]) * pad
+    enc = Cipher(algorithms.AES(_mysql_aes_key(_as_bytes(key), ks)), mode).encryptor()
     return enc.update(data) + enc.finalize()
 
 
-def _aes_decrypt(v, key):
+def _aes_decrypt(v, key, iv=None):
     if v is None or key is None:
         return None
     try:
@@ -108,11 +143,17 @@ def _aes_decrypt(v, key):
     except ImportError:
         return None
     raw = _as_bytes(v)
-    if not raw or len(raw) % 16:
+    try:
+        ks, mode, stream = _aes_mode(iv)
+    except ValueError:
         return None
-    dec = Cipher(algorithms.AES(_mysql_aes_key(_as_bytes(key))), modes.ECB()).decryptor()
+    if not raw or (not stream and len(raw) % 16):
+        return None
+    dec = Cipher(algorithms.AES(_mysql_aes_key(_as_bytes(key), ks)), mode).decryptor()
     try:
         out = dec.update(raw) + dec.finalize()
+        if stream:
+            return out
         pad = out[-1]
         if not 1 <= pad <= 16:
             return None
@@ -159,7 +200,12 @@ def _truncate(x, d):
 def _insert_fn(s, pos, ln, new):
     if s is None or pos is None or ln is None or new is None:
         return None
-    s, new = _as_str(s), _as_str(new)
+    if isinstance(s, (bytes, bytearray)) or isinstance(new, (bytes, bytearray)):
+        # a binary operand makes the whole expression binary (byte units;
+        # ref: builtin_string.go INSERT with binary collation)
+        s, new = _as_bytes(s), _as_bytes(new)
+    else:
+        s, new = _as_str(s), _as_str(new)
     pos, ln = int(_as_num(pos)), int(_as_num(ln))
     if pos < 1 or pos > len(s):
         return s
@@ -171,7 +217,10 @@ def _insert_fn(s, pos, ln, new):
 def _pad(s, ln, p, left: bool):
     if s is None or ln is None or p is None:
         return None
-    s, p = _as_str(s), _as_str(p)
+    if isinstance(s, (bytes, bytearray)) or isinstance(p, (bytes, bytearray)):
+        s, p = _as_bytes(s), _as_bytes(p)
+    else:
+        s, p = _as_str(s), _as_str(p)
     ln = int(_as_num(ln))
     if ln < 0:
         return None

@@ -90,13 +90,18 @@ def field_type_from_spec(ts: A.TypeSpec, not_null: bool = False) -> FieldType:
         return mk(tuple(ts.elems), notnull=not_null)
     if name in ("char", "varchar", "binary", "varbinary", "text", "tinytext", "mediumtext", "longtext",
                 "blob", "tinyblob", "mediumblob", "longblob"):
-        flen = ts.length if ts.length > 0 else 255
+        flen = ts.length if ts.length > 0 else (1 if name == "binary" else 255)
         ft = new_varchar(flen)
         # byte-semantics functions (LENGTH/HEX/ASCII) consult the declared
         # charset (ref: types.FieldType.GetCharset feeding builtin_string);
         # binary types carry "binary" + the BINARY(n) zero-pad width
         if name in ("binary", "varbinary", "blob", "tinyblob", "mediumblob", "longblob"):
             ft.charset = "binary"
+            if name == "binary":
+                # fixed BINARY(n): TypeCode.String marks the zero-pad width
+                # contract (planner._coerce_datum pads on write; ref:
+                # pkg/table/column.go ProduceStrWithSpecifiedTp)
+                ft.tp = TypeCode.String
         elif ts.charset:
             ft.charset = ts.charset.lower()
         if ts.collate:

@@ -23,7 +23,7 @@ from dataclasses import dataclass, field
 
 from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, IndexScan, Join, Limit, Projection, Selection, Sort, TableScan, TopN
 from ..expr.agg import AGG_FUNCS, AggDesc
-from ..expr.ir import Expr, col, func, lit
+from ..expr.ir import Expr, col, const, func, lit
 from ..parser import ast as A
 from ..types import Datum, DatumKind, FieldType, Flag, MyDecimal, MyTime, TypeCode, new_datetime, new_decimal, new_double, new_longlong, new_varchar
 from .catalog import Catalog, CatalogError, TableMeta, field_type_from_spec
@@ -473,6 +473,22 @@ class _Lowerer:
                 d = func("cast", new_datetime(), d)
             return func(name, d.ft.clone(), d, nexpr, lit(unit, new_varchar(8)))
         args = [rec(a) for a in n.args]
+        if name == "convert_using":
+            # CONVERT(expr USING cs): value re-encoded into cs at eval time
+            # (ref: pkg/expression/builtin_string.go builtinConvertSig);
+            # the result type carries the target charset so downstream
+            # byte-semantics functions (HEX, LENGTH, MD5...) see cs bytes
+            cs = n.args[1].value if hasattr(n.args[1], "value") else "binary"
+            a = args[0]
+            flen = a.ft.flen if a.ft.flen and a.ft.flen > 0 else 255
+            ft = new_varchar(flen)
+            ft.charset = str(cs)
+            if str(cs) == "binary":
+                from ..types import Collation, Flag
+
+                ft.collate = Collation.Binary
+                ft.flag |= Flag.Binary
+            return func("convert_using", ft, *args)
         if name == "datediff":
             a, b = args
             # string-literal dates re-parse as datetime consts (either side)
@@ -693,6 +709,14 @@ def _coerce_datum(d: Datum, ft: FieldType) -> Datum:
             return d
         return Datum.time(MyTime.parse(str(d.val), max(ft.decimal, 0)))
     if et == "string":
+        if ft.tp == TypeCode.String and ft.charset == "binary" and ft.flen > 0:
+            # BINARY(n) stores zero-padded to the declared width (ref:
+            # pkg/table/column.go CastValue -> ProduceStrWithSpecifiedTp)
+            b = d.val if isinstance(d.val, (bytes, bytearray)) else str(d.val).encode("utf-8")
+            b = bytes(b)
+            if len(b) > ft.flen:
+                raise PlanError(f"Data too long for column (max {ft.flen})")
+            return Datum.bytes_(b.ljust(ft.flen, b"\0"))
         if d.kind in (DatumKind.String, DatumKind.Bytes):
             return d
         return Datum.string(str(d.val))
@@ -760,7 +784,16 @@ def _lower_literal(n: A.Literal) -> Expr:
     if n.kind == "str":
         return lit(str(n.value), new_varchar(max(len(str(n.value)), 1)))
     if n.kind == "hex":
-        return lit(bytes(n.value).decode("latin1"), new_varchar(max(len(n.value), 1)))
+        # hex literals are VARBINARY values (ref: pkg/parser/ast/expressions.go
+        # hexadecimal literal -> binary collation), NOT latin1 text: byte
+        # semantics must survive into comparisons, CONCAT and INSERT targets
+        from ..types import Collation, Flag
+
+        ft = new_varchar(max(len(n.value), 1))
+        ft.charset = "binary"
+        ft.collate = Collation.Binary
+        ft.flag |= Flag.Binary
+        return const(Datum.bytes_(bytes(n.value)), ft)
     raise PlanError(f"literal kind {n.kind}")
 
 

@@ -244,8 +244,12 @@ class Session:
 
     def __init__(self, store: TPUStore | None = None, catalog: Catalog | None = None, config=None):
         from ..config import Config
+        from . import builtins_host
         from .sysvar import SysVarStore
 
+        # module-level because extension builtins receive plain values; a
+        # fresh session must not inherit a previous session's SET
+        builtins_host.BLOCK_ENCRYPTION_MODE = "aes-128-ecb"
         self.store = store or TPUStore()
         if catalog is None and store is not None:
             # reopening an existing store: recover the schema from the
@@ -736,6 +740,32 @@ class Session:
             for scope, name, val in stmt.assignments:
                 if not isinstance(val, A.Literal):
                     continue
+                if name == "__set_names__":
+                    # SET NAMES cs [COLLATE c] (ref: pkg/executor/set.go
+                    # setCharset): client/connection/results take cs;
+                    # collation_connection takes the explicit COLLATE, the
+                    # default_collation_for_utf8mb4 override, or the
+                    # charset default (TiDB: *_bin for utf8/utf8mb4,
+                    # collate.GetDefaultCollation)
+                    cs, _, coll = str(val.value).partition("|")
+                    if not coll:
+                        if cs == "utf8mb4":
+                            try:
+                                coll = self.sysvars.get("default_collation_for_utf8mb4")
+                            except Exception:
+                                coll = ""
+                        coll = coll or {
+                            "utf8mb4": "utf8mb4_bin", "utf8": "utf8_bin",
+                            "gbk": "gbk_chinese_ci",
+                            "gb18030": "gb18030_chinese_ci",
+                            "latin1": "latin1_bin", "ascii": "ascii_bin",
+                            "binary": "binary",
+                        }.get(cs, cs + "_bin")
+                    for v in ("character_set_client", "character_set_connection",
+                              "character_set_results"):
+                        self.sysvars.set(v, cs)
+                    self.sysvars.set("collation_connection", coll)
+                    continue
                 if scope == "user":
                     self.user_vars[name.lower()] = str(val.value)
                 else:
@@ -749,6 +779,10 @@ class Session:
                         self.sysvars.set(name, str(val.value))
                     except SysVarError as exc:
                         raise SQLError(str(exc)) from exc
+                    if name.lower() == "block_encryption_mode":
+                        from . import builtins_host
+
+                        builtins_host.BLOCK_ENCRYPTION_MODE = str(val.value)
             return Result()
         if isinstance(stmt, A.UseStmt):
             db = stmt.db.lower()

@@ -147,6 +147,24 @@ def _datum_text(d) -> str:
     return str(v)
 
 
+def _results_recode(text: str, session) -> str:
+    """Model character_set_results: the server would encode result text into
+    the client charset; mysqltest recorded those BYTES into the .result file,
+    which this runner reads back as UTF-8-with-replacement. Reproducing the
+    same transform makes gbk-session recordings comparable."""
+    try:
+        cs = session.sysvars.get("character_set_results").lower()
+    except Exception:
+        return text
+    if cs in ("", "utf8", "utf8mb4", "binary"):
+        return text
+    codec = {"gbk": "gbk", "gb2312": "gb2312", "gb18030": "gb18030",
+             "latin1": "latin-1", "ascii": "ascii", "big5": "big5"}.get(cs)
+    if codec is None:
+        return text
+    return text.encode(codec, "replace").decode("utf-8", "replace")
+
+
 def execute_one(session, sql: str):
     """-> (header_line, row_lines) or raises."""
     res = session.execute(sql)
@@ -155,7 +173,7 @@ def execute_one(session, sql: str):
     header = "\t".join(res.columns)
     rows = []
     for r in res.rows:
-        text = "\t".join(_datum_text(d) for d in r)
+        text = _results_recode("\t".join(_datum_text(d) for d in r), session)
         # cells may embed newlines (SHOW CREATE TABLE): mysqltest prints
         # them literally, so the recording has them as separate lines
         rows.extend(text.split("\n"))
@@ -166,6 +184,9 @@ UNSUPPORTED_PAT = re.compile(
     r"not supported|unsupported|unknown system variable|no such|not implemented",
     re.I,
 )
+
+
+SAMPLES_CAP = 8
 
 
 def run_file(name: str, test_dir: str = TEST_DIR, result_dir: str = RESULT_DIR):
@@ -188,6 +209,7 @@ def run_file(name: str, test_dir: str = TEST_DIR, result_dir: str = RESULT_DIR):
     counts = {"match": 0, "mismatch": 0, "explain_diff": 0, "error_ok": 0,
               "unsupported": 0, "exec_error": 0, "desync": 0}
     samples: list = []
+    cap = SAMPLES_CAP
     cur = 0  # cursor into rlines
 
     def find_echo(stmt_lines):
@@ -279,7 +301,7 @@ def run_file(name: str, test_dir: str = TEST_DIR, result_dir: str = RESULT_DIR):
                 counts["explain_diff"] += 1
             else:
                 counts["mismatch"] += 1
-                if len(samples) < 8:
+                if len(samples) < cap:
                     samples.append({"sql": sql[:120], "got": got[:3], "want": want[:3]})
                 # leave `cur` at the echo point; the next find_echo scans
                 # forward past this statement's recorded output
@@ -294,7 +316,7 @@ def run_file(name: str, test_dir: str = TEST_DIR, result_dir: str = RESULT_DIR):
                 counts["unsupported"] += 1
             else:
                 counts["exec_error"] += 1
-                if len(samples) < 8:
+                if len(samples) < cap:
                     samples.append({"sql": sql[:120], "error": str(exc)[:160]})
     return counts, samples
 
