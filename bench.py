@@ -297,7 +297,7 @@ LOOP_K = {
     "scalar_agg": 8192,
     "q1": 256,
     "topn": 512,
-    "q3": 32,
+    "q3": 128,
 }
 CPU_LOOP_K = 32  # CPU dispatch is ~us; keep the baseline pass quick
 

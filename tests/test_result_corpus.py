@@ -11,11 +11,12 @@ import sys
 import pytest
 
 CORPUS = "/root/reference/tests/integrationtest/t"
-# measured 2026-07-31 (round 5): overall data_match_rate 0.7938 over
-# 2191 statements / 37 files (charset/binary-semantics package: gbk byte
-# functions, BINARY(n) padding, hex literals as VARBINARY, SET NAMES,
-# CONVERT USING). Raise when it improves, never lower.
-RATCHET_DATA = 0.78
+# measured 2026-07-31 (round 5): overall data_match_rate 0.8292 over
+# 2191 statements / 37 files (charset/binary package, expression-index
+# degradation, FROM DUAL, mysql.* bootstrap, row-expression IN lists and
+# (a,b) != ALL NAAJ forms; r5 VERDICT #2 target was >= 0.80). Raise when
+# it improves, never lower.
+RATCHET_DATA = 0.82
 RATCHET_EXEC = 2100  # executed statements (desync guard)
 
 # per-file floors for the former pinned set (these carried the round-4

@@ -587,7 +587,12 @@ class ProgramCache:
         if isinstance(capacities, int):
             capacities = (capacities,)
         capacities = tuple(capacities)
-        key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins)
+        from ..ops.dense_pallas import pallas_mode
+
+        # pallas mode is read at TRACE time (env + backend): a program
+        # traced under one mode must not serve another (mismatched
+        # buffer counts at execution)
+        key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, pallas_mode())
         prog = self._cache.get(key)
         if prog is None:
             from ..util import metrics

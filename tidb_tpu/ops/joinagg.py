@@ -225,24 +225,23 @@ I32_SHIFT = 1 << 31  # static non-negativity bias per addend (plain int:
 
 
 def _pack_keys(both, ok, side):
-    """(key - usable_min) << 1 | side as int32; unusable rows pin above all
-    real keys. Returns (pk, usable_min, overflow_on_range). ONE batched
-    reduce serves both the min and the range check (max via negation —
-    every full-array reduce op costs a ~1.5-3ms dispatch floor on the
-    tunneled v5e, so reduces are rationed as strictly as sorts)."""
-    masked = jnp.where(ok, both, jnp.int64(2**61))
-    mm = jnp.min(jnp.stack([masked, jnp.where(ok, -both, jnp.int64(2**61))]), axis=1)
-    usable_min, usable_max = mm[0], -mm[1]
-    # -2: rel values 2^30-2 / 2^30-1 would pack onto or above the pin
-    # sentinels and silently misclassify real rows as pins
-    overflow = (usable_max - usable_min) >= (_PK_RANGE - 2)
-    rel = jnp.clip(both - usable_min, 0, _PK_RANGE - 1)
+    """key << 1 | side as int32; unusable rows pin above all real keys.
+    Returns (pk, bad_lane). Keys are packed at their ABSOLUTE value (no
+    min-rebase): the old rebasing min-reduce sat on the critical path
+    BEFORE the sort (a ~3ms serial dependency on the tunneled v5e), while
+    the |key| < 2^30-2 width check is pure elementwise — out-of-range
+    usable keys pin AND mark the bad lane, which the caller folds into
+    its one batched overflow any() (-> the general-kernel retry, exactly
+    as rebased range overflow always did)."""
+    k32 = both.astype(jnp.int32)
+    in_range = (both == k32.astype(jnp.int64)) & (jnp.abs(k32) < (_PK_RANGE - 2))
+    usable = ok & in_range
     pk = jnp.where(
-        ok,
-        ((rel.astype(jnp.int32)) << 1) | side,
+        usable,
+        (k32 << 1) | side,
         jnp.where(side == 0, _PIN_HAY, _PIN_PROBE),
     )
-    return pk, usable_min, overflow
+    return pk, ok & ~in_range
 
 
 def membership_chain(outer_key, outer_ok, inner_key, inner_ok, payload):
@@ -260,19 +259,33 @@ def membership_chain(outer_key, outer_ok, inner_key, inner_ok, payload):
     both = jnp.concatenate([inner_key.astype(jnp.int64), outer_key.astype(jnp.int64)])
     ok = jnp.concatenate([inner_ok, outer_ok])
     side = jnp.concatenate([jnp.zeros(nc, jnp.int32), jnp.ones(no, jnp.int32)])
-    pk, _, overflow = _pack_keys(both, ok, side)
+    pk, kbad = _pack_keys(both, ok, side)
     pay32 = payload.astype(jnp.int32)
-    wbad = outer_ok & (payload.astype(jnp.int64) != pay32.astype(jnp.int64))
+    wbad = (outer_ok & (payload.astype(jnp.int64) != pay32.astype(jnp.int64))) | kbad[nc:]
+    wbad = jnp.concatenate([kbad[:nc], wbad])
     pay = jnp.concatenate([jnp.zeros(nc, jnp.int32), pay32])
     spk, spay = jax.lax.sort((pk, pay), num_keys=1)
+
+    from .dense_pallas import pallas_mode
+
+    mode = pallas_mode()
+    if mode:
+        from .joinscan import membership_segscan
+
+        ok_out, overflow = membership_segscan(
+            spk, wbad, interpret=(mode == "interpret")
+        )
+        return spay.astype(jnp.int64), ok_out, overflow
     is_inner = (spk & 1) == 0
     is_real = spk < _PIN_HAY
-    prev_pk = jnp.concatenate([jnp.full(1, -2, jnp.int32), spk[:-1]])
+    # sentinel below every real pk (|key| < 2^30-2 keeps pk > INT32_MIN+4;
+    # -2 collided with real key -1 under no-rebase packing)
+    prev_pk = jnp.concatenate([jnp.full(1, -(2**31), jnp.int32), spk[:-1]])
     # duplicate usable inner keys (adjacent equal pk on the inner side) and
     # payload width, batched into ONE any() (reduce floors — see below)
-    overflow = overflow | jnp.any(jnp.stack([
+    overflow = jnp.any(jnp.stack([
         is_inner & is_real & (spk == prev_pk),
-        jnp.concatenate([jnp.zeros(nc, bool), wbad]),
+        wbad,
     ]))
     keydiff = (spk | jnp.int32(1)) != (prev_pk | jnp.int32(1))
     # run-head flag ("head is a usable inner row") packed into the LSB of
@@ -306,7 +319,7 @@ def packed_join_groupsum(hay_key, hay_ok, probe_key, probe_ok, aggs):
     both = jnp.concatenate([hay_key.astype(jnp.int64), probe_key.value.astype(jnp.int64)])
     ok = jnp.concatenate([hay_ok, probe_ok])
     side = jnp.concatenate([jnp.zeros(nb, jnp.int32), jnp.ones(np_, jnp.int32)])
-    pk, usable_min, overflow = _pack_keys(both, ok, side)
+    pk, kbad = _pack_keys(both, ok, side)
 
     # one int32 sort: packed key + ONE int32 lane per distinct agg argument
     # (nulls pre-masked to 0 so only COUNT needs the null-bit word).
@@ -344,14 +357,55 @@ def packed_join_groupsum(hay_key, hay_ok, probe_key, probe_ok, aggs):
     lanes_s = list(sorted_ops[1 : 1 + len(lanes)])
     nw_s = sorted_ops[-1] if nbits else None
 
+    from .dense_pallas import pallas_mode
+
+    mode = pallas_mode()
+    if mode and len(lanes) <= 2:
+        # TPU fast path: ONE Pallas sweep replaces every post-sort scan
+        # and the overflow reduce (ops/joinscan.py)
+        from .joinscan import postsort_segscan
+
+        lane_keys = list(combo_of)
+        nn_bits = [nullbit_of[k[1]] for k in lane_keys]
+        bad_all = kbad | jnp.concatenate([jnp.zeros(nb, bool), width_bad])
+        gv, cnt, key32, sums, nns, ovf, _jr = postsort_segscan(
+            spk, lanes_s, bad_all, nw_s=nw_s, nn_bits=nn_bits,
+            interpret=(mode == "interpret"),
+        )
+        by_combo = {k: (sums[i], nns[i]) for i, k in enumerate(lane_keys)}
+        zeros = jnp.zeros(n, bool)
+        states = []
+        for desc, avs in aggs:
+            if desc.name == "count":
+                if avs:
+                    _, nn = by_combo[(id(avs[0].value), id(avs[0].null))]
+                    states.append([(nn, zeros)])
+                else:
+                    states.append([(cnt, zeros)])
+                continue
+            a = avs[0]
+            s, nn = by_combo[(id(a.value), id(a.null))]
+            empty = nn == 0
+            if desc.name == "sum":
+                states.append([(s, empty)])
+            else:  # avg: [count, sum]
+                states.append([(nn, zeros), (s, empty)])
+        key_out = CompVal(
+            jnp.where(gv, (key32 >> 1).astype(jnp.int64), jnp.int64(0)),
+            zeros, probe_key.ft,
+        )
+        return states, gv, key_out, ovf, cnt
+
     is_hay = (spk & 1) == 0
     is_real = spk < _PIN_HAY
-    prev_pk = jnp.concatenate([jnp.full(1, -2, jnp.int32), spk[:-1]])
+    # sentinel below every real pk (|key| < 2^30-2 keeps pk > INT32_MIN+4;
+    # -2 collided with real key -1 under no-rebase packing)
+    prev_pk = jnp.concatenate([jnp.full(1, -(2**31), jnp.int32), spk[:-1]])
     dup_hay = is_hay & is_real & (spk == prev_pk)
     # ONE batched any() for every per-row overflow condition (each
     # standalone reduce costs a ~1.5-3ms dispatch floor on this platform)
-    overflow = overflow | jnp.any(
-        jnp.stack([dup_hay, jnp.concatenate([jnp.zeros(nb, bool), width_bad])])
+    overflow = jnp.any(
+        jnp.stack([dup_hay, kbad | jnp.concatenate([jnp.zeros(nb, bool), width_bad])])
     )
     keydiff = (spk | jnp.int32(1)) != (prev_pk | jnp.int32(1))
     # first probe row of its key run (prev is hay, or a different key);
@@ -415,7 +469,7 @@ def packed_join_groupsum(hay_key, hay_ok, probe_key, probe_ok, aggs):
             states.append([(cnt_nn, zeros), (s, empty)])
 
     key_out = CompVal(
-        jnp.where(is_real, (spk >> 1).astype(jnp.int64) + usable_min, jnp.int64(0)),
+        jnp.where(is_real, (spk >> 1).astype(jnp.int64), jnp.int64(0)),
         zeros, probe_key.ft,
     )
     return states, group_valid, key_out, overflow, extent_cnt

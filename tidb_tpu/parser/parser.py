@@ -1818,9 +1818,16 @@ class Parser:
         out = []
         while True:
             if self.at_op("("):
-                raise ParseError(
-                    "expression index elements ((expr)) are not supported yet"
-                )
+                # expression index element ((expr)): parsed and marked —
+                # creation sites drop the element, and a UNIQUE index that
+                # lost one must ALSO drop uniqueness (the remaining columns
+                # would otherwise enforce a STRICTER constraint). ref:
+                # pkg/ddl/index.go buildIndexColumns expression columns
+                self.next()
+                self.expr()
+                self.expect_op(")")
+                self.eat_kw("ASC") or self.eat_kw("DESC")
+                out.append(("__expr__", -2))
             else:
                 c = self.ident()
                 plen = -1

@@ -389,15 +389,26 @@ class Catalog:
             indices = []
             for j, idx in enumerate(getattr(stmt, "indexes", []) or []):
                 iname = getattr(idx, "name", "") or f"idx_{j}"
-                icols = [c[0].lower() if isinstance(c, tuple) else str(c).lower() for c in idx.columns]
+                raw = [c[0].lower() if isinstance(c, tuple) else str(c).lower() for c in idx.columns]
+                # expression elements ("__expr__") are dropped; a UNIQUE
+                # index that lost one also drops uniqueness — the leftover
+                # plain columns would otherwise enforce a STRICTER
+                # constraint than declared (reject legal inserts)
+                icols = [c for c in raw if c != "__expr__"]
+                had_expr = len(icols) != len(raw)
                 if getattr(idx, "primary", False):
+                    if not icols:
+                        continue
                     c = next((c for c in cols if c.name == icols[0]), None)
                     if len(icols) == 1 and c is not None and c.ft.is_int():
                         handle_col = icols[0]
                         continue
                     pk_cols = icols
                     continue
-                indices.append(IndexMeta(iname, self._alloc_id(), icols, getattr(idx, "unique", False)))
+                if not icols:
+                    continue  # pure expression index: parsed-and-dropped
+                unique = getattr(idx, "unique", False) and not had_expr
+                indices.append(IndexMeta(iname, self._alloc_id(), icols, unique))
             if pk_cols and handle_col is None:
                 for cn in pk_cols:
                     cm = next((c for c in cols if c.name == cn), None)
@@ -491,6 +502,14 @@ class Catalog:
                 )
             if any(i.name == index_name for i in tbl.indices):
                 raise CatalogError(f"index {index_name!r} already exists")
+            raw = [c.lower() for c in col_names]
+            col_names = [c for c in raw if c != "__expr__"]
+            if not col_names:
+                raise CatalogError(
+                    "pure expression index has no plain columns (dropped)"
+                )
+            if len(col_names) != len(raw):
+                unique = False  # see create_table: degraded expr index
             for cn in col_names:
                 tbl.col(cn)  # validates
             im = IndexMeta(index_name, self._alloc_id(), [c.lower() for c in col_names], unique, state)

@@ -369,6 +369,20 @@ class _Lowerer:
             e = func("between", BOOL, x, lo, hi)
             return func("not", BOOL, e) if n.negated else e
         if isinstance(n, A.InList):
+            if isinstance(n.expr, A.RowExpr) or any(
+                isinstance(i, A.RowExpr) for i in n.items
+            ):
+                # (a,b) IN ((1,2),(3,4)) -> OR of row equalities, each a
+                # component conjunction — SQL three-valued logic keeps the
+                # NULL semantics exact (ref: expression_rewriter.go
+                # buildRowExpr / the NAAJ decomposition)
+                disj = None
+                for i in n.items:
+                    e = _expand_row_cmp(A.BinaryOp("eq", n.expr, i))
+                    disj = e if disj is None else A.BinaryOp("or", disj, e)
+                if n.negated:
+                    disj = A.UnaryOp("not", disj)
+                return rec(disj)
             x = rec(n.expr)
             items = [self._coerce_const(x, rec(i), "in") for i in n.items]
             e = func("in", BOOL, x, *items)
@@ -1209,6 +1223,12 @@ def _split_disjuncts(e):
 
 
 def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None, enable_index_merge: bool = False) -> PlannedQuery:
+    if (isinstance(stmt.from_clause, A.TableName)
+            and stmt.from_clause.name.lower() == "dual"
+            and not getattr(stmt.from_clause, "db", "")):
+        # FROM DUAL is the no-table SELECT (ref: parser.y TableRefsClause
+        # DUAL production; MySQL compat)
+        stmt.from_clause = None
     if stmt.from_clause is None:
         raise PlanError("SELECT without FROM is evaluated by the session")
     if stmt.ctes:

@@ -116,6 +116,7 @@ def qualify_tables_ast(stmt, cur_db: str) -> None:
             collect_ctes(getattr(n, f_))
 
     collect_ctes(stmt)
+    cte_names.add("dual")  # FROM DUAL: pseudo-table, never db-qualified
     virtual = ("information_schema", "performance_schema")
 
     def walk(n):
@@ -125,6 +126,12 @@ def qualify_tables_ast(stmt, cur_db: str) -> None:
             return
         if not hasattr(n, "__dataclass_fields__"):
             return
+        if isinstance(n, A.SelectStmt) and isinstance(n.from_clause, A.TableName) \
+                and not (n.from_clause.db or "") \
+                and n.from_clause.name.lower() == "dual":
+            # FROM DUAL is the no-table SELECT (MySQL compat; ref:
+            # parser.y TableRefsClause DUAL production)
+            n.from_clause = None
         if isinstance(n, A.TableName):
             db = (n.db or "").lower()
             if db in virtual:
@@ -287,6 +294,15 @@ class Session:
         "CREATE TABLE IF NOT EXISTS `mysql.stats_meta` (version BIGINT NOT NULL, table_id BIGINT NOT NULL, modify_count BIGINT NOT NULL DEFAULT 0, count BIGINT NOT NULL DEFAULT 0, snapshot BIGINT NOT NULL DEFAULT 0)",
         "CREATE TABLE IF NOT EXISTS `mysql.tidb` (variable_name VARCHAR(64) NOT NULL, variable_value VARCHAR(1024) DEFAULT NULL, comment VARCHAR(1024))",
         "CREATE TABLE IF NOT EXISTS `mysql.global_variables` (variable_name VARCHAR(64) NOT NULL, variable_value VARCHAR(16383) DEFAULT NULL)",
+        # account tables (ref: bootstrap.go CreateUserTable/CreateDBPrivTable
+        # and friends); CREATE USER/GRANT mirror rows in via privilege.py
+        "CREATE TABLE IF NOT EXISTS `mysql.user` (Host CHAR(255), User CHAR(32), authentication_string TEXT, plugin CHAR(64), Select_priv CHAR(1) DEFAULT 'N', Insert_priv CHAR(1) DEFAULT 'N', Update_priv CHAR(1) DEFAULT 'N', Delete_priv CHAR(1) DEFAULT 'N', Create_priv CHAR(1) DEFAULT 'N', Drop_priv CHAR(1) DEFAULT 'N', Grant_priv CHAR(1) DEFAULT 'N', Super_priv CHAR(1) DEFAULT 'N', account_locked CHAR(1) DEFAULT 'N')",
+        "CREATE TABLE IF NOT EXISTS `mysql.db` (Host CHAR(255), DB CHAR(64), User CHAR(32), Select_priv CHAR(1) DEFAULT 'N', Insert_priv CHAR(1) DEFAULT 'N', Update_priv CHAR(1) DEFAULT 'N', Delete_priv CHAR(1) DEFAULT 'N', Create_priv CHAR(1) DEFAULT 'N', Drop_priv CHAR(1) DEFAULT 'N')",
+        "CREATE TABLE IF NOT EXISTS `mysql.tables_priv` (Host CHAR(255), DB CHAR(64), User CHAR(32), Table_name CHAR(64), Grantor CHAR(128), Table_priv TEXT, Column_priv TEXT)",
+        "CREATE TABLE IF NOT EXISTS `mysql.gc_delete_range` (job_id BIGINT NOT NULL, element_id BIGINT NOT NULL, start_key VARCHAR(255), end_key VARCHAR(255), ts BIGINT)",
+        "CREATE TABLE IF NOT EXISTS `mysql.analyze_jobs` (id BIGINT, table_schema CHAR(64), table_name CHAR(64), job_info TEXT, start_time DATETIME, end_time DATETIME, state VARCHAR(15))",
+        "CREATE TABLE IF NOT EXISTS `mysql.stats_histograms` (table_id BIGINT NOT NULL, is_index TINYINT NOT NULL, hist_id BIGINT NOT NULL, distinct_count BIGINT NOT NULL, null_count BIGINT DEFAULT 0, version BIGINT DEFAULT 0)",
+        "CREATE TABLE IF NOT EXISTS `mysql.stats_buckets` (table_id BIGINT NOT NULL, is_index TINYINT NOT NULL, hist_id BIGINT NOT NULL, bucket_id BIGINT NOT NULL, count BIGINT NOT NULL, repeats BIGINT NOT NULL, upper_bound TEXT, lower_bound TEXT)",
     ]
 
     def _bootstrap_mysql_schema(self) -> None:
@@ -618,6 +634,21 @@ class Session:
             try:
                 for name, host, pw in stmt.users:
                     self.catalog.privileges.create_user(name, host, pw, stmt.if_not_exists)
+                    # mirror into mysql.user (ref: bootstrap.go + executor
+                    # simple.go executeCreateUser writes the row directly);
+                    # delete-then-insert keeps IF NOT EXISTS re-runs at one
+                    # row, and quotes in names must be SQL-escaped
+                    ne, he = name.replace("'", "''"), host.replace("'", "''")
+                    try:
+                        self.execute(
+                            f"delete from `mysql.user` where User = '{ne}' and Host = '{he}'"
+                        )
+                        self.execute(
+                            "insert into `mysql.user` (Host, User, authentication_string, plugin) "
+                            f"values ('{he}', '{ne}', '', 'mysql_native_password')"
+                        )
+                    except SQLError:
+                        pass
             except PrivilegeError as exc:
                 raise SQLError(str(exc)) from exc
             return Result()
@@ -627,6 +658,13 @@ class Session:
             try:
                 for name, host in stmt.users:
                     self.catalog.privileges.drop_user(name, host, stmt.if_exists)
+                    ne, he = name.replace("'", "''"), host.replace("'", "''")
+                    try:
+                        self.execute(
+                            f"delete from `mysql.user` where User = '{ne}' and Host = '{he}'"
+                        )
+                    except SQLError:
+                        pass
             except PrivilegeError as exc:
                 raise SQLError(str(exc)) from exc
             return Result()
@@ -1112,10 +1150,18 @@ class Session:
                 lw = _Lowerer(_Scope([]))
                 ev = RefEvaluator()
                 exprs = [lw.lower_base(f.expr) for f in stmt.fields]
-                row = [ev.eval(e, []) for e in exprs]
                 from .planner import _field_label
 
                 names = [_field_label(f) for f in stmt.fields]
+                if stmt.where is not None:
+                    # SELECT ... FROM DUAL WHERE <cond> (the only legal
+                    # table-less WHERE form; ref: MySQL DUAL semantics)
+                    w = rw._rewrite_expr(stmt.where, [], stmt)
+                    from ..expr.eval_ref import _truth
+
+                    if _truth(ev.eval(lw.lower_base(w), [])) is not True:
+                        return names, [e.ft for e in exprs], []
+                row = [ev.eval(e, []) for e in exprs]
                 return names, [e.ft for e in exprs], [row]
             rw.rewrite_select(stmt)
         except SubqueryError as exc:

@@ -590,6 +590,13 @@ class SubqueryRewriter:
                 "cmp", n.subquery, schema, stmt,
                 probe_exprs=[n.expr], cmp_op=n.op, cmp_all=n.all,
             )
+        if isinstance(n.expr, A.RowExpr) and (
+            (n.op == "eq" and not n.all) or (n.op == "ne" and n.all)
+        ):
+            # (a,b) = ANY (...) == row IN; (a,b) != ALL (...) == row NOT IN
+            # (ref: expression_rewriter.go handleCompareSubquery NAAJ path)
+            shim = A.InSubquery(n.expr, n.subquery, negated=(n.op == "ne"))
+            return self._uncorrelated_tuple_in(shim, schema, stmt, n.op == "ne")
         fts, rows = self._exec_values(n.subquery)
         x = self._rewrite_expr(n.expr, schema, stmt)
         values = [r[0] for r in rows]
