@@ -11,13 +11,15 @@ import sys
 import pytest
 
 CORPUS = "/root/reference/tests/integrationtest/t"
-# measured 2026-07-31 (round 5): overall data_match_rate 0.8292 over
-# 2191 statements / 37 files (charset/binary package, expression-index
-# degradation, FROM DUAL, mysql.* bootstrap, row-expression IN lists and
-# (a,b) != ALL NAAJ forms; r5 VERDICT #2 target was >= 0.80). Raise when
-# it improves, never lower.
+# measured 2026-07-31 (round 5): data_match_rate 0.8269 over 2235
+# statements / 37 files with ZERO desync (wrapped-echo matching fixed
+# the tpch file, so 44 previously unalignable statements now execute
+# and count — the denominator grew). Charset/binary package,
+# expression-index degradation, FROM DUAL, mysql.* bootstrap, row
+# expressions, EXTRACT incl. composite units, SUBSTRING FROM/FOR.
+# Raise when it improves, never lower.
 RATCHET_DATA = 0.82
-RATCHET_EXEC = 2100  # executed statements (desync guard)
+RATCHET_EXEC = 2200  # executed statements (desync guard)
 
 # per-file floors for the former pinned set (these carried the round-4
 # ratchet; keep them from silently regressing inside a passing aggregate)

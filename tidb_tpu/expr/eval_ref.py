@@ -723,6 +723,26 @@ class RefEvaluator:
                 return a
             return Datum.string(str(a.val))
         if dst == "time":
+            from ..types import TypeCode as _TC
+
+            if a.kind in (DatumKind.String, DatumKind.Bytes):
+                # CAST('...' AS DATETIME/DATE) (ref: builtin_cast.go
+                # castStringAsTime -> types.ParseTime); bare time-of-day
+                # strings parse at the zero date ('10:30:00' -> hour 10)
+                s = self._sval(a).strip()
+                try:
+                    t = MyTime.parse(s, max(e.ft.decimal, 0))
+                except (ValueError, TypeError):
+                    try:
+                        t = MyTime.parse("0000-00-00 " + s, max(e.ft.decimal, 0))
+                    except (ValueError, TypeError):
+                        return Datum.NULL
+                a = Datum.time(t)
+            if e.ft.tp == _TC.Date and isinstance(a.val, MyTime):
+                from ..types.mytime import unpack_datetime
+
+                y, m, d2, *_ = unpack_datetime(a.val.packed)
+                return Datum.time(MyTime.from_ymd(y, m, d2))
             return a
         raise NotImplementedError(f"ref cast to {dst}")
 

@@ -1044,6 +1044,23 @@ class Parser:
         if self.at_op("(") and not quoted:
             lname = name.lower()
             self.next()
+            if lname in ("substring", "substr", "mid") and not self.at_op(")"):
+                # SUBSTRING(str FROM pos [FOR len]) (ref: parser.y
+                # SubstringExpr); the comma form reuses the generic
+                # argument loop below
+                e = self.expr()
+                if self.eat_kw("FROM"):
+                    pos = self.expr()
+                    args = [e, pos]
+                    if self.eat_kw("FOR"):
+                        args.append(self.expr())
+                    self.expect_op(")")
+                    return A.FuncCall("substr", args)
+                args = [e]
+                while self.eat_op(","):
+                    args.append(self.expr())
+                self.expect_op(")")
+                return A.FuncCall(lname, args)
             if lname == "extract" and self.peek().upper in self._EXTRACT_UNITS:
                 # EXTRACT(unit FROM expr) (ref: parser.y ExtractExpr)
                 unit = self.next().upper.lower()

@@ -407,6 +407,13 @@ class _Lowerer:
             return func("case", ft, *flat)
         if isinstance(n, A.Cast):
             ft = field_type_from_spec(n.to_type)
+            if getattr(n.to_type, "name", "") == "date":
+                # field_type_from_spec folds DATE into DATETIME storage;
+                # the CAST result type keeps the DATE kind so the oracle
+                # truncates the time part (ref: builtin_cast.go
+                # castStringAsTime with tp mysql.TypeDate)
+                ft = ft.clone()
+                ft.tp = TypeCode.Date
             if n.to_type.name == "signed":
                 ft = new_longlong()
             elif n.to_type.name == "unsigned":
@@ -487,6 +494,44 @@ class _Lowerer:
                 d = func("cast", new_datetime(), d)
             return func(name, d.ft.clone(), d, nexpr, lit(unit, new_varchar(8)))
         args = [rec(a) for a in n.args]
+        if name == "extract":
+            # EXTRACT(unit FROM e): simple units ride as a const string arg
+            # (compile.py / eval_ref.py _op_extract dispatch); composite
+            # units decompose into arithmetic over the simple ones (ref:
+            # types.ExtractDatetimeNum, builtin_time.go extract)
+            d = args[1]
+            if not d.ft.is_time():
+                d = func("cast", new_datetime(), d)
+            unit = str(n.args[0].value).lower()
+            LL = new_longlong()
+
+            def part(u):
+                return func(u, LL, d)
+
+            composite = {
+                "year_month": [("year", 100), ("month", 1)],
+                "day_hour": [("day", 100), ("hour", 1)],
+                "day_minute": [("day", 10000), ("hour", 100), ("minute", 1)],
+                "day_second": [("day", 1000000), ("hour", 10000), ("minute", 100), ("second", 1)],
+                "hour_minute": [("hour", 100), ("minute", 1)],
+                "hour_second": [("hour", 10000), ("minute", 100), ("second", 1)],
+                "minute_second": [("minute", 100), ("second", 1)],
+            }
+            simple = {"year", "month", "day", "hour", "minute", "second"}
+            if unit not in composite and unit not in simple:
+                # WEEK/QUARTER/MICROSECOND and *_MICROSECOND composites:
+                # the packed kernels carry no microsecond/week machinery —
+                # a clean error beats the raw unknown-scalar-op crash
+                raise PlanError(f"EXTRACT unit {unit!r} not supported yet")
+            if unit in composite:
+                out = None
+                for u, scale in composite[unit]:
+                    t = part(u) if scale == 1 else func(
+                        "mul", LL, part(u), lit(scale, LL)
+                    )
+                    out = t if out is None else func("plus", LL, out, t)
+                return out
+            return func("extract", new_longlong(), args[0], d)
         if name == "convert_using":
             # CONVERT(expr USING cs): value re-encoded into cs at eval time
             # (ref: pkg/expression/builtin_string.go builtinConvertSig);

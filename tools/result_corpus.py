@@ -119,6 +119,17 @@ def parse_test(text: str):
     return items
 
 
+def _strip_leading_comments(sql: str) -> str:
+    """tpch.test prefixes every query with a /* Qn ... */ block comment."""
+    s = sql.lstrip()
+    while s.startswith("/*"):
+        end = s.find("*/")
+        if end < 0:
+            break
+        s = s[end + 2 :].lstrip()
+    return s
+
+
 def _norm(line: str) -> str:
     return line.rstrip("\r\n")
 
@@ -214,9 +225,13 @@ def run_file(name: str, test_dir: str = TEST_DIR, result_dir: str = RESULT_DIR):
 
     def find_echo(stmt_lines):
         """Locate the echo of this statement at/near the cursor; returns the
-        index AFTER the echo, or None."""
+        index AFTER the echo, or None. mysqltest may re-wrap long
+        statements across lines (tpch.result wraps each CREATE TABLE at
+        column boundaries), so an exact line-by-line match is followed by
+        a whitespace-normalized multi-line fallback."""
         first = stmt_lines[0].strip()
-        # search a bounded window to tolerate small desyncs
+        want_norm = " ".join(" ".join(stmt_lines).split())
+        first_tok = want_norm.split(" ", 1)[0]
         for i in range(cur, min(cur + 200, len(rlines))):
             if rlines[i].strip() == first:
                 # multi-line statements echo line by line
@@ -229,6 +244,17 @@ def run_file(name: str, test_dir: str = TEST_DIR, result_dir: str = RESULT_DIR):
                     j += 1
                 if ok:
                     return j
+            # wrapped echo: join result lines until the normalized texts
+            # agree (or diverge)
+            if rlines[i].strip().startswith(first_tok):
+                acc = ""
+                for j in range(i, min(i + 80, len(rlines))):
+                    acc = (acc + " " + rlines[j].strip()).strip()
+                    accn = " ".join(acc.split())
+                    if accn == want_norm:
+                        return j + 1
+                    if not want_norm.startswith(accn):
+                        break
         return None
 
     n_stmt = sum(1 for it in items if it[0] == "stmt")
@@ -297,7 +323,7 @@ def run_file(name: str, test_dir: str = TEST_DIR, result_dir: str = RESULT_DIR):
             if got == want:
                 counts["match"] += 1
                 cur += len(got)
-            elif sql.lstrip().lower().startswith(("explain", "desc")):
+            elif _strip_leading_comments(sql).lower().startswith(("explain", "desc")):
                 counts["explain_diff"] += 1
             else:
                 counts["mismatch"] += 1
