@@ -50,8 +50,26 @@ def test_ddl_history(api):
 def test_settings_metrics(api):
     code, st = _get(api, "/settings")
     assert code == 200 and "max_execution_time" in st
-    code, m = _get(api, "/metrics")
-    assert code == 200 and "prometheus" in m
+    code, m = _get(api, "/metrics/json")
+    assert code == 200 and "prometheus" in m and "samples" in m
+
+
+def test_metrics_text_exposition(api):
+    """GET /metrics is raw Prometheus text v0.0.4 — what a scraper parses."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    from scrape_check import validate
+
+    with urllib.request.urlopen(f"http://{api.host}:{api.port}/metrics") as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        assert "version=0.0.4" in r.headers["Content-Type"]
+        text = r.read().decode()
+    assert "# TYPE tidb_tpu_cop_requests_total counter" in text
+    assert 'tidb_tpu_cop_duration_seconds_bucket{le="+Inf"}' in text
+    assert validate(text) == []
 
 
 def test_mvcc_versions(api):

@@ -8,6 +8,17 @@ pkg/executor/join/hash_join_v2.go, agg_stream_executor.go)."""
 import numpy as np
 import pytest
 
+import jax
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _fresh_jax_caches():
+    """jax 0.4.x: jitted subfunctions cached by earlier tests under a
+    different x64 weak-type state poison the Pallas kernels' lowering
+    (i32/i64 verifier mismatch). A clean cache per kernel module keeps
+    these hermetic; newer jax keys the cache correctly."""
+    jax.clear_caches()
+
 from tidb_tpu.chunk import Chunk
 from tidb_tpu.exec import (
     Aggregation,

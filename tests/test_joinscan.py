@@ -4,6 +4,17 @@ tests/test_joinagg.py; the compiled path runs on TPU via bench.py)."""
 
 import numpy as np
 import pytest
+
+import jax
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _fresh_jax_caches():
+    """jax 0.4.x: jitted subfunctions cached by earlier tests under a
+    different x64 weak-type state poison the Pallas kernels' lowering
+    (i32/i64 verifier mismatch). A clean cache per kernel module keeps
+    these hermetic; newer jax keys the cache correctly."""
+    jax.clear_caches()
 import jax.numpy as jnp
 
 from tidb_tpu.chunk import Chunk

@@ -113,7 +113,7 @@ def test_scatter_to_buckets_roundtrip():
 def test_exchange_group_agg_all_to_all():
     """Each device owns one hash partition after all_to_all; per-key counts
     across the mesh match a host group-by."""
-    from jax import shard_map
+    from tidb_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P_
 
     mesh = region_mesh()

@@ -59,3 +59,23 @@ def test_explain_analyze_multi_region(sess):
     by_exec = {r[0]: r for r in rows}
     assert by_exec["push[TableScan]"][1] == 100
     assert by_exec["push[TableScan]"][2] == 3  # one summary per region task
+
+
+def test_explain_analyze_attribution_columns(sess):
+    """The device-time attribution columns (ref: EXPLAIN ANALYZE execution
+    info: cop task compile time + coprocessor-cache hit ratio + bytes)."""
+    res = sess.execute("EXPLAIN ANALYZE SELECT count(*) FROM t WHERE v < 3")
+    assert res.columns == ["executor", "rows", "tasks", "time", "compile", "cache", "bytes"]
+    by_exec = {r[0]: r for r in res.values()}
+    scan = by_exec["push[TableScan]"]
+    n_tasks = scan[2]
+    hits, total = scan[5].split("/")
+    assert int(total) == n_tasks and 0 <= int(hits) <= n_tasks
+    assert scan[4].endswith("ms")  # compile time, shared per fused program
+    assert scan[6] > 0  # decoded region bytes ride the scan row
+    # the SAME query again: every per-task program now comes from the cache
+    res2 = sess.execute("EXPLAIN ANALYZE SELECT count(*) FROM t WHERE v < 3")
+    scan2 = {r[0]: r for r in res2.values()}["push[TableScan]"]
+    hits2, total2 = scan2[5].split("/")
+    assert hits2 == total2  # all cache hits, no recompiles
+    assert scan2[4] == "0.00ms"

@@ -498,6 +498,9 @@ def encode_cop_response(resp) -> bytes:
         w.i64(sm.time_processed_ns)
         w.i64(sm.num_produced_rows)
         w.i64(sm.num_iterations)
+        w.i64(sm.time_compile_ns)
+        w.bool_(sm.cache_hit)
+        w.i64(sm.num_bytes)
     w.bool_(resp.last_range is not None)
     if resp.last_range is not None:
         w.i32(len(resp.last_range))
@@ -514,7 +517,10 @@ def decode_cop_response(b: bytes):
     chunk = decode_chunk(r.blob()) if r.bool_() else None
     region_error = r.s() or None
     other_error = r.s() or None
-    summaries = [ExecSummary(r.i64(), r.i64(), r.i64()) for _ in range(r.i32())]
+    summaries = [
+        ExecSummary(r.i64(), r.i64(), r.i64(), r.i64(), r.bool_(), r.i64())
+        for _ in range(r.i32())
+    ]
     last_range = None
     if r.bool_():
         last_range = [KeyRange(r.blob(), r.blob()) for _ in range(r.i32())]

@@ -3,7 +3,10 @@ sysvars at boot; cmd/tidb-server/main.go:654 setGlobalVars)."""
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib  # 3.11+
+except ModuleNotFoundError:  # gated: from_toml degrades, everything else works
+    tomllib = None
 from dataclasses import dataclass
 
 
@@ -23,8 +26,11 @@ class Config:
 
     @classmethod
     def from_toml(cls, path: str) -> "Config":
-        with open(path, "rb") as f:
-            data = tomllib.load(f)
+        if tomllib is not None:
+            with open(path, "rb") as f:
+                data = tomllib.load(f)
+        else:
+            data = _parse_flat_toml(open(path, encoding="utf-8").read())
         return cls.from_dict(data)
 
     @classmethod
@@ -39,6 +45,52 @@ class Config:
             elif k in known:
                 flat[k] = v
         return cls(**flat)
+
+
+def _strip_comment(raw: str) -> str:
+    """Drop a trailing # comment, but not a # inside a quoted value."""
+    quote = None
+    for j, ch in enumerate(raw):
+        if quote:
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+        elif ch == "#":
+            return raw[:j]
+    return raw
+
+
+def _parse_flat_toml(text: str) -> dict:
+    """Pre-3.11 fallback: the [section] / key = scalar subset the config
+    files actually use (ints, bools, quoted strings). Not a general parser."""
+    data: dict = {}
+    cur = data
+    for raw in text.splitlines():
+        line = _strip_comment(raw).strip()
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            cur = data.setdefault(line[1:-1].strip(), {})
+            continue
+        if "=" not in line:
+            continue
+        k, _, v = line.partition("=")
+        v = v.strip()
+        if v.lower() in ("true", "false"):
+            val: object = v.lower() == "true"
+        elif (v.startswith('"') and v.endswith('"')) or (v.startswith("'") and v.endswith("'")):
+            val = v[1:-1]
+        else:
+            try:
+                val = int(v)
+            except ValueError:
+                try:
+                    val = float(v)
+                except ValueError:
+                    val = v
+        cur[k.strip()] = val
+    return data
 
 
 DEFAULT = Config()

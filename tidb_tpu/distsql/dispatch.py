@@ -106,64 +106,45 @@ def select_stream(store: TPUStore, req: KVRequest):
     """Sequential per-task chunk generator — the bounded-memory dispatch
     the degraded OOM path uses (one region's result live at a time;
     ref: copr worker pool degraded to a single in-order worker)."""
-    res = SelectResult(chunks=[])
-    tasks = _build_tasks(store, req.ranges)
-    for i, task in enumerate(tasks):
-        one = SelectResult(chunks=[])
-        _run_one_task(store, req, i, task, one.chunks, one.exec_summaries)
-        for c in one.chunks:
+    scan_kind = _scan_kind(req)
+    for task in _build_tasks(store, req.ranges):
+        summaries: list = []
+        for c in _run_one_task(store, req, task, summaries, scan_kind=scan_kind):
             if c is not None:
-                yield c, one.exec_summaries
+                yield c, summaries
 
 
-def _run_one_task(store, req, i, task, out_chunks, summaries, retries=MAX_RETRY):
-    ranges = task.ranges
-    while True:
-        from ..util import metrics
+def _scan_kind(req) -> str:
+    from ..exec.dag import IndexScan
 
-        if req.checker is not None:
-            req.checker.before_cop_request()
-        metrics.DISTSQL_TASKS.inc()
-        creq = CopRequest(
-            req.dag, ranges, req.start_ts, task.region_id, task.epoch,
-            aux_chunks=req.aux_chunks, paging_size=req.paging_size,
-            small_groups=req.small_groups,
-        )
-        resp = store.coprocessor(creq)
-        if resp.region_error is not None:
-            if retries <= 0:
-                raise RuntimeError(f"region retries exhausted: {resp.region_error}")
-            metrics.DISTSQL_RETRIES.inc()
-            for s2 in _build_tasks(store, ranges):
-                _run_one_task(store, req, i, s2, out_chunks, summaries, retries - 1)
-            return
-        if resp.other_error is not None:
-            raise RuntimeError(resp.other_error)
-        summaries.append(resp.exec_summaries)
-        out_chunks.append(resp.chunk)
-        if resp.last_range is None:
-            return
-        ranges = resp.last_range
+    return "index" if isinstance(req.dag.scan(), IndexScan) else "table"
 
 
-def select(store: TPUStore, req: KVRequest) -> SelectResult:
-    tasks = _build_tasks(store, req.ranges)
-    results: list = [None] * len(tasks)
-    summaries: list = []
+def _run_one_task(store, req, task, summaries, retries=MAX_RETRY,
+                  dispatch_span=None, scan_kind="table"):
+    """One cop task; drives the paging loop when paging is on (ref:
+    copr/coprocessor.go:1393 handleCopPagingResult — each page's lastRange
+    seeds the next request until the task drains). Shared by select()'s
+    pool workers and the sequential select_stream path so metrics, spans,
+    failpoints, and wire routing cannot drift apart. Returns the task's
+    chunks (retry subtasks included); summaries accumulate in place."""
+    import time as _time
 
-    def run_task(i: int, task: CopTask, retries: int = MAX_RETRY):
-        """One cop task; drives the paging loop when paging is on
-        (ref: copr/coprocessor.go:1393 handleCopPagingResult — each page's
-        lastRange seeds the next request until the task drains)."""
-        from ..util import metrics
+    from ..util import failpoint as _fp
+    from ..util import metrics, tracing
 
+    t_task = _time.monotonic()
+    with tracing.span(
+        "distsql.cop_task",
+        parent=None if tracing.current_span() is not None else dispatch_span,
+        region_id=task.region_id, epoch=task.epoch,
+    ) as sp:
         out_chunks: list = []
         ranges = task.ranges
+        pages = 0
         while True:
             if req.checker is not None:
                 req.checker.before_cop_request()
-            from ..util import failpoint as _fp
-
             _fp.eval("distsql.before_task")
             metrics.DISTSQL_TASKS.inc()
             creq = CopRequest(
@@ -181,18 +162,47 @@ def select(store: TPUStore, req: KVRequest) -> SelectResult:
                 if retries <= 0:
                     raise RuntimeError(f"region retries exhausted: {resp.region_error}")
                 metrics.DISTSQL_RETRIES.inc()
-                # re-split the REMAINING ranges against the fresh region view
-                sub = _build_tasks(store, ranges)
-                for s in sub:
-                    out_chunks.extend(run_task(i, s, retries - 1))
+                if sp is not None:
+                    sp.set("region_error", resp.region_error)
+                # re-split the REMAINING ranges against the fresh region
+                # view; subtask spans nest under this one (ambient)
+                for s2 in _build_tasks(store, ranges):
+                    out_chunks.extend(_run_one_task(
+                        store, req, s2, summaries, retries - 1, scan_kind=scan_kind,
+                    ))
                 return out_chunks
             if resp.other_error is not None:
                 raise RuntimeError(resp.other_error)
             summaries.append(resp.exec_summaries)
             out_chunks.append(resp.chunk)
+            pages += 1
             if resp.last_range is None:
+                if sp is not None:
+                    sp.set("pages", pages)
+                    sp.set("rows", sum(c.num_rows() for c in out_chunks if c is not None))
+                metrics.DISTSQL_TASK_DURATION.labels(scan_kind).observe(
+                    _time.monotonic() - t_task
+                )
                 return out_chunks
             ranges = resp.last_range
+
+
+def select(store: TPUStore, req: KVRequest) -> SelectResult:
+    from ..util import tracing
+
+    tasks = _build_tasks(store, req.ranges)
+    results: list = [None] * len(tasks)
+    summaries: list = []
+    # cross-thread span handoff: pool workers don't inherit contextvars,
+    # so capture the dispatching thread's span here and parent the
+    # per-task spans on it explicitly (pkg/util/tracing's SpanFromContext
+    # handover at the copIterator worker boundary)
+    dispatch_span = tracing.current_span()
+    scan_kind = _scan_kind(req)
+
+    def run_task(i: int, task: CopTask):
+        return _run_one_task(store, req, task, summaries,
+                             dispatch_span=dispatch_span, scan_kind=scan_kind)
 
     if req.batch_cop and len(tasks) > 1:
         # batch coprocessor: one batch per STORE; a worker drives all of

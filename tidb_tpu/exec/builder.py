@@ -27,6 +27,15 @@ import jax.numpy as jnp
 from ..chunk.device import DeviceBatch
 from ..expr.compile import CompVal, ExprCompiler, normalize_device_column
 from ..ops import apply_selection, group_aggregate, hash_join, scalar_aggregate, topn
+from ..ops import dense_pallas as _eager_dense_pallas  # noqa: F401
+from ..ops import joinagg as _eager_joinagg  # noqa: F401
+from ..ops import joinscan as _eager_joinscan  # noqa: F401
+
+# ^ the packed-join modules are imported lazily on the hot path below, but
+# MUST already be loaded before any jit trace starts: their module-level
+# jnp constants (_PIN_HAY, I64_MAX, ...) would be staged as tracers if the
+# first import happened inside the traced program, leaking into every
+# later trace (jax UnexpectedTracerError, order-dependent).
 from ..ops.aggregate import GatherState, finalize_agg
 from ..types import FieldType
 from .dag import Aggregation, DAGRequest, IndexScan, Join, Limit, Projection, Selection, Sort, TableScan, TopN, Window, collect_scans, current_schema_fts
@@ -584,23 +593,51 @@ class ProgramCache:
         small_groups: int | None = None,
         unique_joins: bool = True,
     ) -> CompiledDAG:
+        return self.get_info(dag, capacities, group_capacity, join_capacity,
+                             topn_full, small_groups, unique_joins)[0]
+
+    def get_info(
+        self,
+        dag: DAGRequest,
+        capacities,
+        group_capacity: int = DEFAULT_GROUP_CAPACITY,
+        join_capacity: int | None = None,
+        topn_full: bool = False,
+        small_groups: int | None = None,
+        unique_joins: bool = True,
+    ) -> tuple:
+        """(program, cache_hit, compile_ns) — the attribution triple the
+        exec summaries and the TRACE span tree surface (ref: the
+        coprocessor-cache hit flag in copr responses)."""
+        import time as _t
+
         if isinstance(capacities, int):
             capacities = (capacities,)
         capacities = tuple(capacities)
         from ..ops.dense_pallas import pallas_mode
+        from ..util import metrics, tracing
 
         # pallas mode is read at TRACE time (env + backend): a program
         # traced under one mode must not serve another (mismatched
         # buffer counts at execution)
         key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, pallas_mode())
         prog = self._cache.get(key)
-        if prog is None:
-            from ..util import metrics
-
+        if prog is not None:
+            metrics.PROGRAM_CACHE_HITS.inc()
+            with tracing.span("exec.program", cache_hit=True):
+                pass
+            return prog, True, 0
+        with tracing.span("exec.program", cache_hit=False) as sp:
             metrics.PROGRAM_COMPILES.inc()
+            t0 = _t.perf_counter_ns()
             prog = build_program(dag, capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins)
-            self._cache[key] = prog
-        return prog
+            compile_ns = _t.perf_counter_ns() - t0
+            metrics.PROGRAM_COMPILE_DURATION.observe(compile_ns / 1e9)
+            if sp is not None:
+                sp.set("compile_ns", compile_ns)
+        self._cache[key] = prog
+        metrics.PROGRAM_CACHE_ENTRIES.set(len(self._cache))
+        return prog, False, compile_ns
 
     def stats(self):
         return {"entries": len(self._cache)}

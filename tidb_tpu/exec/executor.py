@@ -87,6 +87,18 @@ def drive_program(cache: ProgramCache, dag: DAGRequest, batches, group_capacity:
     batches: one DeviceBatch per scan in canonical order (dag.collect_scans)
     — a single batch is accepted for single-scan DAGs.
     Returns (chunk, per-executor produced-row counts, scan first)."""
+    chunk, counts, _ = drive_program_info(cache, dag, batches, group_capacity, max_retries, join_capacity, small_groups)
+    return chunk, counts
+
+
+def drive_program_info(cache: ProgramCache, dag: DAGRequest, batches, group_capacity: int, max_retries: int = 3, join_capacity: int | None = None, small_groups: int | None = None):
+    """drive_program plus the compile/cache attribution triple:
+    (chunk, counts, {"cache_hit", "compile_ns"}) — jit defers the XLA
+    compile to the first call, so a fresh program's first execution time
+    counts as compile time (trace+compile dominate it by orders of
+    magnitude)."""
+    import time as _time
+
     if not isinstance(batches, (list, tuple)):
         batches = [batches]
     caps = tuple(b.capacity for b in batches)
@@ -95,13 +107,19 @@ def drive_program(cache: ProgramCache, dag: DAGRequest, batches, group_capacity:
     tf = False
     smg = small_groups
     uj = True
+    info = {"cache_hit": True, "compile_ns": 0}
     for _ in range(max_retries + 1):
-        prog = cache.get(dag, caps, gc, jc, tf, smg, uj)
+        prog, hit, build_ns = cache.get_info(dag, caps, gc, jc, tf, smg, uj)
+        t0 = _time.perf_counter_ns()
         packed, valid, n, (g_ovf, j_ovf, t_ovf), ex_rows = prog.fn(*batches)
         g_ovf, j_ovf, t_ovf = bool(g_ovf), bool(j_ovf), bool(t_ovf)
+        if not hit:
+            info["cache_hit"] = False
+            # bool() above blocked on the result: first-call = trace+compile
+            info["compile_ns"] += build_ns + (_time.perf_counter_ns() - t0)
         if not g_ovf and not j_ovf and not t_ovf:
             counts = [int(x) for x in np.asarray(ex_rows)]
-            return decode_outputs(packed, valid, prog.out_fts), counts
+            return decode_outputs(packed, valid, prog.out_fts), counts, info
         if g_ovf:
             # drop a wrong stats hint AND grow capacity in the same retry:
             # the driver cannot tell whether the dense kernel ran (the agg

@@ -54,6 +54,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..util.jaxcompat import enable_x64 as _enable_x64
 from .keys import sort_key_arrays
 
 LANES = 128
@@ -391,7 +392,7 @@ def group_aggregate_dense_pallas(group_bys, aggs, row_valid, g_cap: int, mode: s
             for g in range(G):
                 o_ref[acc_rows + 2 + g, :] = jnp.full((LANES,), repm[g], jnp.int32)
 
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         in_specs = [
             pl.BlockSpec((tr, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
             for _ in lanes

@@ -35,10 +35,24 @@ remote Mosaic compiler rejects 64-bit grid arithmetic).
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from ..util.jaxcompat import enable_x64 as _enable_x64
+
+
+def _x64_ctx(interpret: bool):
+    """x64(False) for the Mosaic (real-TPU) lowering only. In interpret
+    mode the kernel is staged into the OUTER x64-on trace but lowered
+    later with x64 back on; tracing it under x64(False) desyncs literal
+    avals from their lowered constants ('func.call' operand i32/i64
+    mismatch). The kernels are explicitly i32-typed, so the flag only
+    matters to Mosaic's 64-bit-rewrite pass."""
+    return contextlib.nullcontext() if interpret else _enable_x64(False)
 
 LANES = 128
 TR = 256
@@ -166,9 +180,12 @@ def _make_kernel(nb: int, nc: int, nn_bits):
         macc[1, :] = macc[1, :] + jnp.sum(contrib.astype(jnp.int32), axis=0, dtype=jnp.int32)
         macc[2, :] = macc[2, :] | jnp.max(bad_ref[:], axis=0)
         # run cap: open-run carry or an emitted count crossing the bound
-        # (vector OR — Mosaic has no scalar VMEM stores)
-        macc[0, :] = macc[0, :] | jnp.where(runcap, 1, 0) | jnp.max(
-            jnp.where(emit & (pc >= _RUN_CAP - T), 1, 0), axis=0
+        # (vector OR — Mosaic has no scalar VMEM stores). int32 literals:
+        # int-only where() branches default to int64 when tracing with x64
+        # on (the interpret path)
+        one, zero = jnp.int32(1), jnp.int32(0)
+        macc[0, :] = macc[0, :] | jnp.where(runcap, one, zero) | jnp.max(
+            jnp.where(emit & (pc >= _RUN_CAP - T), one, zero), axis=0
         )
 
         @pl.when(i == nb - 1)
@@ -210,7 +227,7 @@ def postsort_segscan(spk, lanes_s, bad_lane, nw_s=None, nn_bits=(),
     mspec = pl.BlockSpec((8, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM)
     n_out = 3 + 3 * nc + len(nnb)
     nscan = 1 + 3 * nc + len(nnb)
-    with jax.enable_x64(False):
+    with _x64_ctx(interpret):
         outs = pl.pallas_call(
             _make_kernel(nb, nc, list(nn_bits)),
             grid=(nb,),
@@ -344,7 +361,7 @@ def membership_segscan(spk, bad_lane, interpret: bool = False):
     nb = R // TR
     spec = pl.BlockSpec((TR, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
     mspec = pl.BlockSpec((8, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM)
-    with jax.enable_x64(False):
+    with _x64_ctx(interpret):
         ok2, meta = pl.pallas_call(
             _make_member_kernel(nb),
             grid=(nb,),

@@ -1,0 +1,7 @@
+"""JAX version shims for the mesh modules — the implementations live in
+util.jaxcompat (dependency-free, shared with the ops kernels); this module
+keeps the established import path for the mesh call sites."""
+
+from __future__ import annotations
+
+from ..util.jaxcompat import shard_map  # noqa: F401

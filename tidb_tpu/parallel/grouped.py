@@ -24,7 +24,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from .compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..chunk.device import DeviceBatch

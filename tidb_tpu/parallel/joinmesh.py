@@ -188,7 +188,7 @@ def run_sharded_join_agg(
             group_capacity, extra_overflow=extra,
         )
 
-    from jax import shard_map
+    from .compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec_p = jax.tree.map(lambda _: P(REGION_AXIS), stacked_probe)

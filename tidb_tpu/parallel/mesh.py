@@ -160,7 +160,7 @@ def run_sharded_partial_agg(dag: DAGRequest, stacked: DeviceBatch, mesh: Mesh):
                 k += 1
         return merged
 
-    from jax import shard_map
+    from .compat import shard_map
 
     spec_batch = jax.tree.map(lambda _: P(REGION_AXIS), stacked)
     out_spec = [(P(), P())] * _n_state_cols(agg)

@@ -114,6 +114,17 @@ def try_mesh_select(
     devs = jax.devices()
     if len(devs) < min_devices:
         return None
+    from ..util import tracing
+
+    with tracing.span("parallel.mesh_select", kind=kind, n_devices=len(devs),
+                      n_ranges=len(ranges)) as sp:
+        out = _mesh_select(store, dag, ranges, start_ts, group_capacity, aux_chunks, kind, devs)
+        if sp is not None and out is not None:
+            sp.set("rows", out.num_rows())
+        return out
+
+
+def _mesh_select(store, dag, ranges, start_ts, group_capacity, aux_chunks, kind, devs) -> Chunk | None:
     from .grouped import run_sharded_grouped_agg
     from .mesh import region_mesh, stack_region_batches
 

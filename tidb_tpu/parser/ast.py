@@ -601,7 +601,8 @@ class BRIEStmt:
 
 @dataclass
 class TraceStmt:
-    target: object
+    target: object  # statement
+    format: str = "row"  # 'row' | 'json' (ref: parser.y TraceStmt FORMAT)
 
 
 @dataclass

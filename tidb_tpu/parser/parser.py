@@ -325,7 +325,13 @@ class Parser:
             return self.brie_stmt(kw.lower())
         if kw == "TRACE":
             self.next()
-            return A.TraceStmt(self.statement())
+            fmt = "row"
+            if self.eat_kw("FORMAT"):
+                self.eat_op("=")
+                fmt = self.next().text.lower()
+                if fmt not in ("row", "json"):
+                    raise ParseError(f"TRACE FORMAT {fmt!r} not supported (row|json)")
+            return A.TraceStmt(self.statement(), fmt)
         if kw == "FLASHBACK":
             self.next()
             self.expect_kw("TABLE")

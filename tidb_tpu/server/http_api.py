@@ -7,12 +7,15 @@ pkg/server/handler/tikvhandler — docs/tidb_http_api.md):
   GET /schema/{db}/{table}             one table's TableInfo
   GET /ddl/history                     DDL job log (newest first)
   GET /settings                        config + global sysvars
-  GET /metrics                         prometheus-style counters
+  GET /metrics                         Prometheus text exposition v0.0.4
+                                       (text/plain — scrapers point here)
+  GET /metrics/json                    the same samples as a JSON object
   GET /mvcc/key/{db}/{table}/{handle}  MVCC versions of one row
   GET /regions/meta                    region/cluster layout
 
 Runs on its own port next to the MySQL protocol listener, like the
-reference's status server. JSON bodies; 404 with a message otherwise."""
+reference's status server. JSON bodies except /metrics; 404 with a
+message otherwise."""
 
 from __future__ import annotations
 
@@ -67,13 +70,19 @@ class StatusServer:
                 pass
 
             def do_GET(self):  # noqa: N802 (stdlib contract)
+                ctype = "application/json"
                 try:
-                    code, body = outer._route(self.path)
+                    routed = outer._route(self.path)
+                    if len(routed) == 3:  # raw body + explicit content type
+                        code, data, ctype = routed
+                        data = data if isinstance(data, bytes) else data.encode()
+                    else:
+                        code, body = routed
+                        data = json.dumps(body, indent=1, default=str).encode()
                 except Exception as exc:  # noqa: BLE001 — surface, don't kill the thread
-                    code, body = 500, {"error": str(exc)}
-                data = json.dumps(body, indent=1, default=str).encode()
+                    code, data = 500, json.dumps({"error": str(exc)}).encode()
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -131,7 +140,15 @@ class StatusServer:
         if parts == ["metrics"]:
             from ..util import metrics
 
-            return 200, {"prometheus": metrics.REGISTRY.dump()}
+            # raw exposition a Prometheus scraper actually parses
+            return 200, metrics.REGISTRY.dump(), "text/plain; version=0.0.4; charset=utf-8"
+        if parts == ["metrics", "json"]:
+            from ..util import metrics
+
+            return 200, {
+                "prometheus": metrics.REGISTRY.dump(),
+                "samples": dict(metrics.REGISTRY.sample_lines()),
+            }
         if parts == ["regions", "meta"]:
             return 200, [
                 {"region_id": r.region_id, "epoch": r.epoch,

@@ -1268,6 +1268,19 @@ def _split_disjuncts(e):
 
 
 def plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None, enable_index_merge: bool = False) -> PlannedQuery:
+    """Span-instrumented entry (ref: the optimizer trace hooks in
+    pkg/planner/optimize.go); _plan_select does the work."""
+    from ..util import tracing
+
+    with tracing.span("planner.plan") as sp:
+        plan = _plan_select(stmt, catalog, mat, enable_index_merge)
+        if sp is not None:
+            sp.set("access_path", plan.access_path)
+            sp.set("probe_table", plan.probe_table.name)
+        return plan
+
+
+def _plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None, enable_index_merge: bool = False) -> PlannedQuery:
     if (isinstance(stmt.from_clause, A.TableName)
             and stmt.from_clause.name.lower() == "dual"
             and not getattr(stmt.from_clause, "db", "")):

@@ -442,6 +442,9 @@ class Session:
             explicit=explicit,
             schema_ver=self.catalog.version,
         )
+        from ..util import metrics
+
+        metrics.OPEN_TXNS.inc()
         # pin the snapshot against GC for the txn's lifetime
         self.store.register_snapshot(self.txn.start_ts)
 
@@ -451,6 +454,9 @@ class Session:
         txn, self.txn = self.txn, None
         if txn is None:
             return
+        from ..util import metrics
+
+        metrics.OPEN_TXNS.dec()
         self.store.unregister_snapshot(txn.start_ts)
         if not txn.mutations:
             self.store.txn.release_all(txn.start_ts)
@@ -484,6 +490,9 @@ class Session:
     def _rollback(self):
         txn, self.txn = self.txn, None
         if txn is not None:
+            from ..util import metrics
+
+            metrics.OPEN_TXNS.dec()
             self.store.unregister_snapshot(txn.start_ts)
             self.store.txn.release_all(txn.start_ts)
 
@@ -574,19 +583,27 @@ class Session:
         adapter.go:458/1580; pkg/util/stmtsummary Add)."""
         import time as _time
 
+        from ..util import metrics, tracing
+
         t0 = _time.perf_counter()
         c0 = _time.thread_time()
+        self._last_plan_digest = ""
+        stmt_type = "invalid"
         try:
-            stmt = parse_one(sql)
+            with tracing.span("session.parse", sql=sql[:256]):
+                stmt = parse_one(sql)
+            stmt_type = type(stmt).__name__.removesuffix("Stmt").lower()
             res = self.execute_stmt(stmt)
         except Exception as exc:
             from ..distsql.runaway import QueryKilledError
 
+            metrics.STATEMENTS.labels(stmt_type, "error").inc()
             self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, 0, False, str(exc),
                               cpu_ms=(_time.thread_time() - c0) * 1e3)
             if isinstance(exc, QueryKilledError):
                 raise SQLError(str(exc)) from exc
             raise
+        metrics.STATEMENTS.labels(stmt_type, "ok").inc()
         rows = len(res.rows) if getattr(res, "rows", None) else getattr(res, "affected", 0)
         self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, rows, True,
                           cpu_ms=(_time.thread_time() - c0) * 1e3)
@@ -603,6 +620,7 @@ class Session:
                 slow_threshold_ms=thr,
                 summary_enabled=self.sysvars.get_bool("tidb_enable_stmt_summary"),
                 cpu_ms=cpu_ms,
+                plan_digest=getattr(self, "_last_plan_digest", ""),
             )
         except Exception:  # noqa: BLE001 — observability must never fail a query
             pass
@@ -930,7 +948,34 @@ class Session:
             return self._show(stmt)
         if isinstance(stmt, A.ExplainStmt):
             return self._explain(stmt)
+        if isinstance(stmt, A.TraceStmt):
+            return self._trace(stmt)
         raise SQLError(f"statement {type(stmt).__name__} not supported yet")
+
+    def _trace(self, stmt: A.TraceStmt) -> Result:
+        """TRACE [FORMAT='row'|'json'] <stmt> (ref: executor/trace.go
+        TraceExec + pkg/util/tracing): run the statement on its NORMAL
+        execution path under a root span — every layer's instrumentation
+        (plan, dispatch, per-region cop tasks, program compile/cache,
+        store decode/execute) attaches children — and return the span tree
+        as the result set. A failing statement still returns the partial
+        tree, with the error recorded on the failing span."""
+        from ..util import tracing
+
+        with tracing.trace("session", stmt=type(stmt.target).__name__) as root:
+            try:
+                with tracing.span("session.execute"):
+                    inner = self.execute_stmt(stmt.target)
+                root.set("rows", len(inner.rows) if inner.rows else inner.affected)
+            except Exception as exc:  # noqa: BLE001 — the tree IS the result
+                root.set("error", str(exc))
+        if stmt.format == "json":
+            return Result(columns=["trace"], rows=[[Datum.string(root.to_json())]])
+        rows = [
+            [Datum.string(op), Datum.i64(start_us), Datum.i64(dur_us), Datum.string(attrs)]
+            for op, start_us, dur_us, attrs in root.rows()
+        ]
+        return Result(columns=["operation", "start_us", "duration_us", "attrs"], rows=rows)
 
     @staticmethod
     def _value_literal(val) -> A.Literal:
@@ -1183,6 +1228,14 @@ class Session:
             stmt, self.catalog, mat=rw.mat_dict(),
             enable_index_merge=self.sysvars.get_bool("tidb_enable_index_merge"),
         )
+        # plan digest: access path + executor-shape fingerprint, the join
+        # key between slow-log rows and statement summaries (ref:
+        # plancodec.NormalizePlan -> plan_digest in the slow log)
+        import hashlib as _hashlib
+
+        self._last_plan_digest = _hashlib.sha256(
+            f"{plan.access_path}|{plan.dag.fingerprint()}".encode()
+        ).hexdigest()[:32]
         ts = self._pin_read_ts()
         # OOM action chain (ref: util/memory tracker actions): first evict
         # the store's reclaimable chunk/batch caches; a second breach is
@@ -1507,8 +1560,8 @@ class Session:
             from ..types import new_double
 
             D = new_double()
-            names = ["time", "query_time", "digest", "query", "success"]
-            fts = [S, D, S, new_varchar(4096), I]
+            names = ["time", "query_time", "digest", "plan_digest", "query", "success", "error"]
+            fts = [S, D, S, S, new_varchar(4096), I, new_varchar(1024)]
             rows = []
             import datetime as _dt
 
@@ -1516,8 +1569,10 @@ class Session:
                 rows.append([
                     Datum.string(_dt.datetime.fromtimestamp(e.ts, _dt.timezone.utc).strftime("%Y-%m-%d %H:%M:%S")),
                     Datum.f64(e.duration_ms / 1e3),
-                    Datum.string(e.digest), Datum.string(e.sql),
+                    Datum.string(e.digest), Datum.string(e.plan_digest),
+                    Datum.string(e.sql),
                     Datum.i64(1 if e.success else 0),
+                    Datum.string(e.error),
                 ])
         elif kind == "statements_summary":
             # ref: pkg/util/stmtsummary -> information_schema.statements_summary
@@ -2545,10 +2600,10 @@ class Session:
         if kind == "status":
             from ..util import metrics
 
-            rows = []
-            for line in metrics.REGISTRY.dump().splitlines():
-                name, _, value = line.rpartition(" ")
-                rows.append([Datum.string(name), Datum.string(value)])
+            rows = [
+                [Datum.string(series), Datum.string(value)]
+                for series, value in metrics.REGISTRY.sample_lines()
+            ]
             return Result(columns=["Variable_name", "Value"], rows=rows)
         if kind == "tables":
             names = sorted(set(self.catalog.tables()) | set(self.catalog.views))
@@ -2639,22 +2694,36 @@ class Session:
         names = [type(e).__name__ for e in executor_walk(rp.push_dag.executors)]
         rows_sum = [0] * len(names)
         time_ns = [0] * len(names)
+        compile_ns = [0] * len(names)
+        cache_hits = [0] * len(names)
+        bytes_sum = [0] * len(names)
         for task_summaries in sink:
             for i, s in enumerate(task_summaries[: len(names)]):
                 rows_sum[i] += s.num_produced_rows
                 time_ns[i] += s.time_processed_ns
+                compile_ns[i] += getattr(s, "time_compile_ns", 0)
+                cache_hits[i] += 1 if getattr(s, "cache_hit", False) else 0
+                bytes_sum[i] += getattr(s, "num_bytes", 0)
         out = []
         if sink:
+            # compile/cache attribute the task's ONE fused program to every
+            # executor it contains; cache prints hits/tasks (ref: the
+            # cop_cache hit ratio in EXPLAIN ANALYZE's execution info)
             out += [[
                 Datum.string(f"push[{n}]"), Datum.i64(rows_sum[i]), Datum.i64(len(sink)),
                 Datum.string(f"{time_ns[i] / 1e6:.2f}ms"),
+                Datum.string(f"{compile_ns[i] / 1e6:.2f}ms"),
+                Datum.string(f"{cache_hits[i]}/{len(sink)}"),
+                Datum.i64(bytes_sum[i]),
             ] for i, n in enumerate(names)]
         else:
             # oracle/materialized path: no coprocessor tasks ran
             out.append([Datum.string("(no coprocessor summaries: oracle or in-memory path)"),
-                        Datum.NULL, Datum.i64(0), Datum.NULL])
+                        Datum.NULL, Datum.i64(0), Datum.NULL, Datum.NULL, Datum.NULL, Datum.NULL])
         if rp.root_dag is not None:
             for e in rp.root_dag.executors[1:]:
-                out.append([Datum.string(f"root[{type(e).__name__}]"), Datum.NULL, Datum.i64(1), Datum.NULL])
-        out.append([Datum.string("result"), Datum.i64(len(out_rows)), Datum.i64(1), Datum.NULL])
-        return Result(columns=["executor", "rows", "tasks", "time"], rows=out)
+                out.append([Datum.string(f"root[{type(e).__name__}]"), Datum.NULL, Datum.i64(1),
+                            Datum.NULL, Datum.NULL, Datum.NULL, Datum.NULL])
+        out.append([Datum.string("result"), Datum.i64(len(out_rows)), Datum.i64(1),
+                    Datum.NULL, Datum.NULL, Datum.NULL, Datum.NULL])
+        return Result(columns=["executor", "rows", "tasks", "time", "compile", "cache", "bytes"], rows=out)

@@ -26,7 +26,7 @@ from ..codec import tablecodec
 from ..codec.rowcodec import RowEncoder, decode_row_to_datum_map, fill_origin_default
 from ..exec.builder import DEFAULT_GROUP_CAPACITY, ProgramCache
 from ..exec.dag import DAGRequest
-from ..exec.executor import OverflowRetryError, drive_program, run_dag_reference, _pow2
+from ..exec.executor import OverflowRetryError, drive_program_info, run_dag_reference, _pow2
 from ..types import Datum
 from .kv import MemKV
 from .region import Cluster, Region
@@ -67,11 +67,17 @@ class CopRequest:
 
 @dataclass
 class ExecSummary:
-    """(ref: tipb.ExecutorExecutionSummary, cop_handler.go:518)."""
+    """(ref: tipb.ExecutorExecutionSummary, cop_handler.go:518). Extended
+    with device-time attribution: where the task's wall time went —
+    XLA compile (vs. a program-cache hit) and the bytes the executor
+    moved (scan row: decoded region bytes; final row: result bytes)."""
 
     time_processed_ns: int = 0
     num_produced_rows: int = 0
     num_iterations: int = 1
+    time_compile_ns: int = 0  # 0 on a cache hit
+    cache_hit: bool = False  # the fused program came from the cache
+    num_bytes: int = 0
 
 
 @dataclass
@@ -382,7 +388,8 @@ class TPUStore:
         return resp
 
     def _coprocessor(self, req: CopRequest, group_capacity: int) -> CopResponse:
-        from ..util import failpoint, metrics
+        from ..exec.dag import executor_walk
+        from ..util import failpoint, metrics, tracing
 
         if failpoint.eval("cop-region-error"):
             # fault injection at the RPC seam (ref: unistore/rpc.go:265-271)
@@ -397,25 +404,36 @@ class TPUStore:
         t0 = time.monotonic_ns()
         last_range = None
         page = None
+        in_bytes = 0
+        info = {"cache_hit": False, "compile_ns": 0}
         try:
-            if req.paging_size is not None:
-                from ..exec.dag import Aggregation as _Agg, Limit as _Limit, Sort as _Sort, TopN as _TopN, executor_walk
+            with tracing.span("cop.decode", region_id=req.region_id) as dsp:
+                if req.paging_size is not None:
+                    from ..exec.dag import Aggregation as _Agg, Limit as _Limit, Sort as _Sort, TopN as _TopN
 
-                if req.paging_size <= 0:
-                    return CopResponse(other_error=f"invalid paging_size {req.paging_size}")
-                if any(isinstance(e, (_Agg, _TopN, _Limit, _Sort)) for e in executor_walk(req.dag.executors)):
-                    # per-page agg/top-k/limit results are not mergeable by
-                    # concatenation — row-local DAGs only (scan/sel/proj/join)
-                    return CopResponse(other_error="paging requires a row-local DAG (no aggregation/TopN/Limit)")
-                page, last_range = self._paged_region_chunk(
-                    region, req.ranges, req.dag, req.start_ts, req.paging_size
-                )
-                batch = to_device_batch(page, capacity=_pow2(max(page.num_rows(), 1)))
-            else:
-                batch = self.region_device_batch(region, req.ranges, req.dag, req.start_ts)
+                    if req.paging_size <= 0:
+                        return CopResponse(other_error=f"invalid paging_size {req.paging_size}")
+                    if any(isinstance(e, (_Agg, _TopN, _Limit, _Sort)) for e in executor_walk(req.dag.executors)):
+                        # per-page agg/top-k/limit results are not mergeable by
+                        # concatenation — row-local DAGs only (scan/sel/proj/join)
+                        return CopResponse(other_error="paging requires a row-local DAG (no aggregation/TopN/Limit)")
+                    page, last_range = self._paged_region_chunk(
+                        region, req.ranges, req.dag, req.start_ts, req.paging_size
+                    )
+                    in_bytes = page.nbytes()
+                    batch = to_device_batch(page, capacity=_pow2(max(page.num_rows(), 1)))
+                else:
+                    in_bytes = self.region_chunk(region, req.ranges, req.dag, req.start_ts).nbytes()
+                    batch = self.region_device_batch(region, req.ranges, req.dag, req.start_ts)
+                if dsp is not None:
+                    dsp.set("bytes_to_device", in_bytes)
             batches = [batch] + [self._aux_batch(c) for c in req.aux_chunks]
-            chunk, ex_rows = drive_program(self.programs, req.dag, batches, group_capacity,
-                                           small_groups=req.small_groups)
+            with tracing.span("cop.execute", region_id=req.region_id) as xsp:
+                chunk, ex_rows, info = drive_program_info(self.programs, req.dag, batches, group_capacity,
+                                                          small_groups=req.small_groups)
+                if xsp is not None:
+                    xsp.set("rows", chunk.num_rows())
+                    xsp.set("cache_hit", info["cache_hit"])
         except (OverflowRetryError, NotImplementedError):
             # degenerate fan-out OR an op the device cannot express (JSON,
             # regexp, host-only funcs reaching a pushed executor): fall back
@@ -424,15 +442,15 @@ class TPUStore:
 
             _m.COP_FALLBACKS.inc()
             try:
-                from ..exec.dag import executor_walk
-
-                region_chunk = page if page is not None else self.region_chunk(region, req.ranges, req.dag, req.start_ts)
-                rows = run_dag_reference(req.dag, [region_chunk] + list(req.aux_chunks))
-                chunk = Chunk.from_rows(req.dag.output_fts(), rows)
+                with tracing.span("cop.oracle_fallback", region_id=req.region_id):
+                    region_chunk = page if page is not None else self.region_chunk(region, req.ranges, req.dag, req.start_ts)
+                    rows = run_dag_reference(req.dag, [region_chunk] + list(req.aux_chunks))
+                    chunk = Chunk.from_rows(req.dag.output_fts(), rows)
                 # fallback summaries: aligned with the device path's
                 # per-executor walk (build pipelines included); counts are
                 # the final row count
                 ex_rows = [chunk.num_rows()] * len(executor_walk(req.dag.executors))
+                info = {"cache_hit": False, "compile_ns": 0}
             except (RuntimeError, TypeError, NotImplementedError, ValueError) as exc:
                 if failpoint.eval("cop-debug-raise"):
                     raise  # loud-failure gate (VERDICT r2 weak #10)
@@ -445,9 +463,20 @@ class TPUStore:
         # per-executor produced-row counts are real (measured inside the
         # fused program); the time is the whole fused program's — XLA fuses
         # the pipeline into one kernel, so per-operator time does not exist
-        # (ref: cop_handler.go:518-531 fills per-executor summaries)
+        # (ref: cop_handler.go:518-531 fills per-executor summaries).
+        # compile/cache attribution is likewise per-program: every summary
+        # of the task carries it; bytes attribute to the data movers (the
+        # scan's decoded region bytes in, the final executor's result out).
+        walk = executor_walk(req.dag.executors)
+        out_bytes = chunk.nbytes()
         summaries = [
-            ExecSummary(time_processed_ns=elapsed, num_produced_rows=r)
-            for r in ex_rows
+            ExecSummary(
+                time_processed_ns=elapsed, num_produced_rows=r,
+                time_compile_ns=info["compile_ns"], cache_hit=info["cache_hit"],
+                num_bytes=in_bytes if i == 0 else (out_bytes if i == len(ex_rows) - 1 else 0),
+            )
+            for i, r in enumerate(ex_rows)
         ]
+        for ex, r in zip(walk, ex_rows):
+            metrics.COP_EXECUTOR_ROWS.labels(type(ex).__name__.lower()).inc(r)
         return CopResponse(chunk=chunk, exec_summaries=summaries, last_range=last_range)

@@ -1,0 +1,31 @@
+"""JAX version shims, in one dependency-free module (importable from ops,
+parallel, and exec without package cycles).
+
+`jax.shard_map` (with its `check_vma` kwarg) only exists on newer JAX; older
+releases ship it as `jax.experimental.shard_map.shard_map` with the kwarg
+spelled `check_rep`. Likewise `jax.enable_x64` is the new-jax spelling of
+the context manager older releases keep in `jax.experimental`. One wrapper
+each keeps every call site on the new spelling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.6: top-level function, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_vma=check_vma)
+except ImportError:  # jax 0.4.x: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_rep=check_vma)
+
+
+enable_x64 = getattr(jax, "enable_x64", None)
+if enable_x64 is None:
+    from jax.experimental import enable_x64  # noqa: F401

@@ -1,6 +1,9 @@
-"""Metrics registry — counters and histograms per subsystem (ref:
-pkg/metrics Prometheus wrappers; this is the in-process equivalent with a
-text exposition dump instead of an HTTP endpoint)."""
+"""Metrics registry — counters, gauges and histograms per subsystem, plain
+and labeled (ref: pkg/metrics Prometheus wrappers; CounterVec/HistogramVec
+are the prometheus client_golang vec types). `Registry.dump()` emits the
+Prometheus text exposition format v0.0.4 — `# HELP`/`# TYPE` headers,
+label sets, and cumulative `_bucket{le="..."}` lines — which the HTTP
+status server serves raw at `GET /metrics`."""
 
 from __future__ import annotations
 
@@ -10,14 +13,31 @@ from bisect import bisect_right
 _DEFAULT_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
 
 
-class Counter:
-    __slots__ = ("name", "help", "_v", "_lock")
+def _esc(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
 
-    def __init__(self, name: str, help: str = ""):
+
+def _fmt_labels(names: tuple, values: tuple) -> str:
+    if not names:
+        return ""
+    return "{" + ",".join(f'{k}="{_esc(v)}"' for k, v in zip(names, values)) + "}"
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6f}"
+    return str(v)
+
+
+class Counter:
+    __slots__ = ("name", "help", "_v", "_lock", "_labels")
+
+    def __init__(self, name: str, help: str = "", labels: str = ""):
         self.name = name
         self.help = help
         self._v = 0
         self._lock = threading.Lock()
+        self._labels = labels  # pre-rendered {k="v",...} or ""
 
     def inc(self, n: int = 1):
         with self._lock:
@@ -27,11 +47,47 @@ class Counter:
     def value(self) -> int:
         return self._v
 
+    def _expose(self) -> list[str]:
+        return [f"{self.name}{self._labels} {self._v}"]
+
+
+class Gauge:
+    """A value that goes up AND down (open txns, cache entries, pool size)."""
+
+    __slots__ = ("name", "help", "_v", "_lock", "_labels")
+
+    def __init__(self, name: str, help: str = "", labels: str = ""):
+        self.name = name
+        self.help = help
+        self._v = 0.0
+        self._lock = threading.Lock()
+        self._labels = labels
+
+    def set(self, v: float):
+        with self._lock:
+            self._v = v
+
+    def inc(self, n: float = 1):
+        with self._lock:
+            self._v += n
+
+    def dec(self, n: float = 1):
+        with self._lock:
+            self._v -= n
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def _expose(self) -> list[str]:
+        v = self._v
+        return [f"{self.name}{self._labels} {_fmt_value(int(v) if float(v).is_integer() else v)}"]
+
 
 class Histogram:
-    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_n", "_lock")
+    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_n", "_lock", "_labels")
 
-    def __init__(self, name: str, help: str = "", buckets=_DEFAULT_BUCKETS):
+    def __init__(self, name: str, help: str = "", buckets=_DEFAULT_BUCKETS, labels: str = ""):
         self.name = name
         self.help = help
         self.buckets = tuple(buckets)
@@ -39,6 +95,7 @@ class Histogram:
         self._sum = 0.0
         self._n = 0
         self._lock = threading.Lock()
+        self._labels = labels
 
     def observe(self, v: float):
         with self._lock:
@@ -54,40 +111,141 @@ class Histogram:
     def sum(self) -> float:
         return self._sum
 
+    def _expose(self) -> list[str]:
+        """Cumulative bucket lines + sum + count, the histogram exposition
+        contract (`le` is inclusive upper bound; +Inf == count)."""
+        base = self._labels[1:-1] if self._labels else ""
+        lines = []
+        with self._lock:
+            cum = 0
+            for ub, c in zip(self.buckets, self._counts):
+                cum += c
+                ls = ",".join(x for x in (base, f'le="{ub}"') if x)
+                lines.append(f"{self.name}_bucket{{{ls}}} {cum}")
+            ls = ",".join(x for x in (base, 'le="+Inf"') if x)
+            lines.append(f"{self.name}_bucket{{{ls}}} {self._n}")
+            lines.append(f"{self.name}_sum{self._labels} {self._sum:.6f}")
+            lines.append(f"{self.name}_count{self._labels} {self._n}")
+        return lines
+
+
+class _Vec:
+    """Label-set family sharing one metric name (ref: prometheus *Vec).
+    `labels(**kv)` returns (creating once) the child for that label set."""
+
+    _child_cls: type = Counter
+    typ = "counter"
+
+    def __init__(self, name: str, help: str = "", labelnames: tuple = (), **kw):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._kw = kw
+        self._children: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values, **kv):
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally or by name, not both")
+            values = tuple(kv[n] for n in self.labelnames)
+        values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(f"{self.name} expects labels {self.labelnames}, got {values}")
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._child_cls(
+                    self.name, self.help,
+                    labels=_fmt_labels(self.labelnames, values), **self._kw,
+                )
+                self._children[values] = child
+            return child
+
+    def _expose(self) -> list[str]:
+        with self._lock:
+            kids = [self._children[k] for k in sorted(self._children)]
+        out: list[str] = []
+        for c in kids:
+            out.extend(c._expose())
+        return out
+
+
+class CounterVec(_Vec):
+    _child_cls = Counter
+    typ = "counter"
+
+
+class GaugeVec(_Vec):
+    _child_cls = Gauge
+    typ = "gauge"
+
+
+class HistogramVec(_Vec):
+    _child_cls = Histogram
+    typ = "histogram"
+
+
+_TYPE_OF = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
+
 
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: dict[str, object] = {}
 
-    def counter(self, name: str, help: str = "") -> Counter:
+    def _get_or_make(self, name: str, factory):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = Counter(name, help)
+                m = factory()
                 self._metrics[name] = m
             return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_make(name, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_make(name, lambda: Gauge(name, help))
 
     def histogram(self, name: str, help: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = Histogram(name, help, buckets)
-                self._metrics[name] = m
-            return m
+        return self._get_or_make(name, lambda: Histogram(name, help, buckets))
+
+    def counter_vec(self, name: str, help: str = "", labelnames: tuple = ()) -> CounterVec:
+        return self._get_or_make(name, lambda: CounterVec(name, help, labelnames))
+
+    def gauge_vec(self, name: str, help: str = "", labelnames: tuple = ()) -> GaugeVec:
+        return self._get_or_make(name, lambda: GaugeVec(name, help, labelnames))
+
+    def histogram_vec(self, name: str, help: str = "", labelnames: tuple = (), buckets=_DEFAULT_BUCKETS) -> HistogramVec:
+        return self._get_or_make(
+            name, lambda: HistogramVec(name, help, labelnames, buckets=buckets)
+        )
 
     def dump(self) -> str:
-        """Prometheus-style text exposition."""
-        lines = []
+        """Prometheus text exposition format v0.0.4 (the scrapeable form;
+        tools/scrape_check.py validates this output in the test suite)."""
         with self._lock:
-            for name in sorted(self._metrics):
-                m = self._metrics[name]
-                if isinstance(m, Counter):
-                    lines.append(f"{name} {m.value}")
-                else:
-                    lines.append(f"{name}_count {m.count}")
-                    lines.append(f"{name}_sum {m.sum:.6f}")
-        return "\n".join(lines)
+            items = sorted(self._metrics.items())
+        lines: list[str] = []
+        for name, m in items:
+            typ = getattr(m, "typ", None) or _TYPE_OF[type(m)]
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {typ}")
+            lines.extend(m._expose())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def sample_lines(self) -> list[tuple[str, str]]:
+        """(series-with-labels, value) pairs of every sample — the SHOW
+        STATUS / JSON view, comment lines excluded."""
+        out = []
+        for line in self.dump().splitlines():
+            if not line or line.startswith("#"):
+                continue
+            series, _, value = line.rpartition(" ")
+            out.append((series, value))
+        return out
 
     def reset(self):
         with self._lock:
@@ -101,12 +259,31 @@ COP_REQUESTS = REGISTRY.counter("tidb_tpu_cop_requests_total", "coprocessor requ
 COP_ERRORS = REGISTRY.counter("tidb_tpu_cop_errors_total", "coprocessor requests failed")
 COP_FALLBACKS = REGISTRY.counter("tidb_tpu_cop_oracle_fallbacks_total", "cop requests served by the oracle fallback")
 COP_DURATION = REGISTRY.histogram("tidb_tpu_cop_duration_seconds", "coprocessor request latency")
+COP_EXECUTOR_ROWS = REGISTRY.counter_vec(
+    "tidb_tpu_cop_executor_rows_total", "rows produced per pushed executor",
+    labelnames=("executor",),
+)
 DISTSQL_TASKS = REGISTRY.counter("tidb_tpu_distsql_tasks_total", "per-region cop tasks dispatched")
+DISTSQL_TASK_DURATION = REGISTRY.histogram_vec(
+    "tidb_tpu_distsql_task_duration_seconds", "per-region cop task latency incl. paging+retries",
+    labelnames=("scan",),
+)
 MESH_SELECTS = REGISTRY.counter("tidb_tpu_mesh_selects_total", "SQL plans executed over the device mesh")
 SPILL_PARTITIONS = REGISTRY.counter("tidb_tpu_spill_partitions_total", "out-of-capacity host-partitioned multi-pass executions (the spill analog)")
 MEM_EVICTIONS = REGISTRY.counter("tidb_tpu_mem_evictions_total", "store cache evictions by the OOM action")
 MEM_DEGRADED_QUERIES = REGISTRY.counter("tidb_tpu_mem_degraded_total", "queries degraded to the low-memory fold path")
 DISTSQL_RETRIES = REGISTRY.counter("tidb_tpu_distsql_region_retries_total", "region-error retries")
 PROGRAM_COMPILES = REGISTRY.counter("tidb_tpu_program_compiles_total", "fused XLA programs built")
+PROGRAM_CACHE_HITS = REGISTRY.counter("tidb_tpu_program_cache_hits_total", "program-cache hits (compile skipped)")
+PROGRAM_CACHE_ENTRIES = REGISTRY.gauge("tidb_tpu_program_cache_entries", "compiled programs resident in the cache")
+PROGRAM_COMPILE_DURATION = REGISTRY.histogram(
+    "tidb_tpu_program_compile_seconds", "XLA trace+compile time per program",
+    buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+)
+STATEMENTS = REGISTRY.counter_vec(
+    "tidb_tpu_statements_total", "statements executed by type and outcome",
+    labelnames=("type", "status"),
+)
+OPEN_TXNS = REGISTRY.gauge("tidb_tpu_open_txns", "transactions currently open")
 NATIVE_DECODES = REGISTRY.counter("tidb_tpu_native_decode_batches_total", "region batches decoded by the C++ rowcodec")
 NATIVE_DECODE_FALLBACKS = REGISTRY.counter("tidb_tpu_native_decode_fallbacks_total", "native decode errors served by the python decoder")

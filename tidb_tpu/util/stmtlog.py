@@ -46,7 +46,8 @@ def normalize_sql(sql: str) -> tuple[str, str]:
 @dataclass
 class SlowLogEntry:
     """(ref: the slow-log fields adapter.go writes: Time, Query_time, SQL,
-    digest, result rows, success)."""
+    digest, result rows, success). plan_digest joins slow-log rows against
+    statement summaries (ref: the Plan_digest slow-log field)."""
 
     ts: float
     duration_ms: float
@@ -55,6 +56,7 @@ class SlowLogEntry:
     rows: int
     success: bool
     error: str = ""
+    plan_digest: str = ""
 
 
 @dataclass
@@ -101,8 +103,13 @@ class StmtLog:
         slow_threshold_ms: float | None = 300.0,
         summary_enabled: bool = True,
         cpu_ms: float = 0.0,
+        plan_digest: str = "",
     ):
-        is_slow = slow_threshold_ms is not None and duration_ms > slow_threshold_ms
+        # a FAILED statement leaves a slow-log artifact regardless of the
+        # threshold (slow log still enabled) — a fast-failing dispatch
+        # error is exactly the query one needs to find afterwards (ref:
+        # adapter.go LogSlowQuery records failed statements with their error)
+        is_slow = slow_threshold_ms is not None and (duration_ms > slow_threshold_ms or not success)
         if not summary_enabled and not is_slow:
             return  # neither sink wants it: skip the lexer+digest pass
         norm, digest = normalize_sql(sql)
@@ -127,7 +134,8 @@ class StmtLog:
                 s.last_seen = now
             if is_slow:
                 self.slow.append(
-                    SlowLogEntry(now, duration_ms, sql[:4096], digest, rows, success, error)
+                    SlowLogEntry(now, duration_ms, sql[:4096], digest, rows, success,
+                                 error, plan_digest)
                 )
                 if len(self.slow) > self.slow_capacity:
                     del self.slow[: len(self.slow) - self.slow_capacity]

@@ -1,0 +1,211 @@
+"""Zero-dependency Prometheus text-exposition (v0.0.4) validator.
+
+The test suite runs `validate()` against `Registry.dump()` so an exposition
+regression — a missing `# TYPE`, a non-cumulative `_bucket` series, a
+`+Inf` bucket that disagrees with `_count` — fails tier-1 instead of
+silently breaking every scraper pointed at `GET /metrics`.
+
+Checks (the subset of the format spec an in-process registry can violate):
+  * line grammar: `# HELP`/`# TYPE` comments, `name{labels} value` samples
+  * metric/label name charsets, label value quoting
+  * `# TYPE` precedes its samples and appears at most once per family
+  * counter samples are finite and non-negative
+  * histogram families expose `_bucket`/`_sum`/`_count`; bucket counts are
+    cumulative (non-decreasing in `le` order) per label group; the `+Inf`
+    bucket exists and equals `_count`
+
+Usage: `python tools/scrape_check.py [file]` (stdin when no file);
+exit 0 clean, exit 1 with one error per line otherwise.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import sys
+
+_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+
+def _parse_labels(s: str, errs: list, ln: int) -> dict:
+    """`k="v",k2="v2"` -> dict; appends errors instead of raising."""
+    out: dict = {}
+    i = 0
+    while i < len(s):
+        m = re.match(r'\s*([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"', s[i:])
+        if not m:
+            errs.append(f"line {ln}: bad label syntax at {s[i:]!r}")
+            return out
+        key = m.group(1)
+        i += m.end()
+        buf = []
+        while i < len(s):
+            c = s[i]
+            if c == "\\":
+                if i + 1 >= len(s):
+                    errs.append(f"line {ln}: dangling escape in label value")
+                    return out
+                nxt = s[i + 1]
+                buf.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                i += 2
+                continue
+            if c == '"':
+                i += 1
+                break
+            buf.append(c)
+            i += 1
+        else:
+            errs.append(f"line {ln}: unterminated label value for {key!r}")
+            return out
+        out[key] = "".join(buf)
+        if i < len(s) and s[i] == ",":
+            i += 1
+    return out
+
+
+def _split_sample(line: str, errs: list, ln: int):
+    """-> (name, labels-dict, value) or None."""
+    if "{" in line:
+        name, rest = line.split("{", 1)
+        if "}" not in rest:
+            errs.append(f"line {ln}: missing closing brace")
+            return None
+        labels_s, _, tail = rest.rpartition("}")
+        labels = _parse_labels(labels_s, errs, ln)
+    else:
+        parts = line.split(None, 1)
+        if len(parts) != 2:
+            errs.append(f"line {ln}: sample needs a name and a value: {line!r}")
+            return None
+        name, tail = parts
+        labels = {}
+    name = name.strip()
+    fields = tail.split()
+    if not fields or len(fields) > 2:  # optional timestamp rides after value
+        errs.append(f"line {ln}: expected 'value [timestamp]' after name: {line!r}")
+        return None
+    if not _NAME.match(name):
+        errs.append(f"line {ln}: invalid metric name {name!r}")
+        return None
+    for k in labels:
+        if not _LABEL.match(k):
+            errs.append(f"line {ln}: invalid label name {k!r}")
+    try:
+        value = float(fields[0])
+    except ValueError:
+        errs.append(f"line {ln}: unparseable value {fields[0]!r}")
+        return None
+    return name, labels, value
+
+
+def validate(text: str) -> list[str]:
+    """All format violations found, [] when the exposition is clean."""
+    errs: list[str] = []
+    types: dict[str, str] = {}
+    seen_samples: set = set()
+    series_keys: set = set()
+    samples: list[tuple[str, dict, float, int]] = []
+    for ln, raw in enumerate(text.splitlines(), 1):
+        line = raw.rstrip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                continue  # arbitrary comments are legal
+            name = parts[2]
+            if not _NAME.match(name):
+                errs.append(f"line {ln}: invalid metric name in comment: {name!r}")
+                continue
+            if parts[1] == "TYPE":
+                typ = parts[3].strip() if len(parts) > 3 else ""
+                if typ not in _TYPES:
+                    errs.append(f"line {ln}: unknown TYPE {typ!r} for {name}")
+                if name in types:
+                    errs.append(f"line {ln}: duplicate # TYPE for {name}")
+                if name in seen_samples:
+                    errs.append(f"line {ln}: # TYPE {name} after its samples")
+                types[name] = typ
+            continue
+        parsed = _split_sample(line, errs, ln)
+        if parsed is None:
+            continue
+        name, labels, value = parsed
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        seen_samples.add(name)
+        seen_samples.add(base)
+        samples.append((name, labels, value, ln))
+        key = (name, tuple(sorted(labels.items())))
+        if key in series_keys:
+            errs.append(f"line {ln}: duplicate series {name}{labels}")
+        series_keys.add(key)
+        typ = types.get(name) or types.get(base)
+        if typ == "counter" and (value < 0 or math.isnan(value)):
+            errs.append(f"line {ln}: counter {name} has invalid value {value}")
+    _check_histograms(types, samples, errs)
+    return errs
+
+
+def _check_histograms(types: dict, samples: list, errs: list) -> None:
+    for base, typ in types.items():
+        if typ != "histogram":
+            continue
+        # group the family's series by their non-le label set
+        groups: dict[tuple, dict] = {}
+        for name, labels, value, ln in samples:
+            if name not in (f"{base}_bucket", f"{base}_sum", f"{base}_count"):
+                continue
+            rest = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+            g = groups.setdefault(rest, {"buckets": [], "sum": None, "count": None})
+            if name.endswith("_bucket"):
+                if "le" not in labels:
+                    errs.append(f"line {ln}: {base}_bucket without an le label")
+                    continue
+                try:
+                    ub = float(labels["le"])
+                except ValueError:
+                    errs.append(f"line {ln}: bad le value {labels['le']!r}")
+                    continue
+                g["buckets"].append((ub, value, ln))
+            elif name.endswith("_sum"):
+                g["sum"] = value
+            else:
+                g["count"] = value
+        if not groups:
+            errs.append(f"histogram {base} declared but exposes no samples")
+        for rest, g in groups.items():
+            where = f"{base}{{{','.join(f'{k}={v}' for k, v in rest)}}}"
+            if g["count"] is None or g["sum"] is None:
+                errs.append(f"{where}: histogram missing _sum or _count")
+            buckets = sorted(g["buckets"])
+            if not buckets:
+                errs.append(f"{where}: histogram has no _bucket samples")
+                continue
+            prev = -1.0
+            for ub, v, ln in buckets:
+                if v < prev:
+                    errs.append(
+                        f"line {ln}: {where} bucket le={ub} count {v} < previous {prev} (not cumulative)"
+                    )
+                prev = v
+            inf = [v for ub, v, _ in buckets if math.isinf(ub)]
+            if not inf:
+                errs.append(f"{where}: histogram missing the +Inf bucket")
+            elif g["count"] is not None and inf[0] != g["count"]:
+                errs.append(
+                    f"{where}: +Inf bucket {inf[0]} != _count {g['count']}"
+                )
+
+
+def main(argv: list[str]) -> int:
+    text = open(argv[1], encoding="utf-8").read() if len(argv) > 1 else sys.stdin.read()
+    errors = validate(text)
+    for e in errors:
+        print(e, file=sys.stderr)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
