@@ -545,6 +545,86 @@ def _cpu_only_main():
     print(json.dumps(out))
 
 
+def _pd_skew_main():
+    """BENCH_PD_SKEW=1: the control-plane scenario — a skewed keyspace
+    whose regions all land on one store, measured as per-store cop-task
+    counts before and after PD balancing (ISSUE 3 satellite; hermetic
+    CPU, the scheduling decision is platform-independent)."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tidb_tpu.codec import tablecodec
+    from tidb_tpu.sql.session import Session
+    from tidb_tpu.util import metrics
+
+    def labeled_counts(family: str, label: str) -> dict:
+        out = {}
+        for series, value in metrics.REGISTRY.sample_lines():
+            if series.startswith(family + "{"):
+                out[series.split(f'{label}="')[1].split('"')[0]] = int(value)
+        return out
+
+    def store_task_counts() -> dict:
+        return labeled_counts("tidb_tpu_distsql_store_tasks_total", "store")
+
+    n_stores, n_regions, rows = 4, 12, 1200
+    s = Session()
+    s.execute("CREATE TABLE skew (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO skew VALUES " + ",".join(f"({i},{i % 97})" for i in range(rows)))
+    tid = s.catalog.table("skew").table_id
+    for i in range(1, n_regions):
+        s.store.cluster.split(tablecodec.encode_row_key(tid, i * rows // n_regions))
+    s.store.cluster.set_stores(n_stores)
+    # the skew: every region pinned on store 0 (the hot-device pathology
+    # static round-robin produced after splits landed unevenly)
+    for r in s.store.cluster.regions():
+        s.store.cluster.set_store(r.region_id, 0)
+
+    def delta(base: dict) -> dict:
+        now = store_task_counts()
+        return {str(i): now.get(str(i), 0) - base.get(str(i), 0) for i in range(n_stores)}
+
+    query = "SELECT count(*), sum(v) FROM skew WHERE v < 50"
+    base = store_task_counts()
+    for _ in range(4):
+        s.execute(query)
+    before = delta(base)
+
+    ticks = 0
+    for ticks in range(1, 17):
+        s.pd_ops = s.store.pd.tick()
+        counts = s.store.cluster.counts_per_store()
+        if max(counts.values()) - min(counts.values()) <= s.store.pd.conf.balance_tolerance:
+            break
+    base = store_task_counts()
+    for _ in range(4):
+        s.execute(query)
+    after = delta(base)
+
+    def ratio(counts: dict) -> float:
+        hi, lo = max(counts.values()), min(counts.values())
+        return round(hi / max(lo, 1), 2)
+
+    print(json.dumps({
+        "metric": "pd_skew_balance",
+        "stores": n_stores,
+        "regions": n_regions,
+        "ticks_to_converge": ticks,
+        "tasks_per_store_before": before,
+        "tasks_per_store_after": after,
+        "max_min_ratio_before": ratio(before),
+        "max_min_ratio_after": ratio(after),
+        "region_counts_after": {str(k): v for k, v in s.store.cluster.counts_per_store().items()},
+        "operators": labeled_counts("pd_operator_total", "type"),
+    }))
+
+
 def _config_rows(name: str) -> int:
     # every config now runs the full 4M-row resident batch: q3's packed
     # join+groupsum kernel (r5) compiles in ~75s warm-cache at 4M — the
@@ -618,6 +698,9 @@ def main():
 
     if os.environ.get("BENCH_CPU_ONLY"):
         _cpu_only_main()
+        return
+    if os.environ.get("BENCH_PD_SKEW"):
+        _pd_skew_main()
         return
     if os.environ.get("BENCH_PARITY"):
         _parity_only_main(os.environ["BENCH_PARITY"])

@@ -2,7 +2,9 @@
 pkg/statistics/handle auto-analyze) — the domain's always-on workers,
 collapsed to thread-based runtimes over the embedded engine:
 
-  Timer        periodic callbacks with jittered ticks (pkg/timer runtime)
+  Timer        periodic callbacks with jittered ticks (pkg/timer runtime);
+               also drives the placement driver's scheduling tick
+               (tidb_tpu/pd PlacementDriver.timer) and GC below
   TTLWorker    scans TTL-attached tables and deletes expired rows in
                bounded batches (pkg/ttl/ttlworker scan+delete workers)
   DistTask     task -> subtask split, N executor workers pulling from a

@@ -23,6 +23,11 @@ class Config:
     # observability
     enable_metrics: bool = True
     slow_query_threshold_ms: int = 300
+    # placement driver (tidb_tpu/pd; ref: pd ScheduleConfig) — bridged
+    # onto the store's PlacementDriver by the session at boot
+    pd_tick_interval: float = 10.0
+    pd_max_region_size: int = 1 << 22  # bytes; split-checker threshold
+    pd_max_region_keys: int = 1 << 16  # keys; split-checker threshold
 
     @classmethod
     def from_toml(cls, path: str) -> "Config":

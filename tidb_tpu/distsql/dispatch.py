@@ -147,6 +147,12 @@ def _run_one_task(store, req, task, summaries, retries=MAX_RETRY,
                 req.checker.before_cop_request()
             _fp.eval("distsql.before_task")
             metrics.DISTSQL_TASKS.inc()
+            # authoritative placement lookup (a miss routes through the
+            # PD, never a modulo guess) — the per-store counts are what
+            # bench.py's skew scenario reads before/after PD balancing
+            metrics.DISTSQL_STORE_TASKS.labels(
+                str(store.cluster.store_of(task.region_id))
+            ).inc()
             creq = CopRequest(
                 req.dag, ranges, req.start_ts, task.region_id, task.epoch,
                 aux_chunks=req.aux_chunks, paging_size=req.paging_size,
@@ -208,7 +214,7 @@ def select(store: TPUStore, req: KVRequest) -> SelectResult:
         # batch coprocessor: one batch per STORE; a worker drives all of
         # its store's region tasks back-to-back (one dispatch per store,
         # not per region — ref: batch_coprocessor.go grouping regions per
-        # TiFlash store, balanced by the PD placement in cluster.scatter)
+        # TiFlash store, balanced by the PD's authoritative placement map)
         by_store: dict[int, list] = {}
         for i, t in enumerate(tasks):
             by_store.setdefault(store.cluster.store_of(t.region_id), []).append((i, t))

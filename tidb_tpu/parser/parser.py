@@ -2523,6 +2523,11 @@ class Parser:
             s.kind = "stats_meta"
         elif self.eat_kw("STATS_HISTOGRAMS"):
             s.kind = "stats_histograms"
+        elif self.eat_kw("PLACEMENT"):
+            # SHOW PLACEMENT [LABELS] (ref: the reference's SHOW PLACEMENT;
+            # ours reports the PD's region->store map + scheduling state)
+            self.eat_kw("LABELS")
+            s.kind = "placement"
         elif self.eat_kw("TABLE"):
             self.expect_kw("STATUS")
             s.kind = "table_status"

@@ -12,6 +12,14 @@ pkg/server/handler/tikvhandler — docs/tidb_http_api.md):
   GET /metrics/json                    the same samples as a JSON object
   GET /mvcc/key/{db}/{table}/{handle}  MVCC versions of one row
   GET /regions/meta                    region/cluster layout
+  GET /pd/api/v1/regions               PD view: regions + placement + size
+  GET /pd/api/v1/stores                PD view: per-store region/hot counts
+  GET /pd/api/v1/hotspot               PD view: hot read/write peers
+  GET /pd/api/v1/operators             PD view: pending + recent operators
+
+The /pd/api/v1 prefix mirrors the reference PD's HTTP API (pd
+server/api/router.go), served here from the same status port since the
+PD is embedded in the store process.
 
 Runs on its own port next to the MySQL protocol listener, like the
 reference's status server. JSON bodies except /metrics; 404 with a
@@ -149,6 +157,19 @@ class StatusServer:
                 "prometheus": metrics.REGISTRY.dump(),
                 "samples": dict(metrics.REGISTRY.sample_lines()),
             }
+        if len(parts) == 4 and parts[:3] == ["pd", "api", "v1"]:
+            pd = getattr(s.store, "pd", None)
+            if pd is None:
+                return 404, {"error": "no placement driver attached to this store"}
+            view = {
+                "regions": pd.regions_view,
+                "stores": pd.stores_view,
+                "hotspot": pd.hotspot_view,
+                "operators": pd.operators_view,
+            }.get(parts[3])
+            if view is None:
+                return 404, {"error": f"unknown pd route {parts[3]!r} (regions|stores|hotspot|operators)"}
+            return 200, view()
         if parts == ["regions", "meta"]:
             return 200, [
                 {"region_id": r.region_id, "epoch": r.epoch,

@@ -204,6 +204,11 @@ class MySQLServer:
         self.host, self.port = self._sock.getsockname()
         self._threads: list = []
         self._closing = False
+        # a config'd server boots the placement driver's scheduling loop
+        # (ref: PD runs beside the cluster; embedded here, so the server
+        # owns its lifecycle). Config-less servers (tests) tick manually.
+        if config is not None and getattr(self.store, "pd", None) is not None:
+            self.store.pd.start_background(config.pd_tick_interval)
 
     def serve_forever(self):
         while not self._closing:
@@ -235,6 +240,8 @@ class MySQLServer:
 
     def close(self):
         self._closing = True
+        if getattr(self.store, "pd", None) is not None:
+            self.store.pd.stop()
         try:
             self._sock.close()
         except OSError:

@@ -282,6 +282,12 @@ class Session:
             if config.paging_size:
                 self.sysvars.set("tidb_enable_paging", "ON")
                 self.sysvars.set("tidb_max_chunk_size", str(config.paging_size))
+            # PD scheduling knobs onto the store's placement driver
+            pd = getattr(self.store, "pd", None)
+            if pd is not None:
+                pd.conf.tick_interval = config.pd_tick_interval
+                pd.conf.max_region_size = config.pd_max_region_size
+                pd.conf.max_region_keys = config.pd_max_region_keys
 
     # the writable slice of the mysql schema (ref: session/bootstrap.go:768
     # doDDLWorks — the full bootstrap creates ~40 tables; these are the
@@ -2597,6 +2603,37 @@ class Session:
                 for d, r in store.items()
             ]
             return Result(columns=cols, rows=rows)
+        if kind == "placement":
+            # SHOW PLACEMENT (ref: executor/show_placement.go — the
+            # reference lists placement policies; our placement unit is
+            # the region->store map the PD schedules, so each region is a
+            # target with its store binding and scheduling state)
+            pd = getattr(self.store, "pd", None)
+            if pd is None:
+                return Result(columns=["Target", "Placement", "Scheduling_State"], rows=[])
+            rows = []
+            for st in pd.stores_view():
+                rows.append([
+                    Datum.string(f"STORE {st['store_id']}"),
+                    Datum.string(
+                        f"regions={st['region_count']} size={st['region_size']} "
+                        f"keys={st['region_keys']}"
+                    ),
+                    Datum.string(
+                        f"hot_read={st['hot_read_regions']} hot_write={st['hot_write_regions']}"
+                    ),
+                ])
+            for r in pd.regions_view():
+                rows.append([
+                    Datum.string(f"REGION {r['region_id']}"),
+                    Datum.string(
+                        f"store={r['store']} range=[{r['start_key'][:24]},"
+                        f"{r['end_key'][:24]}) epoch={r['epoch']} "
+                        f"size={r['approximate_size']} keys={r['approximate_keys']}"
+                    ),
+                    Datum.string(pd.scheduling_state(r["region_id"])),
+                ])
+            return Result(columns=["Target", "Placement", "Scheduling_State"], rows=rows)
         if kind == "status":
             from ..util import metrics
 

@@ -27,10 +27,13 @@ class MemKV:
         # store/txn.py); RLock so the engine can nest puts under it
         self.lock = threading.RLock()
 
-    def put(self, key: bytes, value: bytes | None, ts: int):
-        """value None = tombstone."""
+    def put(self, key: bytes, value: bytes | None, ts: int) -> bool:
+        """value None = tombstone. Returns whether the key had a LIVE
+        (non-tombstone) latest version before this put — the flow
+        recorder's insert/update/delete discriminator."""
         with self.lock:
             versions = self._data.get(key)
+            prev_live = bool(versions) and versions[-1][1] is not None
             if versions is None:
                 self._data[key] = [(ts, value)]
                 self._dirty = True
@@ -38,6 +41,7 @@ class MemKV:
                 versions.append((ts, value))
                 if len(versions) > 1 and versions[-2][0] > ts:
                     versions.sort(key=lambda v: v[0])
+            return prev_live
 
     def _ensure_sorted(self):
         if self._dirty:

@@ -6,12 +6,23 @@ into per-region tasks (ref: copr/coprocessor.go:331 buildCopTasks) and the
 mesh layer maps regions onto TPU devices (SURVEY.md §2.5). Epochs support
 the region-error/retry path: a split bumps the epoch, in-flight tasks with
 the stale epoch get EpochNotMatch and re-split, mirroring
-copr/coprocessor.go:1424 handleCopResponse.
+copr/coprocessor.go:1424 handleCopResponse. Merges (PR 3) bump the
+surviving epoch and delete the absorbed region, so stale tasks surface
+either EpochNotMatch or region-not-found — both re-split cleanly.
+
+Placement (region -> store) lives in an authoritative map owned by the
+placement driver (`tidb_tpu/pd`): a split child inherits its parent's
+store (peers stay put, like TiKV), and a lookup miss is routed through
+`PlacementDriver.place_region()` — a recorded least-loaded decision, not
+the seed's silent `region_id % n_stores` guess. All cluster state is
+lock-protected: the PD tick mutates topology from a background Timer
+thread while cop dispatch reads it.
 """
 
 from __future__ import annotations
 
 import bisect
+import threading
 from dataclasses import dataclass, field
 
 KEY_MAX = b"\xff" * 32
@@ -31,52 +42,131 @@ class Region:
 class Cluster:
     """All regions, sorted by start key, covering [b'', KEY_MAX).
 
-    Also plays the mock PD: regions are assigned to stores (the TPU-chip
-    analog of TiKV/TiFlash stores), `scatter()` rebalances round-robin
-    (ref: PD scatter; unistore/pd.go + cluster.go), and the store-global
-    TSO lives on TPUStore."""
+    Region->store placement (stores are the TPU-chip analog of
+    TiKV/TiFlash stores) is authoritative: `scatter()` is the bootstrap
+    round-robin (ref: PD scatter-region), after which the PD's
+    schedulers own every change via `set_store`/`split`/`merge`."""
 
     def __init__(self, n_stores: int = 1):
         self._regions: list[Region] = [Region(1, b"", KEY_MAX)]
         self._next_id = 2
         self.n_stores = max(n_stores, 1)
         self._store_of: dict[int, int] = {1: 0}
+        self._mu = threading.RLock()
+        self.pd = None  # PlacementDriver; owns placement misses when attached
 
     def set_stores(self, n: int):
-        self.n_stores = max(n, 1)
+        with self._mu:
+            self.n_stores = max(n, 1)
         self.scatter()
 
     def store_of(self, region_id: int) -> int:
-        return self._store_of.get(region_id, region_id % self.n_stores)
+        """Authoritative placement lookup. A miss is NOT answered with a
+        modulo guess: it routes through the PD (recorded least-loaded
+        placement) so every subsequent lookup agrees."""
+        with self._mu:
+            sid = self._store_of.get(region_id)
+        if sid is not None:
+            return sid
+        if self.pd is not None:
+            return self.pd.place_region(region_id)
+        return self.place_least_loaded(region_id)
+
+    def place_least_loaded(self, region_id: int) -> int:
+        """Place one region on the store with the fewest regions and
+        record the decision (the PD's placement primitive; also the
+        standalone-Cluster fallback when no PD is attached)."""
+        with self._mu:
+            counts = {i: 0 for i in range(self.n_stores)}
+            for r in self._regions:
+                sid = self._store_of.get(r.region_id)
+                if sid is not None:
+                    counts[sid] = counts.get(sid, 0) + 1
+            target = min(range(self.n_stores), key=lambda i: counts.get(i, 0))
+            if any(r.region_id == region_id for r in self._regions):
+                self._store_of[region_id] = target
+            return target
+
+    def set_store(self, region_id: int, store_id: int) -> None:
+        """Move a region's placement (the PD move-operator primitive)."""
+        with self._mu:
+            self._store_of[region_id] = store_id
+
+    def counts_per_store(self) -> dict[int, int]:
+        with self._mu:
+            counts = {i: 0 for i in range(self.n_stores)}
+            for r in self._regions:
+                sid = self._store_of.get(r.region_id)
+                if sid is not None:
+                    counts[sid] = counts.get(sid, 0) + 1
+            return counts
 
     def scatter(self):
-        """Round-robin region->store placement (ref: PD scatter-region)."""
-        for i, r in enumerate(self._regions):
-            self._store_of[r.region_id] = i % self.n_stores
+        """Round-robin region->store placement (ref: PD scatter-region;
+        bootstrap-time only — steady state belongs to the schedulers)."""
+        with self._mu:
+            for i, r in enumerate(self._regions):
+                self._store_of[r.region_id] = i % self.n_stores
 
     def regions(self) -> list[Region]:
-        return list(self._regions)
+        with self._mu:
+            return list(self._regions)
 
     def region_by_id(self, rid: int) -> Region | None:
-        for r in self._regions:
-            if r.region_id == rid:
-                return r
-        return None
+        with self._mu:
+            for r in self._regions:
+                if r.region_id == rid:
+                    return r
+            return None
 
     def split(self, key: bytes) -> Region:
         """Split the region containing `key` at `key`; bumps both epochs
-        (ref: mockstore SplitKeys)."""
-        i = self._locate(key)
-        r = self._regions[i]
-        if r.start_key == key:
+        (ref: mockstore SplitKeys). The child inherits the parent's store
+        — a split keeps peers in place; rebalancing is a separate PD
+        decision (ref: TiKV split + balance-region)."""
+        with self._mu:
+            i = self._locate(key)
+            r = self._regions[i]
+            if r.start_key == key:
+                return r
+            new = Region(self._next_id, key, r.end_key, epoch=r.epoch + 1)
+            self._next_id += 1
+            r.end_key = key
+            r.epoch += 1
+            self._regions.insert(i + 1, new)
+            self._store_of[new.region_id] = self._store_of.get(r.region_id, 0)
+            if self.pd is not None:  # stats follow the topology, whoever
+                # initiated the split (PD operator, DDL pre-split, tests)
+                self.pd.flow.on_split(r.region_id, new.region_id)
+            return new
+
+    def merge(self, left_id: int, right_id: int | None = None) -> Region | None:
+        """Fold the region right of `left_id` into it (ref: pd
+        merge-checker -> TiKV PrepareMerge/CommitMerge collapsed to one
+        step). The survivor keeps the left placement and bumps its epoch
+        past both inputs; the absorbed region disappears, so stale tasks
+        on it get region-not-found and re-split. When `right_id` is
+        given, the merge only proceeds if it still names the immediate
+        right neighbor (operator-staleness guard). Returns the merged
+        region, or None if the merge cannot happen."""
+        with self._mu:
+            for i, r in enumerate(self._regions):
+                if r.region_id == left_id:
+                    break
+            else:
+                return None
+            if i + 1 >= len(self._regions):
+                return None  # rightmost region has no merge partner
+            right = self._regions[i + 1]
+            if right_id is not None and right.region_id != right_id:
+                return None
+            r.end_key = right.end_key
+            r.epoch = max(r.epoch, right.epoch) + 1
+            del self._regions[i + 1]
+            self._store_of.pop(right.region_id, None)
+            if self.pd is not None:
+                self.pd.flow.on_merge(r.region_id, right.region_id)
             return r
-        new = Region(self._next_id, key, r.end_key, epoch=r.epoch + 1)
-        self._next_id += 1
-        r.end_key = key
-        r.epoch += 1
-        self._regions.insert(i + 1, new)
-        self._store_of[new.region_id] = new.region_id % self.n_stores
-        return new
 
     def split_n(self, start: bytes, end: bytes, n: int, keyfn):
         """Split [start, end) into n regions using keyfn(i) boundaries."""
@@ -89,14 +179,16 @@ class Cluster:
         return max(i, 0)
 
     def locate(self, key: bytes) -> Region:
-        return self._regions[self._locate(key)]
+        with self._mu:
+            return self._regions[self._locate(key)]
 
     def regions_in_range(self, start: bytes, end: bytes) -> list[Region]:
         out = []
-        for r in self._regions:
-            if (r.end_key or KEY_MAX) <= start:
-                continue
-            if r.start_key >= end:
-                break
-            out.append(r)
+        with self._mu:
+            for r in self._regions:
+                if (r.end_key or KEY_MAX) <= start:
+                    continue
+                if r.start_key >= end:
+                    break
+                out.append(r)
         return out

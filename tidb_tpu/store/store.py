@@ -94,12 +94,18 @@ class TPUStore:
     EmbedUnistore, mockstore.go:86)."""
 
     def __init__(self):
+        from ..pd.core import PlacementDriver
         from .txn import TxnEngine
 
         self.kv = MemKV()
         self.cluster = Cluster()
         self.programs = ProgramCache()
-        self.txn = TxnEngine(self.kv, on_commit=self._bump_write_ver)
+        # the control plane: flow stats always record (cheap increments);
+        # the schedulers only act when tick()/timer runs (ref: every
+        # TiKV store heartbeats PD whether or not PD is scheduling)
+        self.pd = PlacementDriver(self)
+        self.txn = TxnEngine(self.kv, on_commit=self._bump_write_ver,
+                             on_apply=self.record_applied_writes)
         self._tso = itertools.count(100)
         self._tso_lock = threading.Lock()
         self._active_snapshots: dict[int, int] = {}
@@ -166,18 +172,38 @@ class TPUStore:
     def _bump_write_ver(self):
         self._write_ver += 1
 
+    def _record_write_flow(self, key: bytes, value: bytes | None, prev_live: bool):
+        """Per-key write flow into the PD heartbeat snapshot (ref: TiKV's
+        flow observer feeding pdpb.RegionHeartbeat bytes/keys_written)."""
+        self.pd.flow.record_write(key, 0 if value is None else len(value),
+                                  prev_live=prev_live, delete=value is None)
+
+    def record_applied_writes(self, items):
+        """Batch write flow for appliers that land many keys at once (2PC
+        commit, bulk ingest, LOAD DATA): items of (key, value|None,
+        prev_live). Called AFTER the kv critical section so the flow
+        bookkeeping never extends the reader-blocking window."""
+        self.pd.flow.record_writes(
+            [(k, 0 if v is None else len(v), prev, v is None) for k, v, prev in items]
+        )
+
     # -- write path (ref: table.AddRecord -> memdb -> prewrite/commit) ------
     def put_row(self, table_id: int, handle: int, col_ids: list[int], datums: list[Datum], ts: int):
         key = tablecodec.encode_row_key(table_id, handle)
-        self.kv.put(key, self._row_encoder.encode(col_ids, datums), ts)
+        val = self._row_encoder.encode(col_ids, datums)
+        prev = self.kv.put(key, val, ts)
+        self._record_write_flow(key, val, prev)
         self._write_ver += 1
 
     def delete_row(self, table_id: int, handle: int, ts: int):
-        self.kv.put(tablecodec.encode_row_key(table_id, handle), None, ts)
+        key = tablecodec.encode_row_key(table_id, handle)
+        prev = self.kv.put(key, None, ts)
+        self._record_write_flow(key, None, prev)
         self._write_ver += 1
 
     def put_index(self, key: bytes, value: bytes, ts: int):
-        self.kv.put(key, value, ts)
+        prev = self.kv.put(key, value, ts)
+        self._record_write_flow(key, value, prev)
         self._write_ver += 1
 
     # -- scan/decode with caching -------------------------------------------
@@ -420,11 +446,15 @@ class TPUStore:
                     page, last_range = self._paged_region_chunk(
                         region, req.ranges, req.dag, req.start_ts, req.paging_size
                     )
-                    in_bytes = page.nbytes()
+                    in_bytes, in_rows = page.nbytes(), page.num_rows()
                     batch = to_device_batch(page, capacity=_pow2(max(page.num_rows(), 1)))
                 else:
-                    in_bytes = self.region_chunk(region, req.ranges, req.dag, req.start_ts).nbytes()
+                    rc = self.region_chunk(region, req.ranges, req.dag, req.start_ts)
+                    in_bytes, in_rows = rc.nbytes(), rc.num_rows()
                     batch = self.region_device_batch(region, req.ranges, req.dag, req.start_ts)
+                # read flow into the PD heartbeat (ref: TiKV flow observer
+                # -> pdpb.RegionHeartbeat bytes/keys_read)
+                self.pd.flow.record_read(region.region_id, in_bytes, in_rows)
                 if dsp is not None:
                     dsp.set("bytes_to_device", in_bytes)
             batches = [batch] + [self._aux_batch(c) for c in req.aux_chunks]

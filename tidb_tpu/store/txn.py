@@ -63,11 +63,13 @@ class Lock:
 
 
 class TxnEngine:
-    def __init__(self, kv: MemKV, on_commit=None):
+    def __init__(self, kv: MemKV, on_commit=None, on_apply=None):
         self.kv = kv
         self.locks: dict[bytes, Lock] = {}
         self._mu = threading.RLock()
         self._on_commit = on_commit  # store cache-invalidation hook
+        self._on_apply = on_apply  # batch hook: [(key, value|None, prev_live)]
+        # called AFTER the kv critical section (PD write flow)
 
     # ------------------------------------------------------------------
     def acquire_pessimistic(self, keys: list, primary: bytes, start_ts: int, for_update_ts: int):
@@ -110,6 +112,7 @@ class TxnEngine:
         TSO, no reader can have obtained read_ts >= commit_ts before the
         whole apply is visible — snapshot isolation without the reference's
         lock-wait/resolve read path. Returns the commit_ts used."""
+        applied = []
         with self._mu:
             staged = []
             for k in keys:
@@ -123,8 +126,13 @@ class TxnEngine:
                 if callable(commit_ts):
                     commit_ts = commit_ts()
                 for k, l in staged:
-                    self.kv.put(k, None if l.is_delete else l.value, commit_ts)
+                    v = None if l.is_delete else l.value
+                    prev = self.kv.put(k, v, commit_ts)
                     del self.locks[k]
+                    applied.append((k, v, prev))
+        if self._on_apply is not None and applied:
+            self._on_apply(applied)  # outside the locks — flow bookkeeping
+            # must never extend the window in which readers are blocked
         if self._on_commit is not None and staged:
             self._on_commit()
         return commit_ts
@@ -184,7 +192,10 @@ class TxnEngine:
         """Atomically verify-and-apply (key, value) pairs (BR restore —
         no value-level duplicate checks needed; LOAD DATA wraps its whole
         check+apply in ingest_guard instead)."""
+        applied = []
         with self.ingest_guard():
             self.check_unlocked([k for k, _ in items])
             for k, v in items:
-                self.kv.put(k, v, ts)
+                applied.append((k, v, self.kv.put(k, v, ts)))
+        if self._on_apply is not None and applied:
+            self._on_apply(applied)

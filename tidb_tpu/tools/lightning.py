@@ -132,8 +132,10 @@ def load_data(session, stmt) -> int:
                     vals = [datums[pos[cn]] for cn in idx.col_names] + [Datum.i64(handle)]
                     items.append((tablecodec.encode_index_key(meta.table_id, idx.index_id, vals), b"\x00"))
             session.store.txn.check_unlocked([k for k, _ in items])
-            for k, v in items:
-                session.store.kv.put(k, v, ts)
+            applied = [(k, v, session.store.kv.put(k, v, ts)) for k, v in items]
+        # PD write flow AFTER the guard: bulk-loaded regions must report
+        # their size/keys or the merge-checker sees them as empty
+        session.store.record_applied_writes(applied)
         session.store._bump_write_ver()
         # stats track per durable batch (a later failed batch must not
         # leave committed rows uncounted)

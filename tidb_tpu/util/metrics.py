@@ -264,6 +264,10 @@ COP_EXECUTOR_ROWS = REGISTRY.counter_vec(
     labelnames=("executor",),
 )
 DISTSQL_TASKS = REGISTRY.counter("tidb_tpu_distsql_tasks_total", "per-region cop tasks dispatched")
+DISTSQL_STORE_TASKS = REGISTRY.counter_vec(
+    "tidb_tpu_distsql_store_tasks_total", "cop tasks dispatched per placement store",
+    labelnames=("store",),
+)
 DISTSQL_TASK_DURATION = REGISTRY.histogram_vec(
     "tidb_tpu_distsql_task_duration_seconds", "per-region cop task latency incl. paging+retries",
     labelnames=("scan",),
@@ -287,3 +291,24 @@ STATEMENTS = REGISTRY.counter_vec(
 OPEN_TXNS = REGISTRY.gauge("tidb_tpu_open_txns", "transactions currently open")
 NATIVE_DECODES = REGISTRY.counter("tidb_tpu_native_decode_batches_total", "region batches decoded by the C++ rowcodec")
 NATIVE_DECODE_FALLBACKS = REGISTRY.counter("tidb_tpu_native_decode_fallbacks_total", "native decode errors served by the python decoder")
+
+# placement driver (tidb_tpu/pd) — its own pd_ namespace, like the
+# reference PD process exposing pd_scheduler_*/pd_hotspot_* families
+PD_REGION_HEARTBEATS = REGISTRY.counter("pd_region_heartbeat_total", "region heartbeat snapshots absorbed by the PD")
+PD_OPERATORS = REGISTRY.counter_vec(
+    "pd_operator_total", "operators admitted to the PD queue by type",
+    labelnames=("type",),
+)
+PD_OPERATOR_TIMEOUTS = REGISTRY.counter("pd_operator_timeout_total", "pending operators expired before dispatch")
+PD_OPERATOR_PENDING = REGISTRY.gauge("pd_operator_pending", "operators waiting in the PD queue")
+PD_HOT_REGION = REGISTRY.gauge_vec(
+    "pd_hot_region", "hot regions (read or write) placed on each store",
+    labelnames=("store",),
+)
+PD_STORE_REGIONS = REGISTRY.gauge_vec(
+    "pd_store_regions", "regions placed on each store",
+    labelnames=("store",),
+)
+PD_REGIONS = REGISTRY.gauge("pd_regions", "regions in the cluster")
+PD_PLACEMENT_DECISIONS = REGISTRY.counter("pd_placement_decision_total", "placement-map misses resolved by a PD least-loaded decision")
+PD_TICK_DURATION = REGISTRY.histogram("pd_tick_seconds", "PD scheduling tick latency")
