@@ -625,6 +625,70 @@ def _pd_skew_main():
     }))
 
 
+def _batch_cop_main():
+    """BENCH_BATCH_COP=1: per-region vs batched coprocessor dispatch over a
+    PD-split table (>=16 regions, one store) — the launch-count scenario
+    (ISSUE 4). Hermetic CPU: the quantity under test is per-launch dispatch
+    overhead (N serialized XLA launches vs ONE vmapped launch), which is a
+    host-side property; the cop result cache is drained between runs so
+    every timed statement really decodes and launches."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tidb_tpu.codec import tablecodec
+    from tidb_tpu.sql.session import Session
+    from tidb_tpu.util import metrics
+
+    n_regions, rows, reps = 16, 1600, 6
+    s = Session()
+    s.execute("CREATE TABLE bc (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO bc VALUES " + ",".join(f"({i},{i % 97})" for i in range(rows)))
+    tid = s.catalog.table("bc").table_id
+    for i in range(1, n_regions):
+        s.store.cluster.split(tablecodec.encode_row_key(tid, i * rows // n_regions))
+    query = "SELECT count(*), sum(v) FROM bc WHERE v < 50"
+
+    def drain_cop_cache():
+        with s.store._cop_lock:
+            s.store._cop_cache.clear()
+
+    def measure(mode_on: bool):
+        s.execute(f"SET tidb_allow_batch_cop = {'ON' if mode_on else 'OFF'}")
+        drain_cop_cache()
+        s.execute(query)  # warm: compiles excluded from the timed runs
+        times, launches = [], []
+        for _ in range(reps):
+            drain_cop_cache()
+            l0 = metrics.PROGRAM_LAUNCHES.value
+            t0 = time.perf_counter()
+            s.execute(query)
+            times.append(time.perf_counter() - t0)
+            launches.append(metrics.PROGRAM_LAUNCHES.value - l0)
+        return statistics.median(times), statistics.median(launches)
+
+    t_plain, l_plain = measure(False)
+    t_batch, l_batch = measure(True)
+    log(f"  per-region: {t_plain*1e3:.1f}ms, {l_plain} launches; "
+        f"batched: {t_batch*1e3:.1f}ms, {l_batch} launches")
+    print(json.dumps({
+        "metric": "batch_cop_dispatch",
+        "regions": n_regions,
+        "rows": rows,
+        "launches_per_query_per_region": l_plain,
+        "launches_per_query_batched": l_batch,
+        "launches_saved": l_plain - l_batch,
+        "wall_ms_per_region": round(t_plain * 1e3, 2),
+        "wall_ms_batched": round(t_batch * 1e3, 2),
+        "speedup": round(t_plain / max(t_batch, 1e-9), 2),
+    }))
+
+
 def _config_rows(name: str) -> int:
     # every config now runs the full 4M-row resident batch: q3's packed
     # join+groupsum kernel (r5) compiles in ~75s warm-cache at 4M — the
@@ -701,6 +765,9 @@ def main():
         return
     if os.environ.get("BENCH_PD_SKEW"):
         _pd_skew_main()
+        return
+    if os.environ.get("BENCH_BATCH_COP"):
+        _batch_cop_main()
         return
     if os.environ.get("BENCH_PARITY"):
         _parity_only_main(os.environ["BENCH_PARITY"])

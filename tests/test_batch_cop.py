@@ -1,0 +1,387 @@
+"""Batched multi-region coprocessor: one vmapped XLA launch per store batch
+(ref: copr/batch_coprocessor.go — all regions of a TiFlash store travel in
+one request) + the coprocessor result cache (ref: copr/coprocessor_cache.go).
+
+Covers the batch interaction contract: launch-count regression guard (one
+compile + one launch for a >=16-region scan, then cache hits per repeated
+batch shape), epoch-mismatch of ONE region mid-batch retrying only that
+region, paging exclusion, the batched wire frames, per-region overflow
+fall-out, cop-cache hit/invalidation, aux-cache token identity, and
+deterministic exec-summary ordering (keep_order)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.codec import tablecodec
+from tidb_tpu.distsql import KVRequest, full_table_ranges, select
+from tidb_tpu.exec import Aggregation, ColumnInfo, DAGRequest, Selection, TableScan
+from tidb_tpu.expr import AggDesc, col, func, lit
+from tidb_tpu.store import CopRequest, KeyRange, TPUStore
+from tidb_tpu.types import Datum, new_longlong
+from tidb_tpu.util import metrics
+
+BOOL = new_longlong(notnull=True)
+TID = 91
+FT = new_longlong()
+
+
+def fill_store(n=340, regions=17):
+    """n rows of (v = 3*handle) split into `regions` PD regions, 1 store."""
+    store = TPUStore()
+    for h in range(n):
+        store.put_row(TID, h, [1], [Datum.i64(h * 3)], ts=10)
+    for i in range(1, regions):
+        store.cluster.split(tablecodec.encode_row_key(TID, i * n // regions))
+    assert len(store.cluster.regions()) == regions
+    return store
+
+
+def scan_dag():
+    scan = TableScan(TID, (ColumnInfo(1, FT),))
+    return DAGRequest((scan,), output_offsets=(0,))
+
+
+def agg_dag():
+    scan = TableScan(TID, (ColumnInfo(1, FT),))
+    sel = Selection((func("lt", BOOL, col(0, FT), lit(300, new_longlong())),))
+    agg = Aggregation(group_by=(), aggs=(AggDesc("count", ()),), partial=True)
+    return DAGRequest((scan, sel, agg), output_offsets=(0,))
+
+
+def kvreq(dag, ts, **kw):
+    return KVRequest(dag, full_table_ranges(TID), start_ts=ts, **kw)
+
+
+def all_vals(res):
+    return sorted(r[0].val for r in res.merged().rows())
+
+
+# ------------------------------------------------- launch-count regression
+
+
+def test_one_launch_per_store_for_16_regions():
+    """The acceptance bar: >=16 regions, batch_cop=True -> ONE XLA program
+    execution (and one compile) on the store for the whole scan."""
+    store = fill_store(n=340, regions=17)
+    l0 = metrics.PROGRAM_LAUNCHES.value
+    s0 = store.programs.stats()
+    res = select(store, kvreq(scan_dag(), 100, batch_cop=True))
+    launches = metrics.PROGRAM_LAUNCHES.value - l0
+    s1 = store.programs.stats()
+    assert launches == 1  # one vmapped launch, not 17
+    assert s1["compiles"] - s0["compiles"] == 1
+    assert res.batch_stats == {"batches": 1, "regions": 17, "launches_saved": 16}
+    assert all_vals(res) == [h * 3 for h in range(340)]
+    assert len(res.exec_summaries) == 17  # still one summary list per region
+
+
+def test_one_compile_then_hits_per_batch_shape():
+    """Same batch shape again (after a write invalidates the cop result
+    cache): the vmapped program comes from the ProgramCache — one compile
+    per shape, cache hits and exactly one launch per repeat."""
+    store = fill_store(n=340, regions=17)
+    select(store, kvreq(scan_dag(), 100, batch_cop=True))  # compile + warm
+    for ts in (200, 300, 400):
+        # a write bumps the store write version: cop cache misses, the
+        # decode reruns, but the program (same shape) must NOT recompile
+        store.put_row(TID, 0, [1], [Datum.i64(0)], ts=ts - 10)
+        s0 = store.programs.stats()
+        l0 = metrics.PROGRAM_LAUNCHES.value
+        res = select(store, kvreq(scan_dag(), ts, batch_cop=True))
+        s1 = store.programs.stats()
+        assert s1["compiles"] - s0["compiles"] == 0
+        assert s1["hits"] - s0["hits"] == 1
+        assert metrics.PROGRAM_LAUNCHES.value - l0 == 1
+        assert all_vals(res) == [h * 3 for h in range(340)]
+
+
+def test_batched_matches_per_region_partial_agg():
+    store = fill_store(n=200, regions=8)
+    dag = agg_dag()
+    plain = select(store, kvreq(dag, 100, concurrency=4))
+    store.evict_caches()  # defeat the cop cache: exercise the real launch
+    batched = select(store, kvreq(dag, 101, batch_cop=True))
+    assert sum(all_vals(plain)) == sum(all_vals(batched)) == 100
+    assert plain.batch_stats is None
+    assert batched.batch_stats["regions"] == 8
+
+
+# ------------------------------------------------- batch interaction edges
+
+
+def test_capacity_buckets_split_skewed_regions():
+    """Regions bucket by their own pow2 capacity before stacking: a skewed
+    region must not inflate every lane to its padded size. 4x20-row and
+    3x40-row regions -> two vmapped launches (32- and 64-capacity), never
+    one 64-capacity launch over all seven."""
+    store = TPUStore()
+    n = 200
+    for h in range(n):
+        store.put_row(TID, h, [1], [Datum.i64(h * 3)], ts=10)
+    for b in (20, 40, 60, 80, 120, 160):
+        store.cluster.split(tablecodec.encode_row_key(TID, b))
+    l0 = metrics.PROGRAM_LAUNCHES.value
+    res = select(store, kvreq(scan_dag(), 100, batch_cop=True))
+    assert res.batch_stats == {"batches": 2, "regions": 7, "launches_saved": 5}
+    assert metrics.PROGRAM_LAUNCHES.value - l0 == 2
+    assert all_vals(res) == [h * 3 for h in range(n)]
+
+
+def test_epoch_mismatch_one_region_retries_only_that_region():
+    """A concurrent split lands between task build and dispatch: the stale
+    region falls out of the batch into the single-task retry path; every
+    other region's batched result stands."""
+    store = fill_store(n=200, regions=8)
+    orig = store.batch_coprocessor
+    fired = []
+
+    def hijack(reqs, **kw):
+        if not fired:
+            fired.append(1)
+            store.cluster.split(tablecodec.encode_row_key(TID, 10))
+        return orig(reqs, **kw)
+
+    store.batch_coprocessor = hijack
+    r0 = metrics.DISTSQL_RETRIES.value
+    res = select(store, kvreq(scan_dag(), 100, batch_cop=True))
+    assert metrics.DISTSQL_RETRIES.value - r0 == 1  # only the split region
+    assert res.batch_stats["regions"] == 7  # the other 7 stayed batched
+    assert all_vals(res) == [h * 3 for h in range(200)]
+
+
+def test_paging_requests_are_excluded_from_batching():
+    store = fill_store(n=200, regions=8)
+    called = []
+    orig = store.batch_coprocessor
+    store.batch_coprocessor = lambda *a, **k: called.append(1) or orig(*a, **k)
+    res = select(store, kvreq(scan_dag(), 100, batch_cop=True, paging_size=16))
+    assert not called  # paging bypasses the batch path entirely
+    assert res.batch_stats is None
+    assert all_vals(res) == [h * 3 for h in range(200)]
+
+
+def test_store_batch_endpoint_stale_epoch_inline():
+    """batch_coprocessor itself: a stale-epoch request answers with a
+    region_error without poisoning the rest of the batch."""
+    store = fill_store(n=200, regions=4)
+    dag = scan_dag()
+    regions = store.cluster.regions()
+    reqs = [CopRequest(dag, full_table_ranges(TID), 100, r.region_id, r.epoch)
+            for r in regions]
+    reqs[1] = CopRequest(dag, full_table_ranges(TID), 100,
+                         regions[1].region_id, regions[1].epoch + 7)
+    resps = store.batch_coprocessor(reqs)
+    assert "epoch_not_match" in resps[1].region_error
+    ok = [r for i, r in enumerate(resps) if i != 1]
+    assert all(r.region_error is None and r.chunk is not None for r in ok)
+
+
+def test_batched_overflow_lane_falls_out_alone():
+    """A tiny group capacity overflows the vmapped lanes; each lane then
+    rides the single-region capacity ladder and the results still match."""
+    store = fill_store(n=120, regions=4)
+    scan = TableScan(TID, (ColumnInfo(1, FT),))
+    agg = Aggregation(group_by=(col(0, FT),), aggs=(AggDesc("count", ()),), partial=True)
+    dag = DAGRequest((scan, agg), output_offsets=(0, 1))
+    regions = store.cluster.regions()
+    reqs = [CopRequest(dag, full_table_ranges(TID), 100, r.region_id, r.epoch)
+            for r in regions]
+    resps = store.batch_coprocessor(reqs, group_capacity=2)  # forces overflow
+    assert all(r.region_error is None and r.other_error is None for r in resps)
+    total = sum(row[0].val for r in resps for row in r.chunk.rows())
+    assert total == 120  # every row counted exactly once
+
+
+# ------------------------------------------------- wire frames
+
+
+def test_batch_wire_codec_roundtrip():
+    from tidb_tpu.codec.wire import (
+        decode_batch_cop_request,
+        decode_batch_cop_response,
+        encode_batch_cop_request,
+        encode_batch_cop_response,
+    )
+
+    dag = scan_dag()
+    reqs = [CopRequest(dag, [KeyRange(b"a", b"z")], 5, region_id=i, region_epoch=i + 1)
+            for i in range(3)]
+    back = decode_batch_cop_request(encode_batch_cop_request(reqs))
+    assert [(r.region_id, r.region_epoch, r.start_ts) for r in back] == \
+        [(0, 1, 5), (1, 2, 5), (2, 3, 5)]
+
+    store = fill_store(n=80, regions=4)
+    creqs = [CopRequest(dag, full_table_ranges(TID), 100, r.region_id, r.epoch)
+             for r in store.cluster.regions()]
+    resps = store.batch_coprocessor(creqs)
+    rt = decode_batch_cop_response(encode_batch_cop_response(resps))
+    assert len(rt) == len(resps)
+    for a, b in zip(resps, rt):
+        assert a.chunk.num_rows() == b.chunk.num_rows()
+        assert [s.num_produced_rows for s in a.exec_summaries] == \
+            [s.num_produced_rows for s in b.exec_summaries]
+
+
+def test_batched_dispatch_over_wire_matches():
+    store = fill_store(n=200, regions=8)
+    res = select(store, kvreq(scan_dag(), 100, batch_cop=True, use_wire=True))
+    assert all_vals(res) == [h * 3 for h in range(200)]
+
+
+def test_batch_wire_shares_decoded_aux_identity():
+    """Every region task of a broadcast join carries the same build side:
+    a batch frame must decode it ONCE so the store's identity-keyed group
+    and aux-upload caches still work across the wire seam."""
+    from tidb_tpu.chunk import Chunk
+    from tidb_tpu.codec.wire import decode_batch_cop_request, encode_batch_cop_request
+
+    aux = Chunk.from_rows([FT], [[Datum.i64(9)], [Datum.i64(10)]])
+    dag = scan_dag()
+    reqs = [CopRequest(dag, [KeyRange(b"a", b"z")], 5, region_id=i,
+                       region_epoch=1, aux_chunks=[aux]) for i in range(3)]
+    back = decode_batch_cop_request(encode_batch_cop_request(reqs))
+    assert back[0].aux_chunks[0] is back[1].aux_chunks[0] is back[2].aux_chunks[0]
+
+
+# ------------------------------------------------- coprocessor result cache
+
+
+def test_cop_cache_hits_and_write_invalidation():
+    store = fill_store(n=200, regions=8)
+    dag = scan_dag()
+    select(store, kvreq(dag, 100, concurrency=2))  # populate
+    h0 = metrics.COP_CACHE_HITS.value
+    l0 = metrics.PROGRAM_LAUNCHES.value
+    res = select(store, kvreq(dag, 101, concurrency=2))
+    assert metrics.COP_CACHE_HITS.value - h0 == 8  # every region served cached
+    assert metrics.PROGRAM_LAUNCHES.value - l0 == 0  # zero device work
+    assert all(s.cache_hit and s.time_compile_ns == 0
+               for task in res.exec_summaries for s in task)
+    assert all_vals(res) == [h * 3 for h in range(200)]
+    # a write invalidates: the next read must NOT serve stale data
+    store.put_row(TID, 0, [1], [Datum.i64(-5)], ts=150)
+    h1 = metrics.COP_CACHE_HITS.value
+    res2 = select(store, kvreq(dag, 200, concurrency=2))
+    assert metrics.COP_CACHE_HITS.value - h1 == 0
+    assert all_vals(res2)[0] == -5
+
+
+def test_cop_cache_rejects_older_snapshot():
+    """An entry built at ts=100 must not serve a request at ts=90 — the
+    older snapshot could predate a commit the entry already includes."""
+    store = fill_store(n=40, regions=2)
+    dag = scan_dag()
+    r = store.cluster.regions()[0]
+    req_new = CopRequest(dag, full_table_ranges(TID), 100, r.region_id, r.epoch)
+    store.coprocessor(req_new)
+    h0 = metrics.COP_CACHE_HITS.value
+    req_old = CopRequest(dag, full_table_ranges(TID), 90, r.region_id, r.epoch)
+    store.coprocessor(req_old)
+    assert metrics.COP_CACHE_HITS.value - h0 == 0
+    store.coprocessor(CopRequest(dag, full_table_ranges(TID), 110, r.region_id, r.epoch))
+    assert metrics.COP_CACHE_HITS.value - h0 == 1
+
+
+def test_cop_cache_drained_by_evict():
+    store = fill_store(n=80, regions=4)
+    select(store, kvreq(scan_dag(), 100))
+    assert len(store._cop_cache) > 0
+    freed = store.evict_caches()
+    assert freed > 0 and len(store._cop_cache) == 0
+    h0 = metrics.COP_CACHE_HITS.value
+    select(store, kvreq(scan_dag(), 101))
+    assert metrics.COP_CACHE_HITS.value - h0 == 0  # cold after evict
+
+
+def test_cop_cache_metric_exposed():
+    names = [series for series, _ in metrics.REGISTRY.sample_lines()]
+    assert any("tidb_tpu_cop_cache_hits_total" in n for n in names)
+    assert any("tidb_tpu_batch_cop_batches_total" in n for n in names)
+    assert any("tidb_tpu_program_launches_total" in n for n in names)
+
+
+# ------------------------------------------------- aux cache token identity
+
+
+def test_aux_cache_keys_by_token_not_id():
+    from tidb_tpu.chunk import Chunk
+
+    store = TPUStore()
+    a = Chunk.from_rows([FT], [[Datum.i64(1)]])
+    b = Chunk.from_rows([FT], [[Datum.i64(1)]])
+    ba = store._aux_batch(a)
+    bb = store._aux_batch(b)
+    assert ba is not bb  # equal content, distinct identity -> distinct entries
+    assert store._aux_batch(a) is ba  # stable per object
+    ta, tb = a._device_token, b._device_token
+    assert ta != tb
+    # tokens are monotonic and never reused, even if id() were recycled
+    c = Chunk.from_rows([FT], [[Datum.i64(2)]])
+    store._aux_batch(c)
+    assert c._device_token > max(ta, tb)
+
+
+# ------------------------------------------------- summary determinism
+
+
+def test_exec_summaries_follow_task_order():
+    """Regions of DIFFERENT sizes dispatched over a pool: the scan summary
+    row counts must come back in region (task) order, not completion order
+    — EXPLAIN ANALYZE attribution is deterministic (keep_order)."""
+    store = TPUStore()
+    n = 100
+    for h in range(n):
+        store.put_row(TID, h, [1], [Datum.i64(h)], ts=10)
+    for boundary in (10, 30, 60):  # region sizes 10, 20, 30, 40
+        store.cluster.split(tablecodec.encode_row_key(TID, boundary))
+    for _ in range(3):
+        store.evict_caches()  # defeat the cop cache: run the real path
+        res = select(store, kvreq(scan_dag(), 100, concurrency=4, keep_order=True))
+        assert [task[0].num_produced_rows for task in res.exec_summaries] == \
+            [10, 20, 30, 40]
+
+
+# ------------------------------------------------- SQL-level integration
+
+
+def test_sql_batch_cop_matches_and_explains():
+    from tidb_tpu.sql.session import Session
+
+    s = Session()
+    s.execute("CREATE TABLE bt (id INT PRIMARY KEY, v INT)")
+    s.execute("INSERT INTO bt VALUES " + ",".join(f"({i},{i % 13})" for i in range(1, 401)))
+    tid = s.catalog.table("bt").table_id
+    for i in range(1, 17):
+        s.store.cluster.split(tablecodec.encode_row_key(tid, i * 400 // 17))
+    plain = s.execute("SELECT count(*), sum(v) FROM bt WHERE v < 7").values()
+    s.execute("SET tidb_allow_batch_cop = ON")
+    l0 = metrics.PROGRAM_LAUNCHES.value
+    batched = s.execute("SELECT count(*), sum(v) FROM bt WHERE v < 7").values()
+    assert plain == batched
+    # one batched push launch + the root merge's launch, never 17
+    assert metrics.PROGRAM_LAUNCHES.value - l0 <= 3
+    s.store.evict_caches()  # drain the cop cache: attribute a REAL launch
+    rows = s.execute("EXPLAIN ANALYZE SELECT count(*), sum(v) FROM bt WHERE v < 7").values()
+    by_exec = {r[0]: r for r in rows}
+    bc = by_exec["batch_cop"]
+    assert bc[1] >= 16 and bc[2] >= 1  # regions batched, launches
+    assert bc[5].startswith("saved=") and int(bc[5].split("=")[1]) >= 15
+    # same statement again: every region now comes from the cop result
+    # cache, which did NOT ride a launch — attribution must say so
+    rows2 = s.execute("EXPLAIN ANALYZE SELECT count(*), sum(v) FROM bt WHERE v < 7").values()
+    bc2 = {r[0]: r for r in rows2}["batch_cop"]
+    assert bc2[1] == 0 and bc2[5] == "saved=0"
+
+
+def test_trace_batch_cop_attribution():
+    from tidb_tpu.util import tracing
+
+    store = fill_store(n=200, regions=8)
+    with tracing.trace("test") as root:
+        select(store, kvreq(scan_dag(), 100, batch_cop=True))
+    batch_spans = root.find("distsql.batch_cop")
+    assert len(batch_spans) == 1
+    assert root.sum_attr("distsql.batch_cop", "batch_size") == 8
+    assert root.sum_attr("distsql.batch_cop", "launches_saved") == 7
+    # per-region cop_task spans still exist under the batch span
+    assert len(root.find("distsql.cop_task")) == 8

@@ -9,7 +9,9 @@ from .column import Column
 
 
 class Chunk:
-    __slots__ = ("columns",)
+    # _device_token: lazily-assigned monotonic identity used by the store's
+    # device-batch caches (id() is reused after GC; a token never is)
+    __slots__ = ("columns", "_device_token")
 
     def __init__(self, columns: list[Column]):
         self.columns = columns

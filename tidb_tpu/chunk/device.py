@@ -120,15 +120,7 @@ def to_device_batch(chunk: Chunk, capacity: int | None = None, str_widths: dict[
     cap = capacity or max(1, n)
     cols = []
     for ci, col in enumerate(chunk.columns):
-        if col.ft.is_string() and col.ft.is_ci() and col.is_varlen() and len(col):
-            # the device CI kernels fold ASCII only; any non-ASCII byte in
-            # a case/accent-insensitive column routes the whole plan to the
-            # weight-based oracle (executor.py's NotImplementedError
-            # fallback) rather than comparing wrongly (VERDICT r4 weak #6)
-            if col.blob is not None and col.blob.size and int(col.blob.max()) >= 0x80:
-                raise NotImplementedError(
-                    "non-ASCII data under a CI collation is oracle-evaluated"
-                )
+        _check_ci_ascii(col)
         w = (str_widths or {}).get(ci)
         data, null, length = host_column_arrays(col, cap, w)
         cols.append(
@@ -142,6 +134,77 @@ def to_device_batch(chunk: Chunk, capacity: int | None = None, str_widths: dict[
     row_valid = np.zeros(cap, bool)
     row_valid[:n] = True
     return DeviceBatch(cols, jnp.asarray(row_valid), jnp.int32(n))
+
+
+def shared_str_widths(chunks: list[Chunk]) -> dict[int, int]:
+    """Per-column max byte width across a batch of same-schema chunks — the
+    shared varlen layout a region-stacked batch must agree on (each region's
+    own max would give ragged [N, W] planes that cannot stack)."""
+    widths: dict[int, int] = {}
+    for ch in chunks:
+        for ci, col in enumerate(ch.columns):
+            if not col.is_varlen():
+                continue
+            w = 1
+            if len(col):
+                w = max(int((col.offsets[1:] - col.offsets[:-1]).max()), 1)
+            widths[ci] = max(widths.get(ci, 1), w)
+    return widths
+
+
+def _check_ci_ascii(col: Column) -> None:
+    """The device CI kernels fold ASCII only; any non-ASCII byte in a
+    case/accent-insensitive column routes the whole plan to the
+    weight-based oracle (executor.py's NotImplementedError fallback)
+    rather than comparing wrongly (VERDICT r4 weak #6). THE one routing
+    check — both the single-region and the stacked batch builders call
+    it, so batched and per-region dispatch can never route differently."""
+    if col.ft.is_string() and col.ft.is_ci() and col.is_varlen() and len(col):
+        if col.blob is not None and col.blob.size and int(col.blob.max()) >= 0x80:
+            raise NotImplementedError(
+                "non-ASCII data under a CI collation is oracle-evaluated"
+            )
+
+
+def to_stacked_device_batch(chunks: list[Chunk], capacity: int) -> DeviceBatch:
+    """Stack same-schema chunks into ONE region-batched DeviceBatch whose
+    every leaf carries a leading region axis: data [B, cap, ...], null/
+    row_valid [B, cap], n_rows [B]. This is the input shape of the vmapped
+    fused program (the batch-coprocessor analog of stacking per-region
+    fragments for one launch); `jax.vmap(program, in_axes=0)` maps each
+    region lane back to the single-region program unchanged.
+
+    All chunks must share a schema; varlen columns are padded to the
+    batch-wide max width (shared_str_widths). Stacking happens host-side so
+    the whole batch ships to HBM in one transfer per column."""
+    assert chunks, "cannot stack an empty region batch"
+    widths = shared_str_widths(chunks)
+    n_cols = chunks[0].num_cols()
+    cols: list[DeviceColumn] = []
+    for ci in range(n_cols):
+        datas, nulls, lengths = [], [], []
+        for ch in chunks:
+            col = ch.columns[ci]
+            _check_ci_ascii(col)
+            data, null, length = host_column_arrays(col, capacity, widths.get(ci))
+            datas.append(data)
+            nulls.append(null)
+            lengths.append(length)
+        ft = chunks[0].columns[ci].ft
+        has_len = lengths[0] is not None
+        cols.append(
+            DeviceColumn(
+                jnp.asarray(np.stack(datas)),
+                jnp.asarray(np.stack(nulls)),
+                jnp.asarray(np.stack(lengths)) if has_len else None,
+                ft,
+            )
+        )
+    row_valid = np.zeros((len(chunks), capacity), bool)
+    for b, ch in enumerate(chunks):
+        row_valid[b, : ch.num_rows()] = True
+    n_rows = np.array([ch.num_rows() for ch in chunks], np.int32)
+    return DeviceBatch(cols, jnp.asarray(row_valid), jnp.asarray(n_rows))
 
 
 def pack_string_words(data: jax.Array, length: jax.Array, n_words: int = STRING_WORDS) -> jax.Array:

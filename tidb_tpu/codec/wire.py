@@ -450,7 +450,12 @@ def decode_chunk(b: bytes) -> Chunk:
 
 # -------------------------------------------------------------- cop seam
 
-def encode_cop_request(req) -> bytes:
+def encode_cop_request(req, _aux_index=None) -> bytes:
+    """_aux_index (chunk -> table index) switches the aux section to
+    back-references into a frame-level chunk table: a batch frame carries
+    each distinct broadcast build side ONCE instead of once per region
+    request (N regions x one 64MB build side must not make an N*64MB
+    frame). None keeps the self-contained single-request layout."""
     w = Writer()
     b = encode_dag(req.dag)
     w.blob(b)
@@ -463,13 +468,21 @@ def encode_cop_request(req) -> bytes:
     w.i64(req.region_epoch)
     w.i32(len(req.aux_chunks))
     for c in req.aux_chunks:
-        w.blob(encode_chunk(c))
+        if _aux_index is None:
+            w.blob(encode_chunk(c))
+        else:
+            w.i32(_aux_index(c))
     w.i32(-1 if req.paging_size is None else req.paging_size)
     w.i32(-1 if req.small_groups is None else req.small_groups)
     return w.done()
 
 
-def decode_cop_request(b: bytes):
+def decode_cop_request(b: bytes, _aux_table: list | None = None):
+    """_aux_table is the batch frame's shared chunk table: every region
+    task of a broadcast join references the SAME decoded build side, which
+    restores the object identity the store's batch grouping and aux-upload
+    cache key on — without it, wire-mode batching would decode N distinct
+    copies and every group would collapse to a singleton."""
     from ..store.store import CopRequest, KeyRange
 
     r = Reader(b)
@@ -478,7 +491,11 @@ def decode_cop_request(b: bytes):
     start_ts = r.i64()
     region_id = r.i64()
     epoch = r.i64()
-    aux = [decode_chunk(r.blob()) for _ in range(r.i32())]
+    n_aux = r.i32()
+    if _aux_table is None:
+        aux = [decode_chunk(r.blob()) for _ in range(n_aux)]
+    else:
+        aux = [_aux_table[r.i32()] for _ in range(n_aux)]
     paging = r.i32()
     smg = r.i32()
     return CopRequest(dag, ranges, start_ts, region_id, epoch, aux,
@@ -507,6 +524,7 @@ def encode_cop_response(resp) -> bytes:
         for rg in resp.last_range:
             w.blob(rg.start)
             w.blob(rg.end)
+    w.i32(int(getattr(resp, "batched", 0)))
     return w.done()
 
 
@@ -524,4 +542,54 @@ def decode_cop_response(b: bytes):
     last_range = None
     if r.bool_():
         last_range = [KeyRange(r.blob(), r.blob()) for _ in range(r.i32())]
-    return CopResponse(chunk, region_error, other_error, summaries, last_range)
+    batched = r.i32() if r.i < len(r.b) else 0
+    return CopResponse(chunk, region_error, other_error, summaries, last_range, batched)
+
+
+# ----------------------------------------------------- batched cop frames
+
+def encode_batch_cop_request(reqs) -> bytes:
+    """N cop requests in one frame — the batch-coprocessor wire shape (ref:
+    copr/batch_coprocessor.go batching all of a store's region tasks into
+    one RPC). Layout: request frames with aux back-references, then the
+    shared chunk table — each DISTINCT broadcast build side travels once
+    per frame, however many region requests carry it."""
+    w = Writer()
+    w.i32(len(reqs))
+    table: list = []
+    index: dict[int, int] = {}
+
+    def aux_index(c) -> int:
+        k = id(c)  # objects stay alive for the duration of this call
+        if k not in index:
+            index[k] = len(table)
+            table.append(c)
+        return index[k]
+
+    for req in reqs:
+        w.blob(encode_cop_request(req, _aux_index=aux_index))
+    w.i32(len(table))
+    for c in table:
+        w.blob(encode_chunk(c))
+    return w.done()
+
+
+def decode_batch_cop_request(b: bytes) -> list:
+    r = Reader(b)
+    blobs = [r.blob() for _ in range(r.i32())]
+    table = [decode_chunk(r.blob()) for _ in range(r.i32())]
+    return [decode_cop_request(bb, _aux_table=table) for bb in blobs]
+
+
+def encode_batch_cop_response(resps) -> bytes:
+    """N cop responses in one frame, request order preserved."""
+    w = Writer()
+    w.i32(len(resps))
+    for resp in resps:
+        w.blob(encode_cop_response(resp))
+    return w.done()
+
+
+def decode_batch_cop_response(b: bytes) -> list:
+    r = Reader(b)
+    return [decode_cop_response(r.blob()) for _ in range(r.i32())]

@@ -72,10 +72,17 @@ class CopTask:
 
 @dataclass
 class SelectResult:
-    """(ref: distsql.SelectResult select_result.go:63)."""
+    """(ref: distsql.SelectResult select_result.go:63).
+
+    exec_summaries: one entry per cop response, flattened in TASK order
+    (deterministic across runs — pool completion order never leaks into
+    EXPLAIN ANALYZE attribution, honoring keep_order). batch_stats carries
+    the batched-dispatch attribution ({"batches","regions","launches_saved"})
+    when the batch-cop path ran, for EXPLAIN ANALYZE / TRACE surfacing."""
 
     chunks: list
     exec_summaries: list = field(default_factory=list)
+    batch_stats: dict | None = None
 
     def merged(self) -> Chunk:
         return Chunk.concat(self.chunks) if self.chunks else None
@@ -193,40 +200,129 @@ def _run_one_task(store, req, task, summaries, retries=MAX_RETRY,
             ranges = resp.last_range
 
 
+def _run_store_batch(store, req, entries, results, summaries_by_task,
+                     dispatch_span, scan_kind) -> dict:
+    """ONE batched dispatch for all of a store's region tasks (ref:
+    copr/batch_coprocessor.go — a TiFlash store's regions travel in one
+    request): the store stacks the regions and drives one vmapped launch.
+    A region that comes back with a region_error (stale epoch after a
+    concurrent split, region folded by a merge) falls out of the batch
+    into the standard _run_one_task retry path — the rest of the batch's
+    results stand. Returns this batch's attribution stats."""
+    import time as _time
+
+    from ..util import failpoint as _fp
+    from ..util import metrics, tracing
+
+    creqs = []
+    for i, t in entries:
+        if req.checker is not None:
+            req.checker.before_cop_request()
+        _fp.eval("distsql.before_task")
+        metrics.DISTSQL_TASKS.inc()
+        metrics.DISTSQL_STORE_TASKS.labels(
+            str(store.cluster.store_of(t.region_id))
+        ).inc()
+        creqs.append(CopRequest(
+            req.dag, t.ranges, req.start_ts, t.region_id, t.epoch,
+            aux_chunks=req.aux_chunks, small_groups=req.small_groups,
+        ))
+    t_batch = _time.monotonic()
+    stats = {"batches": 0, "regions": 0, "launches_saved": 0}
+    batch_ids: set = set()
+    with tracing.span("distsql.batch_cop", parent=dispatch_span,
+                      batch_size=len(entries)) as bsp:
+        if req.use_wire:
+            from ..codec.wire import decode_batch_cop_response, encode_batch_cop_request
+
+            resps = decode_batch_cop_response(
+                store.batch_coprocessor_bytes(encode_batch_cop_request(creqs)))
+        else:
+            resps = store.batch_coprocessor(creqs)
+        for (i, t), resp in zip(entries, resps):
+            sums = summaries_by_task[i]
+            if resp.region_error is not None:
+                metrics.DISTSQL_RETRIES.inc()
+                # stale region: re-split its ranges against the fresh
+                # region view and retry ONLY it through the single-task
+                # path (spans nest under the batch span, ambient)
+                chunks: list = []
+                for s2 in _build_tasks(store, t.ranges):
+                    chunks.extend(_run_one_task(
+                        store, req, s2, sums, MAX_RETRY - 1, scan_kind=scan_kind,
+                    ))
+                results[i] = chunks
+                continue
+            if resp.other_error is not None:
+                raise RuntimeError(resp.other_error)
+            # only lanes a vmapped launch actually served count toward
+            # batch attribution — cop-cache hits, overflow fall-outs and
+            # single-path degrades did not ride one (resp.batched == 0);
+            # distinct ids count distinct launches (capacity buckets), so
+            # launches_saved equals the store's served-per-launch-minus-one
+            if resp.batched:
+                stats["regions"] += 1
+                batch_ids.add(resp.batched)
+            sums.append(resp.exec_summaries)
+            results[i] = [resp.chunk]
+            with tracing.span("distsql.cop_task", region_id=t.region_id,
+                              epoch=t.epoch, batched=bool(resp.batched)) as sp:
+                if sp is not None and resp.chunk is not None:
+                    sp.set("rows", resp.chunk.num_rows())
+        stats["batches"] = len(batch_ids)
+        stats["launches_saved"] = max(stats["regions"] - len(batch_ids), 0)
+        if bsp is not None:
+            bsp.set("launches_saved", stats["launches_saved"])
+        metrics.DISTSQL_TASK_DURATION.labels(scan_kind).observe(
+            _time.monotonic() - t_batch
+        )
+    return stats
+
+
 def select(store: TPUStore, req: KVRequest) -> SelectResult:
     from ..util import tracing
 
     tasks = _build_tasks(store, req.ranges)
     results: list = [None] * len(tasks)
-    summaries: list = []
+    # per-task summary buckets, flattened in task order below: pool workers
+    # finish in arbitrary order, and a shared append list would make
+    # EXPLAIN ANALYZE region attribution nondeterministic across runs
+    summaries_by_task: list = [[] for _ in tasks]
     # cross-thread span handoff: pool workers don't inherit contextvars,
     # so capture the dispatching thread's span here and parent the
     # per-task spans on it explicitly (pkg/util/tracing's SpanFromContext
     # handover at the copIterator worker boundary)
     dispatch_span = tracing.current_span()
     scan_kind = _scan_kind(req)
+    batch_stats: dict | None = None
 
     def run_task(i: int, task: CopTask):
-        return _run_one_task(store, req, task, summaries,
+        return _run_one_task(store, req, task, summaries_by_task[i],
                              dispatch_span=dispatch_span, scan_kind=scan_kind)
 
-    if req.batch_cop and len(tasks) > 1:
-        # batch coprocessor: one batch per STORE; a worker drives all of
-        # its store's region tasks back-to-back (one dispatch per store,
-        # not per region — ref: batch_coprocessor.go grouping regions per
-        # TiFlash store, balanced by the PD's authoritative placement map)
+    if req.batch_cop and len(tasks) > 1 and req.paging_size is None:
+        # batch coprocessor: ONE batched dispatch per STORE — the store
+        # stacks its regions and runs one vmapped XLA launch instead of N
+        # serialized per-region launches (ref: batch_coprocessor.go
+        # grouping regions per TiFlash store, balanced by the PD's
+        # authoritative placement map). Paging requests never batch: the
+        # per-page resume cursor is inherently per-region sequential state.
         by_store: dict[int, list] = {}
         for i, t in enumerate(tasks):
             by_store.setdefault(store.cluster.store_of(t.region_id), []).append((i, t))
 
         def run_batch(entries):
-            for i, t in entries:
-                results[i] = run_task(i, t)
+            return _run_store_batch(store, req, entries, results,
+                                    summaries_by_task, dispatch_span, scan_kind)
 
         with ThreadPoolExecutor(max_workers=max(len(by_store), 1)) as pool:
             futs = [pool.submit(run_batch, entries) for entries in by_store.values()]
-            for f in futs:
-                f.result()
+            per_store = [f.result() for f in futs]
+        batch_stats = {
+            "batches": sum(s["batches"] for s in per_store),
+            "regions": sum(s["regions"] for s in per_store),
+            "launches_saved": sum(s["launches_saved"] for s in per_store),
+        }
     elif req.concurrency > 1 and len(tasks) > 1:
         with ThreadPoolExecutor(max_workers=req.concurrency) as pool:
             futs = [pool.submit(run_task, i, t) for i, t in enumerate(tasks)]
@@ -237,4 +333,6 @@ def select(store: TPUStore, req: KVRequest) -> SelectResult:
             results[i] = run_task(i, t)
 
     chunks = [c for sub in results for c in sub if c is not None]
-    return SelectResult(chunks=chunks, exec_summaries=summaries)
+    summaries = [s for per_task in summaries_by_task for s in per_task]
+    return SelectResult(chunks=chunks, exec_summaries=summaries,
+                        batch_stats=batch_stats)

@@ -229,6 +229,10 @@ def _execute_root(
         # per-task ExecutorExecutionSummary lists (ref: tipb exec summaries
         # consumed by EXPLAIN ANALYZE, select_result.go:499)
         summary_sink.extend(res.exec_summaries)
+        if res.batch_stats is not None:
+            # dict entry = batched-dispatch attribution; _explain_analyze
+            # filters it from the per-task summary lists
+            summary_sink.append(res.batch_stats)
     if tracker is not None:
         for c in res.chunks:
             if c is not None:

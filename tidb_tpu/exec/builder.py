@@ -523,9 +523,22 @@ def build_program(
     small_groups: int | None = None,
     unique_joins: bool = True,
     summaries: bool = True,
+    vmap_batch: int | None = None,
 ) -> CompiledDAG:
     """Compile the whole DAG tree (probe pipeline + all join build
-    pipelines) into one fused XLA program over a tuple of device batches."""
+    pipelines) into one fused XLA program over a tuple of device batches.
+
+    vmap_batch=B builds the REGION-BATCHED variant: the first (probe) batch
+    carries a leading region axis of size B (chunk.device
+    to_stacked_device_batch) and the program vmaps over it, so B regions
+    execute in ONE XLA launch — the batch-coprocessor analog of TiFlash
+    serving all of a store's regions from one request
+    (ref: copr/batch_coprocessor.go). Join build sides arriving as broadcast
+    aux batches are shared across regions (in_axes=None), exactly like the
+    broadcast join operand every region task carries. All outputs (packed
+    columns, valid, n_rows, the overflow flags, ex_rows) gain a leading
+    region axis; overflow is therefore PER REGION and the driver can retry
+    only the lanes that overflowed."""
     if isinstance(capacities, int):
         capacities = (capacities,)
     capacities = tuple(capacities)
@@ -551,7 +564,11 @@ def build_program(
         ex = jnp.stack(state.ex_rows) if state.ex_rows else n_out[None].astype(jnp.int64)
         return packed, valid, n_out, (state.group_overflow, state.join_overflow, state.topn_overflow), ex
 
-    jit_fn = jax.jit(program)
+    if vmap_batch is not None:
+        # region axis on the probe batch only; aux/build batches broadcast
+        jit_fn = jax.jit(jax.vmap(program, in_axes=(0,) + (None,) * (n_scans - 1)))
+    else:
+        jit_fn = jax.jit(program)
     return CompiledDAG(jit_fn, dag.output_fts(), capacities, group_capacity, join_capacity)
 
 
@@ -578,10 +595,21 @@ def _agg_result_cols(a, av: list[CompVal], st, group_valid, partial: bool) -> li
 
 
 class ProgramCache:
-    """Fingerprint -> CompiledDAG (ref: coprocessor cache keying)."""
+    """Fingerprint -> CompiledDAG (ref: coprocessor cache keying).
+
+    The key includes the region-batch size (`vmap_batch`): a vmapped
+    program is specialized to its leading axis, so a new batch shape is an
+    honest recompile, not a hit — `stats()` exposes per-instance
+    compiles/hits so tests can assert "one compile + N hits per batch
+    shape" (the launch-count regression guard)."""
 
     def __init__(self):
+        import threading
+
         self._cache: dict = {}
+        self._stats_mu = threading.Lock()  # pool threads share one cache
+        self.compiles = 0
+        self.hits = 0
 
     def get(
         self,
@@ -592,9 +620,10 @@ class ProgramCache:
         topn_full: bool = False,
         small_groups: int | None = None,
         unique_joins: bool = True,
+        vmap_batch: int | None = None,
     ) -> CompiledDAG:
         return self.get_info(dag, capacities, group_capacity, join_capacity,
-                             topn_full, small_groups, unique_joins)[0]
+                             topn_full, small_groups, unique_joins, vmap_batch)[0]
 
     def get_info(
         self,
@@ -605,6 +634,7 @@ class ProgramCache:
         topn_full: bool = False,
         small_groups: int | None = None,
         unique_joins: bool = True,
+        vmap_batch: int | None = None,
     ) -> tuple:
         """(program, cache_hit, compile_ns) — the attribution triple the
         exec summaries and the TRACE span tree surface (ref: the
@@ -620,24 +650,30 @@ class ProgramCache:
         # pallas mode is read at TRACE time (env + backend): a program
         # traced under one mode must not serve another (mismatched
         # buffer counts at execution)
-        key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, pallas_mode())
+        key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, vmap_batch, pallas_mode())
         prog = self._cache.get(key)
         if prog is not None:
+            with self._stats_mu:
+                self.hits += 1
             metrics.PROGRAM_CACHE_HITS.inc()
             with tracing.span("exec.program", cache_hit=True):
                 pass
             return prog, True, 0
         with tracing.span("exec.program", cache_hit=False) as sp:
+            with self._stats_mu:
+                self.compiles += 1
             metrics.PROGRAM_COMPILES.inc()
             t0 = _t.perf_counter_ns()
-            prog = build_program(dag, capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins)
+            prog = build_program(dag, capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, vmap_batch=vmap_batch)
             compile_ns = _t.perf_counter_ns() - t0
             metrics.PROGRAM_COMPILE_DURATION.observe(compile_ns / 1e9)
             if sp is not None:
                 sp.set("compile_ns", compile_ns)
+                if vmap_batch is not None:
+                    sp.set("batch_size", vmap_batch)
         self._cache[key] = prog
         metrics.PROGRAM_CACHE_ENTRIES.set(len(self._cache))
         return prog, False, compile_ns
 
     def stats(self):
-        return {"entries": len(self._cache)}
+        return {"entries": len(self._cache), "compiles": self.compiles, "hits": self.hits}

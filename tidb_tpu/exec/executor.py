@@ -99,6 +99,8 @@ def drive_program_info(cache: ProgramCache, dag: DAGRequest, batches, group_capa
     magnitude)."""
     import time as _time
 
+    from ..util import metrics
+
     if not isinstance(batches, (list, tuple)):
         batches = [batches]
     caps = tuple(b.capacity for b in batches)
@@ -111,6 +113,7 @@ def drive_program_info(cache: ProgramCache, dag: DAGRequest, batches, group_capa
     for _ in range(max_retries + 1):
         prog, hit, build_ns = cache.get_info(dag, caps, gc, jc, tf, smg, uj)
         t0 = _time.perf_counter_ns()
+        metrics.PROGRAM_LAUNCHES.inc()
         packed, valid, n, (g_ovf, j_ovf, t_ovf), ex_rows = prog.fn(*batches)
         g_ovf, j_ovf, t_ovf = bool(g_ovf), bool(j_ovf), bool(t_ovf)
         if not hit:
@@ -141,6 +144,67 @@ def drive_program_info(cache: ProgramCache, dag: DAGRequest, batches, group_capa
 class OverflowRetryError(RuntimeError):
     """Capacity growth retries exhausted; caller may fall back to the
     row-at-a-time oracle (the host fallback SURVEY §7 promises)."""
+
+
+def _slice_region(packed, b: int) -> list:
+    """Region lane `b` of a vmapped program's packed outputs — each leaf
+    loses its leading region axis, recovering the single-region layout
+    decode_outputs consumes."""
+    return [tuple(np.asarray(a)[b] for a in out) for out in packed]
+
+
+def drive_batched_program_info(
+    cache: ProgramCache,
+    dag: DAGRequest,
+    stacked,
+    aux_batches,
+    group_capacity: int,
+    join_capacity: int | None = None,
+    small_groups: int | None = None,
+):
+    """ONE vmapped launch over a region-stacked batch (chunk.device
+    to_stacked_device_batch) — the device half of the batch coprocessor:
+    where the per-region path issues N launches serialized on the single
+    JAX stream, this issues one program execution whose leading axis is the
+    region, then slices per-region results back out.
+
+    Returns (per_region, info): per_region[b] is (chunk, per-executor row
+    counts) for lanes that completed, or None for lanes whose overflow flag
+    fired — group/join/topn overflow is data-dependent per region, so only
+    the overflowing region falls out of the batch; the caller retries it
+    through the single-region capacity ladder (drive_program_info) while
+    every other region's result stands. info is the shared
+    {"cache_hit", "compile_ns"} attribution of the one batched program."""
+    import time as _time
+
+    from ..util import metrics
+
+    B = int(stacked.row_valid.shape[0])
+    cap = int(stacked.row_valid.shape[1])
+    caps = (cap,) + tuple(b.capacity for b in aux_batches)
+    jc = join_capacity or max(caps)
+    prog, hit, build_ns = cache.get_info(
+        dag, caps, group_capacity, jc, False, small_groups, True, vmap_batch=B
+    )
+    t0 = _time.perf_counter_ns()
+    metrics.PROGRAM_LAUNCHES.inc()
+    packed, valid, n, (g_ovf, j_ovf, t_ovf), ex_rows = prog.fn(stacked, *aux_batches)
+    g_ovf, j_ovf, t_ovf = np.asarray(g_ovf), np.asarray(j_ovf), np.asarray(t_ovf)
+    info = {"cache_hit": hit, "compile_ns": 0}
+    if not hit:
+        # the flag fetch above blocked on the result: first-call time is
+        # trace+compile, same attribution as drive_program_info
+        info["compile_ns"] = build_ns + (_time.perf_counter_ns() - t0)
+    valid_np = np.asarray(valid)
+    ex_np = np.asarray(ex_rows)
+    per_region: list = []
+    for b in range(B):
+        if bool(g_ovf[b]) or bool(j_ovf[b]) or bool(t_ovf[b]):
+            per_region.append(None)
+            continue
+        chunk = decode_outputs(_slice_region(packed, b), valid_np[b], prog.out_fts)
+        per_region.append((chunk, [int(x) for x in ex_np[b]]))
+    return per_region, info
 
 
 def _group_key_partition(chunk: Chunk, key_cols: list[int], n_parts: int, salt: int = 0) -> list[Chunk]:

@@ -2728,6 +2728,10 @@ class Session:
             _, _, out_rows = self._run_select(analyze_ast, None)
         finally:
             self._explain_sink = None
+        # dict entries are batched-dispatch attribution riding the sink
+        # alongside the per-task summary lists (distsql/root.py)
+        batch_stats = [e for e in sink if isinstance(e, dict)]
+        sink = [e for e in sink if not isinstance(e, dict)]
         names = [type(e).__name__ for e in executor_walk(rp.push_dag.executors)]
         rows_sum = [0] * len(names)
         time_ns = [0] * len(names)
@@ -2761,6 +2765,14 @@ class Session:
             for e in rp.root_dag.executors[1:]:
                 out.append([Datum.string(f"root[{type(e).__name__}]"), Datum.NULL, Datum.i64(1),
                             Datum.NULL, Datum.NULL, Datum.NULL, Datum.NULL])
+        if batch_stats:
+            # batched coprocessor attribution: rows=regions batch-served,
+            # tasks=vmapped launches, cache column carries launches saved
+            regions = sum(b.get("regions", 0) for b in batch_stats)
+            batches = sum(b.get("batches", 0) for b in batch_stats)
+            saved = sum(b.get("launches_saved", 0) for b in batch_stats)
+            out.append([Datum.string("batch_cop"), Datum.i64(regions), Datum.i64(batches),
+                        Datum.NULL, Datum.NULL, Datum.string(f"saved={saved}"), Datum.NULL])
         out.append([Datum.string("result"), Datum.i64(len(out_rows)), Datum.i64(1),
                     Datum.NULL, Datum.NULL, Datum.NULL, Datum.NULL])
         return Result(columns=["executor", "rows", "tasks", "time", "compile", "cache", "bytes"], rows=out)

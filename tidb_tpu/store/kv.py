@@ -15,12 +15,16 @@ from dataclasses import dataclass, field
 
 
 class MemKV:
-    __slots__ = ("_data", "_keys", "_dirty", "lock")
+    __slots__ = ("_data", "_keys", "_dirty", "lock", "max_version")
 
     def __init__(self):
         self._data: dict[bytes, list[tuple[int, bytes | None]]] = {}
         self._keys: list[bytes] = []
         self._dirty = False
+        # largest commit_ts ever written: a snapshot at start_ts >=
+        # max_version sees EVERY committed version, which is what makes a
+        # coprocessor response reusable across snapshots (store cop cache)
+        self.max_version = 0
         # structural lock: every read/write takes it, and TxnEngine.commit
         # holds it across the WHOLE apply loop, so a concurrent snapshot
         # read can never observe half a commit (the docstring invariant of
@@ -41,6 +45,8 @@ class MemKV:
                 versions.append((ts, value))
                 if len(versions) > 1 and versions[-2][0] > ts:
                     versions.sort(key=lambda v: v[0])
+            if ts > self.max_version:
+                self.max_version = ts
             return prev_live
 
     def _ensure_sorted(self):

@@ -18,15 +18,15 @@ from __future__ import annotations
 import itertools
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 from ..chunk import Chunk, to_device_batch
-from ..chunk.device import DeviceBatch
+from ..chunk.device import DeviceBatch, to_stacked_device_batch
 from ..codec import tablecodec
 from ..codec.rowcodec import RowEncoder, decode_row_to_datum_map, fill_origin_default
 from ..exec.builder import DEFAULT_GROUP_CAPACITY, ProgramCache
 from ..exec.dag import DAGRequest
-from ..exec.executor import OverflowRetryError, drive_program_info, run_dag_reference, _pow2
+from ..exec.executor import OverflowRetryError, drive_batched_program_info, drive_program_info, run_dag_reference, _pow2
 from ..types import Datum
 from .kv import MemKV
 from .region import Cluster, Region
@@ -87,6 +87,10 @@ class CopResponse:
     other_error: str | None = None
     exec_summaries: list = field(default_factory=list)
     last_range: list | None = None  # [KeyRange] resume cursor; None = drained
+    batched: int = 0  # nonzero = served by a vmapped batch launch (NOT by
+    # the cop cache, an overflow fall-out, or a single-path degrade); the
+    # value identifies the launch within its batch_coprocessor call, so the
+    # dispatch layer can count distinct launches for launches_saved
 
 
 class TPUStore:
@@ -112,8 +116,13 @@ class TPUStore:
         self._write_ver = 0
         self._chunk_cache: dict = {}
         self._batch_cache: dict = {}
-        self._aux_batch_cache: dict = {}  # id(chunk) -> DeviceBatch (broadcast reuse)
+        self._aux_batch_cache: dict = {}  # chunk token -> (chunk, DeviceBatch)
         self._aux_lock = threading.Lock()  # select() fans tasks over threads
+        self._chunk_tokens = itertools.count(1)  # monotonic chunk identity
+        # coprocessor RESULT cache (ref: pkg/store/copr/coprocessor_cache.go):
+        # a whole region response keyed by the region's data version
+        self._cop_cache: dict = {}
+        self._cop_lock = threading.Lock()
         self._row_encoder = RowEncoder()
 
     def evict_caches(self) -> int:
@@ -124,6 +133,11 @@ class TPUStore:
         freed = 0
         for c in self._chunk_cache.values():
             freed += c.nbytes()
+        with self._cop_lock:
+            for resp, _ts, _flow in self._cop_cache.values():
+                if resp.chunk is not None:
+                    freed += resp.chunk.nbytes()
+            self._cop_cache.clear()
         for cache in (self._chunk_cache, self._batch_cache, self._aux_batch_cache):
             cache.clear()
         return freed
@@ -171,6 +185,11 @@ class TPUStore:
 
     def _bump_write_ver(self):
         self._write_ver += 1
+        # every cop-cache key embeds the old write version, so entries can
+        # never serve stale data — the clear just stops dead weight from
+        # crowding live entries out of the LRU window
+        with self._cop_lock:
+            self._cop_cache.clear()
 
     def _record_write_flow(self, key: bytes, value: bytes | None, prev_live: bool):
         """Per-key write flow into the PD heartbeat snapshot (ref: TiKV's
@@ -193,18 +212,18 @@ class TPUStore:
         val = self._row_encoder.encode(col_ids, datums)
         prev = self.kv.put(key, val, ts)
         self._record_write_flow(key, val, prev)
-        self._write_ver += 1
+        self._bump_write_ver()
 
     def delete_row(self, table_id: int, handle: int, ts: int):
         key = tablecodec.encode_row_key(table_id, handle)
         prev = self.kv.put(key, None, ts)
         self._record_write_flow(key, None, prev)
-        self._write_ver += 1
+        self._bump_write_ver()
 
     def put_index(self, key: bytes, value: bytes, ts: int):
         prev = self.kv.put(key, value, ts)
         self._record_write_flow(key, value, prev)
-        self._write_ver += 1
+        self._bump_write_ver()
 
     # -- scan/decode with caching -------------------------------------------
     def region_chunk(self, region: Region, ranges: list, dag: DAGRequest, start_ts: int) -> Chunk:
@@ -367,16 +386,30 @@ class TPUStore:
 
     _AUX_CACHE_MAX = 16
 
+    def _chunk_token(self, chunk: Chunk) -> int:
+        """Monotonic identity for a chunk object. id() is reused after GC —
+        a dead build side's cache entry could alias a brand-new chunk at
+        the same address; a token handed out once per object never can."""
+        tok = getattr(chunk, "_device_token", None)
+        if tok is None:
+            with self._aux_lock:
+                tok = getattr(chunk, "_device_token", None)
+                if tok is None:
+                    tok = next(self._chunk_tokens)
+                    chunk._device_token = tok
+        return tok
+
     def _aux_batch(self, chunk: Chunk) -> DeviceBatch:
         """Broadcast build-side chunk -> DeviceBatch, uploaded once per
         chunk object (all region tasks of a join share the operand).
 
-        Bounded LRU: a long-lived store must not pin HBM for every build
-        side ever joined (the chunk ref also keeps the id() key valid)."""
-        key = id(chunk)
+        Bounded LRU keyed by the chunk token (never-reused identity); the
+        entry pins the chunk so the device batch and its source live and
+        die together."""
+        key = self._chunk_token(chunk)
         with self._aux_lock:
             cached = self._aux_batch_cache.get(key)
-            if cached is not None and cached[0] is chunk:
+            if cached is not None:
                 self._aux_batch_cache.pop(key)  # refresh LRU position
                 self._aux_batch_cache[key] = cached
                 return cached[1]
@@ -386,6 +419,87 @@ class TPUStore:
             while len(self._aux_batch_cache) > self._AUX_CACHE_MAX:
                 self._aux_batch_cache.pop(next(iter(self._aux_batch_cache)))
         return batch
+
+    # -- coprocessor result cache (ref: copr/coprocessor_cache.go) ----------
+    _COP_CACHE_MAX = 128
+
+    def _cop_cache_key(self, req: CopRequest, write_ver: int):
+        return (
+            req.region_id,
+            req.region_epoch,
+            write_ver,
+            req.dag.fingerprint(),
+            tuple((r.start, r.end) for r in req.ranges),
+            req.small_groups,
+        )
+
+    def _cop_cacheable(self, req: CopRequest) -> bool:
+        # paging responses carry per-page cursors; aux chunks (join build
+        # sides) are statement-local operands with no data version to key on
+        return req.paging_size is None and not req.aux_chunks
+
+    def _cop_cache_get(self, req: CopRequest) -> CopResponse | None:
+        """Serve a whole region response from the result cache when the
+        region's data version — (epoch, store write version) — and the DAG
+        fingerprint match (ref: coprocessor_cache.go keying responses by
+        region data version). Entries are only CREATED for snapshots that
+        already see every committed version (start_ts >= kv.max_version at
+        put time), so with the write version unchanged any request at
+        start_ts >= the entry's sees byte-identical data; an OLDER snapshot
+        might predate a version the entry includes and must miss. A hit
+        still records read flow — the region logically served the rows, and
+        hiding cached traffic from the PD would blind the hot-region
+        scheduler to exactly the hottest (most re-read) regions."""
+        if not self._cop_cacheable(req):
+            return None
+        key = self._cop_cache_key(req, self._write_ver)
+        with self._cop_lock:
+            ent = self._cop_cache.get(key)
+            if ent is None:
+                return None
+            resp, entry_ts, flow = ent
+            if req.start_ts < entry_ts:
+                return None
+            self._cop_cache.pop(key)  # refresh LRU position
+            self._cop_cache[key] = ent
+        from ..util import metrics
+
+        metrics.COP_CACHE_HITS.inc()
+        self.pd.flow.record_read(req.region_id, flow[0], flow[1])
+        summaries = [replace(s, cache_hit=True, time_compile_ns=0) for s in resp.exec_summaries]
+        return CopResponse(chunk=resp.chunk, exec_summaries=summaries)
+
+    def _cop_cache_put(self, req: CopRequest, resp: CopResponse,
+                       flow: tuple = (0, 0), write_ver: int | None = None) -> None:
+        """flow = (decoded bytes, rows) of the region read — replayed into
+        the PD heartbeat on every hit so flow stats see cached traffic.
+
+        write_ver is the caller's snapshot of _write_ver taken BEFORE it
+        read the region: the insert is refused under _cop_lock if a write
+        landed since (version moved, or a half-applied commit already
+        raised kv.max_version) — otherwise a pre-write response could be
+        filed under the post-write key and serve stale rows."""
+        if (
+            not self._cop_cacheable(req)
+            or resp.chunk is None
+            or resp.region_error is not None
+            or resp.other_error is not None
+            or resp.last_range is not None
+        ):
+            return
+        ver = self._write_ver if write_ver is None else write_ver
+        key = self._cop_cache_key(req, ver)
+        with self._cop_lock:
+            if ver != self._write_ver:
+                return  # a write raced the read: the response may predate it
+            # a snapshot that predates some committed version would cache a
+            # view NEWER snapshots must not inherit (MVCC: same write_ver,
+            # different visibility) — only the all-seeing snapshot caches
+            if req.start_ts < self.kv.max_version:
+                return
+            self._cop_cache[key] = (resp, req.start_ts, flow)
+            while len(self._cop_cache) > self._COP_CACHE_MAX:
+                self._cop_cache.pop(next(iter(self._cop_cache)))
 
     # -- the serialized endpoint (the sidecar seam) -------------------------
     def coprocessor_bytes(self, req_bytes: bytes) -> bytes:
@@ -427,10 +541,14 @@ class TPUStore:
             return CopResponse(region_error=f"region {req.region_id} not found")
         if req.region_epoch != region.epoch:
             return CopResponse(region_error=f"epoch_not_match: have {region.epoch}, got {req.region_epoch}")
+        cached = self._cop_cache_get(req)
+        if cached is not None:
+            return cached
+        ver = self._write_ver  # pre-read snapshot: gates the cache insert
         t0 = time.monotonic_ns()
         last_range = None
         page = None
-        in_bytes = 0
+        in_bytes, in_rows = 0, 0
         info = {"cache_hit": False, "compile_ns": 0}
         try:
             with tracing.span("cop.decode", region_id=req.region_id) as dsp:
@@ -509,4 +627,191 @@ class TPUStore:
         ]
         for ex, r in zip(walk, ex_rows):
             metrics.COP_EXECUTOR_ROWS.labels(type(ex).__name__.lower()).inc(r)
-        return CopResponse(chunk=chunk, exec_summaries=summaries, last_range=last_range)
+        resp = CopResponse(chunk=chunk, exec_summaries=summaries, last_range=last_range)
+        self._cop_cache_put(req, resp, flow=(in_bytes, in_rows), write_ver=ver)
+        return resp
+
+    # -- the batched coprocessor endpoint -----------------------------------
+    def batch_coprocessor(self, reqs: list[CopRequest], group_capacity: int = DEFAULT_GROUP_CAPACITY) -> list[CopResponse]:
+        """Serve a store's worth of region tasks from ONE vmapped XLA
+        launch per (DAG fingerprint, snapshot) group (ref:
+        copr/batch_coprocessor.go — all regions of a TiFlash store travel
+        in one request). Every region's rows decode as usual, pad to the
+        group's shared power-of-two capacity, stack along a leading region
+        axis, and execute as a single vmapped program; per-region partial
+        results slice back out, so the root-side merge is unchanged.
+
+        Per-request validation happens UP FRONT: a stale epoch, missing
+        region or cache hit answers immediately and falls out of the batch
+        — the rest of the batch still executes. Paging requests and armed
+        cop failpoints route through the single-request path (resume
+        cursors and injection sites live there). Responses come back in
+        request order."""
+        from ..util import failpoint, metrics
+
+        responses: list = [None] * len(reqs)
+        groups: dict = {}
+        for i, req in enumerate(reqs):
+            if (
+                req.paging_size is not None
+                or failpoint.is_armed("cop-region-error")
+                or failpoint.is_armed("cop-other-error")
+            ):
+                responses[i] = self.coprocessor(req, group_capacity)
+                continue
+            region = self.cluster.region_by_id(req.region_id)
+            if region is None:
+                metrics.COP_REQUESTS.inc()
+                metrics.COP_ERRORS.inc()
+                responses[i] = CopResponse(region_error=f"region {req.region_id} not found")
+                continue
+            if req.region_epoch != region.epoch:
+                metrics.COP_REQUESTS.inc()
+                metrics.COP_ERRORS.inc()
+                responses[i] = CopResponse(
+                    region_error=f"epoch_not_match: have {region.epoch}, got {req.region_epoch}"
+                )
+                continue
+            cached = self._cop_cache_get(req)
+            if cached is not None:
+                metrics.COP_REQUESTS.inc()
+                responses[i] = cached
+                continue
+            key = (
+                req.dag.fingerprint(),
+                req.start_ts,
+                req.small_groups,
+                tuple(self._chunk_token(c) for c in req.aux_chunks),
+            )
+            groups.setdefault(key, []).append((i, req, region))
+        for entries in groups.values():
+            if len(entries) == 1:  # nothing to amortize: the plain path
+                i, req, _region = entries[0]
+                responses[i] = self.coprocessor(req, group_capacity)
+                continue
+            self._run_cop_batch(entries, responses, group_capacity)
+        return responses
+
+    def _run_cop_batch(self, entries, responses, group_capacity: int) -> None:
+        """Decode a same-DAG group of region tasks, bucket by shared pow2
+        capacity, and launch one vmapped program per bucket — the
+        documented (store, DAG-fingerprint, capacity) launch unit. Without
+        the bucketing, one skewed region would pad EVERY lane to its size
+        and a 16-region batch could cost ~16x the per-region footprint.
+        Lanes whose overflow flag fired — and a whole bucket on any
+        batched-trace failure — degrade to the single-request path, which
+        owns the capacity ladder and the oracle fallback."""
+        from ..util import tracing
+
+        req0 = entries[0][1]
+        dag = req0.dag
+        ver = self._write_ver  # pre-read snapshot: gates the cache inserts
+        try:
+            with tracing.span("cop.batch_decode", regions=len(entries)) as dsp:
+                chunks = [
+                    self.region_chunk(region, req.ranges, dag, req.start_ts)
+                    for (_i, req, region) in entries
+                ]
+                if dsp is not None:
+                    dsp.set("bytes_to_device", sum(ch.nbytes() for ch in chunks))
+                aux_batches = [self._aux_batch(c) for c in req0.aux_chunks]
+        except Exception:  # noqa: BLE001 — degrade, never lose the batch
+            for i, req, _region in entries:
+                responses[i] = self.coprocessor(req, group_capacity)
+            return
+        buckets: dict[int, list] = {}
+        for k, ch in enumerate(chunks):
+            buckets.setdefault(_pow2(max(ch.num_rows(), 1)), []).append(k)
+        batch_id = 0
+        for cap, idxs in buckets.items():
+            if len(idxs) == 1:  # nothing to amortize at this capacity
+                i, req, _region = entries[idxs[0]]
+                responses[i] = self.coprocessor(req, group_capacity)
+                continue
+            batch_id += 1
+            self._launch_cop_bucket(
+                [entries[k] for k in idxs], [chunks[k] for k in idxs], cap,
+                aux_batches, responses, group_capacity, ver, batch_id,
+            )
+
+    def _launch_cop_bucket(self, entries, chunks, cap: int, aux_batches,
+                           responses, group_capacity: int, write_ver: int,
+                           batch_id: int) -> None:
+        """ONE vmapped launch for a capacity bucket of decoded regions."""
+        from ..exec.dag import executor_walk
+        from ..util import metrics, tracing
+
+        req0 = entries[0][1]
+        dag = req0.dag
+        # per-bucket clock: a later bucket's lanes must not be billed for
+        # earlier buckets' launches (decode is cached and near-free here)
+        t0 = time.monotonic_ns()
+        try:
+            with tracing.span("cop.batch_execute", regions=len(entries),
+                              capacity=cap) as xsp:
+                stacked = to_stacked_device_batch(chunks, cap)
+                per_region, info = drive_batched_program_info(
+                    self.programs, dag, stacked, aux_batches, group_capacity,
+                    small_groups=req0.small_groups,
+                )
+                if xsp is not None:
+                    xsp.set("cache_hit", info["cache_hit"])
+        except Exception:  # noqa: BLE001 — degrade, never lose the bucket
+            # oracle-only ops, CI non-ASCII routing, vmap-ineligible shapes:
+            # the single path reproduces the error handling contract
+            # (other_error / oracle fallback / cop-debug-raise) per region
+            for i, req, _region in entries:
+                responses[i] = self.coprocessor(req, group_capacity)
+            return
+        elapsed = time.monotonic_ns() - t0
+        share = elapsed // max(len(entries), 1)
+        walk = executor_walk(dag.executors)
+        metrics.BATCH_COP_BATCHES.inc()
+        served = 0
+        for (i, req, region), ch, res in zip(entries, chunks, per_region):
+            if res is None:
+                # this lane's group/join/topn capacity overflowed: only it
+                # rides the single-request retry ladder
+                responses[i] = self.coprocessor(req, group_capacity)
+                continue
+            chunk, ex_rows = res
+            # read flow ONLY for lanes the batch actually served — fall-out
+            # lanes (and whole-bucket degrades) record theirs inside the
+            # single path, so the PD never sees a region's read twice
+            self.pd.flow.record_read(region.region_id, ch.nbytes(), ch.num_rows())
+            metrics.COP_REQUESTS.inc()
+            metrics.BATCH_COP_REGIONS.inc()
+            metrics.COP_DURATION.observe(share / 1e9)
+            # compile time belongs to the ONE shared program: the first lane
+            # carries it, the rest are cache hits by construction
+            compile_ns = info["compile_ns"] if served == 0 else 0
+            cache_hit = info["cache_hit"] if served == 0 else True
+            served += 1
+            in_b, out_b = ch.nbytes(), chunk.nbytes()
+            summaries = [
+                ExecSummary(
+                    time_processed_ns=share, num_produced_rows=r,
+                    time_compile_ns=compile_ns, cache_hit=cache_hit,
+                    num_bytes=in_b if k == 0 else (out_b if k == len(ex_rows) - 1 else 0),
+                )
+                for k, r in enumerate(ex_rows)
+            ]
+            for ex, r in zip(walk, ex_rows):
+                metrics.COP_EXECUTOR_ROWS.labels(type(ex).__name__.lower()).inc(r)
+            resp = CopResponse(chunk=chunk, exec_summaries=summaries, batched=batch_id)
+            self._cop_cache_put(req, resp, flow=(in_b, ch.num_rows()), write_ver=write_ver)
+            responses[i] = resp
+        if served > 1:
+            metrics.BATCH_COP_LAUNCHES_SAVED.inc(served - 1)
+
+    def batch_coprocessor_bytes(self, req_bytes: bytes) -> bytes:
+        """The sidecar seam of the batched endpoint: one frame of N cop
+        requests in, one frame of N responses out (ref: the BatchCommands /
+        BatchCop stream framing over serialized protos)."""
+        from ..codec.wire import decode_batch_cop_request, encode_batch_cop_response
+
+        try:
+            reqs = decode_batch_cop_request(req_bytes)
+        except Exception as exc:  # malformed bytes must not kill the server
+            return encode_batch_cop_response([CopResponse(other_error=f"bad batch request: {exc}")])
+        return encode_batch_cop_response(self.batch_coprocessor(reqs))

@@ -24,6 +24,13 @@ def disable(name: str):
         _active.pop(name, None)
 
 
+def is_armed(name: str) -> bool:
+    """True when the failpoint is enabled, WITHOUT consuming a count —
+    batch paths use this to route through the single-request code where
+    the injection site actually lives."""
+    return name in _active
+
+
 def eval(name: str):  # noqa: A001 (mirrors the reference API)
     """Returns the failpoint's value if enabled, else None. A callable
     value is invoked (and may raise, the usual injection shape); an int

@@ -75,6 +75,18 @@ class Span:
             out.extend(c.find(name))
         return out
 
+    def sum_attr(self, name: str, attr: str) -> int:
+        """Sum a numeric attribute over every span named `name` under (and
+        including) this one — how a statement-level reader aggregates
+        per-dispatch attribution (e.g. `batch_size` / `launches_saved` on
+        the distsql.batch_cop spans) without walking the tree by hand."""
+        total = 0
+        for sp in self.find(name):
+            v = sp.attrs.get(attr)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                total += v
+        return int(total)
+
     def to_dict(self) -> dict:
         with self._lock:
             kids = list(self.children)
