@@ -757,6 +757,45 @@ def _run_config_subprocess(name: str, budget: int):
         return {"skipped": str(exc)}
 
 
+def _chaos_main():
+    """BENCH_CHAOS=1: the robustness scenario (ISSUE 6 satellite) — the
+    same seeded mixed workload run clean and with a 10% per-statement
+    fault rate (one-shot busy storms / not-leader flaps), reporting
+    p50/p99 query latency side by side. Hermetic CPU: the quantity under
+    test is the retry/backoff machinery's overhead, a host-side property;
+    correctness invariants (zero wrong results, typed errors only,
+    breakers re-closed) are asserted on the faulted run too."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from chaos import run_chaos
+
+    n = int(os.environ.get("BENCH_CHAOS_STATEMENTS", "120"))
+    clean = run_chaos(seed=13, statements=n, fault_rate=0.0)
+    faulted = run_chaos(seed=13, statements=n, fault_rate=0.10)
+    assert faulted["wrong_results"] == [], faulted["wrong_results"]
+    assert faulted["untyped_errors"] == [], faulted["untyped_errors"]
+    assert faulted["breakers_all_closed"], faulted["breakers"]
+    print(json.dumps({
+        "metric": "chaos_fault_latency",
+        "statements": n,
+        "fault_rate": 0.10,
+        "clean": {"p50_ms": clean["p50_ms"], "p99_ms": clean["p99_ms"]},
+        "faulted": {"p50_ms": faulted["p50_ms"], "p99_ms": faulted["p99_ms"],
+                    "ok": faulted["ok"], "typed_errors": faulted["typed_errors"],
+                    "breaker_trips": faulted["breaker_trips"],
+                    "failovers": faulted["failovers"]},
+        "p99_overhead_x": round(faulted["p99_ms"] / max(clean["p99_ms"], 1e-9), 2),
+    }))
+
+
 def main():
     import os
 
@@ -768,6 +807,9 @@ def main():
         return
     if os.environ.get("BENCH_BATCH_COP"):
         _batch_cop_main()
+        return
+    if os.environ.get("BENCH_CHAOS"):
+        _chaos_main()
         return
     if os.environ.get("BENCH_PARITY"):
         _parity_only_main(os.environ["BENCH_PARITY"])

@@ -1,0 +1,123 @@
+"""Backoffer: per-kind exponential budgets with equal jitter, the
+tidb_backoff_weight scaling, deadline clamping, and KILL-mid-backoff
+(ISSUE 6; ref: tikv/client-go retry/backoff.go + TiDB BackOffWeight)."""
+
+import random
+
+import pytest
+
+from tidb_tpu.distsql.runaway import QueryKilledError, RunawayChecker
+from tidb_tpu.util import metrics
+from tidb_tpu.util.backoff import CONFIGS, Backoffer, BackoffExhausted
+
+
+class FakeClock:
+    """Deterministic time: sleep() advances now() — no wall-clock in the
+    schedule assertions."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps: list[float] = []
+
+    def now(self) -> float:
+        return self.t
+
+    def sleep(self, s: float) -> None:
+        self.sleeps.append(s)
+        self.t += s
+
+
+def make(budget_ms=10_000, weight=1, checker=None, seed=1):
+    clk = FakeClock()
+    b = Backoffer(budget_ms=budget_ms, weight=weight, checker=checker,
+                  rng=random.Random(seed), sleep_fn=clk.sleep, now_fn=clk.now)
+    return b, clk
+
+
+def test_exponential_growth_capped_with_equal_jitter():
+    b, _ = make()
+    cfg = CONFIGS["region_miss"]
+    for attempt in range(12):
+        slept = b.backoff("region_miss")
+        raw = min(cfg.base_ms * 2 ** attempt, cfg.cap_ms)
+        # equal jitter: uniform[raw/2, raw]
+        assert raw / 2 <= slept <= raw + 1e-9
+    assert b.attempts["region_miss"] == 12
+
+
+def test_budget_scales_with_backoff_weight_and_exhausts_per_task():
+    b, _ = make(budget_ms=20, weight=2)  # 40ms effective
+    total = 0.0
+    with pytest.raises(BackoffExhausted) as ei:
+        for _ in range(50):
+            total += b.backoff("server_busy")
+    assert ei.value.kind == "server_busy"
+    assert total <= 40.0
+    # weight 0: no sleep budget at all — first backoff raises
+    b0, _ = make(budget_ms=200, weight=0)
+    with pytest.raises(BackoffExhausted):
+        b0.backoff("region_miss")
+
+
+def test_per_kind_budgets_are_independent_but_share_the_total():
+    b, _ = make()
+    b.backoff("region_miss")
+    b.backoff("server_busy")
+    # each kind restarts its own exponent: second region_miss is attempt 1
+    assert b.attempts == {"region_miss": 1, "server_busy": 1}
+    assert b.total_ms > 0
+
+
+def test_server_suggested_backoff_is_a_floor():
+    b, _ = make(seed=3)
+    slept = b.backoff("server_busy", suggested_ms=77)
+    assert slept >= 77
+
+
+def test_sleep_never_passes_the_checker_deadline():
+    clk = FakeClock()
+    checker = RunawayChecker(max_execution_ms=50, now_fn=clk.now)
+    b = Backoffer(budget_ms=10_000, weight=1, checker=checker,
+                  rng=random.Random(1), sleep_fn=clk.sleep, now_fn=clk.now)
+    slept = b.sleep(500, "store_unavailable")
+    assert slept <= 50.0 + 1e-9  # clamped to the deadline, not the ask
+    assert clk.t <= 0.0501
+
+
+def test_kill_query_interrupts_mid_backoff():
+    clk = FakeClock()
+    checker = RunawayChecker(max_execution_ms=0, now_fn=clk.now)
+    kills_after = [3]
+
+    def killing_sleep(s):
+        clk.sleep(s)
+        kills_after[0] -= 1
+        if kills_after[0] == 0:
+            checker.kill()
+
+    b = Backoffer(budget_ms=10_000, weight=1, checker=checker,
+                  rng=random.Random(1), sleep_fn=killing_sleep, now_fn=clk.now)
+    with pytest.raises(QueryKilledError):
+        b.sleep(500, "server_busy")
+    # died mid-sleep: only the slices before the kill actually ran
+    assert sum(clk.sleeps) < 500 / 1000.0
+    assert len(clk.sleeps) == 3
+
+
+def test_backoff_metric_and_span_attribution():
+    from tidb_tpu.util import tracing
+
+    before = metrics.BACKOFF_SECONDS.labels("not_leader").value
+    b, _ = make()
+    with tracing.trace("t") as root:
+        with tracing.span("distsql.cop_task") as sp:
+            slept = b.backoff("not_leader")
+        assert sp.attrs["backoff_ms"] == pytest.approx(slept, abs=0.02)
+    assert root is not None
+    after = metrics.BACKOFF_SECONDS.labels("not_leader").value
+    assert after - before == pytest.approx(slept / 1000.0, abs=1e-6)
+
+
+def test_unknown_kind_gets_a_default_schedule():
+    b, _ = make()
+    assert b.backoff("mystery_kind") > 0  # total, no KeyError

@@ -75,8 +75,9 @@ class TestFailpoints:
 
     def test_injected_other_error_surfaces(self, sess):
         with failpoint.enabled("cop-other-error"):
-            with pytest.raises(RuntimeError, match="injected"):
+            with pytest.raises(SQLError, match="injected") as ei:
                 sess.execute("SELECT count(*) FROM t")
+        assert ei.value.code == 1105  # ER_UNKNOWN_ERROR: non-retryable cop failure
 
     def test_counted_failpoint_expires(self):
         failpoint.enable("fp-x", 2)

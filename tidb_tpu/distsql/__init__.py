@@ -1,4 +1,14 @@
-from .dispatch import KVRequest, SelectResult, select, full_table_ranges, handle_ranges
+from .dispatch import (
+    BreakerBoard,
+    CircuitBreaker,
+    CopInternalError,
+    KVRequest,
+    RegionUnavailableError,
+    SelectResult,
+    select,
+    full_table_ranges,
+    handle_ranges,
+)
 from .root import RootPlan, execute_root, split_dag
 
 __all__ = [
@@ -10,4 +20,8 @@ __all__ = [
     "RootPlan",
     "execute_root",
     "split_dag",
+    "BreakerBoard",
+    "CircuitBreaker",
+    "RegionUnavailableError",
+    "CopInternalError",
 ]

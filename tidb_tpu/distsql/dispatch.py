@@ -10,6 +10,8 @@ but scan-decode and host encode overlap.
 
 from __future__ import annotations
 
+import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
@@ -22,6 +24,135 @@ from ..store import CopRequest, KeyRange, TPUStore
 I64_MIN = -(1 << 63)
 I64_MAX = (1 << 63) - 1
 MAX_RETRY = 8
+
+
+class RegionUnavailableError(RuntimeError):
+    """Every retry budget for a region is spent — MySQL error 9005
+    "Region is unavailable" (ref: tidb errno.ErrRegionUnavailable; raised
+    when client-go's Backoffer times out on region errors)."""
+
+
+class CopInternalError(RuntimeError):
+    """The coprocessor answered `other_error` — a non-retryable execution
+    failure, MySQL error 1105 (ref: copr handleCopResponse returning
+    errors.Errorf for OtherError)."""
+
+
+# ------------------------------------------------------------ circuit breaker
+
+class CircuitBreaker:
+    """Per-store breaker (ref: client-go's store slow-score / liveness
+    state machine, and the classic closed -> open -> half-open breaker).
+    N consecutive failures open it; an open breaker rejects requests (the
+    dispatch layer fails the store's tasks over through a PD re-placement
+    instead of paying the timeout again); after `probe_after` seconds one
+    probe request is let through — success closes, failure re-opens."""
+
+    __slots__ = ("store_id", "state", "fails", "opened_at", "last_probe",
+                 "threshold", "probe_after", "_now", "_lock")
+
+    def __init__(self, store_id: int, threshold: int = 3,
+                 probe_after: float = 0.05, now_fn=time.monotonic):
+        self.store_id = store_id
+        self.state = "closed"
+        self.fails = 0
+        self.opened_at = 0.0
+        self.last_probe = 0.0
+        self.threshold = threshold
+        self.probe_after = probe_after
+        self._now = now_fn
+        self._lock = threading.Lock()
+
+    def _gauge(self):
+        from ..util import metrics
+
+        metrics.BREAKER_STATE.labels(str(self.store_id)).set(
+            {"closed": 0, "half-open": 1, "open": 2}[self.state])
+
+    def allow_request(self) -> bool:
+        """The probe admission is RATE-LIMITED, not a single token: a
+        probe whose outcome never reaches record_success/record_failure
+        (the request died on an unrelated error, the task re-split away,
+        the statement was killed mid-probe) must not wedge the breaker —
+        the next window simply admits another probe."""
+        now = self._now()
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                if now - self.opened_at < self.probe_after:
+                    return False
+                self.state = "half-open"  # time served: admit a probe
+            elif now - self.last_probe < self.probe_after:
+                return False  # a probe was admitted this window
+            self.last_probe = now
+            self._gauge()
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            changed = self.state != "closed" or self.fails
+            self.state, self.fails = "closed", 0
+            if changed:
+                self._gauge()
+
+    def record_failure(self) -> bool:
+        """Returns True when THIS failure opened (or re-opened) the
+        breaker — the caller's cue to fail the task over."""
+        from ..util import metrics
+
+        with self._lock:
+            self.fails += 1
+            if self.state == "half-open" or (
+                self.state == "closed" and self.fails >= self.threshold
+            ):
+                self.state, self.opened_at = "open", self._now()
+                metrics.BREAKER_TRIPS.labels(str(self.store_id)).inc()
+                self._gauge()
+                return True
+            return self.state == "open"
+
+
+class BreakerBoard:
+    """All of a TPUStore's per-store breakers (client-side shared state:
+    every session and dispatch thread on the store consults one board)."""
+
+    def __init__(self, threshold: int = 3, probe_after: float = 0.05,
+                 now_fn=time.monotonic):
+        self.threshold = threshold
+        self.probe_after = probe_after
+        self._now = now_fn
+        self._breakers: dict[int, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+
+    def get(self, store_id: int) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(store_id)
+            if b is None:
+                b = self._breakers[store_id] = CircuitBreaker(
+                    store_id, self.threshold, self.probe_after, self._now)
+            return b
+
+    def allow_request(self, store_id: int) -> bool:
+        return self.get(store_id).allow_request()
+
+    def record_success(self, store_id: int) -> None:
+        self.get(store_id).record_success()
+
+    def record_failure(self, store_id: int) -> bool:
+        return self.get(store_id).record_failure()
+
+    def open_stores(self) -> set:
+        with self._lock:
+            return {sid for sid, b in self._breakers.items() if b.state == "open"}
+
+    def states(self) -> dict:
+        with self._lock:
+            return {sid: b.state for sid, b in self._breakers.items()}
+
+    def all_closed(self) -> bool:
+        with self._lock:
+            return all(b.state == "closed" for b in self._breakers.values())
 
 
 def full_table_ranges(table_id: int) -> list[KeyRange]:
@@ -61,6 +192,8 @@ class KVRequest:
     small_groups: int | None = None  # planner NDV hint -> dense agg kernel
     checker: object = None  # RunawayChecker — before_cop_request() raises
     # past the deadline / after KILL (ref: resourcegroup checker.go:27)
+    backoff_weight: int = 2  # tidb_backoff_weight: scales every retry
+    # budget (ref: sessionctx BackOffWeight -> copr backoffer construction)
 
 
 @dataclass
@@ -127,19 +260,52 @@ def _scan_kind(req) -> str:
     return "index" if isinstance(req.dag.scan(), IndexScan) else "table"
 
 
+def _failover(store, region_id: int, bad_store: int, boff) -> int | None:
+    """Ask the PD to re-place a region off a sick store (ref: client-go
+    marking a store unreachable + PD moving peers away). When no healthy
+    store exists, backs off on the store_unavailable budget — maybe the
+    store comes back or a breaker probe succeeds — and returns None."""
+    from ..util.backoff import BackoffExhausted
+
+    pd = getattr(store, "pd", None)
+    avoid = store.breakers.open_stores() | store.down_stores()
+    target = pd.failover_region(region_id, bad_store, avoid=avoid) if pd else None
+    if target is None:
+        try:
+            boff.backoff("store_unavailable",
+                         f"no healthy store for region {region_id}")
+        except BackoffExhausted as exc:
+            raise RegionUnavailableError(str(exc)) from exc
+    return target
+
+
 def _run_one_task(store, req, task, summaries, retries=MAX_RETRY,
-                  dispatch_span=None, scan_kind="table"):
+                  dispatch_span=None, scan_kind="table", boff=None):
     """One cop task; drives the paging loop when paging is on (ref:
     copr/coprocessor.go:1393 handleCopPagingResult — each page's lastRange
     seeds the next request until the task drains). Shared by select()'s
     pool workers and the sequential select_stream path so metrics, spans,
-    failpoints, and wire routing cannot drift apart. Returns the task's
-    chunks (retry subtasks included); summaries accumulate in place."""
+    failpoints, wire routing AND the typed error contract cannot drift
+    apart. Returns the task's chunks (retry subtasks included); summaries
+    accumulate in place.
+
+    Region errors are CLASSIFIED (ref: copr/coprocessor.go:1424
+    handleCopResponse): each kind retries on its own Backoffer budget;
+    store_unavailable additionally feeds the store's circuit breaker and —
+    once the breaker opens — fails the task over via a PD re-placement
+    decision instead of hammering the sick store."""
     import time as _time
 
+    from ..store.errors import parse_region_error
     from ..util import failpoint as _fp
     from ..util import metrics, tracing
+    from ..util.backoff import Backoffer, BackoffExhausted
 
+    if boff is None:
+        # one budget per TASK, shared with its re-split subtasks (the
+        # reference allocates one Backoffer per request chain)
+        boff = Backoffer(weight=req.backoff_weight, checker=req.checker)
+    board = store.breakers
     t_task = _time.monotonic()
     with tracing.span(
         "distsql.cop_task",
@@ -153,13 +319,18 @@ def _run_one_task(store, req, task, summaries, retries=MAX_RETRY,
             if req.checker is not None:
                 req.checker.before_cop_request()
             _fp.eval("distsql.before_task")
+            sid = store.cluster.store_of(task.region_id)
+            if not board.allow_request(sid):
+                # breaker open: do NOT pay the sick store's failure again —
+                # fail over through a PD re-placement (or wait for a probe
+                # window on the store_unavailable budget)
+                _failover(store, task.region_id, sid, boff)
+                continue
             metrics.DISTSQL_TASKS.inc()
             # authoritative placement lookup (a miss routes through the
             # PD, never a modulo guess) — the per-store counts are what
             # bench.py's skew scenario reads before/after PD balancing
-            metrics.DISTSQL_STORE_TASKS.labels(
-                str(store.cluster.store_of(task.region_id))
-            ).inc()
+            metrics.DISTSQL_STORE_TASKS.labels(str(sid)).inc()
             creq = CopRequest(
                 req.dag, ranges, req.start_ts, task.region_id, task.epoch,
                 aux_chunks=req.aux_chunks, paging_size=req.paging_size,
@@ -172,20 +343,52 @@ def _run_one_task(store, req, task, summaries, retries=MAX_RETRY,
             else:
                 resp = store.coprocessor(creq)
             if resp.region_error is not None:
-                if retries <= 0:
-                    raise RuntimeError(f"region retries exhausted: {resp.region_error}")
+                err = parse_region_error(resp.region_error)
                 metrics.DISTSQL_RETRIES.inc()
+                metrics.REGION_ERRORS.labels(err.kind).inc()
                 if sp is not None:
                     sp.set("region_error", resp.region_error)
-                # re-split the REMAINING ranges against the fresh region
-                # view; subtask spans nest under this one (ambient)
+                if retries <= 0:
+                    raise RegionUnavailableError(
+                        f"region retries exhausted: {resp.region_error}")
+                try:
+                    if err.kind == "store_unavailable":
+                        opened = board.record_failure(sid)
+                        pd = getattr(store, "pd", None)
+                        if pd is not None:
+                            pd.note_store_down(sid)
+                        if opened:
+                            _failover(store, task.region_id, sid, boff)
+                        else:
+                            boff.backoff("store_unavailable", resp.region_error)
+                        continue  # same task, fresh placement lookup
+                    if err.kind == "server_busy":
+                        board.record_failure(sid)
+                        boff.backoff("server_busy", resp.region_error,
+                                     suggested_ms=getattr(err, "backoff_ms", 0))
+                        continue
+                    if err.kind == "not_leader":
+                        boff.backoff("not_leader", resp.region_error)
+                        continue
+                    # epoch_not_match / region_not_found / generic miss:
+                    # brief backoff, then re-split the REMAINING ranges
+                    # against the fresh region view; subtask spans nest
+                    # under this one (ambient)
+                    boff.backoff(err.kind, resp.region_error)
+                except BackoffExhausted as exc:
+                    raise RegionUnavailableError(str(exc)) from exc
                 for s2 in _build_tasks(store, ranges):
                     out_chunks.extend(_run_one_task(
-                        store, req, s2, summaries, retries - 1, scan_kind=scan_kind,
+                        store, req, s2, summaries, retries - 1,
+                        scan_kind=scan_kind, boff=boff,
                     ))
                 return out_chunks
             if resp.other_error is not None:
-                raise RuntimeError(resp.other_error)
+                raise CopInternalError(resp.other_error)
+            board.record_success(sid)
+            pd = getattr(store, "pd", None)
+            if pd is not None:
+                pd.note_store_up(sid)
             summaries.append(resp.exec_summaries)
             out_chunks.append(resp.chunk)
             pages += 1
@@ -214,6 +417,18 @@ def _run_store_batch(store, req, entries, results, summaries_by_task,
     from ..util import failpoint as _fp
     from ..util import metrics, tracing
 
+    sid = store.cluster.store_of(entries[0][1].region_id)
+    if not store.breakers.allow_request(sid):
+        # the store's circuit breaker is open: skip the batched dispatch
+        # entirely — every lane falls out to the single-task path, which
+        # owns the failover-through-PD decision (exactly like stale-epoch
+        # lanes, just before the launch instead of after)
+        for i, t in entries:
+            results[i] = _run_one_task(
+                store, req, t, summaries_by_task[i],
+                dispatch_span=dispatch_span, scan_kind=scan_kind,
+            )
+        return {"batches": 0, "regions": 0, "launches_saved": 0}
     creqs = []
     for i, t in entries:
         if req.checker is not None:
@@ -239,13 +454,19 @@ def _run_store_batch(store, req, entries, results, summaries_by_task,
                 store.batch_coprocessor_bytes(encode_batch_cop_request(creqs)))
         else:
             resps = store.batch_coprocessor(creqs)
+        served_ok = 0
         for (i, t), resp in zip(entries, resps):
             sums = summaries_by_task[i]
             if resp.region_error is not None:
+                from ..store.errors import parse_region_error
+
                 metrics.DISTSQL_RETRIES.inc()
-                # stale region: re-split its ranges against the fresh
-                # region view and retry ONLY it through the single-task
-                # path (spans nest under the batch span, ambient)
+                metrics.REGION_ERRORS.labels(parse_region_error(resp.region_error).kind).inc()
+                # faulted lane (stale epoch, folded region, down store):
+                # re-split its ranges against the fresh region view and
+                # retry ONLY it through the single-task path, which owns
+                # classification, backoff, breakers and failover (spans
+                # nest under the batch span, ambient)
                 chunks: list = []
                 for s2 in _build_tasks(store, t.ranges):
                     chunks.extend(_run_one_task(
@@ -254,7 +475,8 @@ def _run_store_batch(store, req, entries, results, summaries_by_task,
                 results[i] = chunks
                 continue
             if resp.other_error is not None:
-                raise RuntimeError(resp.other_error)
+                raise CopInternalError(resp.other_error)
+            served_ok += 1
             # only lanes a vmapped launch actually served count toward
             # batch attribution — cop-cache hits, overflow fall-outs and
             # single-path degrades did not ride one (resp.batched == 0);
@@ -269,6 +491,10 @@ def _run_store_batch(store, req, entries, results, summaries_by_task,
                               epoch=t.epoch, batched=bool(resp.batched)) as sp:
                 if sp is not None and resp.chunk is not None:
                     sp.set("rows", resp.chunk.num_rows())
+        if served_ok:
+            # at least one lane answered cleanly: the store is reachable
+            # (closes a half-open probe; resets the consecutive-fail count)
+            store.breakers.record_success(sid)
         stats["batches"] = len(batch_ids)
         stats["launches_saved"] = max(stats["regions"] - len(batch_ids), 0)
         if bsp is not None:

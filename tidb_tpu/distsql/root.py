@@ -175,6 +175,7 @@ def execute_root(
     low_memory: bool = False,
     small_groups: int | None = None,
     checker=None,
+    backoff_weight: int = 2,
 ) -> Chunk:
     """Run a logical (Complete-mode) DAG over the store: split, dispatch the
     pushdown half per region, merge at root. The caller-visible result is
@@ -195,7 +196,7 @@ def execute_root(
         out = _execute_root(
             store, dag, ranges, start_ts, aux_chunks, concurrency, cache,
             group_capacity, paging_size, batch_cop, summary_sink, tracker,
-            low_memory, small_groups, checker,
+            low_memory, small_groups, checker, backoff_weight,
         )
         if sp is not None:
             sp.set("rows", out.num_rows())
@@ -205,7 +206,7 @@ def execute_root(
 def _execute_root(
     store, dag, ranges, start_ts, aux_chunks, concurrency, cache,
     group_capacity, paging_size, batch_cop, summary_sink, tracker,
-    low_memory, small_groups, checker,
+    low_memory, small_groups, checker, backoff_weight=2,
 ) -> Chunk:
     plan = split_dag(dag)
     if low_memory and plan.root_dag is not None:
@@ -223,6 +224,7 @@ def _execute_root(
             plan.push_dag, ranges, start_ts, concurrency=concurrency,
             aux_chunks=aux_chunks or [], paging_size=paging_size,
             batch_cop=batch_cop, small_groups=small_groups, checker=checker,
+            backoff_weight=backoff_weight,
         ),
     )
     if summary_sink is not None:

@@ -15,8 +15,13 @@ import time
 
 
 class QueryKilledError(Exception):
-    """Surfaced as MySQL error 3024 (ER_QUERY_TIMEOUT) or 1317
-    (ER_QUERY_INTERRUPTED) by the session."""
+    """Surfaced as MySQL error 3024 (ER_QUERY_TIMEOUT, `timeout=True`)
+    or 1317 (ER_QUERY_INTERRUPTED, explicit KILL) by the session — the
+    flag is typed here at the raise site, never parsed from the text."""
+
+    def __init__(self, message: str, timeout: bool = False):
+        super().__init__(message)
+        self.timeout = timeout
 
 
 class RunawayChecker:
@@ -31,11 +36,19 @@ class RunawayChecker:
         """KILL QUERY: the next dispatch boundary aborts the statement."""
         self._killed = True
 
+    @property
+    def deadline(self) -> float | None:
+        """Absolute monotonic deadline (None = unlimited) — the Backoffer
+        clamps its sleeps so a statement never sleeps past its own
+        MAX_EXECUTION_TIME (it would only wake up to die)."""
+        return self._deadline
+
     def before_cop_request(self):
         """The BeforeCopRequest hook: raise when over budget or killed."""
         if self._killed:
             raise QueryKilledError("Query execution was interrupted")
         if self._deadline is not None and self._now() > self._deadline:
             raise QueryKilledError(
-                "Query execution was interrupted, maximum statement execution time exceeded"
+                "Query execution was interrupted, maximum statement execution time exceeded",
+                timeout=True,
             )

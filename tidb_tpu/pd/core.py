@@ -230,6 +230,10 @@ class PlacementDriver:
         # drains ONE heartbeat interval and owns the scheduling round)
         self._timer = None
         self.last_tick_root = None  # last pd.tick trace (TRACE/debug view)
+        # store health as dispatch reported it + the tick's own probes
+        # (ref: PD's store state machine Up/Disconnected/Down driven by
+        # store heartbeats); surfaced in /pd/api/v1/stores
+        self.store_health: dict[int, str] = {}
         self.cluster.pd = self  # placement authority hookup
 
     # -- placement authority ------------------------------------------------
@@ -243,6 +247,54 @@ class PlacementDriver:
 
         metrics.PD_PLACEMENT_DECISIONS.inc()
         return self.cluster.place_least_loaded(region_id)
+
+    # -- store health + failover --------------------------------------------
+    def note_store_down(self, store_id: int) -> None:
+        """Dispatch-reported store failure (ref: client-go feeding store
+        liveness back; PD flips the store Disconnected)."""
+        with self._mu:
+            self.store_health[store_id] = "down"
+
+    def note_store_up(self, store_id: int) -> None:
+        # lock-free fast path: dispatch calls this after EVERY successful
+        # cop response — only a store actually marked down pays the lock
+        if self.store_health.get(store_id) != "down":
+            return
+        with self._mu:
+            if self.store_health.get(store_id) == "down":
+                self.store_health[store_id] = "up"
+
+    def store_state(self, store_id: int) -> str:
+        with self._mu:
+            return self.store_health.get(store_id, "up")
+
+    def failover_region(self, region_id: int, bad_store: int,
+                        avoid=frozenset()) -> int | None:
+        """Re-place one region off a failed store onto the least-loaded
+        healthy store — the dispatch layer's escape hatch once a store's
+        circuit breaker opens (ref: PD evicting peers off a Down store).
+        Recorded as a finished `failover` operator so /pd/api/v1/operators
+        shows the storm. Returns the target store, or None when every
+        other store is down/avoided (caller backs off and retries)."""
+        from ..util import metrics
+
+        if self.cluster.region_by_id(region_id) is None:
+            return None
+        candidates = [
+            s for s in range(self.cluster.n_stores)
+            if s != bad_store and s not in avoid and not self.store.store_down(s)
+        ]
+        if not candidates:
+            return None
+        counts = self.cluster.counts_per_store()
+        target = min(candidates, key=lambda s: counts.get(s, 0))
+        self.cluster.set_store(region_id, target)
+        self.note_store_down(bad_store)
+        op = self.new_operator("failover", region_id, source=bad_store, target=target)
+        self.queue.retire(op, "finished", "store failover")
+        metrics.PD_OPERATORS.labels("failover").inc()
+        metrics.PD_FAILOVERS.inc()
+        return target
 
     def new_operator(self, kind: str, region_id: int, **kw) -> Operator:
         with self._mu:
@@ -288,6 +340,10 @@ class PlacementDriver:
                 self._absorb(beats)
                 if hsp is not None:
                     hsp.set("heartbeats", len(beats))
+            with tracing.span("pd.health") as psp:
+                down = self._probe_stores()
+                if psp is not None:
+                    psp.set("down_stores", down)
             with tracing.span("pd.schedule") as ssp:
                 proposed = 0
                 for sched in self.checkers + self.schedulers:
@@ -310,6 +366,32 @@ class PlacementDriver:
             root.set("operators", len(dispatched))
         metrics.PD_TICK_DURATION.observe(time.monotonic() - t0)
         return dispatched
+
+    def _probe_stores(self) -> int:
+        """Liveness-probe every store (ref: PD's store heartbeat watchdog):
+        refresh the health view, and close a tripped circuit breaker whose
+        store answers again — but ONLY for stores with no regions placed
+        (their traffic failed over away, so no request would ever run the
+        breaker's own half-open probe). A store still holding regions —
+        e.g. one opened by a server-busy storm the liveness ping cannot
+        see — keeps its probe discipline: dispatch traffic decides.
+        Returns the down-store count."""
+        board = getattr(self.store, "breakers", None)
+        counts = self.cluster.counts_per_store()
+        down = 0
+        for sid in range(self.cluster.n_stores):
+            up = self.store.ping_store(sid)
+            with self._mu:
+                self.store_health[sid] = "up" if up else "down"
+            if not up:
+                down += 1
+            elif (
+                board is not None
+                and counts.get(sid, 0) == 0
+                and board.states().get(sid) not in (None, "closed")
+            ):
+                board.record_success(sid)
+        return down
 
     def _absorb(self, beats: list[RegionHeartbeat]) -> None:
         from ..util import metrics
@@ -369,6 +451,12 @@ class PlacementDriver:
         if self.cluster.region_by_id(op.region_id) is None:
             self.queue.retire(op, "cancelled", "region gone")
             return
+        if not self.store.ping_store(op.target):
+            # a balance/hot-region proposal computed before the outage (or
+            # during it — the schedulers see the empty store as the least
+            # loaded) must not ping-pong regions back ONTO a down store
+            self.queue.retire(op, "cancelled", f"target store {op.target} down")
+            return
         self.cluster.set_store(op.region_id, op.target)
         self.queue.retire(op, "finished")
 
@@ -412,9 +500,15 @@ class PlacementDriver:
 
     def stores_view(self) -> list[dict]:
         stats = self.flow.stats()
+        breaker_states = {}
+        board = getattr(self.store, "breakers", None)
+        if board is not None:
+            breaker_states = board.states()
         by_store: dict[int, dict] = {
             s: {"store_id": s, "region_count": 0, "region_size": 0, "region_keys": 0,
-                "hot_read_regions": 0, "hot_write_regions": 0}
+                "hot_read_regions": 0, "hot_write_regions": 0,
+                "state": self.store_state(s),
+                "breaker": breaker_states.get(s, "closed")}
             for s in range(self.cluster.n_stores)
         }
         hot_r = {p.region_id for p in self.hot_read.hot_peers()}

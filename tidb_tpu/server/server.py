@@ -125,7 +125,9 @@ class Connection:
             try:
                 res = self.session.execute(stmt_sql)
             except (SQLError, PlanError, CatalogError, ParseError) as exc:
-                self.io.write(P.err_packet(1105, str(exc)))
+                # typed statement errors carry their MySQL errno (9005
+                # region-unavailable, 3024/1317 killed); the rest are 1105
+                self.io.write(P.err_packet(getattr(exc, "code", 1105), str(exc)))
                 return
             except Exception as exc:  # noqa: BLE001 — wire must answer
                 self.io.write(P.err_packet(1105, f"internal error: {exc}"))

@@ -191,7 +191,14 @@ def ast_digest(stmt) -> str:
 
 
 class SQLError(ValueError):
-    pass
+    """User-facing statement error. `code` is the MySQL error number the
+    wire server puts in the ERR packet (ref: pkg/errno; 1105 = generic
+    ER_UNKNOWN_ERROR, 9005 = ErrRegionUnavailable, 3024 = ER_QUERY_TIMEOUT,
+    1317 = ER_QUERY_INTERRUPTED)."""
+
+    def __init__(self, message: str, code: int = 1105):
+        super().__init__(message)
+        self.code = code
 
 
 def _show_like(stmt, name: str) -> bool:
@@ -601,13 +608,24 @@ class Session:
             stmt_type = type(stmt).__name__.removesuffix("Stmt").lower()
             res = self.execute_stmt(stmt)
         except Exception as exc:
+            from ..distsql.dispatch import CopInternalError, RegionUnavailableError
             from ..distsql.runaway import QueryKilledError
 
             metrics.STATEMENTS.labels(stmt_type, "error").inc()
             self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, 0, False, str(exc),
                               cpu_ms=(_time.thread_time() - c0) * 1e3)
             if isinstance(exc, QueryKilledError):
-                raise SQLError(str(exc)) from exc
+                # 3024 ER_QUERY_TIMEOUT (deadline) vs 1317 ER_QUERY_INTERRUPTED
+                # (KILL QUERY) — same split the reference makes
+                code = 3024 if getattr(exc, "timeout", False) else 1317
+                raise SQLError(str(exc), code=code) from exc
+            if isinstance(exc, RegionUnavailableError):
+                # every backoff budget spent / every store unhealthy:
+                # MySQL 9005 (ref: errno.ErrRegionUnavailable), not a bare
+                # RuntimeError that reads like an engine bug
+                raise SQLError(f"Region is unavailable: {exc}", code=9005) from exc
+            if isinstance(exc, CopInternalError):
+                raise SQLError(str(exc), code=1105) from exc
             raise
         metrics.STATEMENTS.labels(stmt_type, "ok").inc()
         rows = len(res.rows) if getattr(res, "rows", None) else getattr(res, "affected", 0)
@@ -1336,6 +1354,7 @@ class Session:
                             batch_cop=self.sysvars.get_bool("tidb_allow_batch_cop"),
                             summary_sink=self._explain_sink,
                             checker=self._runaway_checker(),
+                            backoff_weight=self.sysvars.get_int("tidb_backoff_weight"),
                         )
                         try:
                             chunk = execute_root(

@@ -1,5 +1,18 @@
 from .kv import MemKV
 from .region import Region, Cluster
 from .store import TPUStore, CopRequest, CopResponse, KeyRange
+from .errors import (
+    RegionError,
+    NotLeader,
+    EpochNotMatch,
+    RegionNotFound,
+    ServerIsBusy,
+    StoreUnavailable,
+    parse_region_error,
+)
 
-__all__ = ["MemKV", "Region", "Cluster", "TPUStore", "CopRequest", "CopResponse", "KeyRange"]
+__all__ = [
+    "MemKV", "Region", "Cluster", "TPUStore", "CopRequest", "CopResponse", "KeyRange",
+    "RegionError", "NotLeader", "EpochNotMatch", "RegionNotFound", "ServerIsBusy",
+    "StoreUnavailable", "parse_region_error",
+]

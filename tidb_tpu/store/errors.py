@@ -1,0 +1,131 @@
+"""Typed region errors — the classification layer the dispatch client
+retries on (ref: kvproto errorpb.Error: NotLeader / EpochNotMatch /
+ServerIsBusy / StoreNotMatch, and client-go's per-kind Backoffer budgets,
+tikv/client-go retry/backoff.go + copr/coprocessor.go:1424 handleCopResponse).
+
+The wire seam carries `CopResponse.region_error` as a string (exactly like
+the reference carries errorpb inside the cop response proto), so every
+typed error ENCODES to a stable `kind`-prefixed string and PARSES back on
+the client side — region errors survive both the single-request bytes seam
+and the batched frames without a codec change. `parse_region_error` is
+total: an unrecognized string still classifies (as `region_miss`, the
+catch-all retry kind) so an old peer can never wedge a new client.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class RegionError:
+    """Base: a retryable region-level failure. `kind` selects the
+    Backoffer budget; `message` is the wire string it round-trips to."""
+
+    message: str
+    kind: str = "region_miss"
+
+    def __str__(self) -> str:
+        return self.message
+
+
+@dataclass(frozen=True)
+class NotLeader(RegionError):
+    """The peer asked is not the region's leader (ref: errorpb.NotLeader;
+    the client refreshes leadership and retries on the updateLeader
+    budget). store_id is the store that rejected the request."""
+
+    store_id: int = -1
+    kind: str = "not_leader"
+
+    @staticmethod
+    def make(region_id: int, store_id: int) -> "NotLeader":
+        return NotLeader(f"not_leader: region {region_id} store {store_id}",
+                         store_id=store_id)
+
+
+@dataclass(frozen=True)
+class EpochNotMatch(RegionError):
+    """Stale region epoch after a split/merge — the client re-splits its
+    ranges against the fresh region view (ref: errorpb.EpochNotMatch)."""
+
+    kind: str = "epoch_not_match"
+
+
+@dataclass(frozen=True)
+class RegionNotFound(RegionError):
+    """The region id no longer exists (absorbed by a merge) — re-split,
+    same as a stale epoch (ref: errorpb.RegionNotFound)."""
+
+    kind: str = "region_not_found"
+
+
+@dataclass(frozen=True)
+class ServerIsBusy(RegionError):
+    """The store is overloaded and suggests how long to wait (ref:
+    errorpb.ServerIsBusy.backoff_ms; client-go honors the suggestion as a
+    floor on its serverBusy backoff)."""
+
+    backoff_ms: int = 0
+    kind: str = "server_busy"
+
+    @staticmethod
+    def make(store_id: int, backoff_ms: int = 0) -> "ServerIsBusy":
+        return ServerIsBusy(
+            f"server_is_busy: store {store_id} backoff_ms={backoff_ms}",
+            backoff_ms=backoff_ms,
+        )
+
+
+@dataclass(frozen=True)
+class StoreUnavailable(RegionError):
+    """The placement store is down/unreachable — the breaker-counting
+    kind: repeated hits open the store's circuit breaker and the task
+    fails over through a PD re-placement (ref: client-go's store
+    liveness/slow-score marking a store unreachable)."""
+
+    store_id: int = -1
+    kind: str = "store_unavailable"
+
+    @staticmethod
+    def make(store_id: int) -> "StoreUnavailable":
+        return StoreUnavailable(f"store_unavailable: store {store_id}",
+                                store_id=store_id)
+
+
+def _int_after(s: str, token: str, default: int = -1) -> int:
+    i = s.rfind(token)
+    if i < 0:
+        return default
+    tail = s[i + len(token):].lstrip()
+    digits = ""
+    for c in tail:
+        if c.isdigit() or (c == "-" and not digits):
+            digits += c
+        else:
+            break
+    try:
+        return int(digits)
+    except ValueError:
+        return default
+
+
+def parse_region_error(message: str | None) -> RegionError | None:
+    """Classify a wire region-error string into its typed form. Total:
+    anything unrecognized is a generic `region_miss` (retry + re-split,
+    the safe default — exactly how the seed treated every region error)."""
+    if message is None:
+        return None
+    m = message.strip()
+    low = m.lower()
+    if "not_leader" in low or "not leader" in low:
+        return NotLeader(m, store_id=_int_after(low, "store"))
+    if "server_is_busy" in low or "server is busy" in low:
+        return ServerIsBusy(m, backoff_ms=max(_int_after(low, "backoff_ms="), 0))
+    if "store_unavailable" in low or "store unavailable" in low:
+        return StoreUnavailable(m, store_id=_int_after(low, "store"))
+    if "epoch_not_match" in low or "epoch not match" in low:
+        return EpochNotMatch(m)
+    if "not found" in low:
+        return RegionNotFound(m)
+    return RegionError(m)

@@ -93,6 +93,30 @@ class CopResponse:
     # dispatch layer can count distinct launches for launches_saved
 
 
+def _fault_matches(value, store_id: int) -> bool:
+    """Per-store failpoint arming: True fires for every store; a
+    set/list/tuple of ids fires for those stores; a dict
+    `{"stores": ids-or-None, ...}` fires for the listed stores (None =
+    all) and may carry extra payload (`backoff_ms` for server-busy); a
+    ZERO-arg callable returns any of those shapes per hit (custom
+    fire-N-times logic — `failpoint.eval` already invokes callables with
+    no arguments, so this is the only callable arity that exists; a
+    value arriving un-invoked via `failpoint.peek` is asked here).
+    None/falsy never fires."""
+    if not value:
+        return False
+    if callable(value):  # peek path hands over the raw callable
+        return _fault_matches(value(), store_id)
+    if value is True or isinstance(value, int):
+        return True
+    if isinstance(value, (set, frozenset, list, tuple)):
+        return store_id in value
+    if isinstance(value, dict):
+        stores = value.get("stores")
+        return stores is None or store_id in stores
+    return True
+
+
 class TPUStore:
     """KV + regions + TPU coprocessor, one process (ref: mockstore
     EmbedUnistore, mockstore.go:86)."""
@@ -124,6 +148,48 @@ class TPUStore:
         self._cop_cache: dict = {}
         self._cop_lock = threading.Lock()
         self._row_encoder = RowEncoder()
+        # fault switches: logical placement stores marked down answer every
+        # cop request with a typed StoreUnavailable region error (the
+        # in-process analog of a TiKV store dropping off the network)
+        self._down_stores: set[int] = set()
+        self._down_lock = threading.Lock()
+        # per-store circuit breakers — client-side state, but shared by
+        # every session/dispatch thread on this store (runtime import:
+        # the distsql layer imports this module at load time)
+        from ..distsql.dispatch import BreakerBoard
+
+        self.breakers = BreakerBoard()
+
+    # -- store fault switches (chaos/testing; ref: failpoint-driven store
+    # outages in the reference's integration suites) ------------------------
+    def set_down(self, store_id: int) -> None:
+        """Take one logical placement store down: every cop request whose
+        region is placed there answers `store_unavailable` until set_up."""
+        with self._down_lock:
+            self._down_stores.add(store_id)
+
+    def set_up(self, store_id: int) -> None:
+        with self._down_lock:
+            self._down_stores.discard(store_id)
+
+    def store_down(self, store_id: int) -> bool:
+        with self._down_lock:
+            return store_id in self._down_stores
+
+    def down_stores(self) -> set:
+        with self._down_lock:
+            return set(self._down_stores)
+
+    def ping_store(self, store_id: int) -> bool:
+        """Store liveness probe (ref: client-go store liveness check /
+        PD's store heartbeat watchdog): False when the store is switched
+        down OR the unreachable failpoint is armed for it. Non-consuming —
+        a probe must never eat a fire-N-times count."""
+        from ..util import failpoint
+
+        if self.store_down(store_id):
+            return False
+        return not _fault_matches(failpoint.peek("store/unreachable"), store_id)
 
     def evict_caches(self) -> int:
         """Drop the decoded-chunk and device-batch caches — the first OOM
@@ -501,6 +567,28 @@ class TPUStore:
             while len(self._cop_cache) > self._COP_CACHE_MAX:
                 self._cop_cache.pop(next(iter(self._cop_cache)))
 
+    def _region_fault(self, region_id: int):
+        """The typed fault ladder for one region's placement store: the
+        set_down switch and the three per-store-armable failpoints
+        (`store/unreachable`, `store/not-leader`, `store/server-busy`) —
+        each returns a typed RegionError the dispatch client classifies
+        onto its own backoff budget. None = healthy."""
+        from ..util import failpoint
+        from .errors import NotLeader, ServerIsBusy, StoreUnavailable
+
+        sid = self.cluster.store_of(region_id)
+        if self.store_down(sid):
+            return StoreUnavailable.make(sid)
+        if _fault_matches(failpoint.eval("store/unreachable"), sid):
+            return StoreUnavailable.make(sid)
+        if _fault_matches(failpoint.eval("store/not-leader"), sid):
+            return NotLeader.make(region_id, sid)
+        busy = failpoint.eval("store/server-busy")
+        if _fault_matches(busy, sid):
+            ms = busy.get("backoff_ms", 0) if isinstance(busy, dict) else 0
+            return ServerIsBusy.make(sid, ms)
+        return None
+
     # -- the serialized endpoint (the sidecar seam) -------------------------
     def coprocessor_bytes(self, req_bytes: bytes) -> bytes:
         """Serve one cop request from wire bytes to wire bytes — the
@@ -539,6 +627,9 @@ class TPUStore:
         region = self.cluster.region_by_id(req.region_id)
         if region is None:
             return CopResponse(region_error=f"region {req.region_id} not found")
+        err = self._region_fault(req.region_id)
+        if err is not None:
+            return CopResponse(region_error=str(err))
         if req.region_epoch != region.epoch:
             return CopResponse(region_error=f"epoch_not_match: have {region.epoch}, got {req.region_epoch}")
         cached = self._cop_cache_get(req)
@@ -664,6 +755,16 @@ class TPUStore:
                 metrics.COP_REQUESTS.inc()
                 metrics.COP_ERRORS.inc()
                 responses[i] = CopResponse(region_error=f"region {req.region_id} not found")
+                continue
+            err = self._region_fault(req.region_id)
+            if err is not None:
+                # typed store faults fall out of the batch exactly like a
+                # stale epoch: the lane answers immediately, the rest of
+                # the batch stands (region errors survive the batch frame
+                # as strings, same as the single-request seam)
+                metrics.COP_REQUESTS.inc()
+                metrics.COP_ERRORS.inc()
+                responses[i] = CopResponse(region_error=str(err))
                 continue
             if req.region_epoch != region.epoch:
                 metrics.COP_REQUESTS.inc()

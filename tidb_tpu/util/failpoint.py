@@ -31,6 +31,13 @@ def is_armed(name: str) -> bool:
     return name in _active
 
 
+def peek(name: str):
+    """The failpoint's raw value WITHOUT consuming a count or invoking a
+    callable — health probes use this to ask 'would this site fire for
+    store N?' without firing it."""
+    return _active.get(name)
+
+
 def eval(name: str):  # noqa: A001 (mirrors the reference API)
     """Returns the failpoint's value if enabled, else None. A callable
     value is invoked (and may raise, the usual injection shape); an int

@@ -281,6 +281,22 @@ SPILL_PARTITIONS = REGISTRY.counter("tidb_tpu_spill_partitions_total", "out-of-c
 MEM_EVICTIONS = REGISTRY.counter("tidb_tpu_mem_evictions_total", "store cache evictions by the OOM action")
 MEM_DEGRADED_QUERIES = REGISTRY.counter("tidb_tpu_mem_degraded_total", "queries degraded to the low-memory fold path")
 DISTSQL_RETRIES = REGISTRY.counter("tidb_tpu_distsql_region_retries_total", "region-error retries")
+BACKOFF_SECONDS = REGISTRY.counter_vec(
+    "tidb_tpu_backoff_seconds_total", "dispatch backoff sleep time by error kind",
+    labelnames=("kind",),
+)
+REGION_ERRORS = REGISTRY.counter_vec(
+    "tidb_tpu_region_errors_total", "typed region errors seen by dispatch",
+    labelnames=("kind",),
+)
+BREAKER_STATE = REGISTRY.gauge_vec(
+    "tidb_tpu_store_breaker_state", "per-store circuit breaker state (0=closed 1=half-open 2=open)",
+    labelnames=("store",),
+)
+BREAKER_TRIPS = REGISTRY.counter_vec(
+    "tidb_tpu_store_breaker_trips_total", "circuit-breaker open transitions per store",
+    labelnames=("store",),
+)
 PROGRAM_COMPILES = REGISTRY.counter("tidb_tpu_program_compiles_total", "fused XLA programs built")
 PROGRAM_LAUNCHES = REGISTRY.counter("tidb_tpu_program_launches_total", "fused XLA program executions dispatched (batched counts once)")
 PROGRAM_CACHE_HITS = REGISTRY.counter("tidb_tpu_program_cache_hits_total", "program-cache hits (compile skipped)")
@@ -316,4 +332,5 @@ PD_STORE_REGIONS = REGISTRY.gauge_vec(
 )
 PD_REGIONS = REGISTRY.gauge("pd_regions", "regions in the cluster")
 PD_PLACEMENT_DECISIONS = REGISTRY.counter("pd_placement_decision_total", "placement-map misses resolved by a PD least-loaded decision")
+PD_FAILOVERS = REGISTRY.counter("pd_failover_total", "regions re-placed onto a healthy store after a store failure")
 PD_TICK_DURATION = REGISTRY.histogram("pd_tick_seconds", "PD scheduling tick latency")

@@ -1,0 +1,265 @@
+"""Seeded chaos harness — drive a mixed SQL workload while a deterministic
+fault schedule arms and disarms store/PD failpoints, and assert the engine's
+ONE inviolable contract: a query under faults either returns the oracle
+result or a TYPED retryable error — never a wrong answer — and the cluster
+converges back to all-breakers-closed once the storm passes (ref: the
+failpoint-driven chaos suites around pingcap/failpoint, and chaos-mesh's
+invariant checking over TiDB clusters).
+
+Two modes share one workload generator:
+
+  * `run_chaos(...)` (default schedule) — storm phases at fixed statement
+    indices: a store outage mid-run (batched dispatch fails over through a
+    PD re-placement), a server-busy storm, a PD heartbeat blackout, counted
+    not-leader flaps, and an operator-timeout window; the PD ticks every
+    `tick_every` statements, exactly like its background timer.
+  * `run_chaos(..., fault_rate=0.1)` — bench mode: each statement rolls the
+    seeded dice and runs under a one-shot fault with that probability
+    (BENCH_CHAOS=1 compares p50/p99 vs a clean run).
+
+Oracle answers are precomputed on a pristine single-region session BEFORE
+any fault is armed, so the comparison itself can never be polluted by the
+schedule. Usage: `python tools/chaos.py [seed [statements]]`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+TID_ROWS = 240
+N_REGIONS = 8
+N_STORES = 4
+
+# every failpoint the schedule may arm — disarmed wholesale in the
+# `finally` so a crashed run never leaks faults into the next test
+FAULT_POINTS = (
+    "store/unreachable",
+    "store/not-leader",
+    "store/server-busy",
+    "pd/heartbeat-lost",
+    "pd/operator-timeout",
+)
+
+
+def _fill_session(split_regions: bool):
+    """One schema+data instance; `split_regions` True builds the sharded
+    chaos cluster, False the single-region oracle."""
+    from tidb_tpu.codec import tablecodec
+    from tidb_tpu.sql.session import Session
+
+    s = Session()
+    s.execute("CREATE TABLE chaos_t (id BIGINT PRIMARY KEY, v BIGINT, g BIGINT)")
+    s.execute("CREATE TABLE chaos_d (g BIGINT PRIMARY KEY, name VARCHAR(16))")
+    s.execute("INSERT INTO chaos_t VALUES " + ",".join(
+        f"({i},{(i * 37) % 101},{i % 6})" for i in range(TID_ROWS)))
+    s.execute("INSERT INTO chaos_d VALUES " + ",".join(
+        f"({g},'grp{g}')" for g in range(6)))
+    if split_regions:
+        tid = s.catalog.table("chaos_t").table_id
+        for i in range(1, N_REGIONS):
+            s.store.cluster.split(tablecodec.encode_row_key(tid, i * TID_ROWS // N_REGIONS))
+        s.store.cluster.set_stores(N_STORES)
+        s.store.cluster.scatter()
+        s.execute("SET tidb_allow_batch_cop = ON")
+        s.execute("SET tidb_backoff_weight = 1")
+    return s
+
+
+def build_workload(seed: int, n: int) -> list[str]:
+    """Deterministic mixed workload: scans, range reads, aggregates,
+    a broadcast join, TopN — every statement fully ordered so result
+    comparison is positional."""
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        t = rng.randrange(6)
+        if t == 0:
+            out.append(f"SELECT count(*), sum(v) FROM chaos_t WHERE v < {rng.randrange(5, 95)}")
+        elif t == 1:
+            a = rng.randrange(0, TID_ROWS - 25)
+            out.append(f"SELECT id, v FROM chaos_t WHERE id BETWEEN {a} AND {a + 20} ORDER BY id")
+        elif t == 2:
+            out.append("SELECT g, count(*), sum(v) FROM chaos_t GROUP BY g ORDER BY g")
+        elif t == 3:
+            p = rng.randrange(10, 90)
+            out.append(
+                "SELECT t.g, d.name, count(*) FROM chaos_t t JOIN chaos_d d ON t.g = d.g "
+                f"WHERE t.v < {p} GROUP BY t.g, d.name ORDER BY t.g")
+        elif t == 4:
+            out.append("SELECT id, v FROM chaos_t ORDER BY v DESC, id LIMIT 10")
+        else:
+            out.append(f"SELECT max(v), min(v), count(*) FROM chaos_t WHERE id >= {rng.randrange(TID_ROWS)}")
+    return out
+
+
+def default_schedule(n: int) -> dict[int, list[tuple]]:
+    """Statement-index -> fault actions. Phases scale with `n` so a short
+    run still sees every storm and still gets a clean convergence tail."""
+    def at(frac: float) -> int:
+        return max(int(n * frac), 1)
+
+    sched: dict[int, list[tuple]] = {}
+
+    def add(i, *action):
+        sched.setdefault(i, []).append(tuple(action))
+
+    # phase 1: store 1 drops off the network mid-run (batched dispatch
+    # lanes fall out, breaker opens, PD fails the regions over)
+    add(at(0.15), "down", 1)
+    add(at(0.28), "up", 1)
+    # phase 2: server-busy storm on store 2 (suggested-backoff honored)
+    add(at(0.35), "arm", "store/server-busy", {"stores": {2}, "backoff_ms": 3})
+    add(at(0.45), "disarm", "store/server-busy")
+    # phase 3: PD heartbeat blackout (ticks keep running, stats starve)
+    add(at(0.50), "arm", "pd/heartbeat-lost", True)
+    add(at(0.60), "disarm", "pd/heartbeat-lost")
+    # phase 4: counted not-leader flaps (transient leadership wobble —
+    # fires 3 times total, then leadership 'settles')
+    add(at(0.63), "arm", "store/not-leader", 3)
+    add(at(0.68), "disarm", "store/not-leader")
+    # phase 5: operator-timeout window + a second, shorter outage
+    add(at(0.72), "arm", "pd/operator-timeout", True)
+    add(at(0.72), "down", 2)
+    add(at(0.78), "up", 2)
+    add(at(0.80), "disarm", "pd/operator-timeout")
+    # everything past at(0.80) runs clean: the convergence tail
+    return sched
+
+
+def _apply(actions, store, fp) -> None:
+    for action in actions:
+        if action[0] == "down":
+            store.set_down(action[1])
+        elif action[0] == "up":
+            store.set_up(action[1])
+        elif action[0] == "arm":
+            fp.enable(action[1], action[2])
+        elif action[0] == "disarm":
+            fp.disable(action[1])
+
+
+def run_chaos(seed: int = 7, statements: int = 200, fault_rate: float | None = None,
+              tick_every: int = 10) -> dict:
+    """Run the workload under the fault schedule; returns the invariant
+    report. Raises nothing on query failures — failures are CLASSIFIED:
+    typed retryable errors are expected under faults, wrong answers and
+    untyped errors are the bugs this harness exists to catch."""
+    from tidb_tpu.sql.session import SQLError
+    from tidb_tpu.util import failpoint as fp
+    from tidb_tpu.util import metrics
+
+    workload = build_workload(seed, statements)
+    oracle_sess = _fill_session(split_regions=False)
+    oracle = [oracle_sess.execute(sql).values() for sql in workload]
+
+    s = _fill_session(split_regions=True)
+    store = s.store
+    rng = random.Random(seed * 31 + 1)
+    schedule = {} if fault_rate is not None else default_schedule(statements)
+
+    def breaker_trips_total() -> float:
+        """Sum of the labeled trip counters via the public sampling API
+        (never _Vec internals — same rule bench.py follows)."""
+        return sum(
+            float(value) for series, value in metrics.REGISTRY.sample_lines()
+            if series.startswith("tidb_tpu_store_breaker_trips_total{")
+        )
+
+    ok = typed = 0
+    wrong: list = []
+    untyped: list = []
+    by_code: dict[int, int] = {}
+    lat_ms: list[float] = []
+    failovers0 = metrics.PD_FAILOVERS.value
+    trips0 = breaker_trips_total()
+    try:
+        for i, sql in enumerate(workload):
+            _apply(schedule.get(i, ()), store, fp)
+            one_shot = fault_rate is not None and rng.random() < fault_rate
+            if one_shot:
+                sid = rng.randrange(1, N_STORES)  # store 0 spared: the
+                # oracle comparison stays possible even at rate 1.0
+                if rng.random() < 0.7:
+                    fp.enable("store/server-busy", {"stores": {sid}, "backoff_ms": 2})
+                else:
+                    fp.enable("store/not-leader", 1)  # one counted flap
+            t0 = time.monotonic()
+            try:
+                got = s.execute(sql).values()
+                lat_ms.append((time.monotonic() - t0) * 1000.0)
+                if got != oracle[i]:
+                    wrong.append({"stmt": i, "sql": sql, "got": repr(got)[:200],
+                                  "want": repr(oracle[i])[:200]})
+                else:
+                    ok += 1
+            except SQLError as exc:
+                lat_ms.append((time.monotonic() - t0) * 1000.0)
+                code = getattr(exc, "code", 0)
+                if code in (9005, 1105, 3024, 1317):
+                    typed += 1
+                    by_code[code] = by_code.get(code, 0) + 1
+                else:
+                    untyped.append({"stmt": i, "sql": sql, "error": str(exc)[:200]})
+            except Exception as exc:  # noqa: BLE001 — the exact bug class we hunt
+                lat_ms.append((time.monotonic() - t0) * 1000.0)
+                untyped.append({"stmt": i, "sql": sql,
+                                "error": f"{type(exc).__name__}: {str(exc)[:200]}"})
+            finally:
+                if one_shot:
+                    fp.disable("store/server-busy")
+                    fp.disable("store/not-leader")
+            if (i + 1) % tick_every == 0:
+                store.pd.tick()
+    finally:
+        for name in FAULT_POINTS:
+            fp.disable(name)
+        for sid in range(N_STORES):
+            store.set_up(sid)
+    # convergence tail: with every fault cleared, the PD's health probes
+    # close any breaker still tripped (this IS part of the run — the
+    # acceptance bar is all-breakers-closed before the harness returns)
+    for _ in range(3):
+        store.pd.tick()
+        if store.breakers.all_closed():
+            break
+
+    lat_sorted = sorted(lat_ms)
+
+    def pct(p: float) -> float:
+        return round(lat_sorted[min(int(len(lat_sorted) * p), len(lat_sorted) - 1)], 2) if lat_sorted else 0.0
+
+    return {
+        "seed": seed,
+        "statements": statements,
+        "ok": ok,
+        "typed_errors": typed,
+        "errors_by_code": by_code,
+        "wrong_results": wrong,
+        "untyped_errors": untyped,
+        "failovers": int(metrics.PD_FAILOVERS.value - failovers0),
+        "breaker_trips": int(breaker_trips_total() - trips0),
+        "breakers": {str(k): v for k, v in sorted(store.breakers.states().items())},
+        "breakers_all_closed": store.breakers.all_closed(),
+        "store_health": [d["state"] for d in store.pd.stores_view()],
+        "p50_ms": pct(0.50),
+        "p99_ms": pct(0.99),
+    }
+
+
+def main():
+    seed = int(sys.argv[1]) if len(sys.argv) > 1 else 7
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    report = run_chaos(seed, n)
+    print(json.dumps(report, indent=2, default=str))
+    bad = report["wrong_results"] or report["untyped_errors"] or not report["breakers_all_closed"]
+    sys.exit(1 if bad else 0)
+
+
+if __name__ == "__main__":
+    main()
