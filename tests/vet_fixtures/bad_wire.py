@@ -1,0 +1,30 @@
+"""True-positive fixture for the `wire-parity` pass (filename ends in
+`wire.py` so the pass picks it up): an encoder with no decoder, and an
+encode/decode pair whose fields don't line up. NEVER imported — scanned
+as text by tests/test_vet.py."""
+
+
+def encode_orphan(w, req):  # VIOLATION: no decode_orphan anywhere
+    w.i64(req.id)
+
+
+def encode_lossy(w, resp):
+    w.i64(resp.rows)
+    w.f64(resp.elapsed)  # VIOLATION: the decoder never reads an f64 back
+
+
+def decode_lossy(r):
+    return r.i64()
+
+
+def encode_nested(w, x):
+    w.blob(encode_orphan_bytes(x))  # helper with no decode_ mirror
+    w.i32(1)
+
+
+def encode_orphan_bytes(x) -> bytes:
+    return b""
+
+
+def decode_nested(r):
+    return r.i32()  # VIOLATION: blob written but never read

@@ -1,0 +1,9 @@
+"""True-positive fixture for the `error-taxonomy` pass: bare
+RuntimeError/Exception raised in what would be a request path. NEVER
+imported — scanned as text by tests/test_vet.py."""
+
+
+def handle_request(region_id: int):
+    if region_id < 0:
+        raise RuntimeError(f"region {region_id} bad")  # VIOLATION: untyped
+    raise Exception("boom")  # VIOLATION: untyped

@@ -1,0 +1,10 @@
+"""True-positive fixture for the `failpoints` pass: arms a name no
+eval/is_armed/peek site under tidb_tpu/ defines — it could never fire.
+NEVER imported — scanned as text by tests/test_vet.py (which feeds it to
+the pass's scanner directly; the live-tree run must not see it, which is
+why fixtures live outside the pass's tests//tools/ scan roots... this one
+is exercised through failpoints._scan on the explicit path)."""
+
+from tidb_tpu.util import failpoint
+
+failpoint.enable("vetfix/undefined-name")

@@ -1,0 +1,11 @@
+"""True-positive fixture for the `jit-purity` pass: a module-level jax
+array constant (the PR-2 tracer-leak class) and an import-time global
+config toggle. NEVER imported — scanned as text by tests/test_vet.py."""
+
+import jax
+import jax.numpy as jnp
+
+BAD_CONST = jnp.zeros(4)  # created whenever this module first imports
+BAD_DERIVED = BAD_CONST + jnp.int64(1)
+
+jax.config.update("jax_enable_x64", False)  # import-order becomes semantics

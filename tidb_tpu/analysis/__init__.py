@@ -1,0 +1,67 @@
+"""tidb-vet — the repo's static-analysis suite (ISSUE 7; ref: go vet /
+Bazel nogo keeping the reference's 1.29M-LoC concurrent codebase honest;
+`tools/failpoint_check.py` proved the pattern in PR 6 and this package
+generalizes it).
+
+Two families:
+
+  * AST lint passes (stdlib `ast`, zero deps), each motivated by a bug a
+    past PR actually paid for — see ANALYZERS.md for the catalog:
+      jit-purity       module-level jax constants / config toggles
+      lock-discipline  `# guarded_by:` attributes accessed off-lock
+      error-taxonomy   bare RuntimeError/Exception in request paths
+      metrics          registration/label consistency (shares promparse
+                       with tools/scrape_check.py)
+      wire-parity      encode_*/decode_* symmetry in codec/wire.py
+      failpoints       armed names resolve to real injection sites
+  * lockwatch (analysis/lockwatch.py) — the runtime lockset / lock-order
+    detector the chaos and PD concurrency tests run under in tier-1.
+
+Driver: `python tools/vet.py [--json]` — exit 0 clean, 1 on findings.
+Suppress a finding with an inline `# vet: ignore[<pass>]` marker.
+"""
+
+from __future__ import annotations
+
+from . import (
+    error_taxonomy,
+    failpoints,
+    jit_purity,
+    lock_discipline,
+    metrics_lint,
+    wire_parity,
+)
+from .common import REPO, Finding, SourceFile, filter_suppressed, load_files, py_files
+
+# pass name -> (module, repo-relative scan roots); the scan roots encode
+# each pass's blast radius (jit purity only matters where programs trace,
+# error taxonomy where exceptions cross the session boundary, ...)
+PASSES = {
+    jit_purity.PASS: (jit_purity, ("tidb_tpu/ops", "tidb_tpu/exec",
+                                   "tidb_tpu/expr", "tidb_tpu/parallel")),
+    lock_discipline.PASS: (lock_discipline, ("tidb_tpu",)),
+    error_taxonomy.PASS: (error_taxonomy, ("tidb_tpu/distsql", "tidb_tpu/store",
+                                           "tidb_tpu/pd")),
+    metrics_lint.PASS: (metrics_lint, ("tidb_tpu",)),
+    wire_parity.PASS: (wire_parity, ("tidb_tpu/codec/wire.py",)),
+    failpoints.PASS: (failpoints, ()),  # owns its own scoping
+}
+
+
+def run_pass(name: str, files=None) -> list:
+    """Run one pass; `files` overrides the default scan roots (fixture
+    testing). Suppression markers are honored either way."""
+    mod, roots = PASSES[name]
+    if files is None:
+        files = load_files(py_files(*roots)) if roots else []
+    findings = mod.run(files)
+    by_rel = {sf.rel: sf for sf in files}
+    return filter_suppressed(findings, by_rel)
+
+
+def run_all() -> list:
+    """Every pass over its default scope, findings sorted by location."""
+    out: list = []
+    for name in PASSES:
+        out.extend(run_pass(name))
+    return sorted(out, key=lambda f: (f.path, f.line, f.passname))

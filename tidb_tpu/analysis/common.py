@@ -1,0 +1,105 @@
+"""Shared plumbing for the `tidb-vet` analysis suite (ref: the shape of a
+golang.org/x/tools/go/analysis.Pass — each pass gets parsed sources and
+reports findings; the driver in tools/vet.py aggregates and sets the exit
+code).
+
+Suppression: a finding anchored on a line carrying (or immediately
+preceded by a line carrying) `# vet: ignore[<pass>]` is dropped. The
+marker names the pass explicitly so a suppression can never silence a
+different analyzer by accident.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_IGNORE = re.compile(r"#\s*vet:\s*ignore\[([a-z0-9_,\- ]+)\]")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer hit: `path` is repo-relative, `line` 1-based."""
+
+    path: str
+    line: int
+    passname: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.passname}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line,
+                "pass": self.passname, "message": self.message}
+
+
+@dataclass
+class SourceFile:
+    """One parsed module: raw text, split lines and the ast tree (None on a
+    syntax error — passes skip unparseable files; vet itself reports them)."""
+
+    path: str  # absolute
+    rel: str  # repo-relative
+    text: str
+    lines: list[str] = field(default_factory=list)
+    tree: ast.AST | None = None
+    parse_error: str | None = None
+
+    @staticmethod
+    def load(path: str, repo: str = REPO) -> "SourceFile":
+        rel = os.path.relpath(path, repo)
+        try:
+            text = open(path, encoding="utf-8").read()
+        except OSError as exc:
+            return SourceFile(path, rel, "", [], None, f"unreadable: {exc}")
+        sf = SourceFile(path, rel, text, text.splitlines())
+        try:
+            sf.tree = ast.parse(text, filename=rel)
+        except SyntaxError as exc:
+            sf.parse_error = f"syntax error: {exc}"
+        return sf
+
+    def suppressed(self, line: int, passname: str) -> bool:
+        """True when `line` (or the line above it) carries an inline
+        `# vet: ignore[<pass>]` marker naming this pass."""
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _IGNORE.search(self.lines[ln - 1])
+                if m and passname in [p.strip() for p in m.group(1).split(",")]:
+                    return True
+        return False
+
+
+def py_files(*rel_paths: str, repo: str = REPO) -> list[str]:
+    """Every .py file under the given repo-relative dirs (files pass
+    through), sorted for deterministic output."""
+    out: list[str] = []
+    for rel in rel_paths:
+        root = os.path.join(repo, rel)
+        if os.path.isfile(root):
+            out.append(root)
+            continue
+        for dirpath, _dirs, files in os.walk(root):
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(dirpath, f))
+    return sorted(out)
+
+
+def load_files(paths) -> list[SourceFile]:
+    return [SourceFile.load(p) for p in paths]
+
+
+def filter_suppressed(findings, files_by_rel: dict) -> list:
+    out = []
+    for f in findings:
+        sf = files_by_rel.get(f.path)
+        if sf is not None and sf.suppressed(f.line, f.passname):
+            continue
+        out.append(f)
+    return out

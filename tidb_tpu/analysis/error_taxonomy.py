@@ -1,0 +1,45 @@
+"""`error-taxonomy` — request paths raise TYPED errors, never bare
+RuntimeError/Exception (ref: the reference's errno/terror discipline:
+every region/cop failure maps to a typed error with a MySQL code; PR 6
+replaced the seed's bare RuntimeErrors in dispatch with
+RegionUnavailableError/CopInternalError and this pass keeps it that way).
+
+Scope: tidb_tpu/distsql/, tidb_tpu/store/, tidb_tpu/pd/ — the request
+paths whose exceptions cross the session boundary and must map onto
+MySQL error codes. `raise RuntimeError(...)` / `raise Exception(...)`
+there silently degrades to error 1105 with no classification, no backoff
+budget, and no breaker accounting.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import Finding
+
+PASS = "error-taxonomy"
+
+_BARE = {"RuntimeError", "Exception"}
+
+
+def run(files) -> list:
+    findings: list = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            name = None
+            if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+                name = exc.func.id
+            elif isinstance(exc, ast.Name):
+                name = exc.id
+            if name in _BARE:
+                findings.append(Finding(
+                    sf.rel, node.lineno, PASS,
+                    f"bare `raise {name}` in a request path — use a typed error "
+                    f"from store/errors.py (or a subsystem exception with a MySQL "
+                    f"code mapping) so dispatch can classify, back off and account it"))
+    return findings

@@ -1,0 +1,171 @@
+"""`failpoints` — failpoint cross-reference checking + catalog generation
+(moved from tools/failpoint_check.py, which remains as a thin CLI shim;
+one pass among peers in the tidb-vet suite since ISSUE 7).
+
+A failpoint armed under a typo'd name silently never fires — the test
+that "exercises" a fault path then passes by exercising nothing (the
+reference avoids this with compile-time failpoint rewriting; a runtime
+registry has no such guard). Statically:
+
+  * every `failpoint.enable/enabled/disable("name")` in tests/, tools/
+    and bench.py must reference a SITE — a `failpoint.eval/is_armed/
+    peek("name")` call — defined in `tidb_tpu/` (or in the same file, for
+    the failpoint module's own unit tests);
+  * every site defined in `tidb_tpu/` must carry a one-line description
+    in DESCRIPTIONS below — that's what makes the generated catalog
+    (FAILPOINTS.md) complete by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+try:
+    from .common import REPO, Finding
+except ImportError:  # loaded by file path (tools/failpoint_check.py shim
+    # keeps itself importable without the engine's jax-importing package
+    # __init__) — pull common.py in the same way
+    import importlib.util as _ilu
+    import sys as _sys
+
+    _spec = _ilu.spec_from_file_location(
+        "_ttvet_common",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "common.py"))
+    _common = _ilu.module_from_spec(_spec)
+    _sys.modules["_ttvet_common"] = _common  # dataclasses resolve __module__
+    _spec.loader.exec_module(_common)
+    REPO, Finding = _common.REPO, _common.Finding
+
+PASS = "failpoints"
+
+# one line per failpoint: what arming it injects (the catalog body)
+DESCRIPTIONS = {
+    "cop-region-error": "injects `epoch_not_match` at the coprocessor RPC seam — exercises the re-split retry path",
+    "cop-other-error": "injects a non-retryable `other_error` cop response — surfaces as CopInternalError / MySQL 1105",
+    "cop-debug-raise": "re-raises store-side execution errors with a stack instead of folding them into `other_error`",
+    "distsql.before_task": "hook before every cop-task send — tests raise or count here to probe the dispatch loop",
+    "ddl_index_delete_only": "pauses online index DDL in the delete-only state so tests can write concurrently",
+    "ddl_index_write_only": "pauses online index DDL in the write-only state",
+    "ddl_index_write_reorg": "pauses online index DDL in the write-reorg (backfill) state",
+    "pd/heartbeat-lost": "drops one tick's region-heartbeat interval on the floor (a lost heartbeat stream)",
+    "pd/operator-timeout": "force-expires every pending PD operator at the next tick's dispatch phase",
+    "store/not-leader": "injects a typed NotLeader region error for requests to armed stores (True/set/dict arming)",
+    "store/server-busy": "injects ServerIsBusy with an optional `backoff_ms` suggestion for armed stores",
+    "store/unreachable": "injects StoreUnavailable for armed stores and fails their liveness probe (ping_store)",
+}
+
+_SITE = re.compile(r"""(?:failpoint|_fp|fp)\s*\.\s*(?:eval|is_armed|peek)\(\s*["']([^"']+)["']""")
+_USE = re.compile(r"""(?:failpoint|_fp|fp)\s*\.\s*(?:enable|enabled|disable)\(\s*["']([^"']+)["']""")
+
+
+def _py_files(*rel_dirs: str):
+    for rel in rel_dirs:
+        root = os.path.join(REPO, rel)
+        if os.path.isfile(root):
+            yield root
+            continue
+        for dirpath, _dirs, files in os.walk(root):
+            if "vet_fixtures" in dirpath:
+                continue  # true-positive corpora are scanned EXPLICITLY by
+                # tests/test_vet.py, never by the live-tree run
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+
+
+def _scan(pattern: re.Pattern, paths) -> dict[str, list[str]]:
+    """name -> ["relpath:line", ...] for every match of `pattern`."""
+    out: dict[str, list[str]] = {}
+    for path in paths:
+        rel = os.path.relpath(path, REPO)
+        try:
+            text = open(path, encoding="utf-8").read()
+        except OSError:
+            continue
+        for ln, line in enumerate(text.splitlines(), 1):
+            for m in pattern.finditer(line):
+                out.setdefault(m.group(1), []).append(f"{rel}:{ln}")
+    return out
+
+
+def check() -> tuple[list[str], dict[str, list[str]]]:
+    """Returns (errors, defined-sites) — the tools/failpoint_check.py
+    contract. Sites defined under tidb_tpu/ are the catalog; uses
+    elsewhere must name one of them OR a site defined in the SAME file
+    (self-contained failpoint unit tests)."""
+    findings, sites = analyze()
+    return [f.message for f in findings], sites
+
+
+def _loc(where: str) -> tuple[str, int]:
+    rel, _, ln = where.rpartition(":")
+    return rel, int(ln)
+
+
+def _unresolved_uses(sites: dict, uses: dict, local_sites: dict) -> list:
+    """Findings for armed names no tidb_tpu/ (or same-file) site defines."""
+    findings: list = []
+    for name, where in sorted(uses.items()):
+        if name in sites:
+            continue
+        local = {w.split(":")[0] for w in local_sites.get(name, ())}
+        missing = [w for w in where if w.split(":")[0] not in local]
+        if missing:
+            rel, ln = _loc(missing[0])
+            findings.append(Finding(
+                rel, ln, PASS,
+                f"failpoint {name!r} armed at {', '.join(missing)} but no "
+                f"eval/is_armed/peek site defines it under tidb_tpu/ — it can never fire"))
+    return findings
+
+
+def analyze() -> tuple[list, dict[str, list[str]]]:
+    """Finding-shaped variant of check() for the vet driver."""
+    sites = _scan(_SITE, _py_files("tidb_tpu"))
+    uses = _scan(_USE, _py_files("tests", "tools", "bench.py"))
+    local_sites = _scan(_SITE, _py_files("tests", "tools", "bench.py"))
+    findings = _unresolved_uses(sites, uses, local_sites)
+    for name in sorted(sites):
+        if name not in DESCRIPTIONS:
+            rel, ln = _loc(sites[name][0])
+            findings.append(Finding(
+                rel, ln, PASS,
+                f"failpoint {name!r} (defined at {sites[name][0]}) has no entry in "
+                f"tidb_tpu/analysis/failpoints.py DESCRIPTIONS — add one line so the "
+                f"catalog stays complete"))
+    return findings, sites
+
+
+def run(files=None) -> list:
+    """Vet-pass entry point. With no `files` the pass owns its scoping
+    (sites in tidb_tpu/, uses in tests//tools//bench.py); with an explicit
+    list (the driver's --files mode) the GIVEN files' arms are checked
+    against the live tree's sites — a fixture corpus must report, not
+    silently fall back to a clean full-tree scan."""
+    if not files:
+        return analyze()[0]
+    sites = _scan(_SITE, _py_files("tidb_tpu"))
+    paths = [sf.path for sf in files]
+    return _unresolved_uses(sites, _scan(_USE, paths), _scan(_SITE, paths))
+
+
+def write_catalog(sites: dict[str, list[str]], path: str) -> None:
+    lines = [
+        "# Failpoint catalog",
+        "",
+        "Generated by `python tools/failpoint_check.py --catalog` — every",
+        "`failpoint.eval/is_armed/peek` site in `tidb_tpu/` and what arming it",
+        "injects. Arm with `failpoint.enable(name, value)` (bool = always, int =",
+        "fire-N-times, set/dict = per-store arming for `store/*` points, a",
+        "ZERO-arg callable returning any of those shapes = custom per-hit",
+        "logic); disarm with `failpoint.disable(name)`.",
+        "",
+        "| failpoint | injection sites | injects |",
+        "|---|---|---|",
+    ]
+    for name in sorted(sites):
+        where = ", ".join(f"`{w}`" for w in sites[name])
+        lines.append(f"| `{name}` | {where} | {DESCRIPTIONS.get(name, '')} |")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")

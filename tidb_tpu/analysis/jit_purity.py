@@ -1,0 +1,99 @@
+"""`jit-purity` — no module-level JAX array constants or global-config
+mutation in the jit-sensitive packages (ops/, exec/, expr/, parallel/).
+
+The PR-2 fixup chased exactly this class: a module whose top level runs
+`X = jnp.int64(...)` gets its constant created whenever the module is
+FIRST imported — and if that first import happens inside a jit trace, the
+"constant" captures the trace (a leaked tracer) or the ambient x64 mode,
+poisoning every later program built from it. Likewise a module-level
+`enable_x64(...)` / `jax.config.update(...)` call flips global state for
+whoever happens to import second.
+
+Flagged at module level only — inside a function, jnp expressions trace
+fresh per program, which is the correct place for them.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import Finding
+
+PASS = "jit-purity"
+
+# attribute roots whose module-level use constructs device values
+_JAX_ROOTS = {"jnp", "jax"}
+_IMPURE_CALLS = {"enable_x64", "update", "disable_x64"}
+
+
+def _jax_aliases(tree: ast.AST) -> set:
+    """Local names bound to jax / jax.numpy by imports."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("jax", "jax.numpy"):
+                    names.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax" and any(a.name == "numpy" for a in node.names):
+                for a in node.names:
+                    if a.name == "numpy":
+                        names.add(a.asname or "numpy")
+    return names or set(_JAX_ROOTS)
+
+
+def _rooted_in_jax(node: ast.AST, aliases: set) -> ast.AST | None:
+    """First CALL rooted at a jax alias (jnp.int64(...), jax.numpy.array(...),
+    jnp.zeros(...).reshape(...)…), else None. Bare attribute references
+    (`jnp.bitwise_and` in a dispatch table) construct no device value and
+    are fine at module level."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        cur = sub
+        while isinstance(cur, (ast.Attribute, ast.Call, ast.Subscript)):
+            cur = cur.func if isinstance(cur, ast.Call) else cur.value
+        if isinstance(cur, ast.Name) and cur.id in aliases:
+            return sub
+    return None
+
+
+def run(files) -> list:
+    findings: list = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        aliases = _jax_aliases(sf.tree)
+        for node in sf.tree.body:  # MODULE level only
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = node.value
+                if value is None:
+                    continue
+                hit = _rooted_in_jax(value, aliases)
+                if hit is not None:
+                    tgt = _target_name(node)
+                    findings.append(Finding(
+                        sf.rel, node.lineno, PASS,
+                        f"module-level jax value bound to {tgt}: created at import "
+                        f"time, it captures whatever trace/x64 mode is ambient when "
+                        f"this module first loads (the PR-2 tracer-leak class) — "
+                        f"build it inside the function, or use a numpy/python constant"))
+            elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                fn = node.value.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else "")
+                if name in _IMPURE_CALLS:
+                    findings.append(Finding(
+                        sf.rel, node.lineno, PASS,
+                        f"module-level call to {name}() mutates global jax config at "
+                        f"import time — import order becomes semantics; gate it in a "
+                        f"function or context manager"))
+    return findings
+
+
+def _target_name(node) -> str:
+    t = node.targets[0] if isinstance(node, ast.Assign) else node.target
+    try:
+        return ast.unparse(t)
+    except Exception:  # noqa: BLE001
+        return "<target>"

@@ -54,16 +54,16 @@ class CircuitBreaker:
     def __init__(self, store_id: int, threshold: int = 3,
                  probe_after: float = 0.05, now_fn=time.monotonic):
         self.store_id = store_id
-        self.state = "closed"
-        self.fails = 0
-        self.opened_at = 0.0
-        self.last_probe = 0.0
+        self.state = "closed"  # guarded_by: _lock
+        self.fails = 0  # guarded_by: _lock
+        self.opened_at = 0.0  # guarded_by: _lock
+        self.last_probe = 0.0  # guarded_by: _lock
         self.threshold = threshold
         self.probe_after = probe_after
         self._now = now_fn
         self._lock = threading.Lock()
 
-    def _gauge(self):
+    def _gauge(self):  # requires: _lock
         from ..util import metrics
 
         metrics.BREAKER_STATE.labels(str(self.store_id)).set(
@@ -96,6 +96,13 @@ class CircuitBreaker:
             if changed:
                 self._gauge()
 
+    def state_view(self) -> str:
+        """Locked state snapshot — the board's views read THROUGH this
+        (vet finding: they used to read `b.state` under the board lock
+        only, racing every transition made under the breaker's own)."""
+        with self._lock:
+            return self.state
+
     def record_failure(self) -> bool:
         """Returns True when THIS failure opened (or re-opened) the
         breaker — the caller's cue to fail the task over."""
@@ -122,7 +129,7 @@ class BreakerBoard:
         self.threshold = threshold
         self.probe_after = probe_after
         self._now = now_fn
-        self._breakers: dict[int, CircuitBreaker] = {}
+        self._breakers: dict[int, CircuitBreaker] = {}  # guarded_by: _lock
         self._lock = threading.Lock()
 
     def get(self, store_id: int) -> CircuitBreaker:
@@ -142,17 +149,20 @@ class BreakerBoard:
     def record_failure(self, store_id: int) -> bool:
         return self.get(store_id).record_failure()
 
-    def open_stores(self) -> set:
+    def _snapshot(self) -> list:
         with self._lock:
-            return {sid for sid, b in self._breakers.items() if b.state == "open"}
+            return list(self._breakers.items())
+
+    def open_stores(self) -> set:
+        # per-breaker states are read under each breaker's own lock, with
+        # the board lock already released (board -> breaker never nests)
+        return {sid for sid, b in self._snapshot() if b.state_view() == "open"}
 
     def states(self) -> dict:
-        with self._lock:
-            return {sid: b.state for sid, b in self._breakers.items()}
+        return {sid: b.state_view() for sid, b in self._snapshot()}
 
     def all_closed(self) -> bool:
-        with self._lock:
-            return all(b.state == "closed" for b in self._breakers.values())
+        return all(b.state_view() == "closed" for sid, b in self._snapshot())
 
 
 def full_table_ranges(table_id: int) -> list[KeyRange]:

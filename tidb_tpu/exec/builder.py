@@ -606,10 +606,12 @@ class ProgramCache:
     def __init__(self):
         import threading
 
+        # _cache is deliberately unguarded: dict get/set are GIL-atomic
+        # and a racing double-compile is benign (last insert wins)
         self._cache: dict = {}
         self._stats_mu = threading.Lock()  # pool threads share one cache
-        self.compiles = 0
-        self.hits = 0
+        self.compiles = 0  # guarded_by: _stats_mu
+        self.hits = 0  # guarded_by: _stats_mu
 
     def get(
         self,
@@ -676,4 +678,5 @@ class ProgramCache:
         return prog, False, compile_ns
 
     def stats(self):
-        return {"entries": len(self._cache), "compiles": self.compiles, "hits": self.hits}
+        with self._stats_mu:
+            return {"entries": len(self._cache), "compiles": self.compiles, "hits": self.hits}

@@ -29,12 +29,15 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..chunk.device import DeviceColumn, pack_string_words
 from ..types import Datum, DatumKind, FieldType, MyDecimal, MyTime, TypeCode
 from .ir import ColumnRef, Const, Expr, ScalarFunc
 
-I64_MIN = jnp.int64(-0x8000000000000000)
+# numpy (not jnp) scalar: created at import with no trace/x64-mode
+# capture — the jit-purity vet pass enforces this for module constants
+I64_MIN = np.int64(-0x8000000000000000)
 
 
 @dataclass

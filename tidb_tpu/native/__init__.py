@@ -28,8 +28,8 @@ _BUILD = os.path.join(_DIR, "_build")
 _SO = os.path.join(_BUILD, "librowcodec.so")
 
 _lock = threading.Lock()
-_lib = None
-_lib_failed = False
+_lib = None  # guarded_by: _lock
+_lib_failed = False  # guarded_by: _lock
 
 # column classes — must match rowcodec.cpp
 CLS_INT, CLS_UINT, CLS_FLOAT, CLS_DECIMAL, CLS_STRING, CLS_HANDLE = 0, 1, 2, 3, 5, 7
@@ -50,8 +50,9 @@ def _build() -> bool:
 def get_lib():
     """The loaded shared library, building it if needed; None = unavailable."""
     global _lib, _lib_failed
-    if _lib is not None or _lib_failed:
-        return _lib
+    # double-checked fast path: once built, the unlocked read is stable
+    if _lib is not None or _lib_failed:  # vet: ignore[lock-discipline]
+        return _lib  # vet: ignore[lock-discipline]
     with _lock:
         if _lib is not None or _lib_failed:
             return _lib
@@ -76,7 +77,7 @@ def get_lib():
             _lib = lib
         except Exception:  # noqa: BLE001
             _lib_failed = True
-    return _lib
+    return _lib  # vet: ignore[lock-discipline] — set under the lock above
 
 
 def available() -> bool:

@@ -38,6 +38,7 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..expr.agg import AggDesc
 from ..expr.compile import CompVal, _round_div, _scale
@@ -58,7 +59,7 @@ from .seg import (
     seg_sum,
 )
 
-I64_MIN_ = jnp.int64(-0x8000000000000000)
+I64_MIN_ = np.int64(-0x8000000000000000)  # numpy: import-time pure (vet: jit-purity)
 
 
 @dataclass

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..expr.compile import CompVal
 from .aggregate import GatherState, _group_aggregate_stream
@@ -217,8 +218,8 @@ _PACKED_AGGS = frozenset({"sum", "count", "avg"})
 _PK_RANGE = 1 << 30  # packed (key - kmin) must fit 30 bits (plus side bit)
 # unusable-row sentinels: above every packed key; hay (even) and probe
 # (odd, = _PIN_HAY|1) pins keep is_hay = ~(pk&1) true even for pins
-_PIN_HAY = jnp.int32((1 << 31) - 4)
-_PIN_PROBE = jnp.int32((1 << 31) - 3)
+_PIN_HAY = np.int32((1 << 31) - 4)  # numpy: import-time pure (vet: jit-purity)
+_PIN_PROBE = np.int32((1 << 31) - 3)
 I32_SHIFT = 1 << 31  # static non-negativity bias per addend (plain int:
 # a module-level jnp expression would leak a tracer when this module is
 # first imported inside a jit trace — the builder imports it lazily)

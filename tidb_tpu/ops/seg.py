@@ -26,17 +26,21 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-I64_MAX = jnp.int64(0x7FFFFFFFFFFFFFFF)
+# numpy (not jnp) scalars: created at import with no trace/x64-mode
+# capture (these feed the x64-world lane construction, never the
+# x64-off pallas kernels) — the jit-purity vet pass enforces this
+I64_MAX = np.int64(0x7FFFFFFFFFFFFFFF)
 # valid-hash space: top bit clear AND low bit clear — a masked hash is even,
 # so it can never equal the (odd) I64_MAX invalid sentinel, keeping the
 # sorted seg ids monotone even in the astronomically-unlikely near-miss
-MAX63 = jnp.int64(0x7FFFFFFFFFFFFFFE)
+MAX63 = np.int64(0x7FFFFFFFFFFFFFFE)
 
 # splitmix64 finalizer constants (public domain; two's-complement int64)
-_C1 = jnp.int64(0xBF58476D1CE4E5B9 - (1 << 64))
-_C2 = jnp.int64(0x94D049BB133111EB - (1 << 64))
-_GOLDEN = jnp.int64(0x9E3779B97F4A7C15 - (1 << 64))
+_C1 = np.int64(0xBF58476D1CE4E5B9 - (1 << 64))
+_C2 = np.int64(0x94D049BB133111EB - (1 << 64))
+_GOLDEN = np.int64(0x9E3779B97F4A7C15 - (1 << 64))
 
 
 def _lsr(x, k: int):

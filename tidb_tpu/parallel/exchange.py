@@ -18,12 +18,13 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..expr.compile import CompVal
 from ..ops.keys import sort_key_arrays
 
-FNV_OFFSET = jnp.int64(-3750763034362895579)  # 0xcbf29ce484222325 as i64
-FNV_PRIME = jnp.int64(1099511628211)
+FNV_OFFSET = np.int64(-3750763034362895579)  # 0xcbf29ce484222325 as i64; numpy: import-time pure
+FNV_PRIME = np.int64(1099511628211)
 
 
 def hash_partition_ids(key_vals: list[CompVal], n_parts: int) -> jax.Array:

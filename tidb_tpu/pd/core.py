@@ -76,7 +76,7 @@ class HotPeerCache:
     def __init__(self, kind: str, conf: PDConfig):
         self.kind = kind
         self.conf = conf
-        self.peers: dict[int, HotPeer] = {}
+        self.peers: dict[int, HotPeer] = {}  # guarded_by: _mu
         # the PD timer thread updates while session/HTTP threads read
         # (SHOW PLACEMENT, /pd/api/v1/hotspot) — snapshot under the lock
         self._mu = threading.Lock()
@@ -149,8 +149,8 @@ class OperatorQueue:
     def __init__(self, limit: int):
         self.limit = limit
         self._mu = threading.Lock()
-        self._pending: list[Operator] = []
-        self.history: list[Operator] = []  # finished/cancelled/timeout ring
+        self._pending: list[Operator] = []  # guarded_by: _mu
+        self.history: list[Operator] = []  # finished/cancelled/timeout ring; guarded_by: _mu
         self._history_max = 128
 
     def add(self, op: Operator) -> bool:
@@ -173,6 +173,13 @@ class OperatorQueue:
     def pending(self) -> list[Operator]:
         with self._mu:
             return list(self._pending)
+
+    def history_view(self) -> list[Operator]:
+        """Locked snapshot of the retired-operator ring (vet finding:
+        /pd/api/v1/operators used to iterate `history` raw while retire()
+        appends from the tick thread)."""
+        with self._mu:
+            return list(self.history)
 
     def retire(self, op: Operator, state: str, note: str = "") -> None:
         op.state = state
@@ -221,19 +228,19 @@ class PlacementDriver:
         self.queue = OperatorQueue(self.conf.operator_limit)
         self.checkers = [SplitChecker(), MergeChecker()]
         self.schedulers = [BalanceRegionScheduler(), HotRegionScheduler()]
-        self.ticks = 0
-        self.heartbeats_seen = 0
-        self._next_op_id = 1
+        self.ticks = 0  # guarded_by: _mu
+        self.heartbeats_seen = 0  # guarded_by: _mu
+        self._next_op_id = 1  # guarded_by: _mu
         self._mu = threading.Lock()  # id/counter bumps
         self._tick_mu = threading.RLock()  # serializes whole ticks
         # (timer-driven + manual tick() must not interleave: each tick
         # drains ONE heartbeat interval and owns the scheduling round)
         self._timer = None
-        self.last_tick_root = None  # last pd.tick trace (TRACE/debug view)
+        self.last_tick_root = None  # last pd.tick trace (TRACE/debug view); guarded_by: _mu
         # store health as dispatch reported it + the tick's own probes
         # (ref: PD's store state machine Up/Disconnected/Down driven by
         # store heartbeats); surfaced in /pd/api/v1/stores
-        self.store_health: dict[int, str] = {}
+        self.store_health: dict[int, str] = {}  # guarded_by: _mu
         self.cluster.pd = self  # placement authority hookup
 
     # -- placement authority ------------------------------------------------
@@ -256,10 +263,9 @@ class PlacementDriver:
             self.store_health[store_id] = "down"
 
     def note_store_up(self, store_id: int) -> None:
-        # lock-free fast path: dispatch calls this after EVERY successful
-        # cop response — only a store actually marked down pays the lock
-        if self.store_health.get(store_id) != "down":
-            return
+        # dispatch calls this after every successful cop response; the
+        # old unlocked fast-path read raced the tick thread's probe
+        # writes (vet: lock-discipline) — one uncontended lock is cheap
         with self._mu:
             if self.store_health.get(store_id) == "down":
                 self.store_health[store_id] = "up"
@@ -300,7 +306,8 @@ class PlacementDriver:
         with self._mu:
             op_id = self._next_op_id
             self._next_op_id += 1
-        return Operator(op_id, kind, region_id, created_tick=self.ticks, **kw)
+            tick = self.ticks
+        return Operator(op_id, kind, region_id, created_tick=tick, **kw)
 
     # -- the tick loop ------------------------------------------------------
     def timer(self, interval: float | None = None):
@@ -332,7 +339,8 @@ class PlacementDriver:
         t0 = time.monotonic()
         dispatched: list[Operator] = []
         with tracing.trace("pd.tick", tick=tick_no) as root:
-            self.last_tick_root = root
+            with self._mu:
+                self.last_tick_root = root
             with tracing.span("pd.heartbeat") as hsp:
                 beats = self.flow.heartbeat()
                 if failpoint.eval("pd/heartbeat-lost"):
@@ -397,9 +405,10 @@ class PlacementDriver:
         from ..util import metrics
 
         live = {r.region_id for r in self.cluster.regions()}
+        with self._mu:
+            self.heartbeats_seen += len(beats)
         for b in beats:
             metrics.PD_REGION_HEARTBEATS.inc()
-            self.heartbeats_seen += 1
             self.hot_read.update(b.region_id, b.read_bytes, b.read_keys)
             self.hot_write.update(b.region_id, b.write_bytes, b.write_keys)
         self.hot_read.prune(live)
@@ -534,7 +543,9 @@ class PlacementDriver:
                 for p in cache.hot_peers()
             ]
 
-        return {"as_of_tick": self.ticks, "read": peers(self.hot_read), "write": peers(self.hot_write)}
+        with self._mu:
+            tick = self.ticks
+        return {"as_of_tick": tick, "read": peers(self.hot_read), "write": peers(self.hot_write)}
 
     def operators_view(self) -> dict:
         def row(o: Operator) -> dict:
@@ -543,7 +554,7 @@ class PlacementDriver:
                     "created_tick": o.created_tick, "note": o.note}
 
         return {"pending": [row(o) for o in self.queue.pending()],
-                "history": [row(o) for o in self.queue.history]}
+                "history": [row(o) for o in self.queue.history_view()]}
 
     def scheduling_state(self, region_id: int) -> str:
         """SHOW PLACEMENT's Scheduling_State column for one region."""

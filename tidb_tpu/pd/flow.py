@@ -56,9 +56,9 @@ class FlowRecorder:
     def __init__(self, cluster):
         self.cluster = cluster
         self._mu = threading.Lock()
-        self._flows: dict[int, RegionFlow] = {}
+        self._flows: dict[int, RegionFlow] = {}  # guarded_by: _mu
 
-    def _flow(self, region_id: int) -> RegionFlow:
+    def _flow(self, region_id: int) -> RegionFlow:  # requires: _mu
         f = self._flows.get(region_id)
         if f is None:
             f = self._flows[region_id] = RegionFlow(region_id)
@@ -97,7 +97,7 @@ class FlowRecorder:
             for rid, k, n, p, d in located:
                 self._apply_write(rid, k, n, p, d)
 
-    def _apply_write(self, region_id: int, key: bytes, nbytes: int,
+    def _apply_write(self, region_id: int, key: bytes, nbytes: int,  # requires: _mu
                      prev_live: bool, delete: bool) -> None:
         f = self._flow(region_id)
         f.write_bytes += nbytes + len(key)

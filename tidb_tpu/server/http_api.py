@@ -141,7 +141,7 @@ class StatusServer:
                 {"id": j.job_id, "type": j.job_type, "state": j.state,
                  "schema_state": j.schema_state, "table": j.table,
                  "query": j.query}
-                for j in reversed(list(s.catalog.ddl_jobs.jobs))
+                for j in reversed(s.catalog.ddl_jobs.view())
             ]
         if parts == ["settings"]:
             return 200, dict(s.sysvars.items())

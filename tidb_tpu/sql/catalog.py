@@ -165,7 +165,7 @@ class TableMeta:
     columns: list  # [ColumnMeta]
     indices: list = field(default_factory=list)  # [IndexMeta]
     handle_col: str | None = None  # integer PRIMARY KEY column used as row handle
-    _next_handle: int = 1  # autoid allocator cursor (ref: meta/autoid)
+    _next_handle: int = 1  # autoid cursor (ref: meta/autoid); guarded_by: _ALLOC_LOCK
     row_count: int = 0  # maintained by DML; the planner's only "statistic"
     next_col_id: int = 0  # max-ever col id + 1: DROP COLUMN must never free
     # its id for reuse (old rows still hold bytes under it)
@@ -218,7 +218,8 @@ class TableMeta:
             return h
 
     def peek_handle(self) -> int:
-        return self._next_handle
+        with _ALLOC_LOCK:
+            return self._next_handle
 
     def observe_handle(self, h: int):
         """Explicit-PK inserts advance the allocator past the used value
@@ -323,14 +324,17 @@ class Catalog:
     (ref: infoschema; ids from meta's global id allocator)."""
 
     def __init__(self):
-        self._tables: dict[str, TableMeta] = {}
-        self._next_id = 1001
-        self._lock = threading.Lock()
+        self._tables: dict[str, TableMeta] = {}  # guarded_by: _lock
+        self._next_id = 1001  # guarded_by: _lock
+        # RLock: DDL entry points hold it across whole schema changes and
+        # re-enter through table() lookups (background TTL/auto-analyze
+        # sessions read the same maps from timer threads)
+        self._lock = threading.RLock()
         self.version = 0  # schema version (ref: domain schema lease)
         self.databases: set[str] = {"test", "mysql"}  # CREATE/DROP DATABASE
         self.bindings: dict = {}  # GLOBAL plan bindings: digest -> record
         self.stats: dict[int, object] = {}  # table_id -> TableStats (ANALYZE)
-        self.views: dict[str, ViewMeta] = {}  # name -> view definition
+        self.views: dict[str, ViewMeta] = {}  # name -> views; guarded_by: _lock
         from .privilege import PrivilegeStore
 
         self.privileges = PrivilegeStore()  # domain-level user/priv cache
@@ -342,7 +346,7 @@ class Catalog:
         self.stmtlog = StmtLog()  # slow-query log + statement summary
         # (domain-level: shared by every session of this catalog)
 
-    def _alloc_id(self) -> int:
+    def _alloc_id(self) -> int:  # requires: _lock
         v = self._next_id
         self._next_id += 1
         return v
@@ -559,16 +563,37 @@ class Catalog:
             self.version += 1
 
     def table_by_id(self, table_id: int) -> TableMeta | None:
+        with self._lock:
+            return self._table_by_id_locked(table_id)
+
+    def _table_by_id_locked(self, table_id: int):  # requires: _lock
         for t in self._tables.values():
             if t.table_id == table_id:
                 return t
         return None
 
     def table(self, name: str) -> TableMeta:
-        t = self._tables.get(name.lower())
+        with self._lock:
+            t = self._tables.get(name.lower())
         if t is None:
             raise CatalogError(f"unknown table {name!r}")
         return t
 
     def tables(self) -> list:
-        return sorted(self._tables)
+        with self._lock:
+            return sorted(self._tables)
+
+    def view_of(self, name: str):
+        """ViewMeta for `name` (None if absent) — the locked lookup every
+        cross-thread reader goes through (planner threads vs CREATE/DROP
+        VIEW; surfaced by lockwatch on `views`)."""
+        with self._lock:
+            return self.views.get(name.lower())
+
+    def view_names(self) -> list:
+        with self._lock:
+            return sorted(self.views)
+
+    def view_snapshot(self) -> list:
+        with self._lock:
+            return list(self.views.values())

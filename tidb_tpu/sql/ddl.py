@@ -51,8 +51,8 @@ class DDLJobLog:
     """Job history (ref: the ddl job + history system tables)."""
 
     def __init__(self):
-        self.jobs: list[DDLJob] = []
-        self._next = 1
+        self.jobs: list[DDLJob] = []  # guarded_by: _lock
+        self._next = 1  # guarded_by: _lock
         self._lock = threading.Lock()
 
     def begin(self, job_type: str, table: str, query: str) -> DDLJob:
@@ -66,6 +66,12 @@ class DDLJobLog:
     def step(self, job: DDLJob, schema_state: str):
         job.schema_state = schema_state
         job.states_seen.append(schema_state)
+
+    def view(self) -> list:
+        """Locked snapshot for readers on other threads (HTTP /ddl/history,
+        ADMIN SHOW DDL JOBS) — `jobs` itself is guarded."""
+        with self._lock:
+            return list(self.jobs)
 
     def finish(self, job: DDLJob, error: str = ""):
         job.state = "cancelled" if error else "synced"

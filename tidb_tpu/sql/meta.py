@@ -190,13 +190,16 @@ def persist_catalog(store, catalog: Catalog) -> None:
         tid = struct.unpack(">q", k[len(M_TABLE_PREFIX):])[0]
         if tid not in live:
             store.kv.put(k, None, ts)
+    with catalog._lock:
+        next_id = catalog._next_id
+        views_snapshot = list(catalog.views.values())
     state = {
         "version": catalog.version,
-        "next_id": catalog._next_id,
+        "next_id": next_id,
         "databases": sorted(catalog.databases),
         "views": {
             v.name: {"columns": v.columns, "select": v.select_sql}
-            for v in catalog.views.values()
+            for v in views_snapshot
         },
     }
     store.kv.put(M_STATE_KEY, json.dumps(state).encode(), ts)
@@ -241,12 +244,15 @@ def load_catalog(store) -> Catalog | None:
             mh = _max_row_handle(store, pid)
             if mh is not None:
                 meta.observe_handle(mh)
-        cat._tables[meta.name] = meta
-    cat._next_id = max(state["next_id"], cat._next_id)
+        with cat._lock:
+            cat._tables[meta.name] = meta
+    with cat._lock:
+        cat._next_id = max(state["next_id"], cat._next_id)
     cat.version = state["version"]
     from .catalog import ViewMeta
 
     for vn, vd in state.get("views", {}).items():
-        cat.views[vn] = ViewMeta(vn, vd["columns"], vd["select"])
+        with cat._lock:
+            cat.views[vn] = ViewMeta(vn, vd["columns"], vd["select"])
     cat.databases |= set(state.get("databases", []))
     return cat

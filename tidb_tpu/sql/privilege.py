@@ -35,7 +35,7 @@ class UserRecord:
 
 class PrivilegeStore:
     def __init__(self):
-        self._users: dict[tuple, UserRecord] = {}
+        self._users: dict[tuple, UserRecord] = {}  # guarded_by: _lock
         self._lock = threading.Lock()
         # bootstrap superuser (ref: session/bootstrap.go root creation)
         self._users[("root", "%")] = UserRecord("root", "%", "", {"all"})
@@ -61,7 +61,7 @@ class PrivilegeStore:
                 raise PrivilegeError("cannot drop the bootstrap superuser")
             del self._users[key]
 
-    def _record(self, name: str, host: str = "%") -> UserRecord:
+    def _record(self, name: str, host: str = "%") -> UserRecord:  # requires: _lock
         u = self._users.get((name.lower(), host)) or self._users.get((name.lower(), "%"))
         if u is None:
             raise PrivilegeError(f"user {name!r} does not exist")
@@ -136,4 +136,5 @@ class PrivilegeStore:
                 return None
 
     def users(self) -> list:
-        return sorted(self._users)
+        with self._lock:
+            return sorted(self._users)

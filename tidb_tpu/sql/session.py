@@ -888,8 +888,9 @@ class Session:
             self._implicit_commit()
             for t in [n for n in self.catalog.tables() if n.startswith(db + ".")]:
                 self.catalog.drop_table(t)
-            for v in [n for n in list(self.catalog.views) if n.startswith(db + ".")]:
-                del self.catalog.views[v]
+            with self.catalog._lock:
+                for v in [n for n in list(self.catalog.views) if n.startswith(db + ".")]:
+                    del self.catalog.views[v]
             self.catalog.databases.discard(db)
             if self.db == db:
                 self.db = "test"
@@ -2536,7 +2537,7 @@ class Session:
         """ADMIN SHOW DDL JOBS / CHECK TABLE (ref: pkg/executor/admin.go)."""
         if stmt.kind == "show_ddl_jobs":
             rows = []
-            for j in reversed(self.catalog.ddl_jobs.jobs):
+            for j in reversed(self.catalog.ddl_jobs.view()):
                 rows.append([
                     Datum.i64(j.job_id), Datum.string(j.job_type), Datum.string(j.table),
                     Datum.string(j.schema_state), Datum.string(j.state),
@@ -2577,7 +2578,7 @@ class Session:
     def _show(self, stmt) -> Result:
         kind = getattr(stmt, "kind", "")
         if kind in ("create_table", "create_view"):
-            vm = self.catalog.views.get(stmt.table.name.lower())
+            vm = self.catalog.view_of(stmt.table.name)
             if kind == "create_view" and vm is None:
                 raise SQLError(f"unknown view {stmt.table.name!r}")
             if vm is not None:
@@ -2662,7 +2663,7 @@ class Session:
             ]
             return Result(columns=["Variable_name", "Value"], rows=rows)
         if kind == "tables":
-            names = sorted(set(self.catalog.tables()) | set(self.catalog.views))
+            names = sorted(set(self.catalog.tables()) | set(self.catalog.view_names()))
             # current database only, short names (multi-db catalog keys
             # are "db.table"; the default db owns the unqualified keys)
             if self.db == "test":

@@ -365,7 +365,8 @@ class SubqueryRewriter:
             if n in p.bindings:
                 return None
             p = p.parent
-        return getattr(self.catalog, "views", {}).get(n)
+        view_of = getattr(self.catalog, "view_of", None)
+        return view_of(n) if view_of is not None else None
 
     def _expand_view(self, node: A.TableName):
         """TableName over a view -> SubqueryTable over its stored SELECT

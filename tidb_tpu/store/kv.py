@@ -18,13 +18,13 @@ class MemKV:
     __slots__ = ("_data", "_keys", "_dirty", "lock", "max_version")
 
     def __init__(self):
-        self._data: dict[bytes, list[tuple[int, bytes | None]]] = {}
-        self._keys: list[bytes] = []
-        self._dirty = False
+        self._data: dict[bytes, list[tuple[int, bytes | None]]] = {}  # guarded_by: lock
+        self._keys: list[bytes] = []  # guarded_by: lock
+        self._dirty = False  # guarded_by: lock
         # largest commit_ts ever written: a snapshot at start_ts >=
         # max_version sees EVERY committed version, which is what makes a
         # coprocessor response reusable across snapshots (store cop cache)
-        self.max_version = 0
+        self.max_version = 0  # guarded_by: lock
         # structural lock: every read/write takes it, and TxnEngine.commit
         # holds it across the WHOLE apply loop, so a concurrent snapshot
         # read can never observe half a commit (the docstring invariant of
@@ -49,7 +49,7 @@ class MemKV:
                 self.max_version = ts
             return prev_live
 
-    def _ensure_sorted(self):
+    def _ensure_sorted(self):  # requires: lock
         if self._dirty:
             self._keys = sorted(self._data.keys())
             self._dirty = False
@@ -120,11 +120,21 @@ class MemKV:
             return versions[-1][0] if versions else 0
 
     def max_ts(self) -> int:
+        # vet(lock-discipline) finding: this walked _data with no lock —
+        # a concurrent put resizing the dict mid-iteration raises
         ts = 0
-        for versions in self._data.values():
-            if versions:
-                ts = max(ts, versions[-1][0])
+        with self.lock:
+            for versions in self._data.values():
+                if versions:
+                    ts = max(ts, versions[-1][0])
         return ts
 
+    def max_committed(self) -> int:
+        """Locked snapshot of max_version (for callers that must not
+        take `lock` around their own critical sections)."""
+        with self.lock:
+            return self.max_version
+
     def __len__(self):
-        return len(self._data)
+        with self.lock:
+            return len(self._data)

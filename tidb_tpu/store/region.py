@@ -48,10 +48,10 @@ class Cluster:
     schedulers own every change via `set_store`/`split`/`merge`."""
 
     def __init__(self, n_stores: int = 1):
-        self._regions: list[Region] = [Region(1, b"", KEY_MAX)]
-        self._next_id = 2
+        self._regions: list[Region] = [Region(1, b"", KEY_MAX)]  # guarded_by: _mu
+        self._next_id = 2  # guarded_by: _mu
         self.n_stores = max(n_stores, 1)
-        self._store_of: dict[int, int] = {1: 0}
+        self._store_of: dict[int, int] = {1: 0}  # guarded_by: _mu
         self._mu = threading.RLock()
         self.pd = None  # PlacementDriver; owns placement misses when attached
 
@@ -173,7 +173,7 @@ class Cluster:
         for i in range(1, n):
             self.split(keyfn(i))
 
-    def _locate(self, key: bytes) -> int:
+    def _locate(self, key: bytes) -> int:  # requires: _mu
         starts = [r.start_key for r in self._regions]
         i = bisect.bisect_right(starts, key) - 1
         return max(i, 0)

@@ -134,24 +134,24 @@ class TPUStore:
         self.pd = PlacementDriver(self)
         self.txn = TxnEngine(self.kv, on_commit=self._bump_write_ver,
                              on_apply=self.record_applied_writes)
-        self._tso = itertools.count(100)
+        self._tso = itertools.count(100)  # guarded_by: _tso_lock
         self._tso_lock = threading.Lock()
-        self._active_snapshots: dict[int, int] = {}
-        self._write_ver = 0
+        self._active_snapshots: dict[int, int] = {}  # guarded_by: _tso_lock
+        self._write_ver = 0  # guarded_by: _cop_lock
         self._chunk_cache: dict = {}
         self._batch_cache: dict = {}
-        self._aux_batch_cache: dict = {}  # chunk token -> (chunk, DeviceBatch)
+        self._aux_batch_cache: dict = {}  # token -> (chunk, DeviceBatch); guarded_by: _aux_lock
         self._aux_lock = threading.Lock()  # select() fans tasks over threads
-        self._chunk_tokens = itertools.count(1)  # monotonic chunk identity
+        self._chunk_tokens = itertools.count(1)  # monotonic chunk identity; guarded_by: _aux_lock
         # coprocessor RESULT cache (ref: pkg/store/copr/coprocessor_cache.go):
         # a whole region response keyed by the region's data version
-        self._cop_cache: dict = {}
+        self._cop_cache: dict = {}  # guarded_by: _cop_lock
         self._cop_lock = threading.Lock()
         self._row_encoder = RowEncoder()
         # fault switches: logical placement stores marked down answer every
         # cop request with a typed StoreUnavailable region error (the
         # in-process analog of a TiKV store dropping off the network)
-        self._down_stores: set[int] = set()
+        self._down_stores: set[int] = set()  # guarded_by: _down_lock
         self._down_lock = threading.Lock()
         # per-store circuit breakers — client-side state, but shared by
         # every session/dispatch thread on this store (runtime import:
@@ -204,8 +204,10 @@ class TPUStore:
                 if resp.chunk is not None:
                     freed += resp.chunk.nbytes()
             self._cop_cache.clear()
-        for cache in (self._chunk_cache, self._batch_cache, self._aux_batch_cache):
-            cache.clear()
+        self._chunk_cache.clear()
+        self._batch_cache.clear()
+        with self._aux_lock:  # select() uploads aux batches from pool threads
+            self._aux_batch_cache.clear()
         return freed
 
     def next_ts(self) -> int:
@@ -250,12 +252,21 @@ class TPUStore:
         return self.kv.gc(sp)
 
     def _bump_write_ver(self):
-        self._write_ver += 1
+        # the bump rides the cache's own lock (vet finding: the unlocked
+        # `+= 1` could lose an increment between two racing writers, and
+        # the TOCTOU guard in _cop_cache_put compares EXACT versions).
         # every cop-cache key embeds the old write version, so entries can
         # never serve stale data — the clear just stops dead weight from
         # crowding live entries out of the LRU window
         with self._cop_lock:
+            self._write_ver += 1
             self._cop_cache.clear()
+
+    def _snapshot_write_ver(self) -> int:
+        """Locked read of the store write version — the pre-read snapshot
+        every cache key embeds."""
+        with self._cop_lock:
+            return self._write_ver
 
     def _record_write_flow(self, key: bytes, value: bytes | None, prev_live: bool):
         """Per-key write flow into the PD heartbeat snapshot (ref: TiKV's
@@ -302,7 +313,7 @@ class TPUStore:
         rkey = (
             region.region_id,
             region.epoch,
-            self._write_ver,
+            self._snapshot_write_ver(),
             start_ts,
             scan.table_id,
             col_ids,
@@ -436,7 +447,7 @@ class TPUStore:
         bkey = (
             region.region_id,
             region.epoch,
-            self._write_ver,
+            self._snapshot_write_ver(),
             start_ts,
             scan.table_id,
             tuple(c.col_id for c in scan.columns),
@@ -518,8 +529,8 @@ class TPUStore:
         scheduler to exactly the hottest (most re-read) regions."""
         if not self._cop_cacheable(req):
             return None
-        key = self._cop_cache_key(req, self._write_ver)
         with self._cop_lock:
+            key = self._cop_cache_key(req, self._write_ver)
             ent = self._cop_cache.get(key)
             if ent is None:
                 return None
@@ -553,15 +564,17 @@ class TPUStore:
             or resp.last_range is not None
         ):
             return
-        ver = self._write_ver if write_ver is None else write_ver
-        key = self._cop_cache_key(req, ver)
         with self._cop_lock:
+            ver = self._write_ver if write_ver is None else write_ver
+            key = self._cop_cache_key(req, ver)
             if ver != self._write_ver:
                 return  # a write raced the read: the response may predate it
             # a snapshot that predates some committed version would cache a
             # view NEWER snapshots must not inherit (MVCC: same write_ver,
             # different visibility) — only the all-seeing snapshot caches
-            if req.start_ts < self.kv.max_version:
+            # (max_committed takes kv.lock INSIDE _cop_lock; that order is
+            # one-way — nothing holding kv.lock ever takes _cop_lock)
+            if req.start_ts < self.kv.max_committed():
                 return
             self._cop_cache[key] = (resp, req.start_ts, flow)
             while len(self._cop_cache) > self._COP_CACHE_MAX:
@@ -635,7 +648,7 @@ class TPUStore:
         cached = self._cop_cache_get(req)
         if cached is not None:
             return cached
-        ver = self._write_ver  # pre-read snapshot: gates the cache insert
+        ver = self._snapshot_write_ver()  # pre-read snapshot: gates the cache insert
         t0 = time.monotonic_ns()
         last_range = None
         page = None
@@ -806,7 +819,7 @@ class TPUStore:
 
         req0 = entries[0][1]
         dag = req0.dag
-        ver = self._write_ver  # pre-read snapshot: gates the cache inserts
+        ver = self._snapshot_write_ver()  # pre-read snapshot: gates the cache inserts
         try:
             with tracing.span("cop.batch_decode", regions=len(entries)) as dsp:
                 chunks = [

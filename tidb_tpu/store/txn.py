@@ -65,7 +65,7 @@ class Lock:
 class TxnEngine:
     def __init__(self, kv: MemKV, on_commit=None, on_apply=None):
         self.kv = kv
-        self.locks: dict[bytes, Lock] = {}
+        self.locks: dict[bytes, Lock] = {}  # guarded_by: _mu
         self._mu = threading.RLock()
         self._on_commit = on_commit  # store cache-invalidation hook
         self._on_apply = on_apply  # batch hook: [(key, value|None, prev_live)]

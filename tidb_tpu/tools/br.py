@@ -114,7 +114,7 @@ def _schema_dict(catalog) -> list:
 def _views_dict(catalog) -> dict:
     return {
         v.name: {"columns": v.columns, "select": v.select_sql}
-        for v in catalog.views.values()
+        for v in catalog.view_snapshot()
     }
 
 
@@ -216,7 +216,7 @@ def restore(store, catalog, src_dir: str) -> dict:
     from ..sql.catalog import ViewMeta
 
     for vn in manifest.get("views", {}):
-        if vn in existing or vn in catalog.views:
+        if vn in existing or catalog.view_of(vn) is not None:
             raise ValueError(f"restore: view {vn!r} already exists")
     for vn, vd in manifest.get("views", {}).items():
         with catalog._lock:

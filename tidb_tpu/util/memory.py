@@ -26,17 +26,19 @@ class MemTracker:
         self.quota = quota
         self.parent = parent
         self.action = action  # callable(tracker, requested) -> None; may free
-        self._consumed = 0
-        self._peak = 0
+        self._consumed = 0  # guarded_by: _lock
+        self._peak = 0  # guarded_by: _lock
         self._lock = threading.Lock()
 
     @property
     def consumed(self) -> int:
-        return self._consumed
+        with self._lock:
+            return self._consumed
 
     @property
     def peak(self) -> int:
-        return self._peak
+        with self._lock:
+            return self._peak
 
     def consume(self, n: int):
         """Account n bytes (negative releases). Over-quota runs the action

@@ -35,7 +35,7 @@ class Counter:
     def __init__(self, name: str, help: str = "", labels: str = ""):
         self.name = name
         self.help = help
-        self._v = 0
+        self._v = 0  # guarded_by: _lock
         self._lock = threading.Lock()
         self._labels = labels  # pre-rendered {k="v",...} or ""
 
@@ -45,10 +45,13 @@ class Counter:
 
     @property
     def value(self) -> int:
-        return self._v
+        with self._lock:
+            return self._v
 
     def _expose(self) -> list[str]:
-        return [f"{self.name}{self._labels} {self._v}"]
+        with self._lock:
+            v = self._v
+        return [f"{self.name}{self._labels} {v}"]
 
 
 class Gauge:
@@ -59,7 +62,7 @@ class Gauge:
     def __init__(self, name: str, help: str = "", labels: str = ""):
         self.name = name
         self.help = help
-        self._v = 0.0
+        self._v = 0.0  # guarded_by: _lock
         self._lock = threading.Lock()
         self._labels = labels
 
@@ -77,10 +80,12 @@ class Gauge:
 
     @property
     def value(self) -> float:
-        return self._v
+        with self._lock:
+            return self._v
 
     def _expose(self) -> list[str]:
-        v = self._v
+        with self._lock:
+            v = self._v
         return [f"{self.name}{self._labels} {_fmt_value(int(v) if float(v).is_integer() else v)}"]
 
 
@@ -91,9 +96,9 @@ class Histogram:
         self.name = name
         self.help = help
         self.buckets = tuple(buckets)
-        self._counts = [0] * (len(self.buckets) + 1)
-        self._sum = 0.0
-        self._n = 0
+        self._counts = [0] * (len(self.buckets) + 1)  # guarded_by: _lock
+        self._sum = 0.0  # guarded_by: _lock
+        self._n = 0  # guarded_by: _lock
         self._lock = threading.Lock()
         self._labels = labels
 
@@ -105,11 +110,13 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return self._n
+        with self._lock:
+            return self._n
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def _expose(self) -> list[str]:
         """Cumulative bucket lines + sum + count, the histogram exposition
@@ -141,7 +148,7 @@ class _Vec:
         self.help = help
         self.labelnames = tuple(labelnames)
         self._kw = kw
-        self._children: dict[tuple, object] = {}
+        self._children: dict[tuple, object] = {}  # guarded_by: _lock
         self._lock = threading.Lock()
 
     def labels(self, *values, **kv):
@@ -192,7 +199,7 @@ _TYPE_OF = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
 class Registry:
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: dict[str, object] = {}
+        self._metrics: dict[str, object] = {}  # guarded_by: _lock
 
     def _get_or_make(self, name: str, factory):
         with self._lock:

@@ -88,9 +88,9 @@ class StmtLog:
 
     def __init__(self, slow_capacity: int = 512, max_digests: int = 3000):
         self._lock = threading.Lock()
-        self.slow: list[SlowLogEntry] = []
+        self.slow: list[SlowLogEntry] = []  # guarded_by: _lock
         self.slow_capacity = slow_capacity
-        self.summaries: dict[str, StmtSummary] = {}
+        self.summaries: dict[str, StmtSummary] = {}  # guarded_by: _lock
         self.max_digests = max_digests
 
     def record(

@@ -42,7 +42,7 @@ class Span:
         self.attrs: dict = dict(attrs)
         self.start_ns = time.perf_counter_ns()
         self.end_ns: int | None = None
-        self.children: list[Span] = []
+        self.children: list[Span] = []  # guarded_by: _lock
         self._lock = threading.Lock()
 
     # -- building ----------------------------------------------------------

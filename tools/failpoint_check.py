@@ -1,122 +1,41 @@
-"""Failpoint cross-reference checker + catalog generator.
+"""Failpoint cross-reference checker + catalog generator — standalone
+entrypoint.
 
-A failpoint armed under a typo'd name silently never fires — the test that
-"exercises" a fault path then passes by exercising nothing (the reference
-avoids this with compile-time failpoint rewriting; a runtime registry has
-no such guard). This tool closes the gap statically:
+Since ISSUE 7 the analysis itself lives in `tidb_tpu/analysis/failpoints.py`
+as one tidb-vet pass among peers (`python tools/vet.py --only failpoints`
+runs the same check); this shim keeps the historical CLI and module API
+(`check()`, `write_catalog()`, `DESCRIPTIONS`, the `_SITE`/`_USE`
+patterns) stable for tests and FAILPOINTS.md generation. The pass module
+is loaded by FILE PATH — like tools/scrape_check.py does for promparse —
+so this tool stays runnable without the engine's jax import.
 
-  * every `failpoint.enable/enabled/disable("name")` in tests/, tools/ and
-    bench.py must reference a SITE — a `failpoint.eval/is_armed/peek("name")`
-    call — defined in `tidb_tpu/` (or in the same file, for the failpoint
-    module's own unit tests);
-  * every site defined in `tidb_tpu/` must carry a one-line description in
-    DESCRIPTIONS below — that's what makes the generated catalog
-    (`--catalog [path]`, default FAILPOINTS.md) complete by construction.
-
-Run by tier-1 (tests/test_tools.py) alongside tools/scrape_check.py.
 Usage: `python tools/failpoint_check.py [--catalog [path]]`;
 exit 0 clean, exit 1 with one error per line otherwise.
 """
 
 from __future__ import annotations
 
+import importlib.util
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# one line per failpoint: what arming it injects (the catalog body)
-DESCRIPTIONS = {
-    "cop-region-error": "injects `epoch_not_match` at the coprocessor RPC seam — exercises the re-split retry path",
-    "cop-other-error": "injects a non-retryable `other_error` cop response — surfaces as CopInternalError / MySQL 1105",
-    "cop-debug-raise": "re-raises store-side execution errors with a stack instead of folding them into `other_error`",
-    "distsql.before_task": "hook before every cop-task send — tests raise or count here to probe the dispatch loop",
-    "ddl_index_delete_only": "pauses online index DDL in the delete-only state so tests can write concurrently",
-    "ddl_index_write_only": "pauses online index DDL in the write-only state",
-    "ddl_index_write_reorg": "pauses online index DDL in the write-reorg (backfill) state",
-    "pd/heartbeat-lost": "drops one tick's region-heartbeat interval on the floor (a lost heartbeat stream)",
-    "pd/operator-timeout": "force-expires every pending PD operator at the next tick's dispatch phase",
-    "store/not-leader": "injects a typed NotLeader region error for requests to armed stores (True/set/dict arming)",
-    "store/server-busy": "injects ServerIsBusy with an optional `backoff_ms` suggestion for armed stores",
-    "store/unreachable": "injects StoreUnavailable for armed stores and fails their liveness probe (ping_store)",
-}
+_spec = importlib.util.spec_from_file_location(
+    "_ttvet_failpoints",
+    os.path.join(REPO, "tidb_tpu", "analysis", "failpoints.py"))
+_fp = importlib.util.module_from_spec(_spec)
+sys.modules["_ttvet_failpoints"] = _fp  # dataclasses resolve __module__
+_spec.loader.exec_module(_fp)
 
-_SITE = re.compile(r"""(?:failpoint|_fp|fp)\s*\.\s*(?:eval|is_armed|peek)\(\s*["']([^"']+)["']""")
-_USE = re.compile(r"""(?:failpoint|_fp|fp)\s*\.\s*(?:enable|enabled|disable)\(\s*["']([^"']+)["']""")
-
-
-def _py_files(*rel_dirs: str):
-    for rel in rel_dirs:
-        root = os.path.join(REPO, rel)
-        if os.path.isfile(root):
-            yield root
-            continue
-        for dirpath, _dirs, files in os.walk(root):
-            for f in sorted(files):
-                if f.endswith(".py"):
-                    yield os.path.join(dirpath, f)
-
-
-def _scan(pattern: re.Pattern, paths) -> dict[str, list[str]]:
-    """name -> ["relpath:line", ...] for every match of `pattern`."""
-    out: dict[str, list[str]] = {}
-    for path in paths:
-        rel = os.path.relpath(path, REPO)
-        try:
-            text = open(path, encoding="utf-8").read()
-        except OSError:
-            continue
-        for ln, line in enumerate(text.splitlines(), 1):
-            for m in pattern.finditer(line):
-                out.setdefault(m.group(1), []).append(f"{rel}:{ln}")
-    return out
-
-
-def check() -> tuple[list[str], dict[str, list[str]]]:
-    """Returns (errors, defined-sites). Sites defined under tidb_tpu/ are
-    the catalog; uses elsewhere must name one of them OR a site defined in
-    the SAME file (self-contained failpoint unit tests)."""
-    sites = _scan(_SITE, _py_files("tidb_tpu"))
-    uses = _scan(_USE, _py_files("tests", "tools", "bench.py"))
-    local_sites = _scan(_SITE, _py_files("tests", "tools", "bench.py"))
-    errors: list[str] = []
-    for name, where in sorted(uses.items()):
-        if name in sites:
-            continue
-        local = {w.split(":")[0] for w in local_sites.get(name, ())}
-        missing = [w for w in where if w.split(":")[0] not in local]
-        if missing:
-            errors.append(
-                f"failpoint {name!r} armed at {', '.join(missing)} but no "
-                f"eval/is_armed/peek site defines it under tidb_tpu/ — it can never fire")
-    for name in sorted(sites):
-        if name not in DESCRIPTIONS:
-            errors.append(
-                f"failpoint {name!r} (defined at {sites[name][0]}) has no entry in "
-                f"tools/failpoint_check.py DESCRIPTIONS — add one line so the catalog stays complete")
-    return errors, sites
-
-
-def write_catalog(sites: dict[str, list[str]], path: str) -> None:
-    lines = [
-        "# Failpoint catalog",
-        "",
-        "Generated by `python tools/failpoint_check.py --catalog` — every",
-        "`failpoint.eval/is_armed/peek` site in `tidb_tpu/` and what arming it",
-        "injects. Arm with `failpoint.enable(name, value)` (bool = always, int =",
-        "fire-N-times, set/dict = per-store arming for `store/*` points, a",
-        "ZERO-arg callable returning any of those shapes = custom per-hit",
-        "logic); disarm with `failpoint.disable(name)`.",
-        "",
-        "| failpoint | injection sites | injects |",
-        "|---|---|---|",
-    ]
-    for name in sorted(sites):
-        where = ", ".join(f"`{w}`" for w in sites[name])
-        lines.append(f"| `{name}` | {where} | {DESCRIPTIONS.get(name, '')} |")
-    with open(path, "w", encoding="utf-8") as f:
-        f.write("\n".join(lines) + "\n")
+# the public API tests import from this module
+DESCRIPTIONS = _fp.DESCRIPTIONS
+_SITE = _fp._SITE
+_USE = _fp._USE
+_py_files = _fp._py_files
+_scan = _fp._scan
+check = _fp.check
+write_catalog = _fp.write_catalog
 
 
 def main(argv: list[str]) -> int:

@@ -14,55 +14,34 @@ Checks (the subset of the format spec an in-process registry can violate):
     cumulative (non-decreasing in `le` order) per label group; the `+Inf`
     bucket exists and equals `_count`
 
+The metric-name / label grammar is shared with the `metrics` vet pass via
+`tidb_tpu/analysis/promparse.py` — ONE parser for both the lint-time and
+scrape-time halves of the exposition contract, so they cannot drift.
+
 Usage: `python tools/scrape_check.py [file]` (stdin when no file);
 exit 0 clean, exit 1 with one error per line otherwise.
 """
 
 from __future__ import annotations
 
+import importlib.util
 import math
+import os
 import re
 import sys
 
-_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
-_LABEL = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
-_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+# load the shared grammar by path (not `import tidb_tpu...`) so this tool
+# stays runnable without the engine's jax import
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "_tt_promparse", os.path.join(_REPO, "tidb_tpu", "analysis", "promparse.py"))
+_promparse = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_promparse)
 
-
-def _parse_labels(s: str, errs: list, ln: int) -> dict:
-    """`k="v",k2="v2"` -> dict; appends errors instead of raising."""
-    out: dict = {}
-    i = 0
-    while i < len(s):
-        m = re.match(r'\s*([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"', s[i:])
-        if not m:
-            errs.append(f"line {ln}: bad label syntax at {s[i:]!r}")
-            return out
-        key = m.group(1)
-        i += m.end()
-        buf = []
-        while i < len(s):
-            c = s[i]
-            if c == "\\":
-                if i + 1 >= len(s):
-                    errs.append(f"line {ln}: dangling escape in label value")
-                    return out
-                nxt = s[i + 1]
-                buf.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
-                i += 2
-                continue
-            if c == '"':
-                i += 1
-                break
-            buf.append(c)
-            i += 1
-        else:
-            errs.append(f"line {ln}: unterminated label value for {key!r}")
-            return out
-        out[key] = "".join(buf)
-        if i < len(s) and s[i] == ",":
-            i += 1
-    return out
+_NAME = _promparse.METRIC_NAME
+_LABEL = _promparse.LABEL_NAME
+_TYPES = _promparse.EXPOSITION_TYPES
+_parse_labels = _promparse.parse_labels
 
 
 def _split_sample(line: str, errs: list, ln: int):

@@ -1,0 +1,74 @@
+"""tidb-vet driver — run the repo's static-analysis suite and fail CI on
+any finding (ISSUE 7; the `go vet` / nogo analog for this codebase).
+
+Usage:
+    python tools/vet.py              # human output, exit 1 on findings
+    python tools/vet.py --json       # machine output (diffable across
+                                     # commits: stable path/line/pass keys)
+    python tools/vet.py --only PASS  # one pass (repeatable)
+    python tools/vet.py --files F..  # run every pass over exactly these
+                                     # files (fixture corpora; failpoints
+                                     # checks their arms vs live sites)
+    python tools/vet.py --list       # pass catalog
+
+Passes live in tidb_tpu/analysis/ (one module per pass; ANALYZERS.md is
+the human catalog). tools/failpoint_check.py remains the standalone
+entrypoint for the failpoints pass + FAILPOINTS.md generation.
+Suppress a finding with `# vet: ignore[<pass>]` on (or just above) the
+flagged line.
+
+Run by tier-1 via tests/test_tools.py and tests/test_vet.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str]) -> int:
+    from tidb_tpu import analysis
+
+    if "--list" in argv:
+        for name, (mod, roots) in analysis.PASSES.items():
+            scope = ", ".join(roots) if roots else "(self-scoped)"
+            print(f"{name:16s} {scope}")
+        return 0
+    only = [argv[i + 1] for i, a in enumerate(argv)
+            if a == "--only" and i + 1 < len(argv)]
+    unknown = [p for p in only if p not in analysis.PASSES]
+    if unknown:
+        print(f"unknown pass(es): {', '.join(unknown)} — see --list", file=sys.stderr)
+        return 2
+    if "--files" in argv:
+        from tidb_tpu.analysis.common import load_files
+
+        paths = [a for a in argv[argv.index("--files") + 1:] if not a.startswith("--")]
+        files = load_files(os.path.abspath(p) for p in paths)
+        findings = []
+        for p in (only or list(analysis.PASSES)):
+            findings.extend(analysis.run_pass(p, files))
+        findings.sort(key=lambda f: (f.path, f.line, f.passname))
+    elif only:
+        findings: list = []
+        for p in only:
+            findings.extend(analysis.run_pass(p))
+        findings.sort(key=lambda f: (f.path, f.line, f.passname))
+    else:
+        findings = analysis.run_all()
+    if "--json" in argv:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        if not findings:
+            ran = ", ".join(only) if only else ", ".join(analysis.PASSES)
+            print(f"ok: 0 findings ({ran})")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
