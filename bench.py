@@ -564,11 +564,9 @@ def _pd_skew_main():
     from tidb_tpu.util import metrics
 
     def labeled_counts(family: str, label: str) -> dict:
-        out = {}
-        for series, value in metrics.REGISTRY.sample_lines():
-            if series.startswith(family + "{"):
-                out[series.split(f'{label}="')[1].split('"')[0]] = int(value)
-        return out
+        # these families carry a single label, so the shared first-label
+        # parser reads them directly; `label` is kept for call-site clarity
+        return {k: int(v) for k, v in metrics.REGISTRY.labeled_samples(family).items()}
 
     def store_task_counts() -> dict:
         return labeled_counts("tidb_tpu_distsql_store_tasks_total", "store")
@@ -796,6 +794,84 @@ def _chaos_main():
     }))
 
 
+def _replica_main():
+    """BENCH_REPLICA=1: leader-only vs follower replica reads (ISSUE 8
+    satellite) — the same query mix over a multi-store cluster with
+    `tidb_replica_read` off and on, reporting per-store cop-task spread
+    and wall clock. Hermetic CPU: the quantity under test is the read
+    ROUTING — how much of the scan load leaves the leader stores — which
+    is a host-side property; the cop result cache is drained between
+    runs so every statement really dispatches."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tidb_tpu.codec import tablecodec
+    from tidb_tpu.sql.session import Session
+    from tidb_tpu.util import metrics
+
+    def labeled_counts(family: str) -> dict:
+        return {k: int(v) for k, v in metrics.REGISTRY.labeled_samples(family).items()}
+
+    n_stores, n_regions, rows, loops = 4, 12, 1200, 6
+    s = Session()
+    s.execute("CREATE TABLE rr (id BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("INSERT INTO rr VALUES " + ",".join(f"({i},{i % 97})" for i in range(rows)))
+    tid = s.catalog.table("rr").table_id
+    for i in range(1, n_regions):
+        s.store.cluster.split(tablecodec.encode_row_key(tid, i * rows // n_regions))
+    s.store.cluster.set_stores(n_stores)
+    s.store.cluster.scatter()
+    queries = [
+        "SELECT count(*), sum(v) FROM rr WHERE v < 50",
+        "SELECT max(v), min(v) FROM rr WHERE id >= 300",
+        "SELECT count(*) FROM rr",
+    ]
+    s.execute(queries[0])  # warm compile out of the timed window
+
+    def run(mode: str) -> dict:
+        s.execute(f"SET tidb_replica_read = '{mode}'")
+        base_store = labeled_counts("tidb_tpu_distsql_store_tasks_total")
+        base_rr = labeled_counts("tidb_tpu_replica_read_total")
+        t0 = time.perf_counter()
+        for _ in range(loops):
+            for q in queries:
+                s.store.evict_caches()  # every statement really dispatches
+                s.execute(q)
+        wall = time.perf_counter() - t0
+        now_store = labeled_counts("tidb_tpu_distsql_store_tasks_total")
+        now_rr = labeled_counts("tidb_tpu_replica_read_total")
+        return {
+            "wall_s": round(wall, 3),
+            "tasks_per_store": {
+                k: now_store.get(k, 0) - base_store.get(k, 0)
+                for k in sorted(set(base_store) | set(now_store))
+            },
+            "replica_reads": {
+                k: now_rr.get(k, 0) - base_rr.get(k, 0)
+                for k in ("leader", "follower")
+            },
+        }
+
+    leader = run("leader")
+    follower = run("follower")
+    total_f = sum(follower["replica_reads"].values()) or 1
+    print(json.dumps({
+        "metric": "replica_read_routing",
+        "stores": n_stores,
+        "regions": n_regions,
+        "statements": loops * len(queries),
+        "leader_only": leader,
+        "follower": follower,
+        "follower_share": round(follower["replica_reads"]["follower"] / total_f, 3),
+    }))
+
+
 def main():
     import os
 
@@ -804,6 +880,9 @@ def main():
         return
     if os.environ.get("BENCH_PD_SKEW"):
         _pd_skew_main()
+        return
+    if os.environ.get("BENCH_REPLICA"):
+        _replica_main()
         return
     if os.environ.get("BENCH_BATCH_COP"):
         _batch_cop_main()

@@ -312,9 +312,15 @@ class TestSessionErrorMapping:
 # ------------------------------------------------------------ chaos harness
 
 def test_chaos_200_statements_zero_wrong_results():
-    """ISSUE 6 acceptance: the seeded storm schedule over a 200-statement
-    mixed workload — zero wrong answers, every error typed, breakers all
-    re-closed, and the storm provably fired (failovers + trips > 0).
+    """ISSUE 6 + ISSUE 8 acceptance: the seeded storm schedule — leader
+    kills, apply-lag, transfer timeouts — over a 200-statement mixed
+    workload running with `tidb_replica_read='follower'`: zero wrong
+    answers, every error typed, breakers all re-closed, the storm
+    provably fired (failovers + trips > 0), every failover was a LEADER
+    TRANSFER (placement moves only on quorum loss, and this storm never
+    loses quorum), and follower peers served a measurable share of cop
+    tasks without ever violating the safe_ts gate (a violation would
+    show up as a wrong result — the oracle comparison IS the gate test).
     ~2min of tier-1 budget, spent deliberately: this is the PR's green
     bar."""
     from chaos import run_chaos
@@ -325,6 +331,9 @@ def test_chaos_200_statements_zero_wrong_results():
     assert report["breakers_all_closed"], report["breakers"]
     assert report["failovers"] >= 1  # the outage really dispatched
     assert report["breaker_trips"] >= 1
+    assert report["transfer_leaders"] >= 1  # failover = leader transfer
+    assert report["failover_moves"] == 0  # quorum never lost -> no moves
+    assert report["replica_reads"]["follower"] > 0
     assert report["ok"] + report["typed_errors"] == 200
 
 
@@ -339,4 +348,6 @@ def test_chaos_short_run_smoke():
     assert report["untyped_errors"] == []
     assert report["breakers_all_closed"], report["breakers"]
     assert report["failovers"] >= 1
+    assert report["failover_moves"] == 0  # transfers, never moves
+    assert report["replica_reads"]["follower"] > 0
     assert report["ok"] + report["typed_errors"] == 40

@@ -1,0 +1,531 @@
+"""Region replication (ISSUE 8): peer sets + the shared placement
+helper, quorum-acked writes, leader transfer (PD operator, breaker
+failover, balance scheduler), NotLeader leader hints, replica reads
+gated on per-peer safe_ts, and the stale-read contract under lagging
+apply (ref: TiKV raftstore peers + resolved-ts follower reads,
+client-go's replica selector and DataIsNotReady fallback)."""
+
+import os
+import sys
+import threading
+
+import pytest
+
+from tidb_tpu.codec import tablecodec
+from tidb_tpu.distsql.dispatch import KVRequest, full_table_ranges, select
+from tidb_tpu.exec.dag import ColumnInfo, DAGRequest, TableScan
+from tidb_tpu.replication import QUORUM_SAFE_TS_MAX
+from tidb_tpu.sql.session import Session, SQLError
+from tidb_tpu.store import (
+    CopRequest,
+    DataIsNotReady,
+    KeyRange,
+    NotLeader,
+    TPUStore,
+    parse_region_error,
+)
+from tidb_tpu.types import Datum, new_longlong
+from tidb_tpu.util import failpoint, metrics
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+TID = 21
+
+
+def fill_store(rows=120, regions=4, stores=4):
+    store = TPUStore()
+    for h in range(rows):
+        store.put_row(TID, h, [1], [Datum.i64(h)], ts=10)
+    for i in range(1, regions):
+        store.cluster.split(tablecodec.encode_row_key(TID, i * rows // regions))
+    store.cluster.set_stores(stores)
+    store.cluster.scatter()
+    return store
+
+
+def scan_req(start_ts=100, **kw):
+    dag = DAGRequest((TableScan(TID, (ColumnInfo(1, new_longlong()),)),), output_offsets=(0,))
+    return KVRequest(dag, full_table_ranges(TID), start_ts=start_ts, **kw)
+
+
+def replica_reads() -> dict:
+    out = {"leader": 0, "follower": 0}
+    for series, value in metrics.REGISTRY.sample_lines():
+        if series.startswith("tidb_tpu_replica_read_total{"):
+            out[series.split('"')[1]] = int(value)
+    return out
+
+
+# ------------------------------------------------------ peer-set topology
+
+class TestPeerSets:
+    def test_scatter_builds_peer_sets_with_leaders(self):
+        store = fill_store()
+        for r in store.cluster.regions():
+            peers = store.cluster.peers_of(r.region_id)
+            leader = store.cluster.leader_of(r.region_id)
+            assert len(peers) == min(store.cluster.max_replicas, 4)
+            assert len(set(peers)) == len(peers)
+            assert leader in peers
+            assert store.cluster.store_of(r.region_id) == leader  # back-compat
+
+    def test_max_replicas_capped_at_n_stores(self):
+        store = TPUStore()
+        store.cluster.set_stores(2)
+        for r in store.cluster.regions():
+            assert len(store.cluster.peers_of(r.region_id)) == 2
+
+    def test_split_child_inherits_peer_set(self):
+        store = fill_store(rows=40, regions=1, stores=4)
+        parent = store.cluster.regions()[0]
+        ppeers = store.cluster.peers_of(parent.region_id)
+        child = store.cluster.split(tablecodec.encode_row_key(TID, 20))
+        assert store.cluster.peers_of(child.region_id) == ppeers
+        assert store.cluster.leader_of(child.region_id) == \
+            store.cluster.leader_of(parent.region_id)
+
+    def test_merge_drops_absorbed_peer_set(self):
+        store = fill_store(rows=40, regions=2, stores=4)
+        left, right = store.cluster.regions()
+        store.cluster.merge(left.region_id, right.region_id)
+        assert store.cluster.region_by_id(right.region_id) is None
+        with store.cluster._mu:
+            assert right.region_id not in store.cluster._peers
+
+    def test_placement_miss_assigns_peers_via_shared_helper(self):
+        """A store_of miss places leader AND peers in one decision —
+        the three historical hard-coding sites route through one
+        helper now (satellite: reset/split drift)."""
+        store = fill_store()
+        child = store.cluster.split(tablecodec.encode_row_key(TID, 7))
+        with store.cluster._mu:
+            store.cluster._store_of.pop(child.region_id)
+            store.cluster._peers.pop(child.region_id)
+        leader = store.cluster.store_of(child.region_id)  # drives the miss
+        peers = store.cluster.peers_of(child.region_id)
+        assert leader in peers and len(peers) == 3
+
+    def test_peer_counts_per_store(self):
+        store = fill_store(rows=40, regions=4, stores=4)
+        counts = store.cluster.peer_counts_per_store()
+        assert sum(counts.values()) == 4 * 3  # 4 regions x 3 replicas
+
+
+# -------------------------------------------------------- leader transfer
+
+class TestLeaderTransfer:
+    def test_transfer_within_peer_set_only_no_epoch_bump(self):
+        store = fill_store()
+        region = store.cluster.regions()[0]
+        rid = region.region_id
+        epoch0 = region.epoch
+        leader = store.cluster.leader_of(rid)
+        follower = store.cluster.followers_of(rid)[0]
+        outsider = next(s for s in range(4) if s not in store.cluster.peers_of(rid))
+        assert not store.cluster.transfer_leader(rid, outsider)
+        assert not store.cluster.transfer_leader(rid, leader)  # already leads
+        assert store.cluster.transfer_leader(rid, follower)
+        assert store.cluster.leader_of(rid) == follower
+        assert store.cluster.region_by_id(rid).epoch == epoch0  # no bump
+        # the old leader becomes a fully-applied follower: it can serve
+        # any snapshot immediately
+        assert store.replication.safe_ts(rid, leader) == QUORUM_SAFE_TS_MAX
+
+    def test_pd_transfer_leader_operator(self):
+        store = fill_store()
+        rid = store.cluster.regions()[0].region_id
+        follower = store.cluster.followers_of(rid)[0]
+        t0 = metrics.PD_TRANSFER_LEADER.value
+        op = store.pd.new_operator("transfer-leader", rid, target=follower)
+        store.pd._apply(op)
+        assert op.state == "finished"
+        assert store.cluster.leader_of(rid) == follower
+        assert metrics.PD_TRANSFER_LEADER.value == t0 + 1
+
+    def test_transfer_leader_timeout_failpoint(self):
+        store = fill_store()
+        rid = store.cluster.regions()[0].region_id
+        follower = store.cluster.followers_of(rid)[0]
+        leader0 = store.cluster.leader_of(rid)
+        with failpoint.enabled("store/transfer-leader-timeout", 1):
+            op = store.pd.new_operator("transfer-leader", rid, target=follower)
+            store.pd._apply(op)
+        assert op.state == "timeout"
+        assert store.cluster.leader_of(rid) == leader0  # nothing moved
+
+    def test_breaker_failover_is_a_leader_transfer(self):
+        """ISSUE 8 acceptance: a down leader store fails over by
+        TRANSFERRING leadership to a live peer — no placement move, the
+        peer sets stay put."""
+        store = fill_store()
+        peer_counts0 = store.cluster.peer_counts_per_store()
+        store.set_down(1)
+        t0 = metrics.PD_TRANSFER_LEADER.value
+        res = select(store, scan_req())
+        assert sum(c.num_rows() for c in res.chunks) == 120
+        assert metrics.PD_TRANSFER_LEADER.value > t0
+        assert store.cluster.counts_per_store().get(1, 0) == 0  # no leaders
+        # peer sets unchanged: store 1 still HOLDS its follower replicas
+        assert store.cluster.peer_counts_per_store() == peer_counts0
+        store.set_up(1)
+
+    def test_quorum_loss_falls_back_to_placement_move(self):
+        """With a majority of a region's peers dead no transfer can win:
+        the PD re-places the whole group on healthy stores (the ONLY
+        failover shape that moves placement)."""
+        store = fill_store()
+        region = store.cluster.regions()[0]
+        peers = store.cluster.peers_of(region.region_id)
+        for p in peers:
+            store.set_down(p)
+        survivor = next(s for s in range(4) if s not in peers)
+        t0 = metrics.PD_TRANSFER_LEADER.value
+        res = select(store, scan_req())
+        assert sum(c.num_rows() for c in res.chunks) == 120
+        assert store.cluster.leader_of(region.region_id) == survivor
+        assert survivor in store.cluster.peers_of(region.region_id)
+        assert metrics.PD_TRANSFER_LEADER.value == t0  # no transfer could win
+        ops = [o for o in store.pd.queue.history_view() if o.kind == "failover"]
+        assert ops and "quorum lost" in ops[-1].note
+        for p in peers:
+            store.set_up(p)
+
+    def test_leader_balance_scheduler_evens_leader_counts(self):
+        store = fill_store(rows=120, regions=8, stores=4)
+        for r in store.cluster.regions():
+            store.cluster.set_store(r.region_id, 0)  # pathological pin
+        t0 = metrics.PD_TRANSFER_LEADER.value
+        for _ in range(8):
+            store.pd.tick()
+            counts = store.cluster.counts_per_store()
+            if max(counts.values()) - min(counts.values()) <= store.pd.conf.balance_tolerance:
+                break
+        counts = store.cluster.counts_per_store()
+        assert max(counts.values()) - min(counts.values()) <= store.pd.conf.balance_tolerance
+        assert metrics.PD_TRANSFER_LEADER.value > t0  # moved BY TRANSFER
+
+
+# ------------------------------------------------- NotLeader leader hints
+
+class TestNotLeaderHint:
+    def test_hint_round_trips_the_wire_string(self):
+        err = NotLeader.make(5, 2, leader_store=3)
+        back = parse_region_error(str(err))
+        assert isinstance(back, NotLeader)
+        assert back.store_id == 2 and back.leader_store == 3
+        # hint-less legacy strings still classify, hint unknown
+        old = parse_region_error("not_leader: region 5 store 2")
+        assert isinstance(old, NotLeader)
+        assert old.store_id == 2 and old.leader_store == -1
+
+    def test_non_leader_peer_answers_hint(self):
+        store = fill_store()
+        region = store.cluster.regions()[0]
+        leader = store.cluster.leader_of(region.region_id)
+        follower = store.cluster.followers_of(region.region_id)[0]
+        dag = DAGRequest((TableScan(TID, (ColumnInfo(1, new_longlong()),)),), output_offsets=(0,))
+        resp = store.coprocessor(CopRequest(
+            dag, [KeyRange(region.start_key, region.end_key)], 100,
+            region.region_id, region.epoch, peer_store=follower))
+        err = parse_region_error(resp.region_error)
+        assert isinstance(err, NotLeader)
+        assert err.store_id == follower and err.leader_store == leader
+
+    def test_dispatch_uses_hint_for_immediate_retry_without_backoff(self):
+        """Satellite: a usable leader hint switches peers in ONE shot —
+        the not_leader backoff budget is never touched. A follower-read
+        against a store whose not-leader failpoint is armed produces
+        exactly that shape: the error carries the REAL leader as hint."""
+        store = fill_store()
+        region = store.cluster.regions()[0]
+        follower = store.cluster.followers_of(region.region_id)[0]
+        b0 = metrics.BACKOFF_SECONDS.labels("not_leader").value
+        e0 = metrics.REGISTRY.counter_vec(
+            "tidb_tpu_region_errors_total", labelnames=("kind",)
+        ).labels("not_leader").value
+        with failpoint.enabled("store/not-leader", {follower}):
+            res = select(store, scan_req(replica_read="follower", concurrency=1))
+        assert sum(c.num_rows() for c in res.chunks) == 120
+        assert metrics.REGISTRY.counter_vec(
+            "tidb_tpu_region_errors_total", labelnames=("kind",)
+        ).labels("not_leader").value > e0  # the flap really fired
+        assert metrics.BACKOFF_SECONDS.labels("not_leader").value == b0  # no budget burned
+
+
+# ------------------------------------------- replica reads + safe_ts gate
+
+class TestReplicaReads:
+    def test_follower_mode_serves_from_followers(self):
+        store = fill_store()
+        r0 = replica_reads()
+        res = select(store, scan_req(replica_read="follower"))
+        assert sum(c.num_rows() for c in res.chunks) == 120
+        r1 = replica_reads()
+        assert r1["follower"] - r0["follower"] >= 4  # every region task
+        assert r1["leader"] == r0["leader"]
+
+    def test_closest_replica_spreads_read_load(self):
+        store = fill_store()
+        for _ in range(6):
+            res = select(store, scan_req(replica_read="closest-replica"))
+            assert sum(c.num_rows() for c in res.chunks) == 120
+        loads = store.replication.read_counts()
+        assert len([s for s, n in loads.items() if n > 0]) >= 3
+
+    def test_lagging_follower_gates_new_snapshots_to_leader(self):
+        """A wedged apply loop must NEVER serve a snapshot past its
+        safe_ts: reads at the new ts fall back to the leader (typed
+        DataIsNotReady wait), reads at or below the watermark still ride
+        the follower — and both return exactly the leader-oracle rows."""
+        store = fill_store()
+        res = select(store, scan_req(replica_read="follower"))  # join peers
+        region = store.cluster.locate(tablecodec.encode_row_key(TID, 500))
+        rid = region.region_id
+        followers = store.cluster.followers_of(rid)
+        with failpoint.enabled("replica/apply-lag", True):
+            store.put_row(TID, 500, [1], [Datum.i64(500)], ts=150)
+            for f in followers:
+                assert store.replication.safe_ts(rid, f) < 150
+            d0 = metrics.REGISTRY.counter_vec(
+                "tidb_tpu_region_errors_total", labelnames=("kind",)
+            ).labels("data_not_ready").value
+            res = select(store, scan_req(start_ts=200, replica_read="follower"))
+            assert sum(c.num_rows() for c in res.chunks) == 121  # leader truth
+            assert metrics.REGISTRY.counter_vec(
+                "tidb_tpu_region_errors_total", labelnames=("kind",)
+            ).labels("data_not_ready").value > d0
+            # stale snapshot UNDER the watermark: the follower serves it
+            r0 = replica_reads()
+            res = select(store, scan_req(start_ts=100, replica_read="follower"))
+            assert sum(c.num_rows() for c in res.chunks) == 120
+            assert replica_reads()["follower"] > r0["follower"]
+        store.pd.tick()  # catch-up: the wedge is gone
+        for f in store.cluster.followers_of(rid):
+            assert store.replication.safe_ts(rid, f) == QUORUM_SAFE_TS_MAX
+
+    def test_batch_cop_groups_by_routed_follower(self):
+        store = fill_store(rows=120, regions=6, stores=3)
+        r0 = replica_reads()
+        res = select(store, scan_req(replica_read="follower", batch_cop=True))
+        assert sum(c.num_rows() for c in res.chunks) == 120
+        assert replica_reads()["follower"] - r0["follower"] >= 6
+
+    def test_cop_request_peer_fields_survive_the_wire(self):
+        from tidb_tpu.codec.wire import decode_cop_request, encode_cop_request
+
+        dag = DAGRequest((TableScan(TID, (ColumnInfo(1, new_longlong()),)),), output_offsets=(0,))
+        req = CopRequest(dag, [KeyRange(b"a", b"z")], 100, 7, 3,
+                         peer_store=2, replica_read=True)
+        back = decode_cop_request(encode_cop_request(req))
+        assert back.peer_store == 2 and back.replica_read is True
+        req = CopRequest(dag, [KeyRange(b"a", b"z")], 100, 7, 3)
+        back = decode_cop_request(encode_cop_request(req))
+        assert back.peer_store == -1 and back.replica_read is False
+
+    def test_data_is_not_ready_round_trips(self):
+        err = DataIsNotReady.make(7, 2, safe_ts=42)
+        back = parse_region_error(str(err))
+        assert isinstance(back, DataIsNotReady)
+        assert back.store_id == 2 and back.safe_ts == 42
+        assert back.kind == "data_not_ready"
+
+
+class TestWatermarkEdges:
+    def test_first_proposal_under_wedge_still_gates(self):
+        """A region's FIRST tracked write while apply-lag is armed must
+        not credit the wedged followers with the write itself (the lazy
+        group bootstrap reads kv.max_committed() AFTER the put landed —
+        review finding: the gate could never fire for first writes)."""
+        store = TPUStore()
+        store.cluster.set_stores(3)
+        with failpoint.enabled("replica/apply-lag", True):
+            store.put_row(TID, 1, [1], [Datum.i64(1)], ts=50)
+            rid = store.cluster.locate(tablecodec.encode_row_key(TID, 1)).region_id
+            for f in store.cluster.followers_of(rid):
+                assert store.replication.safe_ts(rid, f) < 50
+
+    def test_leader_move_within_peers_leaves_no_phantom_lag(self):
+        """set_store() to an existing peer changes leadership; the new
+        leader's stale follower watermark must not linger as ever-growing
+        safe_ts lag in the PD views (review finding)."""
+        store = fill_store()
+        rid = store.cluster.locate(tablecodec.encode_row_key(TID, 1)).region_id
+        follower = store.cluster.followers_of(rid)[0]
+        store.cluster.set_store(rid, follower)  # move onto a peer
+        assert store.cluster.leader_of(rid) == follower
+        store.put_row(TID, 1, [1], [Datum.i64(2)], ts=300)
+        store.pd.tick()  # catch-up + lag gauges
+        assert all(v == 0 for v in store.replication.lag_view().values())
+
+    def test_failover_prefers_caught_up_peer(self):
+        """Raft: only an up-to-date peer may win — with one follower
+        wedged, breaker failover transfers to the caught-up one."""
+        store = fill_store()
+        rid = store.cluster.locate(tablecodec.encode_row_key(TID, 1)).region_id
+        leader = store.cluster.leader_of(rid)
+        lagging, healthy = store.cluster.followers_of(rid)
+        with failpoint.enabled("replica/apply-lag", {lagging}):
+            store.put_row(TID, 1, [1], [Datum.i64(3)], ts=400)
+            store.set_down(leader)
+            target = store.pd.failover_region(rid, leader)
+        assert target == healthy
+        store.set_up(leader)
+
+
+# --------------------------------------------------------- quorum writes
+
+class TestQuorumWrites:
+    def test_one_dropped_ack_still_commits(self):
+        store = fill_store()
+        rid = store.cluster.regions()[0].region_id
+        follower = store.cluster.followers_of(rid)[0]
+        q0 = metrics.REPLICA_QUORUM_FAILS.value
+        with failpoint.enabled("replica/drop-ack", {follower}):
+            assert store.replication.propose(rid, 200)  # 2/3 acks: quorum
+        assert metrics.REPLICA_QUORUM_FAILS.value == q0
+        assert store.replication.quorum_ok(rid)
+
+    def test_majority_dropped_acks_lose_quorum(self):
+        store = fill_store()
+        rid = store.cluster.regions()[0].region_id
+        followers = store.cluster.followers_of(rid)
+        q0 = metrics.REPLICA_QUORUM_FAILS.value
+        with failpoint.enabled("replica/drop-ack", set(followers)):
+            assert not store.replication.propose(rid, 200)  # 1/3 acks
+        assert metrics.REPLICA_QUORUM_FAILS.value > q0
+        assert not store.replication.quorum_ok(rid)
+        # the PD tick's roll call restores quorum WITHOUT a new proposal
+        # (review finding: read-only workloads latched quorum_ok False
+        # forever, degrading later failovers to placement moves)
+        store.pd.tick()
+        assert store.replication.quorum_ok(rid)
+        # ...and a healthy proposal agrees
+        assert store.replication.propose(rid, 201)
+        assert store.replication.quorum_ok(rid)
+
+
+# ------------------------------------------------------- session surfaces
+
+class TestSessionSurfaces:
+    def make_session(self, rows=120, regions=6, stores=3):
+        s = Session()
+        s.execute("CREATE TABLE rep (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute("INSERT INTO rep VALUES " + ",".join(f"({i},{i % 7})" for i in range(rows)))
+        tid = s.catalog.table("rep").table_id
+        for i in range(1, regions):
+            s.store.cluster.split(tablecodec.encode_row_key(tid, i * rows // regions))
+        s.store.cluster.set_stores(stores)
+        s.store.cluster.scatter()
+        return s
+
+    def test_replica_read_sysvar_validates_and_routes(self):
+        s = self.make_session()
+        with pytest.raises(SQLError):
+            s.execute("SET tidb_replica_read = 'sideways'")
+        s.execute("SET tidb_replica_read = 'follower'")
+        assert s.execute("SELECT @@tidb_replica_read").scalar() == "follower"
+        r0 = replica_reads()
+        assert s.execute("SELECT count(*) FROM rep").scalar() == 120
+        assert replica_reads()["follower"] > r0["follower"]
+
+    def test_stale_snapshot_session_rides_followers_only_when_covered(self):
+        """Satellite: a tidb_snapshot-rewound session is served by a
+        follower only when `safe_ts >= snapshot_ts`; a lagging follower
+        never changes the answer at EITHER snapshot."""
+        s = self.make_session(rows=60, regions=3, stores=3)
+        snap_ts = s.store.next_ts()
+        s.execute("SET tidb_replica_read = 'follower'")
+        assert s.execute("SELECT count(*) FROM rep").scalar() == 60  # peers join
+        with failpoint.enabled("replica/apply-lag", True):
+            s.execute("INSERT INTO rep VALUES (1000, 1)")
+            # current reads: the gate forces the leader; count is correct
+            assert s.execute("SELECT count(*) FROM rep").scalar() == 61
+            # rewound session: under every follower's watermark -> follower
+            r0 = replica_reads()
+            s.execute(f"SET tidb_snapshot = '{snap_ts}'")
+            assert s.execute("SELECT count(*) FROM rep").scalar() == 60
+            assert replica_reads()["follower"] > r0["follower"]
+            s.execute("SET tidb_snapshot = ''")
+            assert s.execute("SELECT count(*) FROM rep").scalar() == 61
+
+    def test_show_placement_lists_peers_and_leaders(self):
+        s = self.make_session(rows=40, regions=2, stores=3)
+        rows = s.execute("SHOW PLACEMENT").values()
+        store_rows = [r for r in rows if r[0].startswith("STORE")]
+        region_rows = [r for r in rows if r[0].startswith("REGION")]
+        assert all("leaders=" in r[1] and "peers=" in r[1] for r in store_rows)
+        assert all("leader=" in r[1] and "peers=[" in r[1] for r in region_rows)
+
+    def test_stores_view_surfaces_replica_counts(self):
+        s = self.make_session(rows=40, regions=2, stores=3)
+        for st in s.store.pd.stores_view():
+            assert "leader_count" in st and "peer_count" in st and "safe_ts_lag" in st
+        total_peers = sum(st["peer_count"] for st in s.store.pd.stores_view())
+        assert total_peers == sum(
+            len(s.store.cluster.peers_of(r.region_id))
+            for r in s.store.cluster.regions())
+
+
+# --------------------------------- lockwatch storm: transfers vs dispatch
+
+def test_leader_transfer_storm_under_lockwatch():
+    """ISSUE 8 satellite: leader transfers racing follower-read dispatch
+    AND the PD tick under the runtime lockset detector — zero lock-order
+    cycles, zero unguarded annotated accesses, and every scan returns
+    the full row count (a transfer mid-scan costs at most a NotLeader
+    hint retry, never rows)."""
+    from tidb_tpu.analysis import lockwatch
+
+    rows, regions = 160, 8
+    with lockwatch.watching() as w:
+        store = TPUStore()
+        for h in range(rows):
+            store.put_row(TID, h, [1], [Datum.i64(h)], ts=10)
+        for i in range(1, regions):
+            store.cluster.split(tablecodec.encode_row_key(TID, i * rows // regions))
+        store.cluster.set_stores(4)
+        store.cluster.scatter()
+        dag = DAGRequest((TableScan(TID, (ColumnInfo(1, new_longlong()),)),),
+                         output_offsets=(0,))
+        stop = threading.Event()
+        errors: list = []
+        counts: list = []
+
+        def scanner(mode):
+            while not stop.is_set():
+                try:
+                    res = select(store, KVRequest(
+                        dag, full_table_ranges(TID), 100, replica_read=mode))
+                    counts.append(sum(c.num_rows() for c in res.chunks))
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+                    return
+
+        def transferrer():
+            k = 0
+            while not stop.is_set():
+                for r in store.cluster.regions():
+                    folls = store.cluster.followers_of(r.region_id)
+                    if folls:
+                        store.cluster.transfer_leader(
+                            r.region_id, folls[k % len(folls)])
+                k += 1
+                store.pd.tick()
+
+        threads = [threading.Thread(target=scanner, args=(m,), daemon=True)
+                   for m in ("follower", "closest-replica", "leader")]
+        threads.append(threading.Thread(target=transferrer, daemon=True))
+        for t in threads:
+            t.start()
+        import time
+
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    rep = w.report()
+    assert rep["cycles"] == [], rep["cycles"]
+    assert rep["violations"] == [], "\n".join(rep["violations"])
+    assert not errors, errors
+    assert counts and all(c == rows for c in counts)
+    assert rep["edges"], "lockwatch saw no lock nesting at all"

@@ -50,7 +50,10 @@ DESCRIPTIONS = {
     "ddl_index_write_reorg": "pauses online index DDL in the write-reorg (backfill) state",
     "pd/heartbeat-lost": "drops one tick's region-heartbeat interval on the floor (a lost heartbeat stream)",
     "pd/operator-timeout": "force-expires every pending PD operator at the next tick's dispatch phase",
+    "replica/apply-lag": "wedges armed follower stores' apply loop — their safe_ts stops advancing, so replica reads at newer snapshots answer DataIsNotReady until disarmed (per-store arming)",
+    "replica/drop-ack": "drops armed follower stores' replication acks — proposals count quorum without them, and losing quorum flips the group to quorum_lost (placement-move failover)",
     "store/not-leader": "injects a typed NotLeader region error for requests to armed stores (True/set/dict arming)",
+    "store/transfer-leader-timeout": "times out leader-transfer attempts (breaker failover and the PD transfer-leader operator) — the operator retires as timeout and the caller backs off",
     "store/server-busy": "injects ServerIsBusy with an optional `backoff_ms` suggestion for armed stores",
     "store/unreachable": "injects StoreUnavailable for armed stores and fails their liveness probe (ping_store)",
 }

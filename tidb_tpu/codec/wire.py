@@ -474,6 +474,8 @@ def encode_cop_request(req, _aux_index=None) -> bytes:
             w.i32(_aux_index(c))
     w.i32(-1 if req.paging_size is None else req.paging_size)
     w.i32(-1 if req.small_groups is None else req.small_groups)
+    w.i32(req.peer_store)
+    w.bool_(req.replica_read)
     return w.done()
 
 
@@ -498,9 +500,12 @@ def decode_cop_request(b: bytes, _aux_table: list | None = None):
         aux = [_aux_table[r.i32()] for _ in range(n_aux)]
     paging = r.i32()
     smg = r.i32()
+    peer_store = r.i32()
+    replica_read = r.bool_()
     return CopRequest(dag, ranges, start_ts, region_id, epoch, aux,
                       None if paging < 0 else paging,
-                      None if smg < 0 else smg)
+                      None if smg < 0 else smg,
+                      peer_store=peer_store, replica_read=replica_read)
 
 
 def encode_cop_response(resp) -> bytes:

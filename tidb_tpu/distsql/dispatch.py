@@ -103,6 +103,21 @@ class CircuitBreaker:
         with self._lock:
             return self.state
 
+    def probe_ready(self) -> bool:
+        """Non-consuming routability check: closed, or an open/half-open
+        breaker whose probe window has arrived. The replica selector
+        avoids stores that return False (no point grouping lanes onto a
+        tripped follower) but MUST keep offering ones that return True —
+        otherwise a follower nobody routes to can never half-open-probe
+        back closed (allow_request still gates the actual admission)."""
+        now = self._now()
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                return now - self.opened_at >= self.probe_after
+            return now - self.last_probe >= self.probe_after
+
     def record_failure(self) -> bool:
         """Returns True when THIS failure opened (or re-opened) the
         breaker — the caller's cue to fail the task over."""
@@ -158,6 +173,11 @@ class BreakerBoard:
         # the board lock already released (board -> breaker never nests)
         return {sid for sid, b in self._snapshot() if b.state_view() == "open"}
 
+    def unroutable_stores(self) -> set:
+        """Stores the replica selector should route around right now:
+        tripped breakers still inside their probe-silence window."""
+        return {sid for sid, b in self._snapshot() if not b.probe_ready()}
+
     def states(self) -> dict:
         return {sid: b.state_view() for sid, b in self._snapshot()}
 
@@ -204,6 +224,9 @@ class KVRequest:
     # past the deadline / after KILL (ref: resourcegroup checker.go:27)
     backoff_weight: int = 2  # tidb_backoff_weight: scales every retry
     # budget (ref: sessionctx BackOffWeight -> copr backoffer construction)
+    replica_read: str = "leader"  # tidb_replica_read: leader / follower /
+    # closest-replica — which peer of each region serves the cop task
+    # (ref: sessionctx ReplicaRead -> kvrpcpb.Context.replica_read)
 
 
 @dataclass
@@ -270,11 +293,57 @@ def _scan_kind(req) -> str:
     return "index" if isinstance(req.dag.scan(), IndexScan) else "table"
 
 
+def _route_ctx(store) -> tuple:
+    """One (bad-store set, read-load map) snapshot for a whole routing
+    pass — the batch grouping loop calls _route_task once per lane, and
+    these inputs are loop-invariant there (re-snapshotting per lane
+    would take the board/down/replica locks O(lanes) times)."""
+    return (store.down_stores() | store.breakers.unroutable_stores(),
+            store.replication.read_counts())
+
+
+def _route_task(store, req, task, avoid=frozenset(), leader_only=False,
+                ctx=None) -> int:
+    """Pick the peer that serves this cop task (ref: client-go's replica
+    selector honoring tidb_replica_read). `leader` routes to the leader;
+    `follower` prefers the least-read-loaded healthy follower; `closest-
+    replica` picks the least-read-loaded healthy peer, leader included
+    (the in-process analog of same-AZ proximity: the least-busy chip is
+    'closest'). The client does NOT pre-filter on safe_ts — the store's
+    gate answers DataIsNotReady and the retry loop falls back to the
+    leader, exactly the reference's wire protocol. `ctx` is an optional
+    `_route_ctx` snapshot; the retry loop omits it (a retry wants fresh
+    health state)."""
+    cluster = store.cluster
+    leader = cluster.leader_of(task.region_id)
+    if leader_only or req.replica_read == "leader":
+        return leader
+    peers = cluster.peers_of(task.region_id)
+    # skip peers the client already knows are sick: down switches AND
+    # breakers inside their probe-silence window (else min-by-load keeps
+    # re-picking a tripped follower — its frozen read count looks
+    # attractively idle — and every batch degrades to the single path).
+    # A breaker whose probe window arrived is offered again: someone has
+    # to send the half-open probe that re-closes it.
+    bad, loads = ctx if ctx is not None else _route_ctx(store)
+    healthy = [p for p in peers if p not in avoid and p not in bad]
+    if not healthy:
+        return leader
+    if req.replica_read == "follower":
+        followers = [p for p in healthy if p != leader]
+        if not followers:
+            return leader
+        return min(followers, key=lambda p: (loads.get(p, 0), p))
+    return min(healthy, key=lambda p: (loads.get(p, 0), p))
+
+
 def _failover(store, region_id: int, bad_store: int, boff) -> int | None:
-    """Ask the PD to re-place a region off a sick store (ref: client-go
-    marking a store unreachable + PD moving peers away). When no healthy
-    store exists, backs off on the store_unavailable budget — maybe the
-    store comes back or a breaker probe succeeds — and returns None."""
+    """Ask the PD to fail a region over off its sick LEADER store (ref:
+    client-go marking a store unreachable): a leader transfer among the
+    live peers, or a re-placement when quorum is lost. When nothing can
+    serve (or the transfer timed out), backs off on the
+    store_unavailable budget — maybe the store comes back or a breaker
+    probe succeeds — and returns None."""
     from ..util.backoff import BackoffExhausted
 
     pd = getattr(store, "pd", None)
@@ -300,10 +369,14 @@ def _run_one_task(store, req, task, summaries, retries=MAX_RETRY,
     accumulate in place.
 
     Region errors are CLASSIFIED (ref: copr/coprocessor.go:1424
-    handleCopResponse): each kind retries on its own Backoffer budget;
-    store_unavailable additionally feeds the store's circuit breaker and —
-    once the breaker opens — fails the task over via a PD re-placement
-    decision instead of hammering the sick store."""
+    handleCopResponse): each kind retries on its own Backoffer budget.
+    store_unavailable from the LEADER feeds the store's circuit breaker
+    and — once the breaker opens — fails the task over via the PD (a
+    leader transfer among live peers; placement move only on quorum
+    loss); from a FOLLOWER it just routes around the bad replica.
+    not_leader with a usable hint switches peers immediately (one shot,
+    no backoff); data_not_ready waits once on its own budget, retries
+    the follower, then latches the task onto the leader."""
     import time as _time
 
     from ..store.errors import parse_region_error
@@ -325,15 +398,31 @@ def _run_one_task(store, req, task, summaries, retries=MAX_RETRY,
         out_chunks: list = []
         ranges = task.ranges
         pages = 0
+        local_avoid: set = set()  # follower peers this task routes around
+        leader_only = False  # DataIsNotReady latch: fall back to the leader
+        forced_sid: int | None = None  # NotLeader hint: one-shot target
+        hint_used = False
+        dnr_waits = 0  # DataIsNotReady waits before the leader fallback
         while True:
             if req.checker is not None:
                 req.checker.before_cop_request()
             _fp.eval("distsql.before_task")
-            sid = store.cluster.store_of(task.region_id)
+            if forced_sid is not None:
+                sid, forced_sid = forced_sid, None
+            else:
+                sid = _route_task(store, req, task, avoid=local_avoid,
+                                  leader_only=leader_only)
+            leader = store.cluster.leader_of(task.region_id)
             if not board.allow_request(sid):
-                # breaker open: do NOT pay the sick store's failure again —
-                # fail over through a PD re-placement (or wait for a probe
-                # window on the store_unavailable budget)
+                if sid != leader:
+                    # a sick FOLLOWER never fails the region over — the
+                    # leader is fine; just route around the bad replica
+                    local_avoid.add(sid)
+                    continue
+                # leader breaker open: do NOT pay the sick store's failure
+                # again — fail over through the PD (leader transfer among
+                # live peers, placement move only on quorum loss) or wait
+                # for a probe window on the store_unavailable budget
                 _failover(store, task.region_id, sid, boff)
                 continue
             metrics.DISTSQL_TASKS.inc()
@@ -344,7 +433,8 @@ def _run_one_task(store, req, task, summaries, retries=MAX_RETRY,
             creq = CopRequest(
                 req.dag, ranges, req.start_ts, task.region_id, task.epoch,
                 aux_chunks=req.aux_chunks, paging_size=req.paging_size,
-                small_groups=req.small_groups,
+                small_groups=req.small_groups, peer_store=sid,
+                replica_read=req.replica_read != "leader" and sid != leader,
             )
             if req.use_wire:
                 from ..codec.wire import decode_cop_response, encode_cop_request
@@ -367,18 +457,45 @@ def _run_one_task(store, req, task, summaries, retries=MAX_RETRY,
                         pd = getattr(store, "pd", None)
                         if pd is not None:
                             pd.note_store_down(sid)
-                        if opened:
+                        if sid != leader:
+                            # a dead follower costs a re-route, not a
+                            # failover: the leader still serves (client-go
+                            # trying the next peer in the selector)
+                            local_avoid.add(sid)
+                        elif opened:
                             _failover(store, task.region_id, sid, boff)
                         else:
                             boff.backoff("store_unavailable", resp.region_error)
-                        continue  # same task, fresh placement lookup
+                        continue  # same task, fresh routing decision
                     if err.kind == "server_busy":
                         board.record_failure(sid)
                         boff.backoff("server_busy", resp.region_error,
                                      suggested_ms=getattr(err, "backoff_ms", 0))
                         continue
                     if err.kind == "not_leader":
+                        hint = getattr(err, "leader_store", -1)
+                        if hint >= 0 and hint != sid and not hint_used:
+                            # a usable leader hint: switch peers NOW — one
+                            # immediate retry, no backoff round burned
+                            # (ref: client-go updating the region cache
+                            # from errorpb.NotLeader.leader and retrying)
+                            hint_used = True
+                            forced_sid = hint
+                            continue
                         boff.backoff("not_leader", resp.region_error)
+                        hint_used = False  # a fresh hint may follow the election
+                        continue
+                    if err.kind == "data_not_ready":
+                        # the follower's safe_ts trails start_ts: one short
+                        # wait and a follower retry (maybe the apply loop
+                        # catches up), then the leader serves the rest of
+                        # this task (ref: client-go's DataIsNotReady ->
+                        # leader fallback on the maxDataNotReady budget)
+                        dnr_waits += 1
+                        if dnr_waits > 1:
+                            leader_only = True
+                        else:
+                            boff.backoff("data_not_ready", resp.region_error)
                         continue
                     # epoch_not_match / region_not_found / generic miss:
                     # brief backoff, then re-split the REMAINING ranges
@@ -413,21 +530,22 @@ def _run_one_task(store, req, task, summaries, retries=MAX_RETRY,
             ranges = resp.last_range
 
 
-def _run_store_batch(store, req, entries, results, summaries_by_task,
+def _run_store_batch(store, req, sid, entries, results, summaries_by_task,
                      dispatch_span, scan_kind) -> dict:
     """ONE batched dispatch for all of a store's region tasks (ref:
     copr/batch_coprocessor.go — a TiFlash store's regions travel in one
     request): the store stacks the regions and drives one vmapped launch.
-    A region that comes back with a region_error (stale epoch after a
-    concurrent split, region folded by a merge) falls out of the batch
-    into the standard _run_one_task retry path — the rest of the batch's
-    results stand. Returns this batch's attribution stats."""
+    `sid` is the ROUTED target peer (the leader for every lane under
+    tidb_replica_read='leader'; a follower group otherwise). A region
+    that comes back with a region_error (stale epoch after a concurrent
+    split, region folded by a merge, a follower's safe_ts gate) falls out
+    of the batch into the standard _run_one_task retry path — the rest of
+    the batch's results stand. Returns this batch's attribution stats."""
     import time as _time
 
     from ..util import failpoint as _fp
     from ..util import metrics, tracing
 
-    sid = store.cluster.store_of(entries[0][1].region_id)
     if not store.breakers.allow_request(sid):
         # the store's circuit breaker is open: skip the batched dispatch
         # entirely — every lane falls out to the single-task path, which
@@ -445,12 +563,13 @@ def _run_store_batch(store, req, entries, results, summaries_by_task,
             req.checker.before_cop_request()
         _fp.eval("distsql.before_task")
         metrics.DISTSQL_TASKS.inc()
-        metrics.DISTSQL_STORE_TASKS.labels(
-            str(store.cluster.store_of(t.region_id))
-        ).inc()
+        metrics.DISTSQL_STORE_TASKS.labels(str(sid)).inc()
         creqs.append(CopRequest(
             req.dag, t.ranges, req.start_ts, t.region_id, t.epoch,
             aux_chunks=req.aux_chunks, small_groups=req.small_groups,
+            peer_store=sid,
+            replica_read=(req.replica_read != "leader"
+                          and sid != store.cluster.leader_of(t.region_id)),
         ))
     t_batch = _time.monotonic()
     stats = {"batches": 0, "regions": 0, "launches_saved": 0}
@@ -544,15 +663,21 @@ def select(store: TPUStore, req: KVRequest) -> SelectResult:
         # authoritative placement map). Paging requests never batch: the
         # per-page resume cursor is inherently per-region sequential state.
         by_store: dict[int, list] = {}
+        ctx = _route_ctx(store) if req.replica_read != "leader" else None
         for i, t in enumerate(tasks):
-            by_store.setdefault(store.cluster.store_of(t.region_id), []).append((i, t))
+            # group lanes by their ROUTED peer (leader view by default;
+            # follower/closest targets under tidb_replica_read) — each
+            # target store still gets exactly one batched dispatch
+            by_store.setdefault(_route_task(store, req, t, ctx=ctx),
+                                []).append((i, t))
 
-        def run_batch(entries):
-            return _run_store_batch(store, req, entries, results,
+        def run_batch(sid, entries):
+            return _run_store_batch(store, req, sid, entries, results,
                                     summaries_by_task, dispatch_span, scan_kind)
 
         with ThreadPoolExecutor(max_workers=max(len(by_store), 1)) as pool:
-            futs = [pool.submit(run_batch, entries) for entries in by_store.values()]
+            futs = [pool.submit(run_batch, sid, entries)
+                    for sid, entries in by_store.items()]
             per_store = [f.result() for f in futs]
         batch_stats = {
             "batches": sum(s["batches"] for s in per_store),

@@ -176,6 +176,7 @@ def execute_root(
     small_groups: int | None = None,
     checker=None,
     backoff_weight: int = 2,
+    replica_read: str = "leader",
 ) -> Chunk:
     """Run a logical (Complete-mode) DAG over the store: split, dispatch the
     pushdown half per region, merge at root. The caller-visible result is
@@ -196,7 +197,7 @@ def execute_root(
         out = _execute_root(
             store, dag, ranges, start_ts, aux_chunks, concurrency, cache,
             group_capacity, paging_size, batch_cop, summary_sink, tracker,
-            low_memory, small_groups, checker, backoff_weight,
+            low_memory, small_groups, checker, backoff_weight, replica_read,
         )
         if sp is not None:
             sp.set("rows", out.num_rows())
@@ -207,6 +208,7 @@ def _execute_root(
     store, dag, ranges, start_ts, aux_chunks, concurrency, cache,
     group_capacity, paging_size, batch_cop, summary_sink, tracker,
     low_memory, small_groups, checker, backoff_weight=2,
+    replica_read="leader",
 ) -> Chunk:
     plan = split_dag(dag)
     if low_memory and plan.root_dag is not None:
@@ -224,7 +226,7 @@ def _execute_root(
             plan.push_dag, ranges, start_ts, concurrency=concurrency,
             aux_chunks=aux_chunks or [], paging_size=paging_size,
             batch_cop=batch_cop, small_groups=small_groups, checker=checker,
-            backoff_weight=backoff_weight,
+            backoff_weight=backoff_weight, replica_read=replica_read,
         ),
     )
     if summary_sink is not None:

@@ -215,6 +215,7 @@ class PlacementDriver:
         from .schedulers import (
             BalanceRegionScheduler,
             HotRegionScheduler,
+            LeaderBalanceScheduler,
             MergeChecker,
             SplitChecker,
         )
@@ -227,7 +228,8 @@ class PlacementDriver:
         self.hot_write = HotPeerCache("write", self.conf)
         self.queue = OperatorQueue(self.conf.operator_limit)
         self.checkers = [SplitChecker(), MergeChecker()]
-        self.schedulers = [BalanceRegionScheduler(), HotRegionScheduler()]
+        self.schedulers = [LeaderBalanceScheduler(), BalanceRegionScheduler(),
+                           HotRegionScheduler()]
         self.ticks = 0  # guarded_by: _mu
         self.heartbeats_seen = 0  # guarded_by: _mu
         self._next_op_id = 1  # guarded_by: _mu
@@ -276,28 +278,69 @@ class PlacementDriver:
 
     def failover_region(self, region_id: int, bad_store: int,
                         avoid=frozenset()) -> int | None:
-        """Re-place one region off a failed store onto the least-loaded
-        healthy store — the dispatch layer's escape hatch once a store's
-        circuit breaker opens (ref: PD evicting peers off a Down store).
-        Recorded as a finished `failover` operator so /pd/api/v1/operators
-        shows the storm. Returns the target store, or None when every
-        other store is down/avoided (caller backs off and retries)."""
-        from ..util import metrics
+        """Fail one region over off a sick leader store — the dispatch
+        layer's escape hatch once the leader's circuit breaker opens.
+        Since ISSUE 8 the first choice is a LEADER TRANSFER among live
+        peers (ref: raft leadership election after a leader dies: the
+        data is already replicated, no bytes move); a placement move —
+        re-placing the whole peer set, a fresh-snapshot bootstrap — only
+        happens when QUORUM is lost (majority of peers unreachable, or
+        the last proposal failed its quorum ack). Both shapes record an
+        operator so /pd/api/v1/operators shows the storm, and both count
+        `pd_failover_total`; transfers additionally count
+        `pd_transfer_leader_total`. Returns the new leader store, or None
+        when nothing can serve (caller backs off and retries — e.g. the
+        `store/transfer-leader-timeout` failpoint eating the transfer)."""
+        from ..util import failpoint, metrics
 
         if self.cluster.region_by_id(region_id) is None:
             return None
+        peers = self.cluster.peers_of(region_id)
+        down = self.store.down_stores()
+        live = [
+            p for p in peers
+            if p != bad_store and p not in avoid and p not in down
+            and self.store.ping_store(p)
+        ]
+        quorum = len(peers) // 2 + 1
+        counts = self.cluster.counts_per_store()
+        if len(live) >= quorum and self.store.replication.quorum_ok(region_id):
+            if failpoint.eval("store/transfer-leader-timeout"):
+                op = self.new_operator("transfer-leader", region_id,
+                                       source=bad_store, target=live[0])
+                self.queue.retire(op, "timeout", "transfer-leader timed out")
+                metrics.PD_OPERATOR_TIMEOUTS.inc()
+                return None  # caller backs off; a later attempt may land
+            # raft: only an up-to-date peer may win the election — prefer
+            # fully-applied live peers, then least-loaded among them
+            target = self.store.replication.best_transfer_target(
+                region_id, live, counts)
+            if self.cluster.transfer_leader(region_id, target):
+                self.note_store_down(bad_store)
+                op = self.new_operator("transfer-leader", region_id,
+                                       source=bad_store, target=target)
+                self.queue.retire(op, "finished", "breaker failover: leader transfer")
+                metrics.PD_OPERATORS.labels("transfer-leader").inc()
+                metrics.PD_TRANSFER_LEADER.inc()
+                metrics.PD_FAILOVERS.inc()
+                return target
+            # the transfer lost a race (another thread moved leadership
+            # already, or the peer set changed under us): quorum is NOT
+            # lost — let the caller re-route against the fresh topology
+            return None
+        # quorum lost: re-place the whole group on healthy stores
         candidates = [
             s for s in range(self.cluster.n_stores)
             if s != bad_store and s not in avoid and not self.store.store_down(s)
         ]
         if not candidates:
             return None
-        counts = self.cluster.counts_per_store()
         target = min(candidates, key=lambda s: counts.get(s, 0))
-        self.cluster.set_store(region_id, target)
+        self.cluster.re_place(region_id, target,
+                              avoid=set(avoid) | down | {bad_store})
         self.note_store_down(bad_store)
         op = self.new_operator("failover", region_id, source=bad_store, target=target)
-        self.queue.retire(op, "finished", "store failover")
+        self.queue.retire(op, "finished", "quorum lost: placement move")
         metrics.PD_OPERATORS.labels("failover").inc()
         metrics.PD_FAILOVERS.inc()
         return target
@@ -352,6 +395,14 @@ class PlacementDriver:
                 down = self._probe_stores()
                 if psp is not None:
                     psp.set("down_stores", down)
+            with tracing.span("pd.replication") as rsp:
+                # the resolved-ts worker analog: unwedged followers catch
+                # up to their leader's committed watermark here, and the
+                # per-store safe_ts lag gauges refresh
+                repl = getattr(self.store, "replication", None)
+                advanced = repl.catch_up() if repl is not None else 0
+                if rsp is not None:
+                    rsp.set("followers_advanced", advanced)
             with tracing.span("pd.schedule") as ssp:
                 proposed = 0
                 for sched in self.checkers + self.schedulers:
@@ -421,6 +472,8 @@ class PlacementDriver:
                 self._apply_split(op)
             elif op.kind == "merge":
                 self._apply_merge(op)
+            elif op.kind == "transfer-leader":
+                self._apply_transfer_leader(op)
             elif op.kind in ("move-region", "move-hot-region"):
                 self._apply_move(op)
             else:
@@ -455,6 +508,37 @@ class PlacementDriver:
             self.queue.retire(op, "cancelled", "neighbor gone")
             return
         self.queue.retire(op, "finished", f"absorbed={op.peer_region}")
+
+    def _apply_transfer_leader(self, op: Operator) -> None:
+        """Move a region's leadership to a follower peer (ref: pd's
+        transfer-leader operator -> raft TransferLeader). No epoch bump;
+        in-flight cop tasks at the old leader get NotLeader with a hint."""
+        from ..util import failpoint, metrics
+
+        if self.cluster.region_by_id(op.region_id) is None:
+            self.queue.retire(op, "cancelled", "region gone")
+            return
+        if failpoint.eval("store/transfer-leader-timeout"):
+            self.queue.retire(op, "timeout", "transfer-leader timed out")
+            metrics.PD_OPERATOR_TIMEOUTS.inc()
+            return
+        if not self.store.ping_store(op.target):
+            self.queue.retire(op, "cancelled", f"target store {op.target} down")
+            return
+        from ..replication import QUORUM_SAFE_TS_MAX
+
+        repl = getattr(self.store, "replication", None)
+        if repl is not None and repl.safe_ts(
+                op.region_id, op.target) != QUORUM_SAFE_TS_MAX:
+            # raft refuses to elect a peer that has not applied the full
+            # log; retry after the catch-up phase closes the gap
+            self.queue.retire(op, "cancelled", "target apply lags")
+            return
+        if self.cluster.transfer_leader(op.region_id, op.target):
+            metrics.PD_TRANSFER_LEADER.inc()
+            self.queue.retire(op, "finished")
+        else:
+            self.queue.retire(op, "cancelled", "target no longer a follower peer")
 
     def _apply_move(self, op: Operator) -> None:
         if self.cluster.region_by_id(op.region_id) is None:
@@ -502,6 +586,8 @@ class PlacementDriver:
                 "end_key": r.end_key.hex(),
                 "epoch": r.epoch,
                 "store": self.cluster.store_of(r.region_id),
+                "leader": self.cluster.leader_of(r.region_id),
+                "peers": self.cluster.peers_of(r.region_id),
                 "approximate_size": size,
                 "approximate_keys": keys,
             })
@@ -513,9 +599,14 @@ class PlacementDriver:
         board = getattr(self.store, "breakers", None)
         if board is not None:
             breaker_states = board.states()
+        repl = getattr(self.store, "replication", None)
+        lag = repl.lag_view() if repl is not None else {}
+        peer_counts = self.cluster.peer_counts_per_store()
         by_store: dict[int, dict] = {
             s: {"store_id": s, "region_count": 0, "region_size": 0, "region_keys": 0,
                 "hot_read_regions": 0, "hot_write_regions": 0,
+                "leader_count": 0, "peer_count": peer_counts.get(s, 0),
+                "safe_ts_lag": lag.get(s, 0),
                 "state": self.store_state(s),
                 "breaker": breaker_states.get(s, "closed")}
             for s in range(self.cluster.n_stores)
@@ -525,13 +616,19 @@ class PlacementDriver:
         for r in self.cluster.regions():
             sid = self.cluster.store_of(r.region_id)
             st = by_store.setdefault(sid, {"store_id": sid, "region_count": 0, "region_size": 0,
-                                           "region_keys": 0, "hot_read_regions": 0, "hot_write_regions": 0})
+                                           "region_keys": 0, "hot_read_regions": 0, "hot_write_regions": 0,
+                                           "leader_count": 0, "peer_count": 0, "safe_ts_lag": 0})
             size, keys = stats.get(r.region_id, (0, 0))
+            # region_count IS the leader view ("a region lives where it
+            # leads"); leader_count is kept as the replication-explicit
+            # ALIAS below so the two can never diverge
             st["region_count"] += 1
             st["region_size"] += size
             st["region_keys"] += keys
             st["hot_read_regions"] += 1 if r.region_id in hot_r else 0
             st["hot_write_regions"] += 1 if r.region_id in hot_w else 0
+        for st in by_store.values():
+            st["leader_count"] = st["region_count"]
         return [by_store[s] for s in sorted(by_store)]
 
     def hotspot_view(self) -> dict:

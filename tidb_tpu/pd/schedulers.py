@@ -102,6 +102,61 @@ class BalanceRegionScheduler:
         return ops
 
 
+class LeaderBalanceScheduler:
+    """Even LEADER counts across stores by transferring leadership to
+    follower peers on leader-light stores (ref: schedulers/
+    balance_leader.go — leadership moves are cheap, no data moves, so
+    this runs before region moves get considered). Only regions with a
+    follower peer on the destination store are candidates: a transfer
+    must stay within the peer set."""
+
+    name = "leader-balance-scheduler"
+
+    def schedule(self, pd) -> list[Operator]:
+        from ..replication import QUORUM_SAFE_TS_MAX
+
+        cluster = pd.cluster
+        regions = cluster.regions()
+        if cluster.n_stores < 2 or not regions:
+            return []
+        # never balance ONTO a dead store: a down store's leaders failed
+        # over away, so its zero count would otherwise make it the
+        # destination every round and every proposal would cancel at the
+        # apply-time ping (same rationale as _apply_move's guard)
+        live = [s for s in range(cluster.n_stores) if pd.store.ping_store(s)]
+        if len(live) < 2:
+            return []
+        repl = getattr(pd.store, "replication", None)
+        counts = {s: 0 for s in live}
+        by_leader: dict[int, list] = {s: [] for s in live}
+        for r in regions:
+            sid = cluster.leader_of(r.region_id)
+            if sid in counts:
+                counts[sid] = counts.get(sid, 0) + 1
+                by_leader.setdefault(sid, []).append(r)
+        ops = []
+        while len(ops) < pd.conf.ops_per_tick:
+            src = max(counts, key=counts.get)
+            dst = min(counts, key=counts.get)
+            if counts[src] - counts[dst] <= pd.conf.balance_tolerance:
+                break
+            movable = [r for r in by_leader[src]
+                       if dst in cluster.peers_of(r.region_id)
+                       and (repl is None or repl.safe_ts(
+                           r.region_id, dst) == QUORUM_SAFE_TS_MAX)]
+            if not movable:
+                break  # no caught-up peer on the light store
+            region = movable[0]
+            by_leader[src].remove(region)
+            ops.append(pd.new_operator(
+                "transfer-leader", region.region_id, source=src, target=dst,
+                note=f"leaders {counts[src]}->{counts[dst]}",
+            ))
+            counts[src] -= 1
+            counts[dst] += 1
+        return ops
+
+
 class HotRegionScheduler:
     """Move the hottest peer off the most flow-loaded store (ref:
     schedulers/hot_region.go — byte-rate dominant dimension). One

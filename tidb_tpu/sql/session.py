@@ -1356,6 +1356,7 @@ class Session:
                             summary_sink=self._explain_sink,
                             checker=self._runaway_checker(),
                             backoff_weight=self.sysvars.get_int("tidb_backoff_weight"),
+                            replica_read=self.sysvars.get("tidb_replica_read"),
                         )
                         try:
                             chunk = execute_root(
@@ -2637,17 +2638,21 @@ class Session:
                     Datum.string(f"STORE {st['store_id']}"),
                     Datum.string(
                         f"regions={st['region_count']} size={st['region_size']} "
-                        f"keys={st['region_keys']}"
+                        f"keys={st['region_keys']} leaders={st.get('leader_count', 0)} "
+                        f"peers={st.get('peer_count', 0)} "
+                        f"safe_ts_lag={st.get('safe_ts_lag', 0)}"
                     ),
                     Datum.string(
                         f"hot_read={st['hot_read_regions']} hot_write={st['hot_write_regions']}"
                     ),
                 ])
             for r in pd.regions_view():
+                peers = ",".join(str(p) for p in r.get("peers", ()))
                 rows.append([
                     Datum.string(f"REGION {r['region_id']}"),
                     Datum.string(
-                        f"store={r['store']} range=[{r['start_key'][:24]},"
+                        f"store={r['store']} leader={r.get('leader', r['store'])} "
+                        f"peers=[{peers}] range=[{r['start_key'][:24]},"
                         f"{r['end_key'][:24]}) epoch={r['epoch']} "
                         f"size={r['approximate_size']} keys={r['approximate_keys']}"
                     ),

@@ -79,6 +79,11 @@ DEFINITIONS = {
         SysVar("tidb_enable_paging", "OFF", "both", _bool_validator),
         # ref: sysvar.go TiDBAllowBatchCop (regions-per-store batching)
         SysVar("tidb_allow_batch_cop", "OFF", "both", _bool_validator),
+        # ref: sysvar.go TiDBReplicaRead — which peer of a region serves
+        # reads: the leader (default), a follower whose safe_ts covers the
+        # snapshot, or the least-loaded peer ("closest")
+        SysVar("tidb_replica_read", "leader", "both",
+               _enum_validator("leader", "follower", "closest-replica")),
         SysVar("tidb_opt_agg_push_down", "ON", "both", _bool_validator),
         SysVar("autocommit", "ON", "both", _bool_validator),
         # ref: sysvar.go TiDBTxnMode (pessimistic is TiDB's default)

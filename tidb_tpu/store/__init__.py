@@ -4,6 +4,7 @@ from .store import TPUStore, CopRequest, CopResponse, KeyRange
 from .errors import (
     RegionError,
     NotLeader,
+    DataIsNotReady,
     EpochNotMatch,
     RegionNotFound,
     ServerIsBusy,
@@ -13,6 +14,6 @@ from .errors import (
 
 __all__ = [
     "MemKV", "Region", "Cluster", "TPUStore", "CopRequest", "CopResponse", "KeyRange",
-    "RegionError", "NotLeader", "EpochNotMatch", "RegionNotFound", "ServerIsBusy",
-    "StoreUnavailable", "parse_region_error",
+    "RegionError", "NotLeader", "DataIsNotReady", "EpochNotMatch", "RegionNotFound",
+    "ServerIsBusy", "StoreUnavailable", "parse_region_error",
 ]

@@ -31,17 +31,27 @@ class RegionError:
 
 @dataclass(frozen=True)
 class NotLeader(RegionError):
-    """The peer asked is not the region's leader (ref: errorpb.NotLeader;
-    the client refreshes leadership and retries on the updateLeader
-    budget). store_id is the store that rejected the request."""
+    """The peer asked is not the region's leader (ref: errorpb.NotLeader,
+    whose `leader` field names the peer to go to instead; the client
+    switches peers IMMEDIATELY on a usable hint and only burns the
+    updateLeader backoff budget without one). store_id is the store that
+    rejected the request; leader_store the hinted current leader (-1 =
+    unknown/no hint — e.g. an election in flight)."""
 
     store_id: int = -1
+    leader_store: int = -1
     kind: str = "not_leader"
 
     @staticmethod
-    def make(region_id: int, store_id: int) -> "NotLeader":
-        return NotLeader(f"not_leader: region {region_id} store {store_id}",
-                         store_id=store_id)
+    def make(region_id: int, store_id: int, leader_store: int = -1) -> "NotLeader":
+        # leader_store rides the kind-prefixed wire string BEFORE the
+        # rejecting store so `_int_after`'s rfind("store") still finds the
+        # standalone trailing token (old hint-less strings parse as -1)
+        return NotLeader(
+            f"not_leader: region {region_id} leader_store={leader_store} "
+            f"store {store_id}",
+            store_id=store_id, leader_store=leader_store,
+        )
 
 
 @dataclass(frozen=True)
@@ -74,6 +84,26 @@ class ServerIsBusy(RegionError):
         return ServerIsBusy(
             f"server_is_busy: store {store_id} backoff_ms={backoff_ms}",
             backoff_ms=backoff_ms,
+        )
+
+
+@dataclass(frozen=True)
+class DataIsNotReady(RegionError):
+    """A replica read asked a follower whose applied watermark trails the
+    request's snapshot (ref: errorpb.DataIsNotReady raised by TiKV's
+    replica read when `safe_ts < start_ts`; client-go backs off on the
+    maxDataNotReady budget and falls back to the leader)."""
+
+    store_id: int = -1
+    safe_ts: int = -1
+    kind: str = "data_not_ready"
+
+    @staticmethod
+    def make(region_id: int, store_id: int, safe_ts: int) -> "DataIsNotReady":
+        return DataIsNotReady(
+            f"data_is_not_ready: region {region_id} safe_ts={safe_ts} "
+            f"store {store_id}",
+            store_id=store_id, safe_ts=safe_ts,
         )
 
 
@@ -118,8 +148,12 @@ def parse_region_error(message: str | None) -> RegionError | None:
         return None
     m = message.strip()
     low = m.lower()
+    if "data_is_not_ready" in low or "data is not ready" in low:
+        return DataIsNotReady(m, store_id=_int_after(low, "store"),
+                              safe_ts=_int_after(low, "safe_ts="))
     if "not_leader" in low or "not leader" in low:
-        return NotLeader(m, store_id=_int_after(low, "store"))
+        return NotLeader(m, store_id=_int_after(low, "store"),
+                         leader_store=_int_after(low, "leader_store="))
     if "server_is_busy" in low or "server is busy" in low:
         return ServerIsBusy(m, backoff_ms=max(_int_after(low, "backoff_ms="), 0))
     if "store_unavailable" in low or "store unavailable" in low:

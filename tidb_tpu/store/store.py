@@ -63,6 +63,12 @@ class CopRequest:
     aux_chunks: list = field(default_factory=list)
     paging_size: int | None = None
     small_groups: int | None = None  # planner NDV hint (stats-driven)
+    peer_store: int = -1  # the peer the client routed to (-1 = whoever
+    # leads at serve time); a non-leader peer answers NotLeader unless
+    # replica_read (ref: kvrpcpb.Context.peer)
+    replica_read: bool = False  # follower read: a non-leader peer may
+    # serve IF its safe_ts covers start_ts, else DataIsNotReady
+    # (ref: kvrpcpb.Context.replica_read)
 
 
 @dataclass
@@ -123,6 +129,7 @@ class TPUStore:
 
     def __init__(self):
         from ..pd.core import PlacementDriver
+        from ..replication import ReplicaManager
         from .txn import TxnEngine
 
         self.kv = MemKV()
@@ -132,6 +139,10 @@ class TPUStore:
         # the schedulers only act when tick()/timer runs (ref: every
         # TiKV store heartbeats PD whether or not PD is scheduling)
         self.pd = PlacementDriver(self)
+        # the replication overlay: peer sets live on the cluster, per-peer
+        # applied watermarks (safe_ts) live here; every committed write
+        # proposes through it (ISSUE 8)
+        self.replication = ReplicaManager(self)
         self.txn = TxnEngine(self.kv, on_commit=self._bump_write_ver,
                              on_apply=self.record_applied_writes)
         self._tso = itertools.count(100)  # guarded_by: _tso_lock
@@ -268,38 +279,48 @@ class TPUStore:
         with self._cop_lock:
             return self._write_ver
 
-    def _record_write_flow(self, key: bytes, value: bytes | None, prev_live: bool):
+    def _record_write_flow(self, key: bytes, value: bytes | None, prev_live: bool,
+                           ts: int):
         """Per-key write flow into the PD heartbeat snapshot (ref: TiKV's
-        flow observer feeding pdpb.RegionHeartbeat bytes/keys_written)."""
+        flow observer feeding pdpb.RegionHeartbeat bytes/keys_written) +
+        a replication proposal: the write rides the region's raft-lite
+        log, commits on quorum ack, and advances follower safe_ts."""
         self.pd.flow.record_write(key, 0 if value is None else len(value),
                                   prev_live=prev_live, delete=value is None)
+        rid, leader, peers = self.cluster.locate_placement(key)
+        self.replication.propose(rid, ts, placement=(leader, peers))
 
     def record_applied_writes(self, items):
         """Batch write flow for appliers that land many keys at once (2PC
         commit, bulk ingest, LOAD DATA): items of (key, value|None,
         prev_live). Called AFTER the kv critical section so the flow
-        bookkeeping never extends the reader-blocking window."""
+        bookkeeping never extends the reader-blocking window. Each touched
+        region gets ONE replication proposal at the batch's commit
+        watermark (a raft batch-proposal, not per-key entries)."""
         self.pd.flow.record_writes(
             [(k, 0 if v is None else len(v), prev, v is None) for k, v, prev in items]
         )
+        ts = self.kv.max_committed()
+        for rid in self.cluster.regions_of_keys([k for k, _v, _p in items]):
+            self.replication.propose(rid, ts)
 
     # -- write path (ref: table.AddRecord -> memdb -> prewrite/commit) ------
     def put_row(self, table_id: int, handle: int, col_ids: list[int], datums: list[Datum], ts: int):
         key = tablecodec.encode_row_key(table_id, handle)
         val = self._row_encoder.encode(col_ids, datums)
         prev = self.kv.put(key, val, ts)
-        self._record_write_flow(key, val, prev)
+        self._record_write_flow(key, val, prev, ts)
         self._bump_write_ver()
 
     def delete_row(self, table_id: int, handle: int, ts: int):
         key = tablecodec.encode_row_key(table_id, handle)
         prev = self.kv.put(key, None, ts)
-        self._record_write_flow(key, None, prev)
+        self._record_write_flow(key, None, prev, ts)
         self._bump_write_ver()
 
     def put_index(self, key: bytes, value: bytes, ts: int):
         prev = self.kv.put(key, value, ts)
-        self._record_write_flow(key, value, prev)
+        self._record_write_flow(key, value, prev, ts)
         self._bump_write_ver()
 
     # -- scan/decode with caching -------------------------------------------
@@ -580,26 +601,60 @@ class TPUStore:
             while len(self._cop_cache) > self._COP_CACHE_MAX:
                 self._cop_cache.pop(next(iter(self._cop_cache)))
 
-    def _region_fault(self, region_id: int):
-        """The typed fault ladder for one region's placement store: the
-        set_down switch and the three per-store-armable failpoints
+    def _count_replica_read(self, req: CopRequest) -> None:
+        """tidb_tpu_replica_read_total{target=} — one count per routed
+        request (req.peer_store >= 0), marker-deduped because a batch lane
+        can be re-served by the single-request path (singleton groups,
+        overflow fall-outs) after the batch already admitted it. Also
+        feeds the closest-replica router's per-store read load."""
+        if req.peer_store < 0 or getattr(req, "_replica_counted", False):
+            return
+        req._replica_counted = True
+        from ..util import metrics
+
+        target = ("follower"
+                  if req.peer_store != self.cluster.leader_of(req.region_id)
+                  else "leader")
+        metrics.REPLICA_READS.labels(target).inc()
+        self.replication.note_read(req.peer_store)
+
+    def _region_fault(self, region_id: int, peer_store: int = -1,
+                      replica_read: bool = False, start_ts: int = 0):
+        """The typed fault ladder for the peer a request was routed to
+        (`peer_store`; -1 = whoever leads at serve time): the set_down
+        switch and the three per-store-armable failpoints
         (`store/unreachable`, `store/not-leader`, `store/server-busy`) —
         each returns a typed RegionError the dispatch client classifies
-        onto its own backoff budget. None = healthy."""
+        onto its own backoff budget — then the replication checks: a
+        non-leader peer answers NotLeader WITH the current leader as the
+        hint unless the request is a replica read, and a replica read is
+        gated on the peer's applied watermark (`safe_ts >= start_ts`,
+        else DataIsNotReady — ref: TiKV replica read's resolved-ts
+        check). None = this peer serves."""
         from ..util import failpoint
-        from .errors import NotLeader, ServerIsBusy, StoreUnavailable
+        from .errors import DataIsNotReady, NotLeader, ServerIsBusy, StoreUnavailable
 
-        sid = self.cluster.store_of(region_id)
+        leader = self.cluster.leader_of(region_id)
+        sid = peer_store if peer_store >= 0 else leader
         if self.store_down(sid):
             return StoreUnavailable.make(sid)
         if _fault_matches(failpoint.eval("store/unreachable"), sid):
             return StoreUnavailable.make(sid)
         if _fault_matches(failpoint.eval("store/not-leader"), sid):
-            return NotLeader.make(region_id, sid)
+            # injected leadership wobble: the hint is whatever the cluster
+            # currently believes — pointing at the armed store itself
+            # means "election in flight", no usable hint
+            return NotLeader.make(region_id, sid, leader)
         busy = failpoint.eval("store/server-busy")
         if _fault_matches(busy, sid):
             ms = busy.get("backoff_ms", 0) if isinstance(busy, dict) else 0
             return ServerIsBusy.make(sid, ms)
+        if sid != leader:
+            if not replica_read:
+                return NotLeader.make(region_id, sid, leader)
+            safe = self.replication.safe_ts(region_id, sid)
+            if safe < start_ts:
+                return DataIsNotReady.make(region_id, sid, safe)
         return None
 
     # -- the serialized endpoint (the sidecar seam) -------------------------
@@ -640,11 +695,13 @@ class TPUStore:
         region = self.cluster.region_by_id(req.region_id)
         if region is None:
             return CopResponse(region_error=f"region {req.region_id} not found")
-        err = self._region_fault(req.region_id)
+        err = self._region_fault(req.region_id, req.peer_store,
+                                 req.replica_read, req.start_ts)
         if err is not None:
             return CopResponse(region_error=str(err))
         if req.region_epoch != region.epoch:
             return CopResponse(region_error=f"epoch_not_match: have {region.epoch}, got {req.region_epoch}")
+        self._count_replica_read(req)
         cached = self._cop_cache_get(req)
         if cached is not None:
             return cached
@@ -769,7 +826,8 @@ class TPUStore:
                 metrics.COP_ERRORS.inc()
                 responses[i] = CopResponse(region_error=f"region {req.region_id} not found")
                 continue
-            err = self._region_fault(req.region_id)
+            err = self._region_fault(req.region_id, req.peer_store,
+                                     req.replica_read, req.start_ts)
             if err is not None:
                 # typed store faults fall out of the batch exactly like a
                 # stale epoch: the lane answers immediately, the rest of
@@ -786,6 +844,7 @@ class TPUStore:
                     region_error=f"epoch_not_match: have {region.epoch}, got {req.region_epoch}"
                 )
                 continue
+            self._count_replica_read(req)
             cached = self._cop_cache_get(req)
             if cached is not None:
                 metrics.COP_REQUESTS.inc()

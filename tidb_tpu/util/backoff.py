@@ -59,6 +59,9 @@ CONFIGS = {
     "not_leader": BackoffConfig("not_leader", 2, 100),
     "server_busy": BackoffConfig("server_busy", 10, 400),
     "store_unavailable": BackoffConfig("store_unavailable", 10, 400),
+    # follower safe_ts behind start_ts (ref: BoMaxDataNotReady 2/2000);
+    # one short wait, then the client falls back to the leader
+    "data_not_ready": BackoffConfig("data_not_ready", 2, 80),
 }
 
 DEFAULT_BUDGET_MS = 200.0  # per-task; scaled by tidb_backoff_weight

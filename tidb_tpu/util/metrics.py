@@ -254,6 +254,17 @@ class Registry:
             out.append((series, value))
         return out
 
+    def labeled_samples(self, family: str) -> dict:
+        """First-label-value -> numeric sample for one labeled family
+        (e.g. "tidb_tpu_replica_read_total" -> {"leader": 3.0, ...}) —
+        THE shared parser for bench/chaos-style per-label readouts (three
+        call sites used to hand-roll the same sample_lines() split)."""
+        out: dict[str, float] = {}
+        for series, value in self.sample_lines():
+            if series.startswith(family + "{"):
+                out[series.split('"')[1]] = float(value)
+        return out
+
     def reset(self):
         with self._lock:
             self._metrics.clear()
@@ -304,6 +315,17 @@ BREAKER_TRIPS = REGISTRY.counter_vec(
     "tidb_tpu_store_breaker_trips_total", "circuit-breaker open transitions per store",
     labelnames=("store",),
 )
+# region replication (tidb_tpu/replication) — replica reads + safe_ts
+REPLICA_READS = REGISTRY.counter_vec(
+    "tidb_tpu_replica_read_total", "cop tasks served by peer role under tidb_replica_read routing",
+    labelnames=("target",),
+)
+REPLICA_SAFE_TS_LAG = REGISTRY.gauge_vec(
+    "tidb_tpu_replica_safe_ts_lag", "worst follower safe_ts lag behind its leader's committed watermark, per store (ts units)",
+    labelnames=("store",),
+)
+REPLICA_QUORUM_FAILS = REGISTRY.counter(
+    "tidb_tpu_replica_quorum_fail_total", "write proposals that failed to reach quorum ack")
 PROGRAM_COMPILES = REGISTRY.counter("tidb_tpu_program_compiles_total", "fused XLA programs built")
 PROGRAM_LAUNCHES = REGISTRY.counter("tidb_tpu_program_launches_total", "fused XLA program executions dispatched (batched counts once)")
 PROGRAM_CACHE_HITS = REGISTRY.counter("tidb_tpu_program_cache_hits_total", "program-cache hits (compile skipped)")
@@ -339,5 +361,6 @@ PD_STORE_REGIONS = REGISTRY.gauge_vec(
 )
 PD_REGIONS = REGISTRY.gauge("pd_regions", "regions in the cluster")
 PD_PLACEMENT_DECISIONS = REGISTRY.counter("pd_placement_decision_total", "placement-map misses resolved by a PD least-loaded decision")
-PD_FAILOVERS = REGISTRY.counter("pd_failover_total", "regions re-placed onto a healthy store after a store failure")
+PD_FAILOVERS = REGISTRY.counter("pd_failover_total", "regions failed over off a sick store (leader transfer or placement move)")
+PD_TRANSFER_LEADER = REGISTRY.counter("pd_transfer_leader_total", "region leaderships transferred between peers")
 PD_TICK_DURATION = REGISTRY.histogram("pd_tick_seconds", "PD scheduling tick latency")

@@ -42,8 +42,11 @@ FAULT_POINTS = (
     "store/unreachable",
     "store/not-leader",
     "store/server-busy",
+    "store/transfer-leader-timeout",
     "pd/heartbeat-lost",
     "pd/operator-timeout",
+    "replica/apply-lag",
+    "replica/drop-ack",
 )
 
 
@@ -68,6 +71,10 @@ def _fill_session(split_regions: bool):
         s.store.cluster.scatter()
         s.execute("SET tidb_allow_batch_cop = ON")
         s.execute("SET tidb_backoff_weight = 1")
+        # reads ride followers for the whole storm (ISSUE 8): every cop
+        # task routes through the replica selector and the safe_ts gate —
+        # the oracle comparison is what proves the gate never lies
+        s.execute("SET tidb_replica_read = 'follower'")
     return s
 
 
@@ -109,10 +116,23 @@ def default_schedule(n: int) -> dict[int, list[tuple]]:
     def add(i, *action):
         sched.setdefault(i, []).append(tuple(action))
 
-    # phase 1: store 1 drops off the network mid-run (batched dispatch
-    # lanes fall out, breaker opens, PD fails the regions over)
+    # phase 0: a follower's apply loop wedges (replica reads hit the
+    # safe_ts gate -> DataIsNotReady -> leader fallback, zero wrong rows)
+    add(at(0.06), "arm", "replica/apply-lag", {"stores": {3}})
+    add(at(0.12), "disarm", "replica/apply-lag")
+    # phase 1: store 1 — a LEADER KILL — drops off the network mid-run
+    # (batched dispatch lanes fall out, breaker opens, failover is a
+    # leader TRANSFER among the live peers; the first attempts eat a
+    # counted transfer-leader timeout first)
+    add(at(0.15), "arm", "store/transfer-leader-timeout", 2)
     add(at(0.15), "down", 1)
+    # part of the outage runs with LEADER reads: follower routing would
+    # otherwise mask a dead leader entirely (followers keep serving), and
+    # the failover-is-a-transfer assertion needs leader-targeted traffic
+    add(at(0.18), "set", "tidb_replica_read", "leader")
+    add(at(0.24), "set", "tidb_replica_read", "follower")
     add(at(0.28), "up", 1)
+    add(at(0.28), "disarm", "store/transfer-leader-timeout")
     # phase 2: server-busy storm on store 2 (suggested-backoff honored)
     add(at(0.35), "arm", "store/server-busy", {"stores": {2}, "backoff_ms": 3})
     add(at(0.45), "disarm", "store/server-busy")
@@ -132,16 +152,18 @@ def default_schedule(n: int) -> dict[int, list[tuple]]:
     return sched
 
 
-def _apply(actions, store, fp) -> None:
+def _apply(actions, sess, fp) -> None:
     for action in actions:
         if action[0] == "down":
-            store.set_down(action[1])
+            sess.store.set_down(action[1])
         elif action[0] == "up":
-            store.set_up(action[1])
+            sess.store.set_up(action[1])
         elif action[0] == "arm":
             fp.enable(action[1], action[2])
         elif action[0] == "disarm":
             fp.disable(action[1])
+        elif action[0] == "set":
+            sess.execute(f"SET {action[1]} = '{action[2]}'")
 
 
 def run_chaos(seed: int = 7, statements: int = 200, fault_rate: float | None = None,
@@ -166,10 +188,10 @@ def run_chaos(seed: int = 7, statements: int = 200, fault_rate: float | None = N
     def breaker_trips_total() -> float:
         """Sum of the labeled trip counters via the public sampling API
         (never _Vec internals — same rule bench.py follows)."""
-        return sum(
-            float(value) for series, value in metrics.REGISTRY.sample_lines()
-            if series.startswith("tidb_tpu_store_breaker_trips_total{")
-        )
+        return sum(metrics.REGISTRY.labeled_samples(
+            "tidb_tpu_store_breaker_trips_total").values())
+
+    labeled_total = metrics.REGISTRY.labeled_samples
 
     ok = typed = 0
     wrong: list = []
@@ -177,10 +199,13 @@ def run_chaos(seed: int = 7, statements: int = 200, fault_rate: float | None = N
     by_code: dict[int, int] = {}
     lat_ms: list[float] = []
     failovers0 = metrics.PD_FAILOVERS.value
+    transfers0 = metrics.PD_TRANSFER_LEADER.value
+    replica0 = labeled_total("tidb_tpu_replica_read_total")
+    opkinds0 = labeled_total("pd_operator_total")
     trips0 = breaker_trips_total()
     try:
         for i, sql in enumerate(workload):
-            _apply(schedule.get(i, ()), store, fp)
+            _apply(schedule.get(i, ()), s, fp)
             one_shot = fault_rate is not None and rng.random() < fault_rate
             if one_shot:
                 sid = rng.randrange(1, N_STORES)  # store 0 spared: the
@@ -243,6 +268,17 @@ def run_chaos(seed: int = 7, statements: int = 200, fault_rate: float | None = N
         "wrong_results": wrong,
         "untyped_errors": untyped,
         "failovers": int(metrics.PD_FAILOVERS.value - failovers0),
+        "transfer_leaders": int(metrics.PD_TRANSFER_LEADER.value - transfers0),
+        # placement moves during failover happen ONLY on quorum loss; the
+        # default storm never loses quorum (4 stores, 3 replicas, one
+        # down), so this is the acceptance bar's zero
+        "failover_moves": int(labeled_total("pd_operator_total").get("failover", 0)
+                              - opkinds0.get("failover", 0)),
+        "replica_reads": {
+            k: int(labeled_total("tidb_tpu_replica_read_total").get(k, 0)
+                   - replica0.get(k, 0))
+            for k in ("leader", "follower")
+        },
         "breaker_trips": int(breaker_trips_total() - trips0),
         "breakers": {str(k): v for k, v in sorted(store.breakers.states().items())},
         "breakers_all_closed": store.breakers.all_closed(),
