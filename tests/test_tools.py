@@ -165,22 +165,66 @@ def test_backup_restore_views(sess, tmp_path):
     assert s2.execute("SELECT id FROM v_hi ORDER BY id").values() == [[2]]
 
 
-# ------------------------------------------------------- tidb-vet (ISSUE 7)
+# ------------------------------------------------- tidb-vet (ISSUE 7 + 9)
 
 def test_vet_repo_is_clean():
-    """Tier-1 gate: every tidb-vet pass — jit-purity, lock-discipline,
-    error-taxonomy, metrics, wire-parity, failpoints — reports zero
-    findings on the live tree (the fixture corpus in tests/vet_fixtures/
-    proves each pass CAN fire; see tests/test_vet.py)."""
+    """Tier-1 gate: every tidb-vet pass — the lexical families, the
+    interprocedural dataflow passes, the jaxpr auditor and the
+    stale-suppression audit — reports zero findings on the live tree
+    (the fixture corpus in tests/vet_fixtures/ proves each pass CAN
+    fire; see tests/test_vet.py)."""
     from tidb_tpu import analysis
 
     findings = analysis.run_all()
     assert findings == [], "\n".join(f.render() for f in findings)
-    # the suite really covers all six families
+    # the suite really covers all the families (error-taxonomy was
+    # promoted into dataflow-error-escape in ISSUE 9)
     assert set(analysis.PASSES) == {
-        "jit-purity", "lock-discipline", "error-taxonomy",
-        "metrics", "wire-parity", "failpoints",
+        "jit-purity", "lock-discipline", "metrics", "wire-parity",
+        "failpoints", "dataflow-snapshot", "dataflow-backoff",
+        "dataflow-error-escape", "jax-audit",
     }
+    assert analysis.SUPPRESSIONS == "suppressions"
+
+
+def test_vet_baseline_json_roundtrips():
+    """ISSUE 9 satellite: --baseline emits stable sorted JSON that
+    --diff reads back byte-for-byte (the cross-commit diffing seam) —
+    asserted here at the library level; tests/test_vet.py drives the
+    CLI end to end."""
+    import json
+
+    from tidb_tpu import analysis
+
+    findings = analysis.run_all()
+    dicts = [f.to_dict() for f in findings]
+    assert dicts == sorted(dicts, key=lambda d: (d["path"], d["line"], d["pass"]))
+    assert json.loads(json.dumps(dicts)) == dicts
+
+
+def test_load_data_lock_conflict_is_a_sql_error(sess, tmp_path):
+    """Pin for the live finding dataflow-error-escape surfaced (ISSUE 9):
+    LOAD DATA hitting a key held by a live transaction must surface a
+    typed SQLError, not a raw KeyIsLocked engine exception escaping the
+    session boundary."""
+    from tidb_tpu.codec import tablecodec
+    from tidb_tpu.store.txn import KeyIsLocked
+
+    p = tmp_path / "rows.tsv"
+    p.write_text("9\t90\tz\n")
+    meta = sess.catalog.table("t")
+    key = tablecodec.encode_row_key(meta.table_id, 9)
+    lock_ts = sess.store.next_ts()
+    sess.store.txn.prewrite({key: b"\x00"}, key, lock_ts)
+    try:
+        with pytest.raises(SQLError, match="locked"):
+            sess.execute(f"LOAD DATA INFILE '{p}' INTO TABLE t")
+    except KeyIsLocked as exc:  # the pre-fix failure mode, kept loud
+        pytest.fail(f"KeyIsLocked escaped the session boundary: {exc}")
+    finally:
+        sess.store.txn.release_all(lock_ts)
+    # with the lock gone the import succeeds
+    assert sess.execute(f"LOAD DATA INFILE '{p}' INTO TABLE t").affected == 1
 
 
 # ------------------------------------------------------- failpoint_check

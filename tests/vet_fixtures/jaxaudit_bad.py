@@ -1,0 +1,38 @@
+"""jax-audit true positives: an integer program that leaks float64, and
+a builder whose closure captures a mutating Python scalar (every build
+traces a different jaxpr — the ProgramCache multiplies silently)."""
+
+import itertools
+
+import numpy as np
+
+_counter = itertools.count(1)
+
+
+def _args():
+    return [np.arange(8, dtype=np.int64)]
+
+
+def _f64_leak():
+    import jax.numpy as jnp
+
+    def fn(x):
+        # BAD: int64 input promoted to float64 inside the program
+        return (x.astype(jnp.float64) * 1.5).sum()
+
+    return fn, _args()
+
+
+def _closure_scalar():
+    salt = next(_counter)  # BAD: baked into the trace, changes per build
+
+    def fn(x):
+        return x + salt
+
+    return fn, _args()
+
+
+JAX_AUDIT_CATALOG = [
+    {"name": "f64-leak", "make": _f64_leak, "line": 17},
+    {"name": "closure-scalar", "make": _closure_scalar, "line": 27},
+]

@@ -12,6 +12,7 @@ different analyzer by accident.
 from __future__ import annotations
 
 import ast
+import hashlib
 import os
 import re
 from dataclasses import dataclass, field
@@ -49,30 +50,49 @@ class SourceFile:
     lines: list[str] = field(default_factory=list)
     tree: ast.AST | None = None
     parse_error: str | None = None
+    mtime: float = 0.0  # cache-key ingredients: (rel, mtime, sha) identify
+    sha: str = ""  # one analyzed file revision (tidb_tpu/analysis/vetcache.py)
 
     @staticmethod
     def load(path: str, repo: str = REPO) -> "SourceFile":
         rel = os.path.relpath(path, repo)
         try:
             text = open(path, encoding="utf-8").read()
+            mtime = os.stat(path).st_mtime
         except OSError as exc:
             return SourceFile(path, rel, "", [], None, f"unreadable: {exc}")
-        sf = SourceFile(path, rel, text, text.splitlines())
+        sf = SourceFile(path, rel, text, text.splitlines(), mtime=mtime,
+                        sha=hashlib.sha256(text.encode("utf-8")).hexdigest())
         try:
             sf.tree = ast.parse(text, filename=rel)
         except SyntaxError as exc:
             sf.parse_error = f"syntax error: {exc}"
         return sf
 
-    def suppressed(self, line: int, passname: str) -> bool:
-        """True when `line` (or the line above it) carries an inline
-        `# vet: ignore[<pass>]` marker naming this pass."""
+    def suppression_line(self, line: int, passname: str) -> int | None:
+        """Line number of the inline `# vet: ignore[<pass>]` marker
+        covering `line` (the line itself or the one above), or None."""
         for ln in (line, line - 1):
             if 1 <= ln <= len(self.lines):
                 m = _IGNORE.search(self.lines[ln - 1])
                 if m and passname in [p.strip() for p in m.group(1).split(",")]:
-                    return True
-        return False
+                    return ln
+        return None
+
+    def suppressed(self, line: int, passname: str) -> bool:
+        """True when `line` (or the line above it) carries an inline
+        `# vet: ignore[<pass>]` marker naming this pass."""
+        return self.suppression_line(line, passname) is not None
+
+    def ignore_markers(self) -> list[tuple[int, list[str]]]:
+        """Every `# vet: ignore[...]` marker in the file as
+        (line, [passnames]) — the stale-suppression audit's input."""
+        out = []
+        for ln, text in enumerate(self.lines, 1):
+            m = _IGNORE.search(text)
+            if m:
+                out.append((ln, [p.strip() for p in m.group(1).split(",")]))
+        return out
 
 
 def py_files(*rel_paths: str, repo: str = REPO) -> list[str]:
@@ -95,11 +115,19 @@ def load_files(paths) -> list[SourceFile]:
     return [SourceFile.load(p) for p in paths]
 
 
-def filter_suppressed(findings, files_by_rel: dict) -> list:
+def filter_suppressed(findings, files_by_rel: dict, used: set | None = None) -> list:
+    """Drop findings covered by an inline ignore marker. When `used` is
+    given, every marker that actually suppressed something is recorded as
+    (rel, marker_line, passname) — the stale-suppression audit subtracts
+    this set from the universe of markers."""
     out = []
     for f in findings:
         sf = files_by_rel.get(f.path)
-        if sf is not None and sf.suppressed(f.line, f.passname):
-            continue
+        if sf is not None:
+            ln = sf.suppression_line(f.line, f.passname)
+            if ln is not None:
+                if used is not None:
+                    used.add((f.path, ln, f.passname))
+                continue
         out.append(f)
     return out

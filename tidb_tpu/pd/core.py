@@ -483,7 +483,13 @@ class PlacementDriver:
 
     def _split_key(self, region) -> bytes | None:
         """Median live key of the region — the split point (ref: TiKV's
-        size-based SplitCheck picking the approximate middle key)."""
+        size-based SplitCheck picking the approximate middle key).
+
+        The KV_MAX_TS scan is a deliberate latest-version read: split
+        points should reflect CURRENT data, not any statement snapshot.
+        Control-plane only — the dataflow-snapshot vet pass polices
+        latest-version reads on the request path, and this function is
+        outside that cone (tests/test_vet.py pins that)."""
         keys = [k for k, _ in self.store.kv.scan(region.start_key, region.end_key, KV_MAX_TS)]
         if len(keys) < 2:
             return None

@@ -907,21 +907,35 @@ class Session:
             self._persist_schema()
             return r
         if isinstance(stmt, A.LoadDataStmt):
+            from ..store.txn import TxnError
             from ..tools.lightning import load_data
 
             self._implicit_commit()
-            return Result(affected=load_data(self, stmt))
+            # the bulk-ingest lock check raises KeyIsLocked when a live
+            # 2PC holds a conflicting key — map it like every other txn
+            # conflict (vet dataflow-error-escape: this used to reach the
+            # client as a raw Python exception)
+            try:
+                return Result(affected=load_data(self, stmt))
+            except TxnError as exc:
+                raise SQLError(str(exc)) from exc
         if isinstance(stmt, A.BRIEStmt):
+            from ..store.txn import TxnError
             from ..tools import backup, restore
 
             self._implicit_commit()
-            if stmt.kind == "backup":
-                m = backup(self.store, self.catalog, stmt.storage)
-                row = [Datum.string(stmt.storage), Datum.i64(m["total_keys"]), Datum.i64(m["snapshot_ts"])]
-                return Result(columns=["Destination", "Keys", "SnapshotTS"], rows=[row])
-            info = restore(self.store, self.catalog, stmt.storage)
-            row = [Datum.string(stmt.storage), Datum.i64(info["keys"]), Datum.i64(info["tables"])]
-            return Result(columns=["Source", "Keys", "Tables"], rows=[row])
+            try:
+                if stmt.kind == "backup":
+                    m = backup(self.store, self.catalog, stmt.storage)
+                    row = [Datum.string(stmt.storage), Datum.i64(m["total_keys"]), Datum.i64(m["snapshot_ts"])]
+                    return Result(columns=["Destination", "Keys", "SnapshotTS"], rows=[row])
+                info = restore(self.store, self.catalog, stmt.storage)
+                row = [Datum.string(stmt.storage), Datum.i64(info["keys"]), Datum.i64(info["tables"])]
+                return Result(columns=["Source", "Keys", "Tables"], rows=[row])
+            except TxnError as exc:
+                # RESTORE's bulk_ingest hits a held lock: a typed SQL
+                # error, not an engine stack (vet dataflow-error-escape)
+                raise SQLError(str(exc)) from exc
         if isinstance(stmt, A.AlterTableStmt):
             from .ddl import DDLError, alter_table
 

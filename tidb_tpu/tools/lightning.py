@@ -131,6 +131,9 @@ def load_data(session, stmt) -> int:
                 for idx in meta.indices:
                     vals = [datums[pos[cn]] for cn in idx.col_names] + [Datum.i64(handle)]
                     items.append((tablecodec.encode_index_key(meta.table_id, idx.index_id, vals), b"\x00"))
+            # raises KeyIsLocked on a conflict with a live 2PC; the
+            # session's LOAD DATA branch maps it to a SQLError (vet
+            # dataflow-error-escape: it used to escape the boundary raw)
             session.store.txn.check_unlocked([k for k, _ in items])
             applied = [(k, v, session.store.kv.put(k, v, ts)) for k, v in items]
         # PD write flow AFTER the guard: bulk-loaded regions must report

@@ -21,7 +21,12 @@ Sleeps are engineered, not naive:
     (`backoff_ms`) and the `tidb_tpu_backoff_seconds_total{kind=}` counter.
 
 The schedule values are the reference's, scaled to this engine's
-in-process latencies (a TiKV RPC is ~ms; a cop call here is ~µs)."""
+in-process latencies (a TiKV RPC is ~ms; a cop call here is ~µs).
+
+This module is the ONLY sanctioned sleep on a request path: the
+`dataflow-backoff` vet pass (tidb_tpu/analysis/dataflow.py) flags any
+raw `time.sleep` reachable from dispatch, and any unbounded retry loop
+that never consults a Backoffer budget."""
 
 from __future__ import annotations
 

@@ -1,27 +1,34 @@
 """tidb-vet driver — run the repo's static-analysis suite and fail CI on
-any finding (ISSUE 7; the `go vet` / nogo analog for this codebase).
+any finding (ISSUE 7 seeded it; ISSUE 9 added the interprocedural
+dataflow passes, the jaxpr auditor, result caching and baseline diffing;
+the `go vet` / nogo analog for this codebase).
 
 Usage:
-    python tools/vet.py              # human output, exit 1 on findings
-    python tools/vet.py --json       # machine output (diffable across
-                                     # commits: stable path/line/pass keys)
-    python tools/vet.py --only PASS  # one pass (repeatable)
-    python tools/vet.py --files F..  # run every pass over exactly these
-                                     # files (fixture corpora; failpoints
-                                     # checks their arms vs live sites)
-    python tools/vet.py --list       # pass catalog
+    python tools/vet.py                  # human output, exit 1 on findings
+    python tools/vet.py --json           # machine output (stable, sorted —
+                                         # diffable across commits)
+    python tools/vet.py --only PASS      # one pass (repeatable; globs ok:
+                                         # --only 'dataflow-*')
+    python tools/vet.py --files F..      # run every pass over exactly these
+                                         # files (fixture corpora)
+    python tools/vet.py --baseline FILE  # write current findings to FILE
+                                         # (stable sorted JSON), exit 0
+    python tools/vet.py --diff FILE      # compare against a baseline: print
+                                         # {"new": [...], "fixed": [...]},
+                                         # exit 1 only on NEW findings
+    python tools/vet.py --list           # pass catalog
 
-Passes live in tidb_tpu/analysis/ (one module per pass; ANALYZERS.md is
-the human catalog). tools/failpoint_check.py remains the standalone
-entrypoint for the failpoints pass + FAILPOINTS.md generation.
-Suppress a finding with `# vet: ignore[<pass>]` on (or just above) the
-flagged line.
+Passes live in tidb_tpu/analysis/ (ANALYZERS.md is the human catalog).
+Results cache per file revision in .vet_cache.json; suppress a finding
+with `# vet: ignore[<pass>]` on (or just above) the flagged line — the
+`suppressions` pass flags markers that no longer suppress anything.
 
 Run by tier-1 via tests/test_tools.py and tests/test_vet.py.
 """
 
 from __future__ import annotations
 
+import fnmatch
 import json
 import os
 import sys
@@ -29,43 +36,132 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _flag_value(argv: list[str], flag: str) -> str | None:
+    if flag in argv:
+        i = argv.index(flag)
+        if i + 1 < len(argv):
+            return argv[i + 1]
+    return None
+
+
+def _expand_only(argv: list[str], names) -> tuple[list[str], list[str]]:
+    """--only values (repeatable, glob-capable) -> (matched, unknown)."""
+    pats = [argv[i + 1] for i, a in enumerate(argv)
+            if a == "--only" and i + 1 < len(argv)]
+    matched: list[str] = []
+    unknown: list[str] = []
+    for p in pats:
+        hits = [n for n in names if fnmatch.fnmatch(n, p)]
+        if hits:
+            matched.extend(h for h in hits if h not in matched)
+        else:
+            unknown.append(p)
+    return matched, unknown
+
+
+def _diff_key(d: dict) -> tuple:
+    # line-agnostic: pure line drift between commits is not a new finding
+    return (d["path"], d["pass"], d["message"])
+
+
+def _diff_sets(base: list, cur: list) -> tuple[list, list]:
+    """Multiset comparison: a SECOND instance of an identical defect in
+    the same file is a new finding even though its key already exists
+    (a plain set-diff would wave it through the CI gate)."""
+    from collections import Counter
+
+    base_n = Counter(_diff_key(d) for d in base)
+    cur_n = Counter(_diff_key(d) for d in cur)
+    new: list = []
+    seen: Counter = Counter()
+    for d in cur:
+        k = _diff_key(d)
+        seen[k] += 1
+        if seen[k] > base_n.get(k, 0):
+            new.append(d)
+    fixed: list = []
+    seen = Counter()
+    for d in base:
+        k = _diff_key(d)
+        seen[k] += 1
+        if seen[k] > cur_n.get(k, 0):
+            fixed.append(d)
+    return sorted(new, key=_diff_key), sorted(fixed, key=_diff_key)
+
+
 def main(argv: list[str]) -> int:
     from tidb_tpu import analysis
 
     if "--list" in argv:
-        for name, (mod, roots) in analysis.PASSES.items():
-            scope = ", ".join(roots) if roots else "(self-scoped)"
-            print(f"{name:16s} {scope}")
+        for name, spec in analysis.PASSES.items():
+            scope = ", ".join(spec.roots) if spec.roots else "(self-scoped)"
+            print(f"{name:22s} {scope}")
+        print(f"{analysis.SUPPRESSIONS:22s} (stale-marker audit; --only runs the full suite)")
         return 0
-    only = [argv[i + 1] for i, a in enumerate(argv)
-            if a == "--only" and i + 1 < len(argv)]
-    unknown = [p for p in only if p not in analysis.PASSES]
+    only, unknown = _expand_only(
+        argv, list(analysis.PASSES) + [analysis.SUPPRESSIONS])
     if unknown:
         print(f"unknown pass(es): {', '.join(unknown)} — see --list", file=sys.stderr)
         return 2
     if "--files" in argv:
         from tidb_tpu.analysis.common import load_files
 
-        paths = [a for a in argv[argv.index("--files") + 1:] if not a.startswith("--")]
+        # value flags and their arguments are NOT input files — without
+        # this, `--files a.py --baseline out.json` would analyze the
+        # baseline JSON as source
+        consumed: set = set()
+        for flag in ("--baseline", "--diff", "--only"):
+            for i, a in enumerate(argv):
+                if a == flag:
+                    consumed.add(i)
+                    consumed.add(i + 1)
+        paths = [a for i, a in enumerate(argv[argv.index("--files") + 1:],
+                                         argv.index("--files") + 1)
+                 if not a.startswith("--") and i not in consumed]
         files = load_files(os.path.abspath(p) for p in paths)
         findings = []
         for p in (only or list(analysis.PASSES)):
             findings.extend(analysis.run_pass(p, files))
         findings.sort(key=lambda f: (f.path, f.line, f.passname))
+    elif only and analysis.SUPPRESSIONS in only:
+        # the stale-marker audit needs every other pass's verdict: run
+        # the full suite and keep the selected passes' findings
+        keep = set(only)
+        findings = [f for f in analysis.run_all() if f.passname in keep]
     elif only:
-        findings: list = []
-        for p in only:
-            findings.extend(analysis.run_pass(p))
-        findings.sort(key=lambda f: (f.path, f.line, f.passname))
+        findings = analysis.run_only(only)
     else:
         findings = analysis.run_all()
+
+    dicts = [f.to_dict() for f in findings]
+    baseline_path = _flag_value(argv, "--baseline")
+    if baseline_path is not None:
+        with open(baseline_path, "w", encoding="utf-8") as fh:
+            json.dump(dicts, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"baseline: {len(dicts)} finding(s) -> {baseline_path}")
+        return 0
+    diff_path = _flag_value(argv, "--diff")
+    if diff_path is not None:
+        try:
+            base = json.load(open(diff_path, encoding="utf-8"))
+            if not isinstance(base, list):
+                raise ValueError("baseline must be a JSON array of findings")
+        except (OSError, ValueError) as exc:
+            # a missing/corrupt baseline must be distinguishable from
+            # "new findings found" (exit 1) — CI consumers branch on it
+            print(f"unusable baseline {diff_path!r}: {exc}", file=sys.stderr)
+            return 2
+        new, fixed = _diff_sets(base, dicts)
+        print(json.dumps({"new": new, "fixed": fixed}, indent=2, sort_keys=True))
+        return 1 if new else 0
     if "--json" in argv:
-        print(json.dumps([f.to_dict() for f in findings], indent=2))
+        print(json.dumps(dicts, indent=2))
     else:
         for f in findings:
             print(f.render(), file=sys.stderr)
         if not findings:
-            ran = ", ".join(only) if only else ", ".join(analysis.PASSES)
+            ran = ", ".join(only) if only else ", ".join(analysis.ALL_PASS_NAMES)
             print(f"ok: 0 findings ({ran})")
     return 1 if findings else 0
 
