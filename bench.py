@@ -872,11 +872,93 @@ def _replica_main():
     }))
 
 
+def _cdc_main():
+    """BENCH_CDC=1: changefeed throughput (ISSUE 10 satellite) — the
+    standard write mix (INSERT/UPDATE/DELETE over a sharded table) runs
+    with a live memory-sink changefeed; reports events/sec through the
+    pipeline and the p50/p99 resolved-ts lag sampled after each `pd.cdc`
+    tick (ts units — the TSO distance between the newest commit and the
+    emitted frontier). Hermetic CPU: the pipeline is host-side."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    import random
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tidb_tpu.codec import tablecodec
+    from tidb_tpu.cdc import MemorySink
+    from tidb_tpu.sql.session import Session
+
+    n_stores, n_regions, seed_rows = 4, 8, 400
+    n_stmts = int(os.environ.get("BENCH_CDC_STATEMENTS", "300"))
+    tick_every = 10
+    s = Session()
+    s.execute("CREATE TABLE cdc_t (id BIGINT PRIMARY KEY, v BIGINT, g BIGINT)")
+    s.execute("INSERT INTO cdc_t VALUES " + ",".join(
+        f"({i},{(i * 31) % 97},{i % 8})" for i in range(seed_rows)))
+    tid = s.catalog.table("cdc_t").table_id
+    for i in range(1, n_regions):
+        s.store.cluster.split(tablecodec.encode_row_key(tid, i * seed_rows // n_regions))
+    s.store.cluster.set_stores(n_stores)
+    s.store.cluster.scatter()
+    sink = MemorySink()
+    feed = s.store.cdc.create("bench", sink, s.catalog, table_ids={tid}, start_ts=0)
+    s.store.cdc.tick()  # drain the initial scan out of the timed window
+    emitted0 = feed.view(s.store)["emitted"]
+
+    rng = random.Random(17)
+    next_id = seed_rows
+    lags: list[int] = []
+    t0 = time.perf_counter()
+    for i in range(n_stmts):
+        roll = rng.randrange(4)
+        if roll == 0:
+            s.execute(f"INSERT INTO cdc_t VALUES ({next_id},{rng.randrange(97)},{next_id % 8})")
+            next_id += 1
+        elif roll in (1, 2):
+            s.execute(f"UPDATE cdc_t SET v = {rng.randrange(97)} WHERE id = {rng.randrange(next_id)}")
+        else:
+            s.execute(f"DELETE FROM cdc_t WHERE id = {rng.randrange(next_id)}")
+        if (i + 1) % tick_every == 0:
+            s.store.pd.tick()
+            lags.append(feed.view(s.store)["resolved_lag"])
+    s.store.cdc.tick()  # final drain
+    wall = time.perf_counter() - t0
+    lags_sorted = sorted(lags)
+
+    def pct(p: float) -> int:
+        return lags_sorted[min(int(len(lags_sorted) * p), len(lags_sorted) - 1)] if lags_sorted else 0
+
+    v = feed.view(s.store)
+    print(json.dumps({
+        "metric": "cdc_changefeed_throughput",
+        "statements": n_stmts,
+        "regions": n_regions,
+        "stores": n_stores,
+        "wall_s": round(wall, 3),
+        "events_emitted": v["emitted"] - emitted0,
+        "events_per_sec": round((v["emitted"] - emitted0) / max(wall, 1e-9), 1),
+        "statements_per_sec": round(n_stmts / max(wall, 1e-9), 1),
+        "resolved_lag_p50": pct(0.50),
+        "resolved_lag_p99": pct(0.99),
+        "final_lag": v["resolved_lag"],
+        "pending_at_end": v["pending"],
+    }))
+
+
 def main():
     import os
 
     if os.environ.get("BENCH_CPU_ONLY"):
         _cpu_only_main()
+        return
+    if os.environ.get("BENCH_CDC"):
+        _cdc_main()
         return
     if os.environ.get("BENCH_PD_SKEW"):
         _pd_skew_main()

@@ -403,6 +403,44 @@ class TestQuorumWrites:
         assert store.replication.propose(rid, 201)
         assert store.replication.quorum_ok(rid)
 
+    def test_write_refused_on_quorum_loss_then_succeeds(self):
+        """ISSUE 10 satellite (ROADMAP PR-8 follow-on): a write against a
+        quorum-lost region is REFUSED with MySQL 9005 — it no longer
+        stays silently durable on the shared KV — and succeeds as soon
+        as acks resume. The refusal still counts quorum-fail."""
+        s = Session()
+        s.execute("CREATE TABLE qw (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute("INSERT INTO qw VALUES (1, 1)")
+        s.store.cluster.set_stores(4)
+        s.store.cluster.scatter()
+        tid = s.catalog.table("qw").table_id
+        rid = s.store.cluster.locate(tablecodec.encode_row_key(tid, 2)).region_id
+        followers = s.store.cluster.followers_of(rid)
+        q0 = metrics.REPLICA_QUORUM_FAILS.value
+        with failpoint.enabled("replica/drop-ack", set(followers)):
+            with pytest.raises(SQLError) as ei:
+                s.execute("INSERT INTO qw VALUES (2, 2)")
+            assert ei.value.code == 9005
+            assert "quorum_lost" in str(ei.value)
+            # nothing turned durable: the refused row is invisible
+            assert s.execute("SELECT count(*) FROM qw").values() == [[1]]
+        assert metrics.REPLICA_QUORUM_FAILS.value > q0
+        s.execute("INSERT INTO qw VALUES (2, 2)")  # acks resumed
+        assert s.execute("SELECT count(*) FROM qw").values() == [[2]]
+
+    def test_direct_put_refused_on_quorum_loss(self):
+        from tidb_tpu.store import QuorumLostError
+
+        store = fill_store()
+        # arm the drop against the REGION THE WRITE LANDS IN — peer sets
+        # differ per region after scatter
+        rid = store.cluster.locate(tablecodec.encode_row_key(TID, 999)).region_id
+        followers = store.cluster.followers_of(rid)
+        with failpoint.enabled("replica/drop-ack", set(followers)):
+            with pytest.raises(QuorumLostError):
+                store.put_row(TID, 999, [1], [Datum.i64(999)], ts=300)
+        store.put_row(TID, 999, [1], [Datum.i64(999)], ts=301)
+
 
 # ------------------------------------------------------- session surfaces
 

@@ -75,6 +75,17 @@ REQUEST_ROOTS = (
 ESCAPE_EXTRA_ROOTS = (
     ("store/txn.py", "TxnEngine", "commit"),
 )
+# CDC entry points (ISSUE 10 satellite): the SQL changefeed statements,
+# the /cdc/api/v1 handlers and the sink flush loop are request-path
+# roots for the ESCAPE and BACKOFF passes — typed CDC errors must map at
+# the boundary and the flush/recovery loops must never spin or raw-sleep.
+# NOT snapshot roots: the incremental scans read version RANGES
+# (scan_versions), not statement snapshots.
+CDC_ROOTS = (
+    ("sql/session.py", "Session", "_changefeed"),
+    ("server/http_api.py", "StatusServer", "_cdc_route"),
+    ("cdc/hub.py", "ChangefeedHub", "tick"),
+)
 SESSION_BOUNDARIES = (("sql/session.py", "Session", "execute"),)
 
 # directories whose exception classes form the "typed request-path error"
@@ -871,7 +882,7 @@ def _is_time_sleep(call: ast.Call, graph: CallGraph, fi: FuncInfo) -> bool:
 
 def run_backoff(files: list[SourceFile]) -> list:
     graph = graph_for(files)
-    roots = graph.request_roots()
+    roots = graph.request_roots(extra=CDC_ROOTS)
     if not roots:
         return []
     _compute_backoff_consulters(graph)
@@ -921,7 +932,8 @@ class EscapeAnalysis:
         self._sub_memo: dict = {}
         # escape only matters in the cone of the roots and the boundary
         reach = graph.reachable(
-            graph.request_roots(extra=ESCAPE_EXTRA_ROOTS) + graph.boundaries())
+            graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS)
+            + graph.boundaries())
         work = [graph.funcs[q] for q in sorted(reach)]
         rounds = 0
         while work and rounds < 20000:
@@ -1190,7 +1202,7 @@ def _mapped_types(graph: CallGraph, boundary: FuncInfo) -> set:
 
 def run_escape(files: list[SourceFile]) -> list:
     graph = graph_for(files)
-    roots = graph.request_roots(extra=ESCAPE_EXTRA_ROOTS)
+    roots = graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS)
     boundaries = graph.boundaries()
     if not roots and not boundaries:
         return []
@@ -1236,7 +1248,7 @@ def run_escape(files: list[SourceFile]) -> list:
     # reachability must narrow nothing the lexical rule guaranteed)
     for sf in graph.files:
         rel = sf.rel.replace(os.sep, "/")
-        if not any(rel.startswith(f"tidb_tpu/{d}/") for d in ("distsql", "store", "pd")):
+        if not any(rel.startswith(f"tidb_tpu/{d}/") for d in ("distsql", "store", "pd", "cdc")):
             continue
         for node in ast.walk(sf.tree):
             if not (isinstance(node, ast.Raise) and node.exc is not None):

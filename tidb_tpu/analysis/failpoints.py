@@ -48,6 +48,9 @@ DESCRIPTIONS = {
     "ddl_index_delete_only": "pauses online index DDL in the delete-only state so tests can write concurrently",
     "ddl_index_write_only": "pauses online index DDL in the write-only state",
     "ddl_index_write_reorg": "pauses online index DDL in the write-reorg (backfill) state",
+    "cdc/puller-drop": "drops a changefeed's live log deliveries — the span is marked lost and recovered by an incremental scan from the checkpoint at the next tick (the TiCDC re-subscribe path); nothing is lost, only late",
+    "cdc/resolved-stuck": "pins every changefeed's resolved-ts watermarks — the frontier stops advancing (and the checkpoint with it) until disarmed; emission stays gated so downstream still only sees complete prefixes",
+    "cdc/sink-stall": "skips a tick's sink emission — the sorter keeps the backlog and the emitted checkpoint holds until the stall clears",
     "pd/heartbeat-lost": "drops one tick's region-heartbeat interval on the floor (a lost heartbeat stream)",
     "pd/operator-timeout": "force-expires every pending PD operator at the next tick's dispatch phase",
     "replica/apply-lag": "wedges armed follower stores' apply loop — their safe_ts stops advancing, so replica reads at newer snapshots answer DataIsNotReady until disarmed (per-store arming)",

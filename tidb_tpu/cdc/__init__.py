@@ -1,0 +1,14 @@
+"""Change data capture (ISSUE 10): the TiCDC-analog changefeed
+subsystem — puller over the replication log, commit-ts sorter,
+resolved-ts frontier, rowcodec mounter, pluggable sinks."""
+
+from .events import RowEvent
+from .hub import Changefeed, ChangefeedError, ChangefeedHub, WriteGuard
+from .mounter import Mounter
+from .sink import FileSink, MemorySink, SessionReplaySink, Sink, SinkError, open_sink
+
+__all__ = [
+    "RowEvent", "Changefeed", "ChangefeedError", "ChangefeedHub", "WriteGuard",
+    "Mounter", "FileSink", "MemorySink", "SessionReplaySink", "Sink",
+    "SinkError", "open_sink",
+]

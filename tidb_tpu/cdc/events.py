@@ -1,0 +1,41 @@
+"""Changefeed event shapes (ref: TiCDC's model.RowChangedEvent — the
+mounted, typed form of one row's change — and model.ResolvedTs).
+
+A raw change enters the subsystem as a (key, value|None, commit_ts)
+triple riding a replication proposal; the mounter decodes it back into a
+`RowEvent` with the table's typed column values. Resolved timestamps are
+not events in the sorter — they are the frontier the sink's `flush`
+receives once every row at or below it has been emitted."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class RowEvent:
+    """One row's change, decoded (ref: model.RowChangedEvent). `columns`
+    is ((name, Datum), ...) in table column order — empty for deletes
+    (the reference also omits new-values on delete; the old value is the
+    downstream's to look up if it cares)."""
+
+    table: str
+    table_id: int
+    handle: int
+    op: str  # "put" | "delete"
+    commit_ts: int
+    columns: tuple = field(default=())
+
+    def to_json(self) -> dict:
+        """JSON-lines shape for the file sink (ref: TiCDC's canal-json /
+        simple protocol: type + commit ts + column map)."""
+        return {
+            "type": "row",
+            "table": self.table,
+            "handle": self.handle,
+            "op": self.op,
+            "commit_ts": self.commit_ts,
+            "columns": {
+                name: (None if d.is_null() else d.val) for name, d in self.columns
+            },
+        }

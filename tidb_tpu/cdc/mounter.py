@@ -1,0 +1,69 @@
+"""The mounter: raw KV change -> typed row event (ref: TiCDC's
+cdc/entry/mounter.go — it decodes the raft-log value bytes back into
+column datums against the current schema snapshot).
+
+Only RECORD keys mount (`t{tid}_r{handle}`): index entries are derived
+data the downstream rebuilds itself, and non-table keyspaces (the
+m-prefix schema metadata) are not row changes — both return None and the
+caller counts them as skipped. Partitioned tables mount through the
+partition's physical id back to the LOGICAL table meta, exactly like the
+reference resolves PartitionDefinition.ID -> TableInfo."""
+
+from __future__ import annotations
+
+import threading
+
+from ..codec import tablecodec
+from ..codec.rowcodec import decode_row_to_datum_map, fill_origin_default
+from .events import RowEvent
+
+
+class Mounter:
+    """Decodes change values against a catalog snapshot. The pid->meta
+    map rebuilds whenever the catalog version moves (DDL between events:
+    rows mount against the CURRENT schema, the reference's behavior for
+    a changefeed without a schema-tracker snapshot)."""
+
+    def __init__(self, catalog):
+        self.catalog = catalog
+        self._mu = threading.Lock()
+        self._by_pid: dict = {}  # physical table id -> TableMeta; guarded_by: _mu
+        self._cat_version = -1  # guarded_by: _mu
+
+    def _meta_for(self, pid: int):
+        with self._mu:
+            if self._cat_version != self.catalog.version:
+                by_pid: dict = {}
+                for name in self.catalog.tables():
+                    try:
+                        meta = self.catalog.table(name)
+                    except Exception:  # noqa: BLE001 — a racing DROP TABLE
+                        continue  # must not kill the mount loop
+                    for p in meta.physical_ids():
+                        by_pid[p] = meta
+                self._by_pid = by_pid
+                self._cat_version = self.catalog.version
+            return self._by_pid.get(pid)
+
+    def mount(self, key: bytes, value: bytes | None, commit_ts: int) -> RowEvent | None:
+        """One raw change -> RowEvent, or None when the key is not a row
+        of a known table (index entry, meta keyspace, dropped table)."""
+        try:
+            pid, handle = tablecodec.decode_row_key(key)
+        except ValueError:
+            return None  # index/meta key: derived data, the caller skips
+        meta = self._meta_for(pid)
+        if meta is None:
+            return None
+        if value is None:
+            return RowEvent(meta.name, meta.table_id, handle, "delete", commit_ts)
+        fts_by_id = {c.col_id: c.ft for c in meta.columns}
+        try:
+            dmap = decode_row_to_datum_map(value, fts_by_id)
+            cols = tuple(
+                (c.name, fill_origin_default(value, c.col_id, c.origin_default, dmap[c.col_id]))
+                for c in meta.columns
+            )
+        except Exception:  # noqa: BLE001 — an undecodable value (schema
+            return None  # drifted under the row) skips, never wedges the feed
+        return RowEvent(meta.name, meta.table_id, handle, "put", commit_ts, cols)

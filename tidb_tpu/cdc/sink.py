@@ -1,0 +1,164 @@
+"""Changefeed sinks (ref: TiCDC's cdc/sink — MQ/blackhole/MySQL sinks
+behind one interface). Three concrete sinks:
+
+  MemorySink         buffered events + resolved marks (tests, SHOW-style
+                     introspection; the blackhole sink with a memory)
+  FileSink           JSON-lines under a directory, one file per
+                     changefeed (the storage sink analog; resolved marks
+                     interleave so a consumer can cut complete prefixes)
+  SessionReplaySink  applies the stream into a SECOND cluster through
+                     its store write path (the MySQL-sink analog; the
+                     mirror-equality oracle rides this one)
+
+The contract every sink honors: `write(events)` receives rows in
+(commit_ts, key) order, all at or below the NEXT `flush(resolved_ts)` —
+a flushed resolved ts promises the downstream holds a transactionally
+complete prefix of the source."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+class SinkError(RuntimeError):
+    """A sink rejected the stream (unknown downstream table, closed
+    file): the changefeed parks in the `error` state with this message."""
+
+
+def open_sink(uri: str, name: str):
+    """Sink from a sink-uri (ref: TiCDC's --sink-uri schemes). Supported:
+    `memory://` and `file://<dir>` (empty dir -> ./cdc-output). The
+    session-replay sink needs a live target cluster and is registered via
+    the hub API, not a URI."""
+    scheme, _, rest = uri.partition("://")
+    scheme = scheme.lower()
+    if scheme == "memory":
+        return MemorySink()
+    if scheme == "file":
+        return FileSink(rest or "cdc-output", name)
+    raise SinkError(
+        f"unsupported sink uri {uri!r} (memory:// | file://<dir>; "
+        f"session-replay sinks attach via the changefeed API)")
+
+
+class Sink:
+    def write(self, events: list) -> None:
+        raise NotImplementedError
+
+    def flush(self, resolved_ts: int) -> None:
+        """All events at or below `resolved_ts` are written: make them
+        durable/visible downstream."""
+
+    def close(self) -> None:
+        pass
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+class MemorySink(Sink):
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.events: list = []  # guarded_by: _mu
+        self.resolved: list = []  # flush watermarks, in order; guarded_by: _mu
+
+    def write(self, events: list) -> None:
+        with self._mu:
+            self.events.extend(events)
+
+    def flush(self, resolved_ts: int) -> None:
+        with self._mu:
+            self.resolved.append(resolved_ts)
+
+    def rows(self) -> list:
+        with self._mu:
+            return list(self.events)
+
+    def resolved_view(self) -> list:
+        with self._mu:
+            return list(self.resolved)
+
+    def describe(self) -> str:
+        return "memory://"
+
+
+class FileSink(Sink):
+    """JSON lines: one `{"type":"row",...}` per event, one
+    `{"type":"resolved","ts":N}` per flush. Append-only — a restarted
+    consumer replays from the last resolved mark it trusts."""
+
+    def __init__(self, directory: str, name: str):
+        self.path = os.path.join(directory, f"{name}.jsonl")
+        os.makedirs(directory, exist_ok=True)
+        self._mu = threading.Lock()
+        self._f = open(self.path, "a", encoding="utf-8")  # guarded_by: _mu
+
+    def write(self, events: list) -> None:
+        with self._mu:
+            for ev in events:
+                self._f.write(json.dumps(ev.to_json(), default=str) + "\n")
+
+    def flush(self, resolved_ts: int) -> None:
+        with self._mu:
+            self._f.write(json.dumps({"type": "resolved", "ts": resolved_ts}) + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._mu:
+            self._f.close()
+
+    def describe(self) -> str:
+        return f"file://{self.path}"
+
+
+class SessionReplaySink(Sink):
+    """Replays the stream into a second cluster through its store write
+    path (rows only — the downstream's schema owns its indexes; create
+    the mirror's tables without secondary indexes or rebuild them after).
+    `flush` fast-forwards the mirror's TSO past the resolved frontier so
+    a fresh mirror snapshot sees the complete replayed prefix.
+
+    Delivery after a sink failure is AT-LEAST-ONCE from the last
+    checkpoint (the reference's contract — TiCDC re-sends on recovery),
+    so this sink is idempotent by (key, commit_ts): a version the mirror
+    already holds at or past the event's ts is skipped, exactly like the
+    MySQL sink's REPLACE-by-commit-ts semantics."""
+
+    def __init__(self, session):
+        self.session = session
+
+    def write(self, events: list) -> None:
+        from ..codec import tablecodec
+        from ..sql.catalog import CatalogError
+        from ..types import Datum
+
+        catalog = self.session.catalog
+        store = self.session.store
+        for ev in events:
+            try:
+                meta = catalog.table(ev.table)
+            except CatalogError as exc:
+                raise SinkError(f"replay: no downstream table for {ev.table!r}") from exc
+            if ev.op == "delete":
+                # the row's partition is value-dependent and deletes carry
+                # no values: tombstone the handle in every physical id
+                # (over-deleting is sound — absent keys tombstone to absent)
+                for pid in meta.physical_ids():
+                    key = tablecodec.encode_row_key(pid, ev.handle)
+                    if store.kv.latest_ts(key) < ev.commit_ts:
+                        store.delete_row(pid, ev.handle, ev.commit_ts)
+                continue
+            by_name = dict(ev.columns)
+            datums = [by_name.get(c.name, Datum.NULL) for c in meta.columns]
+            pid = meta.pid_for_row(datums)
+            key = tablecodec.encode_row_key(pid, ev.handle)
+            if store.kv.latest_ts(key) < ev.commit_ts:  # redelivery dedupe
+                store.put_row(pid, ev.handle, meta.col_ids(), datums, ev.commit_ts)
+
+    def flush(self, resolved_ts: int) -> None:
+        self.session.store.advance_tso(resolved_ts)
+
+    def describe(self) -> str:
+        return "session-replay://"

@@ -606,6 +606,19 @@ class TraceStmt:
 
 
 @dataclass
+class ChangefeedStmt:
+    """CREATE/PAUSE/RESUME/DROP CHANGEFEED (ref: TiCDC's `cdc cli
+    changefeed create --sink-uri=... --start-ts=...`, SQL-ified the way
+    the reference SQL-ifies BR as BACKUP/RESTORE)."""
+
+    action: str  # create | pause | resume | drop
+    name: str
+    sink_uri: str = ""
+    tables: list = field(default_factory=list)  # [TableName]; empty = all
+    options: dict = field(default_factory=dict)  # WITH k = v (start_ts, ...)
+
+
+@dataclass
 class CollateExpr(ExprNode):
     """expr COLLATE collation_name (ref: parser.y SimpleExpr collate)."""
 

@@ -332,6 +332,12 @@ class Parser:
                 if fmt not in ("row", "json"):
                     raise ParseError(f"TRACE FORMAT {fmt!r} not supported (row|json)")
             return A.TraceStmt(self.statement(), fmt)
+        if kw in ("PAUSE", "RESUME"):
+            # PAUSE/RESUME CHANGEFEED name (ref: TiCDC changefeed
+            # pause/resume, SQL-ified like BACKUP/RESTORE)
+            self.next()
+            self.expect_kw("CHANGEFEED")
+            return A.ChangefeedStmt(kw.lower(), self.ident())
         if kw == "FLASHBACK":
             self.next()
             self.expect_kw("TABLE")
@@ -1548,6 +1554,37 @@ class Parser:
             while self.eat_op(","):
                 users.append(self.user_spec(with_password=True))
             return A.CreateUserStmt(users, ine)
+        if self.eat_kw("CHANGEFEED"):
+            # CREATE CHANGEFEED name INTO 'sink-uri'
+            #   [FOR TABLE t1, t2] [WITH start_ts = N, ...]
+            name = self.ident()
+            self.expect_kw("INTO")
+            uri_tok = self.next()
+            if uri_tok.kind is not T.STRING:
+                raise ParseError("CREATE CHANGEFEED ... INTO expects a sink-uri string")
+            tables = []
+            if self.eat_kw("FOR"):
+                self.expect_kw("TABLE")
+                tables.append(self.table_name())
+                while self.eat_op(","):
+                    tables.append(self.table_name())
+            opts = {}
+            if self.eat_kw("WITH"):
+                while True:
+                    k = self.ident().lower()
+                    v = True
+                    if self.eat_op("="):
+                        t = self.next()
+                        # only INTEGRAL numbers coerce; '1.5' stays a
+                        # string so the session rejects it with a typed
+                        # SQLError instead of a raw int() ValueError
+                        v = (int(t.text)
+                             if t.kind is T.NUMBER and t.text.lstrip("-").isdigit()
+                             else t.text)
+                    opts[k] = v
+                    if not self.eat_op(","):
+                        break
+            return A.ChangefeedStmt("create", name, uri_tok.text, tables, opts)
         if self.eat_kw("PLACEMENT"):
             self.expect_kw("POLICY")
             if self.eat_kw("IF"):
@@ -2045,6 +2082,8 @@ class Parser:
             while self.eat_op(","):
                 users.append(self.user_spec()[:2])
             return A.DropUserStmt(users, True)
+        if self.eat_kw("CHANGEFEED"):
+            return A.ChangefeedStmt("drop", self.ident())
         if self.eat_kw("PLACEMENT"):
             self.expect_kw("POLICY")
             if self.eat_kw("IF"):
@@ -2523,6 +2562,16 @@ class Parser:
             s.kind = "stats_meta"
         elif self.eat_kw("STATS_HISTOGRAMS"):
             s.kind = "stats_histograms"
+        elif self.eat_kw("CHANGEFEEDS", "CHANGEFEED"):
+            # SHOW CHANGEFEEDS (ref: TiCDC `changefeed list`); the
+            # singular form with a name filters to exactly that feed —
+            # LIKE metacharacters in the name are escaped so `my_feed`
+            # never wildcard-matches `myxfeed` (review finding)
+            s.kind = "changefeeds"
+            if self.peek().kind in (T.IDENT, T.QIDENT) and not self.at_kw("LIKE", "WHERE"):
+                name = self.ident()
+                s.pattern = (name.replace("\\", "\\\\")
+                             .replace("%", "\\%").replace("_", "\\_"))
         elif self.eat_kw("PLACEMENT"):
             # SHOW PLACEMENT [LABELS] (ref: the reference's SHOW PLACEMENT;
             # ours reports the PD's region->store map + scheduling state)

@@ -403,6 +403,14 @@ class PlacementDriver:
                 advanced = repl.catch_up() if repl is not None else 0
                 if rsp is not None:
                     rsp.set("followers_advanced", advanced)
+            with tracing.span("pd.cdc") as csp:
+                # the changefeed frontier driver (ISSUE 10): each feed
+                # recovers lost spans, advances its resolved-ts, drains
+                # the sorter up to the frontier, and flushes its sink
+                hub = getattr(self.store, "cdc", None)
+                emitted = hub.tick() if hub is not None else 0
+                if csp is not None:
+                    csp.set("events_emitted", emitted)
             with tracing.span("pd.schedule") as ssp:
                 proposed = 0
                 for sched in self.checkers + self.schedulers:

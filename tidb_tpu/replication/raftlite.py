@@ -109,14 +109,18 @@ class ReplicaManager:
         return g
 
     def propose(self, region_id: int, ts: int,
-                placement: tuple | None = None) -> bool:
+                placement: tuple | None = None,
+                entries: list | None = None) -> bool:
         """One committed write batch against `region_id` at `ts`: append
         to the leader's log, collect follower acks, commit on quorum, and
         advance every non-lagging follower's applied watermark (the
         common case applies synchronously — healthy raft on a fast LAN).
         `placement` is an optional pre-fetched (leader, peers) snapshot
         (the per-key write path already looked it up — don't take the
-        cluster lock again). Returns False when quorum was NOT reached
+        cluster lock again). `entries` is the batch's change payload —
+        [(key, value|None)] — handed to the CDC hub AFTER the group state
+        settles (the changefeed puller rides this log exactly like TiCDC
+        rides the raft log). Returns False when quorum was NOT reached
         (the write is still durable on the shared KV; the flag is what
         failover consults)."""
         from ..util import metrics
@@ -155,7 +159,43 @@ class ReplicaManager:
             g.quorum_ok = acks >= quorum
             if not g.quorum_ok:
                 metrics.REPLICA_QUORUM_FAILS.inc()
-            return g.quorum_ok
+            ok = g.quorum_ok
+        # CDC delivery OUTSIDE _mu (lock order: the hub's feed locks are
+        # leaves; a subscriber must never nest inside replication state)
+        if entries:
+            hub = getattr(self.store, "cdc", None)
+            if hub is not None:
+                hub.on_proposal(region_id, ts, entries)
+        return ok
+
+    def check_write_quorum(self, region_id: int,
+                           placement: tuple | None = None) -> None:
+        """Live quorum roll call BEFORE a write applies (ROADMAP PR-8
+        follow-on: a write against a quorum-lost region must be REFUSED,
+        not silently durable on the shared KV). Same roll call the PD
+        tick's catch-up takes: the leader always acks its own append; a
+        follower whose ack the `replica/drop-ack` failpoint drops is a
+        partitioned peer. Raises the typed QuorumLostError (MySQL 9005 at
+        the session boundary) and keeps the quorum-fail counter honest —
+        a refused write is still a failed proposal attempt."""
+        from ..store.errors import QuorumLostError
+        from ..util import metrics
+
+        if placement is not None:
+            leader, peers = placement
+        else:
+            leader, peers = self.cluster.placement_of(region_id)
+        followers = [p for p in peers if p != leader]
+        quorum = len(peers) // 2 + 1
+        acks = 1 + sum(1 for f in followers if not self._ack_dropped(f))
+        if acks >= quorum:
+            return
+        metrics.REPLICA_QUORUM_FAILS.inc()
+        with self._mu:
+            g = self._groups.get(region_id)
+            if g is not None:
+                g.quorum_ok = False  # failover consults the latched flag
+        raise QuorumLostError(region_id, acks, quorum)
 
     def safe_ts(self, region_id: int, store_id: int) -> int:
         """The watermark `store_id` may serve reads at for `region_id`

@@ -16,10 +16,13 @@ pkg/server/handler/tikvhandler — docs/tidb_http_api.md):
   GET /pd/api/v1/stores                PD view: per-store region/hot counts
   GET /pd/api/v1/hotspot               PD view: hot read/write peers
   GET /pd/api/v1/operators             PD view: pending + recent operators
+  GET /cdc/api/v1/changefeeds          changefeed list (state, frontier)
+  GET /cdc/api/v1/changefeeds/{name}   one changefeed's detail
 
 The /pd/api/v1 prefix mirrors the reference PD's HTTP API (pd
-server/api/router.go), served here from the same status port since the
-PD is embedded in the store process.
+server/api/router.go) and /cdc/api/v1 mirrors TiCDC's open API — both
+served from this status port since PD and CDC are embedded in the store
+process.
 
 Runs on its own port next to the MySQL protocol listener, like the
 reference's status server. JSON bodies except /metrics; 404 with a
@@ -157,6 +160,8 @@ class StatusServer:
                 "prometheus": metrics.REGISTRY.dump(),
                 "samples": dict(metrics.REGISTRY.sample_lines()),
             }
+        if len(parts) >= 4 and parts[:3] == ["cdc", "api", "v1"]:
+            return self._cdc_route(parts[3:])
         if len(parts) == 4 and parts[:3] == ["pd", "api", "v1"]:
             pd = getattr(s.store, "pd", None)
             if pd is None:
@@ -197,3 +202,18 @@ class StatusServer:
                 return 404, {"error": "no MVCC versions for that handle"}
             return 200, {"handle": h, "versions": out}
         return 404, {"error": f"unknown path {path!r} (see docs/tidb_http_api.md routes)"}
+
+    def _cdc_route(self, parts: list):
+        """/cdc/api/v1/changefeeds[/{name}] (ref: TiCDC's open API
+        api/v1/changefeeds — list + detail). A registered vet
+        request-path root: CDC state reads must stay typed and total."""
+        hub = getattr(self.session.store, "cdc", None)
+        if hub is None or parts[0] != "changefeeds":
+            return 404, {"error": "unknown cdc route (changefeeds)"}
+        views = hub.views()
+        if len(parts) == 1:
+            return 200, views
+        for v in views:
+            if v["name"] == parts[1]:
+                return 200, v
+        return 404, {"error": f"changefeed {parts[1]!r} not found"}

@@ -25,7 +25,7 @@ from ..expr.eval_ref import RefEvaluator, _truth
 from ..expr.ir import col
 from ..parser import ast as A
 from ..parser.parser import parse_one
-from ..store import TPUStore
+from ..store import QuorumLostError, TPUStore
 from ..types import Datum, DatumKind, FieldType, MyDecimal, MyTime, new_longlong
 from .catalog import Catalog, CatalogError, TableMeta
 from .planner import PlanError, _Lowerer, _Scope, _TableRef, _coerce_datum, plan_select
@@ -492,6 +492,11 @@ class Session:
         except TxnError as exc:
             self.store.txn.release_all(txn.start_ts)
             raise SQLError(str(exc)) from exc
+        except QuorumLostError:
+            # a quorum-lost region refused the commit before anything
+            # applied: drop the locks and let execute() map it to 9005
+            self.store.txn.release_all(txn.start_ts)
+            raise
         # non-mutated pessimistic locks (SELECT FOR UPDATE) release now
         self.store.txn.release_all(txn.start_ts)
         # planner row-count stats apply only once the txn is durable
@@ -623,6 +628,10 @@ class Session:
                 # every backoff budget spent / every store unhealthy:
                 # MySQL 9005 (ref: errno.ErrRegionUnavailable), not a bare
                 # RuntimeError that reads like an engine bug
+                raise SQLError(f"Region is unavailable: {exc}", code=9005) from exc
+            if isinstance(exc, QuorumLostError):
+                # a write refused on quorum loss (ROADMAP PR-8 follow-on):
+                # the same 9005 the read path's exhausted budgets surface
                 raise SQLError(f"Region is unavailable: {exc}", code=9005) from exc
             if isinstance(exc, CopInternalError):
                 raise SQLError(str(exc), code=1105) from exc
@@ -979,6 +988,8 @@ class Session:
                 except Exception as exc:  # noqa: BLE001
                     raise SQLError(f"load stats: {exc}") from exc
             return Result()
+        if isinstance(stmt, A.ChangefeedStmt):
+            return self._changefeed(stmt)
         if isinstance(stmt, A.AdminStmt):
             return self._admin(stmt)
         if isinstance(stmt, A.AnalyzeTableStmt):
@@ -990,6 +1001,54 @@ class Session:
         if isinstance(stmt, A.TraceStmt):
             return self._trace(stmt)
         raise SQLError(f"statement {type(stmt).__name__} not supported yet")
+
+    def _changefeed(self, stmt: A.ChangefeedStmt) -> Result:
+        """CREATE/PAUSE/RESUME/DROP CHANGEFEED (ref: TiCDC's changefeed
+        lifecycle, SQL-ified like BACKUP/RESTORE). A registered vet
+        request-path root: typed CDC errors must surface as SQLError."""
+        from ..cdc import ChangefeedError, SinkError
+
+        hub = self.store.cdc
+        try:
+            if stmt.action == "create":
+                table_ids = None
+                if stmt.tables:
+                    ids = set()
+                    for t in stmt.tables:
+                        try:
+                            meta = self.catalog.table(t.name)
+                        except CatalogError as exc:
+                            raise SQLError(str(exc)) from exc
+                        ids.add(meta.table_id)
+                        ids.update(meta.physical_ids())
+                    table_ids = ids
+                unknown = set(stmt.options) - {"start_ts"}
+                if unknown:
+                    # a typo'd option silently changing behavior is worse
+                    # than an error (TiCDC rejects unknown options too)
+                    raise SQLError(
+                        f"unknown changefeed option(s) {sorted(unknown)}; "
+                        f"supported: start_ts")
+                raw_ts = stmt.options.get("start_ts", 0)
+                if isinstance(raw_ts, bool) or not isinstance(raw_ts, int):
+                    # a valueless `WITH start_ts` parses as True; a quoted
+                    # value as str — both must be typed errors, not a raw
+                    # ValueError escaping the boundary (review finding)
+                    raise SQLError(
+                        f"changefeed start_ts must be an integer TSO, got {raw_ts!r}")
+                hub.create(stmt.name, stmt.sink_uri, self.catalog,
+                           table_ids=table_ids, start_ts=raw_ts)
+            elif stmt.action == "pause":
+                hub.pause(stmt.name)
+            elif stmt.action == "resume":
+                hub.resume(stmt.name)
+            elif stmt.action == "drop":
+                hub.drop(stmt.name)
+            else:
+                raise SQLError(f"unknown changefeed action {stmt.action!r}")
+        except (ChangefeedError, SinkError) as exc:
+            raise SQLError(str(exc)) from exc
+        return Result()
 
     def _trace(self, stmt: A.TraceStmt) -> Result:
         """TRACE [FORMAT='row'|'json'] <stmt> (ref: executor/trace.go
@@ -1105,7 +1164,10 @@ class Session:
         if privs.is_super(self.user):
             return
         kind = type(stmt).__name__
-        if kind in ("GrantStmt", "RevokeStmt", "CreateUserStmt", "DropUserStmt", "BRIEStmt"):
+        if kind in ("GrantStmt", "RevokeStmt", "CreateUserStmt", "DropUserStmt",
+                    "BRIEStmt", "ChangefeedStmt"):
+            # changefeed admin follows BR: cluster-level replication is a
+            # SUPER-only surface (ref: TiCDC requiring admin credentials)
             raise SQLError(f"access denied: {self.user!r} needs SUPER")
         if kind == "LoadDataStmt":
             if not privs.check(self.user, "insert", stmt.table.name, db=self.db):
@@ -2673,6 +2735,25 @@ class Session:
                     Datum.string(pd.scheduling_state(r["region_id"])),
                 ])
             return Result(columns=["Target", "Placement", "Scheduling_State"], rows=rows)
+        if kind == "changefeeds":
+            # SHOW CHANGEFEEDS (ref: TiCDC `cli changefeed list`): one row
+            # per feed with its state, frontier, and emission counts
+            rows = []
+            for v in self.store.cdc.views():
+                if not _show_like(stmt, v["name"]):
+                    continue
+                rows.append([
+                    Datum.string(v["name"]), Datum.string(v["state"]),
+                    Datum.string(v["sink"]), Datum.i64(v["start_ts"]),
+                    Datum.i64(v["checkpoint_ts"]), Datum.i64(v["resolved_lag"]),
+                    Datum.i64(v["pending"]), Datum.i64(v["emitted"]),
+                    Datum.i64(v["skipped"]), Datum.string(v["error"]),
+                ])
+            return Result(
+                columns=["Changefeed", "State", "Sink", "Start_ts", "Checkpoint_ts",
+                         "Resolved_lag", "Pending", "Emitted", "Skipped", "Error"],
+                rows=rows,
+            )
         if kind == "status":
             from ..util import metrics
 

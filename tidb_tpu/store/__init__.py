@@ -7,6 +7,8 @@ from .errors import (
     DataIsNotReady,
     EpochNotMatch,
     RegionNotFound,
+    QuorumLost,
+    QuorumLostError,
     ServerIsBusy,
     StoreUnavailable,
     parse_region_error,
@@ -15,5 +17,6 @@ from .errors import (
 __all__ = [
     "MemKV", "Region", "Cluster", "TPUStore", "CopRequest", "CopResponse", "KeyRange",
     "RegionError", "NotLeader", "DataIsNotReady", "EpochNotMatch", "RegionNotFound",
-    "ServerIsBusy", "StoreUnavailable", "parse_region_error",
+    "QuorumLost", "QuorumLostError", "ServerIsBusy", "StoreUnavailable",
+    "parse_region_error",
 ]

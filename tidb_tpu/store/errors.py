@@ -123,6 +123,35 @@ class StoreUnavailable(RegionError):
                                 store_id=store_id)
 
 
+@dataclass(frozen=True)
+class QuorumLost(RegionError):
+    """The region's write quorum is gone — a majority of peers cannot ack
+    (ref: a raft group without a quorum accepts no proposals; TiKV answers
+    Propose errors until a majority returns). Unlike the read-side errors
+    above this one is raised on the WRITE path: the store refuses the
+    write instead of letting it stay silently durable on the shared KV
+    (ROADMAP PR-8 follow-on)."""
+
+    store_id: int = -1
+    kind: str = "quorum_lost"
+
+    @staticmethod
+    def make(region_id: int, acks: int, needed: int) -> "QuorumLost":
+        return QuorumLost(
+            f"quorum_lost: region {region_id} acks={acks} needed={needed}",
+        )
+
+
+class QuorumLostError(RuntimeError):
+    """Exception shape of QuorumLost for the write path (the read path
+    carries region errors as response values; writes raise). The session
+    boundary maps it to MySQL 9005 ErrRegionUnavailable."""
+
+    def __init__(self, region_id: int, acks: int, needed: int):
+        super().__init__(str(QuorumLost.make(region_id, acks, needed)))
+        self.region_id, self.acks, self.needed = region_id, acks, needed
+
+
 def _int_after(s: str, token: str, default: int = -1) -> int:
     i = s.rfind(token)
     if i < 0:
@@ -158,6 +187,8 @@ def parse_region_error(message: str | None) -> RegionError | None:
         return ServerIsBusy(m, backoff_ms=max(_int_after(low, "backoff_ms="), 0))
     if "store_unavailable" in low or "store unavailable" in low:
         return StoreUnavailable(m, store_id=_int_after(low, "store"))
+    if "quorum_lost" in low or "quorum lost" in low:
+        return QuorumLost(m)
     if "epoch_not_match" in low or "epoch not match" in low:
         return EpochNotMatch(m)
     if "not found" in low:

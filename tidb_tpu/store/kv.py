@@ -84,6 +84,27 @@ class MemKV:
                 i += 1
         return iter(out)
 
+    def scan_versions(self, start: bytes, end: bytes, lo_ts: int, hi_ts: int):
+        """Every committed version of keys in [start, end) with
+        lo_ts < commit_ts <= hi_ts, as (key, commit_ts, value|None) in key
+        order — the CDC incremental scan (ref: TiCDC's kv client scanning
+        the range from checkpoint-ts when a region subscription (re)opens;
+        tombstones ride along so deletes replay downstream). One
+        consistent cut: materialized under the lock."""
+        out = []
+        with self.lock:
+            self._ensure_sorted()
+            i = bisect.bisect_left(self._keys, start)
+            while i < len(self._keys):
+                k = self._keys[i]
+                if k >= end:
+                    break
+                for vts, val in self._data.get(k, ()):
+                    if lo_ts < vts <= hi_ts:
+                        out.append((k, vts, val))
+                i += 1
+        return out
+
     def gc(self, safepoint: int) -> int:
         """MVCC garbage collection at `safepoint`: per key, keep every
         version newer than the safepoint plus the newest one at-or-below

@@ -143,8 +143,16 @@ class TPUStore:
         # applied watermarks (safe_ts) live here; every committed write
         # proposes through it (ISSUE 8)
         self.replication = ReplicaManager(self)
+        # change data capture (ISSUE 10): the hub subscribes to every
+        # replication proposal; its WriteGuard brackets the write paths
+        # so the resolved-ts frontier can prove quiescence
+        from ..cdc import ChangefeedHub
+
+        self.cdc = ChangefeedHub(self)
         self.txn = TxnEngine(self.kv, on_commit=self._bump_write_ver,
-                             on_apply=self.record_applied_writes)
+                             on_apply=self.record_applied_writes,
+                             pre_apply=self._check_write_quorum,
+                             write_guard=self.cdc.guard.writing)
         self._tso = itertools.count(100)  # guarded_by: _tso_lock
         self._tso_lock = threading.Lock()
         self._active_snapshots: dict[int, int] = {}  # guarded_by: _tso_lock
@@ -228,6 +236,14 @@ class TPUStore:
         with self._tso_lock:
             return next(self._tso)
 
+    def advance_tso(self, ts: int) -> None:
+        """Fast-forward the TSO past `ts` (the CDC replay sink's
+        downstream clock sync: a mirror snapshot at a fresh TSO must see
+        every replayed version at or below the source's resolved
+        frontier). A no-op when the clock is already ahead."""
+        with self._tso_lock:
+            self._tso = itertools.count(max(next(self._tso), ts + 1))
+
     def register_snapshot(self, start_ts: int) -> None:
         """An open transaction pins its snapshot: GC never collects at or
         above the oldest registered start_ts (ref: the reference's
@@ -280,47 +296,76 @@ class TPUStore:
             return self._write_ver
 
     def _record_write_flow(self, key: bytes, value: bytes | None, prev_live: bool,
-                           ts: int):
+                           ts: int, placement: tuple | None = None):
         """Per-key write flow into the PD heartbeat snapshot (ref: TiKV's
         flow observer feeding pdpb.RegionHeartbeat bytes/keys_written) +
-        a replication proposal: the write rides the region's raft-lite
-        log, commits on quorum ack, and advances follower safe_ts."""
+        a replication proposal carrying the change entry: the write rides
+        the region's raft-lite log, commits on quorum ack, advances
+        follower safe_ts, and feeds any subscribed changefeed."""
         self.pd.flow.record_write(key, 0 if value is None else len(value),
                                   prev_live=prev_live, delete=value is None)
-        rid, leader, peers = self.cluster.locate_placement(key)
-        self.replication.propose(rid, ts, placement=(leader, peers))
+        if placement is None:
+            placement = self.cluster.locate_placement(key)
+        rid, leader, peers = placement
+        self.replication.propose(rid, ts, placement=(leader, peers),
+                                 entries=[(key, value)])
 
-    def record_applied_writes(self, items):
+    def record_applied_writes(self, items, ts: int | None = None):
         """Batch write flow for appliers that land many keys at once (2PC
         commit, bulk ingest, LOAD DATA): items of (key, value|None,
         prev_live). Called AFTER the kv critical section so the flow
         bookkeeping never extends the reader-blocking window. Each touched
-        region gets ONE replication proposal at the batch's commit
-        watermark (a raft batch-proposal, not per-key entries)."""
+        region gets ONE replication proposal at the batch's commit ts
+        (a raft batch-proposal, not per-key entries) carrying exactly its
+        own keys' changes — the CDC puller sees the log sharded the way
+        the raft log is. `ts` defaults to the store commit watermark for
+        legacy callers; batch appliers pass their actual commit_ts so
+        events never wear a concurrent commit's timestamp."""
         self.pd.flow.record_writes(
             [(k, 0 if v is None else len(v), prev, v is None) for k, v, prev in items]
         )
-        ts = self.kv.max_committed()
-        for rid in self.cluster.regions_of_keys([k for k, _v, _p in items]):
-            self.replication.propose(rid, ts)
+        if ts is None:
+            ts = self.kv.max_committed()
+        values = {k: v for k, v, _prev in items}
+        for rid, keys in self.cluster.group_keys_by_region(list(values)).items():
+            self.replication.propose(rid, ts,
+                                     entries=[(k, values[k]) for k in keys])
+
+    def _check_write_quorum(self, keys) -> None:
+        """The pre-apply write gate (ROADMAP PR-8 follow-on): every
+        region a write touches must hold quorum, else the whole write is
+        refused with a typed QuorumLostError (MySQL 9005 at the session
+        boundary) BEFORE anything turns durable on the shared KV. One
+        cluster-lock acquisition fetches every placement."""
+        for rid, placement in self.cluster.placements_of_keys(keys).items():
+            self.replication.check_write_quorum(rid, placement=placement)
 
     # -- write path (ref: table.AddRecord -> memdb -> prewrite/commit) ------
     def put_row(self, table_id: int, handle: int, col_ids: list[int], datums: list[Datum], ts: int):
         key = tablecodec.encode_row_key(table_id, handle)
         val = self._row_encoder.encode(col_ids, datums)
-        prev = self.kv.put(key, val, ts)
-        self._record_write_flow(key, val, prev, ts)
+        placement = self.cluster.locate_placement(key)
+        self.replication.check_write_quorum(placement[0], placement=placement[1:])
+        with self.cdc.guard.writing():
+            prev = self.kv.put(key, val, ts)
+            self._record_write_flow(key, val, prev, ts, placement=placement)
         self._bump_write_ver()
 
     def delete_row(self, table_id: int, handle: int, ts: int):
         key = tablecodec.encode_row_key(table_id, handle)
-        prev = self.kv.put(key, None, ts)
-        self._record_write_flow(key, None, prev, ts)
+        placement = self.cluster.locate_placement(key)
+        self.replication.check_write_quorum(placement[0], placement=placement[1:])
+        with self.cdc.guard.writing():
+            prev = self.kv.put(key, None, ts)
+            self._record_write_flow(key, None, prev, ts, placement=placement)
         self._bump_write_ver()
 
     def put_index(self, key: bytes, value: bytes, ts: int):
-        prev = self.kv.put(key, value, ts)
-        self._record_write_flow(key, value, prev, ts)
+        placement = self.cluster.locate_placement(key)
+        self.replication.check_write_quorum(placement[0], placement=placement[1:])
+        with self.cdc.guard.writing():
+            prev = self.kv.put(key, value, ts)
+            self._record_write_flow(key, value, prev, ts, placement=placement)
         self._bump_write_ver()
 
     # -- scan/decode with caching -------------------------------------------

@@ -26,7 +26,7 @@ Failure semantics match Percolator where observable in-process:
 from __future__ import annotations
 
 import threading
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 from dataclasses import dataclass
 
 from .kv import MemKV
@@ -63,13 +63,23 @@ class Lock:
 
 
 class TxnEngine:
-    def __init__(self, kv: MemKV, on_commit=None, on_apply=None):
+    def __init__(self, kv: MemKV, on_commit=None, on_apply=None,
+                 pre_apply=None, write_guard=None):
         self.kv = kv
         self.locks: dict[bytes, Lock] = {}  # guarded_by: _mu
         self._mu = threading.RLock()
         self._on_commit = on_commit  # store cache-invalidation hook
-        self._on_apply = on_apply  # batch hook: [(key, value|None, prev_live)]
-        # called AFTER the kv critical section (PD write flow)
+        self._on_apply = on_apply  # batch hook: ([(key, value|None,
+        # prev_live)], commit_ts) called AFTER the kv critical section
+        # (PD write flow + replication proposal + CDC delivery)
+        self._pre_apply = pre_apply  # keys hook BEFORE any apply: may raise
+        # (the store's write-quorum gate — a refused commit applies nothing)
+        self._write_guard = write_guard  # zero-arg ctx factory wrapping
+        # [commit-ts draw .. change delivery]: the CDC resolved-ts sampler
+        # treats the window as an in-flight write (cdc/hub.py WriteGuard)
+
+    def _guard(self):
+        return self._write_guard() if self._write_guard is not None else nullcontext()
 
     # ------------------------------------------------------------------
     def acquire_pessimistic(self, keys: list, primary: bytes, start_ts: int, for_update_ts: int):
@@ -113,26 +123,33 @@ class TxnEngine:
         whole apply is visible — snapshot isolation without the reference's
         lock-wait/resolve read path. Returns the commit_ts used."""
         applied = []
-        with self._mu:
-            staged = []
-            for k in keys:
-                l = self.locks.get(k)
-                if l is None or l.start_ts != start_ts:
-                    raise TxnError(f"lock not found for commit (txn {start_ts})")
-                if l.op != "prewrite":
-                    raise TxnError("commit before prewrite (pessimistic lock not converted)")
-                staged.append((k, l))
-            with self.kv.lock:  # readers see all of the commit or none
-                if callable(commit_ts):
-                    commit_ts = commit_ts()
-                for k, l in staged:
-                    v = None if l.is_delete else l.value
-                    prev = self.kv.put(k, v, commit_ts)
-                    del self.locks[k]
-                    applied.append((k, v, prev))
-        if self._on_apply is not None and applied:
-            self._on_apply(applied)  # outside the locks — flow bookkeeping
-            # must never extend the window in which readers are blocked
+        with self._guard():  # entered BEFORE the commit ts is drawn
+            with self._mu:
+                staged = []
+                for k in keys:
+                    l = self.locks.get(k)
+                    if l is None or l.start_ts != start_ts:
+                        raise TxnError(f"lock not found for commit (txn {start_ts})")
+                    if l.op != "prewrite":
+                        raise TxnError("commit before prewrite (pessimistic lock not converted)")
+                    staged.append((k, l))
+                if self._pre_apply is not None and staged:
+                    # the write-quorum gate: raises BEFORE anything applies,
+                    # so a quorum-lost region refuses the whole commit (the
+                    # caller's locks stay put for its rollback path)
+                    self._pre_apply([k for k, _ in staged])
+                with self.kv.lock:  # readers see all of the commit or none
+                    if callable(commit_ts):
+                        commit_ts = commit_ts()
+                    for k, l in staged:
+                        v = None if l.is_delete else l.value
+                        prev = self.kv.put(k, v, commit_ts)
+                        del self.locks[k]
+                        applied.append((k, v, prev))
+            if self._on_apply is not None and applied:
+                self._on_apply(applied, commit_ts)  # outside the locks —
+                # flow bookkeeping must never extend the window in which
+                # readers are blocked
         if self._on_commit is not None and staged:
             self._on_commit()
         return commit_ts
@@ -193,9 +210,12 @@ class TxnEngine:
         no value-level duplicate checks needed; LOAD DATA wraps its whole
         check+apply in ingest_guard instead)."""
         applied = []
-        with self.ingest_guard():
-            self.check_unlocked([k for k, _ in items])
-            for k, v in items:
-                applied.append((k, v, self.kv.put(k, v, ts)))
-        if self._on_apply is not None and applied:
-            self._on_apply(applied)
+        with self._guard():
+            with self.ingest_guard():
+                self.check_unlocked([k for k, _ in items])
+                if self._pre_apply is not None and items:
+                    self._pre_apply([k for k, _ in items])
+                for k, v in items:
+                    applied.append((k, v, self.kv.put(k, v, ts)))
+            if self._on_apply is not None and applied:
+                self._on_apply(applied, ts)

@@ -228,27 +228,33 @@ def restore(store, catalog, src_dir: str) -> dict:
         ids += [p["pid"] for p in (t.get("partition") or {}).get("parts", [])]
         max_id = max(max_id, *ids)
     catalog.ensure_id_above(max_id)
-    ts = store.next_ts()
     n = 0
-    for seg in manifest["segments"]:
-        data = open(os.path.join(src_dir, seg["file"]), "rb").read()
-        if hashlib.sha256(data).hexdigest() != seg["sha256"]:
-            raise ValueError(f"restore: checksum mismatch in {seg['file']}")
-        pos = 0
-        batch = []
-        for _ in range(seg["keys"]):
-            (klen,) = struct.unpack_from("<I", data, pos)
-            pos += 4
-            key = data[pos : pos + klen]
-            pos += klen
-            (vlen,) = struct.unpack_from("<I", data, pos)
-            pos += 4
-            val = data[pos : pos + vlen]
-            pos += vlen
-            batch.append((bytes(key), bytes(val)))
-        # restore must not overwrite keys locked by an in-flight 2PC:
-        # lock-check + apply in one engine critical section (ADVICE r2)
-        store.txn.bulk_ingest(batch, ts)
-        n += len(batch)
+    # the restore ts is drawn INSIDE the CDC WriteGuard window so the
+    # resolved-ts sampler counts the whole restore as an in-flight write:
+    # a frontier candidate can never pass the restore ts before its
+    # change events are delivered (the guard nests fine around
+    # bulk_ingest's own writing() bracket — it is a plain counter)
+    with store.cdc.guard.writing():
+        ts = store.next_ts()
+        for seg in manifest["segments"]:
+            data = open(os.path.join(src_dir, seg["file"]), "rb").read()
+            if hashlib.sha256(data).hexdigest() != seg["sha256"]:
+                raise ValueError(f"restore: checksum mismatch in {seg['file']}")
+            pos = 0
+            batch = []
+            for _ in range(seg["keys"]):
+                (klen,) = struct.unpack_from("<I", data, pos)
+                pos += 4
+                key = data[pos : pos + klen]
+                pos += klen
+                (vlen,) = struct.unpack_from("<I", data, pos)
+                pos += 4
+                val = data[pos : pos + vlen]
+                pos += vlen
+                batch.append((bytes(key), bytes(val)))
+            # restore must not overwrite keys locked by an in-flight 2PC:
+            # lock-check + apply in one engine critical section (ADVICE r2)
+            store.txn.bulk_ingest(batch, ts)
+            n += len(batch)
     store._bump_write_ver()
     return {"tables": len(manifest["schema"]), "keys": n, "snapshot_ts": manifest["snapshot_ts"]}

@@ -95,50 +95,59 @@ def load_data(session, stmt) -> int:
         # writes — runs in one engine critical section, so no concurrent
         # commit can land between the unique scan and the apply (ADVICE r2;
         # review r3: the read_ts-before-lock window allowed duplicates)
-        with session.store.txn.ingest_guard():
-            ts = session.store.next_ts()
-            read_ts = session.store.next_ts()
-            # ALL conflict checks before ANY write: a mid-batch duplicate
-            # must not leave half a batch durable below the checkpoint
-            # (re-running would then collide with the crashed run's rows)
-            seen_pk: set = set()
-            seen_uk: set = set()
-            for handle, datums in batch_rows:
-                if handle in seen_pk:
-                    raise SQLError(f"LOAD DATA: duplicate primary key {handle} within the file")
-                seen_pk.add(handle)
-                key = tablecodec.encode_row_key(meta.pid_for_row(datums), handle)
-                if session.store.kv.get(key, read_ts) is not None:
-                    raise SQLError(f"LOAD DATA: duplicate primary key {handle}")
-                for idx in uniq_idxs:
-                    vals = [datums[pos[cn]] for cn in idx.col_names]
-                    if any(d.is_null() for d in vals):
-                        continue
-                    prefix = tablecodec.encode_index_key(meta.table_id, idx.index_id, vals)
-                    if (idx.index_id, prefix) in seen_uk:
-                        raise SQLError(f"LOAD DATA: duplicate entry for unique key {idx.name!r} within the file")
-                    seen_uk.add((idx.index_id, prefix))
-                    if next(iter(session.store.kv.scan(prefix, prefix + b"\xff", read_ts)), None) is not None:
-                        raise SQLError(f"LOAD DATA: duplicate entry for unique key {idx.name!r}")
-            items = []
-            for handle, datums in batch_rows:
-                items.append((
-                    # partition-aware key routing (partitioned tables store
-                    # rows under their PartitionDef pid)
-                    tablecodec.encode_row_key(meta.pid_for_row(datums), handle),
-                    session.store._row_encoder.encode(meta.col_ids(), datums),
-                ))
-                for idx in meta.indices:
-                    vals = [datums[pos[cn]] for cn in idx.col_names] + [Datum.i64(handle)]
-                    items.append((tablecodec.encode_index_key(meta.table_id, idx.index_id, vals), b"\x00"))
-            # raises KeyIsLocked on a conflict with a live 2PC; the
-            # session's LOAD DATA branch maps it to a SQLError (vet
-            # dataflow-error-escape: it used to escape the boundary raw)
-            session.store.txn.check_unlocked([k for k, _ in items])
-            applied = [(k, v, session.store.kv.put(k, v, ts)) for k, v in items]
-        # PD write flow AFTER the guard: bulk-loaded regions must report
-        # their size/keys or the merge-checker sees them as empty
-        session.store.record_applied_writes(applied)
+        # the CDC WriteGuard brackets [ts draw .. record_applied_writes]
+        # so a changefeed's resolved-ts sampler counts the batch as in
+        # flight until its change events are delivered
+        with session.store.cdc.guard.writing():
+            with session.store.txn.ingest_guard():
+                ts = session.store.next_ts()
+                read_ts = session.store.next_ts()
+                # ALL conflict checks before ANY write: a mid-batch duplicate
+                # must not leave half a batch durable below the checkpoint
+                # (re-running would then collide with the crashed run's rows)
+                seen_pk: set = set()
+                seen_uk: set = set()
+                for handle, datums in batch_rows:
+                    if handle in seen_pk:
+                        raise SQLError(f"LOAD DATA: duplicate primary key {handle} within the file")
+                    seen_pk.add(handle)
+                    key = tablecodec.encode_row_key(meta.pid_for_row(datums), handle)
+                    if session.store.kv.get(key, read_ts) is not None:
+                        raise SQLError(f"LOAD DATA: duplicate primary key {handle}")
+                    for idx in uniq_idxs:
+                        vals = [datums[pos[cn]] for cn in idx.col_names]
+                        if any(d.is_null() for d in vals):
+                            continue
+                        prefix = tablecodec.encode_index_key(meta.table_id, idx.index_id, vals)
+                        if (idx.index_id, prefix) in seen_uk:
+                            raise SQLError(f"LOAD DATA: duplicate entry for unique key {idx.name!r} within the file")
+                        seen_uk.add((idx.index_id, prefix))
+                        if next(iter(session.store.kv.scan(prefix, prefix + b"\xff", read_ts)), None) is not None:
+                            raise SQLError(f"LOAD DATA: duplicate entry for unique key {idx.name!r}")
+                items = []
+                for handle, datums in batch_rows:
+                    items.append((
+                        # partition-aware key routing (partitioned tables store
+                        # rows under their PartitionDef pid)
+                        tablecodec.encode_row_key(meta.pid_for_row(datums), handle),
+                        session.store._row_encoder.encode(meta.col_ids(), datums),
+                    ))
+                    for idx in meta.indices:
+                        vals = [datums[pos[cn]] for cn in idx.col_names] + [Datum.i64(handle)]
+                        items.append((tablecodec.encode_index_key(meta.table_id, idx.index_id, vals), b"\x00"))
+                # raises KeyIsLocked on a conflict with a live 2PC; the
+                # session's LOAD DATA branch maps it to a SQLError (vet
+                # dataflow-error-escape: it used to escape the boundary raw)
+                session.store.txn.check_unlocked([k for k, _ in items])
+                # quorum-lost regions refuse bulk writes too (PR-8 follow-on);
+                # raises BEFORE anything turns durable
+                session.store._check_write_quorum([k for k, _ in items])
+                applied = [(k, v, session.store.kv.put(k, v, ts)) for k, v in items]
+            # PD write flow AFTER the engine guard (bulk-loaded regions
+            # must report their size/keys or the merge-checker sees them
+            # as empty) but INSIDE the write window: the replication
+            # proposal carries this batch's change events at its real ts
+            session.store.record_applied_writes(applied, ts)
         session.store._bump_write_ver()
         # stats track per durable batch (a later failed batch must not
         # leave committed rows uncounted)

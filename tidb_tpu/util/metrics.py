@@ -342,6 +342,24 @@ OPEN_TXNS = REGISTRY.gauge("tidb_tpu_open_txns", "transactions currently open")
 NATIVE_DECODES = REGISTRY.counter("tidb_tpu_native_decode_batches_total", "region batches decoded by the C++ rowcodec")
 NATIVE_DECODE_FALLBACKS = REGISTRY.counter("tidb_tpu_native_decode_fallbacks_total", "native decode errors served by the python decoder")
 
+# change data capture (tidb_tpu/cdc) — the TiCDC-analog changefeed
+# families (ref: ticdc_* metrics: puller/sorter event counts, the
+# checkpoint/resolved lag gauges, sink flush histograms)
+CDC_EVENTS = REGISTRY.counter(
+    "tidb_tpu_cdc_events_total", "raw change entries captured from the replication log (live + recovery scans)")
+CDC_EVENTS_EMITTED = REGISTRY.counter(
+    "tidb_tpu_cdc_events_emitted_total", "mounted row events emitted to changefeed sinks")
+CDC_EVENTS_SKIPPED = REGISTRY.counter(
+    "tidb_tpu_cdc_events_skipped_total", "captured entries skipped at mount (index entries, meta keys, unknown tables)")
+CDC_RESOLVED_LAG = REGISTRY.gauge_vec(
+    "tidb_tpu_cdc_resolved_ts_lag", "latest commit watermark minus the changefeed's emitted resolved frontier (ts units)",
+    labelnames=("changefeed",),
+)
+CDC_SINK_FLUSH = REGISTRY.histogram(
+    "tidb_tpu_cdc_sink_flush_seconds", "sink write+flush latency per changefeed tick")
+CDC_RECOVERY_SCANS = REGISTRY.counter(
+    "tidb_tpu_cdc_recovery_scans_total", "incremental re-scans after a lost subscription, pause resume, or changefeed birth")
+
 # placement driver (tidb_tpu/pd) — its own pd_ namespace, like the
 # reference PD process exposing pd_scheduler_*/pd_hotspot_* families
 PD_REGION_HEARTBEATS = REGISTRY.counter("pd_region_heartbeat_total", "region heartbeat snapshots absorbed by the PD")

@@ -47,6 +47,9 @@ FAULT_POINTS = (
     "pd/operator-timeout",
     "replica/apply-lag",
     "replica/drop-ack",
+    "cdc/puller-drop",
+    "cdc/resolved-stuck",
+    "cdc/sink-stall",
 )
 
 
@@ -288,9 +291,231 @@ def run_chaos(seed: int = 7, statements: int = 200, fault_rate: float | None = N
     }
 
 
+# ------------------------------------------------------- the CDC storm phase
+# (ISSUE 10 acceptance: a live changefeed replays into a second cluster
+# while the storm throws splits, merges, leader transfers, an outage,
+# apply-lag and the cdc/* failpoints at it; at the end the mirror must be
+# scan-identical to the source, the resolved frontier monotone, and every
+# key's events in commit order with no duplicates)
+
+
+class CheckingSink:
+    """Ordering oracle wrapped around the replay sink: per-key commit_ts
+    strictly increasing, no (key, commit_ts) duplicates, every row above
+    the last flushed resolved ts, the resolved marks themselves monotone
+    — the changefeed consistency contract, checked at the sink seam."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.last_by_key: dict = {}
+        self.resolved = 0
+        self.events = 0
+        self.violations: list = []
+
+    def write(self, events):
+        for ev in events:
+            k = (ev.table, ev.handle)
+            if ev.commit_ts <= self.resolved:
+                self.violations.append(
+                    f"event {k} at {ev.commit_ts} at/below flushed resolved {self.resolved}")
+            last = self.last_by_key.get(k, 0)
+            if ev.commit_ts <= last:
+                self.violations.append(
+                    f"per-key order broken: {k} at {ev.commit_ts} after {last}")
+            self.last_by_key[k] = ev.commit_ts
+            self.events += 1
+        self.inner.write(events)
+
+    def flush(self, resolved_ts):
+        if resolved_ts < self.resolved:
+            self.violations.append(
+                f"resolved regressed: {resolved_ts} < {self.resolved}")
+        self.resolved = resolved_ts
+        self.inner.flush(resolved_ts)
+
+    def close(self):
+        self.inner.close()
+
+    def describe(self):
+        return f"checking({self.inner.describe()})"
+
+
+def build_cdc_workload(seed: int, n: int) -> list[str]:
+    """Mixed DML + reads: the write mix the changefeed must capture, the
+    read mix that keeps the fault machinery (replica reads, breakers,
+    batched cop) busy underneath it."""
+    rng = random.Random(seed * 7 + 3)
+    reads = build_workload(seed, n)
+    out = []
+    next_id = TID_ROWS
+    for i in range(n):
+        t = rng.randrange(8)
+        if t in (0, 1):
+            out.append(f"INSERT INTO chaos_t VALUES ({next_id},{rng.randrange(100)},{next_id % 6})")
+            next_id += 1
+        elif t == 2:
+            out.append(f"UPDATE chaos_t SET v = {rng.randrange(100)} WHERE id = {rng.randrange(next_id)}")
+        elif t == 3:
+            out.append(f"DELETE FROM chaos_t WHERE id = {rng.randrange(next_id)}")
+        elif t == 4:
+            out.append(f"UPDATE chaos_d SET name = 'g{rng.randrange(100)}' WHERE g = {rng.randrange(6)}")
+        else:
+            out.append(reads[i])
+    return out
+
+
+def cdc_schedule(n: int) -> dict[int, list[tuple]]:
+    """The CDC storm: every topology change the repo can throw plus the
+    three cdc/* failpoints, with a clean convergence tail."""
+    def at(frac: float) -> int:
+        return max(int(n * frac), 1)
+
+    sched: dict[int, list[tuple]] = {}
+
+    def add(i, *action):
+        sched.setdefault(i, []).append(tuple(action))
+
+    add(at(0.06), "split")  # region split mid-stream: sorter hand-off
+    add(at(0.10), "arm", "replica/apply-lag", {"stores": {3}})
+    add(at(0.18), "disarm", "replica/apply-lag")
+    add(at(0.22), "transfer")  # leader transfers under live capture
+    add(at(0.28), "arm", "cdc/sink-stall", True)
+    add(at(0.34), "disarm", "cdc/sink-stall")
+    add(at(0.38), "down", 1)  # store outage: reads fail over; writes and
+    add(at(0.48), "up", 1)  # the shared-KV log keep flowing
+    add(at(0.52), "arm", "cdc/resolved-stuck", True)
+    add(at(0.60), "disarm", "cdc/resolved-stuck")
+    add(at(0.64), "arm", "cdc/puller-drop", True)
+    add(at(0.70), "disarm", "cdc/puller-drop")
+    add(at(0.74), "merge")  # region merge: watermark min-fold
+    add(at(0.78), "transfer")
+    # past at(0.78): clean tail — the feed must drain and converge
+    return sched
+
+
+def _apply_cdc(actions, sess, fp, tid) -> None:
+    from tidb_tpu.codec import tablecodec
+
+    for action in actions:
+        if action[0] == "split":
+            handles = [h for h, in
+                       ((r[0],) for r in sess.execute(
+                           "SELECT id FROM chaos_t ORDER BY id").values())]
+            if handles:
+                mid = handles[len(handles) // 2]
+                sess.store.cluster.split(tablecodec.encode_row_key(tid, mid))
+        elif action[0] == "merge":
+            regions = sess.store.cluster.regions()
+            if len(regions) > 2:
+                sess.store.cluster.merge(regions[0].region_id)
+        elif action[0] == "transfer":
+            for r in sess.store.cluster.regions():
+                folls = sess.store.cluster.followers_of(r.region_id)
+                if folls:
+                    sess.store.cluster.transfer_leader(r.region_id, folls[0])
+        else:
+            _apply([action], sess, fp)
+
+
+def run_cdc_storm(seed: int = 11, statements: int = 160,
+                  tick_every: int = 6) -> dict:
+    """The changefeed chaos acceptance (ISSUE 10): a feed created BEFORE
+    the storm replays chaos_t/chaos_d into a pristine mirror cluster via
+    the session-replay sink while the schedule churns topology and arms
+    the cdc/* failpoints. Returns the invariant report; `main_cdc`
+    asserts mirror equality, frontier monotonicity, zero ordering
+    violations and zero untyped errors."""
+    from tidb_tpu.cdc import SessionReplaySink
+    from tidb_tpu.sql.session import Session, SQLError
+    from tidb_tpu.util import failpoint as fp
+    from tidb_tpu.util import metrics
+
+    sess = _fill_session(split_regions=True)
+    mirror = Session()
+    mirror.execute("CREATE TABLE chaos_t (id BIGINT PRIMARY KEY, v BIGINT, g BIGINT)")
+    mirror.execute("CREATE TABLE chaos_d (g BIGINT PRIMARY KEY, name VARCHAR(16))")
+    tid = sess.catalog.table("chaos_t").table_id
+    did = sess.catalog.table("chaos_d").table_id
+    sink = CheckingSink(SessionReplaySink(mirror))
+    feed = sess.store.cdc.create("storm", sink, sess.catalog,
+                                 table_ids={tid, did}, start_ts=0)
+
+    workload = build_cdc_workload(seed, statements)
+    schedule = cdc_schedule(statements)
+    ok = typed = 0
+    untyped: list = []
+    frontier_samples: list = []
+    recov0 = metrics.CDC_RECOVERY_SCANS.value
+    try:
+        for i, sql in enumerate(workload):
+            _apply_cdc(schedule.get(i, ()), sess, fp, tid)
+            try:
+                sess.execute(sql)
+                ok += 1
+            except SQLError as exc:
+                if getattr(exc, "code", 0) in (9005, 1105, 3024, 1317):
+                    typed += 1
+                else:
+                    untyped.append({"stmt": i, "sql": sql, "error": str(exc)[:200]})
+            except Exception as exc:  # noqa: BLE001 — the bug class we hunt
+                untyped.append({"stmt": i, "sql": sql,
+                                "error": f"{type(exc).__name__}: {str(exc)[:200]}"})
+            if (i + 1) % tick_every == 0:
+                sess.store.pd.tick()
+                frontier_samples.append((i, feed.view(sess.store)["checkpoint_ts"]))
+    finally:
+        for name in FAULT_POINTS:
+            fp.disable(name)
+        for sid in range(N_STORES):
+            sess.store.set_up(sid)
+    # drain: with every fault cleared the feed must converge (backlog
+    # flushes, recovery scans settle, frontier passes the last commit)
+    last_commit = sess.store.kv.max_committed()
+    for _ in range(12):
+        sess.store.pd.tick()
+        frontier_samples.append((statements, feed.view(sess.store)["checkpoint_ts"]))
+        v = feed.view(sess.store)
+        if v["pending"] == 0 and v["checkpoint_ts"] >= last_commit:
+            break
+
+    def scan(s, table):
+        return s.execute(f"SELECT * FROM {table} ORDER BY 1").values()
+
+    frontiers = [f for _, f in frontier_samples]
+    return {
+        "seed": seed,
+        "statements": statements,
+        "ok": ok,
+        "typed_errors": typed,
+        "untyped_errors": untyped,
+        "events_emitted": sink.events,
+        "ordering_violations": sink.violations,
+        "recovery_scans": int(metrics.CDC_RECOVERY_SCANS.value - recov0),
+        "frontier_samples": frontiers,
+        "frontier_monotone": all(a <= b for a, b in zip(frontiers, frontiers[1:])),
+        "frontier_advanced": bool(frontiers) and frontiers[-1] > frontiers[0],
+        "feed_state": feed.view(sess.store)["state"],
+        "mirror_equal": {
+            "chaos_t": scan(sess, "chaos_t") == scan(mirror, "chaos_t"),
+            "chaos_d": scan(sess, "chaos_d") == scan(mirror, "chaos_d"),
+        },
+        "source_rows": len(scan(sess, "chaos_t")),
+        "mirror_rows": len(scan(mirror, "chaos_t")),
+    }
+
+
 def main():
     seed = int(sys.argv[1]) if len(sys.argv) > 1 else 7
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    if os.environ.get("CHAOS_CDC"):
+        report = run_cdc_storm(seed if len(sys.argv) > 1 else 11, n)
+        print(json.dumps(report, indent=2, default=str))
+        bad = (not all(report["mirror_equal"].values())
+               or report["ordering_violations"] or report["untyped_errors"]
+               or not report["frontier_monotone"]
+               or not report["frontier_advanced"]
+               or report["feed_state"] != "normal")
+        sys.exit(1 if bad else 0)
     report = run_chaos(seed, n)
     print(json.dumps(report, indent=2, default=str))
     bad = report["wrong_results"] or report["untyped_errors"] or not report["breakers_all_closed"]
