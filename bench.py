@@ -55,6 +55,16 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _compile_seconds() -> float:
+    """Cumulative XLA trace+compile seconds this process has spent
+    (tidb_tpu_program_compile_seconds histogram sum). Every scenario
+    reports `compile_s` — its delta over the run — as a first-class
+    metric next to throughput (ROADMAP: compile-time budgets)."""
+    from tidb_tpu.util import metrics
+
+    return metrics.PROGRAM_COMPILE_DURATION.sum
+
+
 ROWS = 1 << 22  # 4M resident rows per batch
 CPU_ROWS = 1 << 19
 PARITY_ROWS = 1 << 12
@@ -403,7 +413,8 @@ def bench_config(cfg, device, n, iters, loop_k=None):
         # fetch of the data-dependent scalar cannot lie
         t0 = time.perf_counter()
         int(loop(*batches))
-        log(f"  [{cfg.name}/{device.platform}] compile+first: {time.perf_counter()-t0:.2f}s")
+        compile_s = time.perf_counter() - t0  # trace+compile dominate call 1
+        log(f"  [{cfg.name}/{device.platform}] compile+first: {compile_s:.2f}s")
         times = []
         for _ in range(iters):
             t0 = time.perf_counter()
@@ -428,7 +439,7 @@ def bench_config(cfg, device, n, iters, loop_k=None):
         assert gbs <= HBM_ROOFLINE_GBS, (
             f"{cfg.name}: claimed {gbs:.0f} GB/s exceeds any plausible HBM roofline — measurement bug"
         )
-        return rps, gbs, spread, _checksum(chunk)
+        return rps, gbs, spread, _checksum(chunk), compile_s
 
 
 def parity_gate(cfg, n=PARITY_ROWS):
@@ -537,7 +548,7 @@ def _cpu_only_main():
     out = {}
     for cfg in _configs():
         try:
-            rps, gbs, spread, _ = bench_config(cfg, cpu, _cpu_config_rows(cfg.name), 3, loop_k=CPU_LOOP_K)
+            rps, gbs, spread, _, _c = bench_config(cfg, cpu, _cpu_config_rows(cfg.name), 3, loop_k=CPU_LOOP_K)
             log(f"  [{cfg.name}/cpu-subprocess] {rps/1e6:.2f} Mrows/s, {gbs:.1f} GB/s, spread {spread:.0f}%")
             out[cfg.name] = rps
         except Exception as exc:  # noqa: BLE001
@@ -611,6 +622,7 @@ def _pd_skew_main():
 
     print(json.dumps({
         "metric": "pd_skew_balance",
+        "compile_s": round(_compile_seconds(), 2),
         "stores": n_stores,
         "regions": n_regions,
         "ticks_to_converge": ticks,
@@ -651,6 +663,9 @@ def _batch_cop_main():
     for i in range(1, n_regions):
         s.store.cluster.split(tablecodec.encode_row_key(tid, i * rows // n_regions))
     query = "SELECT count(*), sum(v) FROM bc WHERE v < 50"
+    # pin the vmapped tier: the mesh tier would otherwise claim this
+    # partial-agg shape in BOTH modes (it has its own BENCH_MESH scenario)
+    s.execute("SET tidb_enable_tpu_mesh = OFF")
 
     def drain_cop_cache():
         with s.store._cop_lock:
@@ -676,6 +691,7 @@ def _batch_cop_main():
         f"batched: {t_batch*1e3:.1f}ms, {l_batch} launches")
     print(json.dumps({
         "metric": "batch_cop_dispatch",
+        "compile_s": round(_compile_seconds(), 2),
         "regions": n_regions,
         "rows": rows,
         "launches_per_query_per_region": l_plain,
@@ -726,11 +742,12 @@ def _one_config_main(name: str):
         sys.stderr.write(out.stderr[-3000:])
         raise RuntimeError(f"{name}: parity gate failed")
     log(f"  [{name}] parity gate vs oracle: OK")
-    rps, gbs, spread, csum = bench_config(cfg, jax.devices()[0], _config_rows(name), ITERS)
+    rps, gbs, spread, csum, compile_s = bench_config(cfg, jax.devices()[0], _config_rows(name), ITERS)
     print(json.dumps({
         "mrows_per_sec": round(rps / 1e6, 2),
         "gb_per_sec": round(gbs, 1),
         "spread_pct": round(spread, 1),
+        "compile_s": round(compile_s, 2),
         "checksum": csum,
     }))
 
@@ -783,6 +800,7 @@ def _chaos_main():
     assert faulted["breakers_all_closed"], faulted["breakers"]
     print(json.dumps({
         "metric": "chaos_fault_latency",
+        "compile_s": round(_compile_seconds(), 2),
         "statements": n,
         "fault_rate": 0.10,
         "clean": {"p50_ms": clean["p50_ms"], "p99_ms": clean["p99_ms"]},
@@ -863,6 +881,7 @@ def _replica_main():
     total_f = sum(follower["replica_reads"].values()) or 1
     print(json.dumps({
         "metric": "replica_read_routing",
+        "compile_s": round(_compile_seconds(), 2),
         "stores": n_stores,
         "regions": n_regions,
         "statements": loops * len(queries),
@@ -937,6 +956,7 @@ def _cdc_main():
     v = feed.view(s.store)
     print(json.dumps({
         "metric": "cdc_changefeed_throughput",
+        "compile_s": round(_compile_seconds(), 2),
         "statements": n_stmts,
         "regions": n_regions,
         "stores": n_stores,
@@ -951,9 +971,115 @@ def _cdc_main():
     }))
 
 
+def _mesh_main():
+    """BENCH_MESH=1: host-merge vs on-device-psum dispatch (ISSUE 11) —
+    the same scalar-aggregate scan over a PD-split table, dispatched (a)
+    through the vmapped batch tier with the per-region partial states
+    merged by the ROOT on the host, and (b) through the mesh tier where
+    `shard_map` psum-reduces the partial states over the region axis and
+    each store answers ONE merged state. Several region counts; hermetic
+    CPU with a forced multi-device host platform (the collective itself
+    is topology-independent; what this measures is the dispatch/merge
+    path, a host+launch-count property). compile_s is reported per mode —
+    the mesh program's shard_map trace is the new compile cost."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        n_dev = int(os.environ.get("BENCH_MESH_DEVICES", "8"))
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_dev}"
+        ).strip()
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tidb_tpu.codec import tablecodec
+    from tidb_tpu.distsql.dispatch import KVRequest, full_table_ranges, select
+    from tidb_tpu.exec.dag import Aggregation, ColumnInfo, DAGRequest, Selection, TableScan
+    from tidb_tpu.expr import AggDesc, col, func, lit
+    from tidb_tpu.store import TPUStore
+    from tidb_tpu.types import Datum, new_longlong
+
+    region_counts = [int(x) for x in os.environ.get("BENCH_MESH_REGIONS", "4,8,16").split(",")]
+    rows, reps = int(os.environ.get("BENCH_MESH_ROWS", "4096")), 6
+    TID, I = 7, new_longlong()
+    results = []
+    for n_regions in region_counts:
+        store = TPUStore()
+        for h in range(rows):
+            store.put_row(TID, h, [1, 2], [Datum.i64(h % 97), Datum.i64(h)], ts=10)
+        for i in range(1, n_regions):
+            store.cluster.split(tablecodec.encode_row_key(TID, i * rows // n_regions))
+        scan = TableScan(TID, (ColumnInfo(1, I), ColumnInfo(2, I)))
+        pred = func("lt", new_longlong(notnull=True), col(0, I), lit(50, I))
+        agg = Aggregation(group_by=(), aggs=(
+            AggDesc("count", ()), AggDesc("sum", (col(1, I),)),
+            AggDesc("avg", (col(1, I),)),
+        ), partial=True)
+        dag = DAGRequest((scan, Selection((pred,)), agg), output_offsets=(0, 1, 2, 3))
+
+        def measure(mesh_on: bool):
+            from tidb_tpu.util import metrics
+
+            def req(ts):
+                return KVRequest(dag, full_table_ranges(TID), start_ts=ts,
+                                 batch_cop=not mesh_on, mesh=mesh_on)
+
+            def drain():
+                with store._cop_lock:
+                    store._cop_cache.clear()
+
+            c0 = _compile_seconds()
+            drain()
+            res = select(store, req(100))  # warm: compiles excluded below
+            compile_s = _compile_seconds() - c0
+            merged_states = sum(
+                1 for c in res.chunks if c is not None and c.num_rows())
+            times = []
+            l0 = metrics.PROGRAM_LAUNCHES.value
+            for k in range(reps):
+                drain()
+                t0 = time.perf_counter()
+                select(store, req(101 + k))
+                times.append(time.perf_counter() - t0)
+            launches = (metrics.PROGRAM_LAUNCHES.value - l0) / reps
+            return {
+                "wall_ms": round(statistics.median(times) * 1e3, 2),
+                "compile_s": round(compile_s, 2),
+                "launches_per_query": launches,
+                "partial_states_at_root": merged_states,
+            }
+
+        host = measure(False)
+        mesh = measure(True)
+        log(f"  [mesh/{n_regions} regions] host-merge {host['wall_ms']}ms "
+            f"({host['partial_states_at_root']} states) vs psum {mesh['wall_ms']}ms "
+            f"({mesh['partial_states_at_root']} states)")
+        results.append({
+            "regions": n_regions,
+            "host_merge": host,
+            "device_psum": mesh,
+            "speedup": round(host["wall_ms"] / max(mesh["wall_ms"], 1e-9), 2),
+        })
+    print(json.dumps({
+        "metric": "mesh_dispatch_psum",
+        "rows": rows,
+        "devices": len(jax.devices()),
+        "compile_s": round(_compile_seconds(), 2),
+        "by_region_count": results,
+    }))
+
+
 def main():
     import os
 
+    if os.environ.get("BENCH_MESH"):
+        _mesh_main()
+        return
     if os.environ.get("BENCH_CPU_ONLY"):
         _cpu_only_main()
         return

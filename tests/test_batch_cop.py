@@ -70,7 +70,8 @@ def test_one_launch_per_store_for_16_regions():
     s1 = store.programs.stats()
     assert launches == 1  # one vmapped launch, not 17
     assert s1["compiles"] - s0["compiles"] == 1
-    assert res.batch_stats == {"batches": 1, "regions": 17, "launches_saved": 16}
+    assert res.batch_stats == {"batches": 1, "regions": 17, "launches_saved": 16,
+                               "mesh_batches": 0, "mesh_lanes": 0}
     assert all_vals(res) == [h * 3 for h in range(340)]
     assert len(res.exec_summaries) == 17  # still one summary list per region
 
@@ -98,12 +99,21 @@ def test_one_compile_then_hits_per_batch_shape():
 def test_batched_matches_per_region_partial_agg():
     store = fill_store(n=200, regions=8)
     dag = agg_dag()
-    plain = select(store, kvreq(dag, 100, concurrency=4))
+    # mesh=False pins the per-region pool path (the mesh tier would
+    # otherwise claim this partial-agg shape — tested separately below)
+    plain = select(store, kvreq(dag, 100, concurrency=4, mesh=False))
     store.evict_caches()  # defeat the cop cache: exercise the real launch
-    batched = select(store, kvreq(dag, 101, batch_cop=True))
+    batched = select(store, kvreq(dag, 101, batch_cop=True, mesh=False))
     assert sum(all_vals(plain)) == sum(all_vals(batched)) == 100
     assert plain.batch_stats is None
     assert batched.batch_stats["regions"] == 8
+    assert batched.batch_stats["mesh_lanes"] == 0
+    store.evict_caches()
+    meshed = select(store, kvreq(dag, 102))  # planner default: mesh tier
+    assert sum(all_vals(meshed)) == 100
+    assert meshed.batch_stats["mesh_lanes"] == 8
+    # ONE merged partial state came back (no per-region host merge)
+    assert sum(1 for c in meshed.chunks if c is not None and c.num_rows()) == 1
 
 
 # ------------------------------------------------- batch interaction edges
@@ -122,7 +132,8 @@ def test_capacity_buckets_split_skewed_regions():
         store.cluster.split(tablecodec.encode_row_key(TID, b))
     l0 = metrics.PROGRAM_LAUNCHES.value
     res = select(store, kvreq(scan_dag(), 100, batch_cop=True))
-    assert res.batch_stats == {"batches": 2, "regions": 7, "launches_saved": 5}
+    assert res.batch_stats == {"batches": 2, "regions": 7, "launches_saved": 5,
+                               "mesh_batches": 0, "mesh_lanes": 0}
     assert metrics.PROGRAM_LAUNCHES.value - l0 == 2
     assert all_vals(res) == [h * 3 for h in range(n)]
 
@@ -353,6 +364,10 @@ def test_sql_batch_cop_matches_and_explains():
     tid = s.catalog.table("bt").table_id
     for i in range(1, 17):
         s.store.cluster.split(tablecodec.encode_row_key(tid, i * 400 // 17))
+    # this test pins the VMAPPED batch tier + cop-cache attribution;
+    # the mesh tier (which sits above it and skips the cop cache) has its
+    # own SQL-level coverage in tests/test_mesh_dispatch.py
+    s.execute("SET tidb_enable_tpu_mesh = OFF")
     plain = s.execute("SELECT count(*), sum(v) FROM bt WHERE v < 7").values()
     s.execute("SET tidb_allow_batch_cop = ON")
     l0 = metrics.PROGRAM_LAUNCHES.value

@@ -42,6 +42,21 @@ def test_join_probe_hint():
     assert s.execute(sql).values() == s.execute(hinted).values()
 
 
+def test_global_binding_with_backslash_literal_mirrors(monkeypatch):
+    """The bind_info mirror SQL shares the user-mirror escape contract
+    (#review): a bound statement whose text contains backslash-escaped
+    string literals must still land one row in mysql.bind_info — only
+    doubling quotes would let the backslash swallow the closing quote and
+    silently drop the mirror row."""
+    s = _sess()
+    s.execute("create table bs (w bigint, n varchar(10))")
+    tgt = "select w from bs where n = 'x\\\\'"
+    hint = "select /*+ HASH_AGG() */ w from bs where n = 'x\\\\'"
+    s.execute(f"create global binding for {tgt} using {hint}")
+    rows = s.execute("select original_sql from mysql.bind_info").values()
+    assert any("x\\\\" in r[0] for r in rows), rows
+
+
 def test_session_binding_applies_and_drops():
     s = _sess()
     s.execute("create binding for select w from t where v = 3 "

@@ -115,6 +115,39 @@ class TestDensePallas:
         pal = group_aggregate([g], aggs, db.row_valid, 64, small_groups=8)
         _assert_same(ref, pal)
 
+    def test_row_count_bound_gates_eligibility(self):
+        """ADVICE r5 medium, pinned at the boundary: the 12-bit limb
+        accumulators wrap past int32 around 2^26 rows, so eligibility is
+        a strict n < MAX_ROWS — the old docstring's 2^31 claim was wrong.
+        The 2^26 case uses zero-copy broadcast views: the gate must reject
+        on SHAPE alone, before any value work could materialize 512MB."""
+        from tidb_tpu.expr.compile import CompVal
+        from tidb_tpu.ops.dense_pallas import MAX_ROWS, dense_pallas_eligible
+
+        assert MAX_ROWS == 1 << 26  # (N/128 rows) * 4095 < 2^31 -> N < ~2^26
+        n = MAX_ROWS
+        big_v = np.broadcast_to(np.int64(0), (n,))
+        big_n = np.broadcast_to(False, (n,))
+        g = CompVal(big_v, big_n, new_longlong())
+        aggs = [(AggDesc("count", ()), [])]
+        assert not dense_pallas_eligible([g], aggs, merge=False)
+
+    def test_row_count_bound_is_strict(self, monkeypatch):
+        """Boundary semantics (< not <=) without 512MB allocations: shrink
+        the bound and check both sides of it."""
+        import tidb_tpu.ops.dense_pallas as dp
+        from tidb_tpu.expr.compile import CompVal
+
+        fts, ch = make_data(n=64, k_card=4)
+        db, vals = eval_vals(fts, ch, [col(0, fts[0]), col(1, fts[1])])
+        g, d = vals
+        aggs = [(AggDesc("sum", (col(1, fts[1]),)), [d])]
+        n = g.null.shape[0]
+        monkeypatch.setattr(dp, "MAX_ROWS", n)
+        assert not dp.dense_pallas_eligible([g], aggs, merge=False)
+        monkeypatch.setattr(dp, "MAX_ROWS", n + 1)
+        assert dp.dense_pallas_eligible([g], aggs, merge=False)
+
     def test_ineligible_falls_back(self):
         """min/max and DOUBLE args route to the XLA dense kernel unchanged."""
         fts, ch = make_data(n=120, k_card=4)

@@ -242,6 +242,48 @@ def test_packed_chain_three_tables(monkeypatch):
     assert "packed" in calls
 
 
+def test_packed_int32_min_key_no_phantom_join(monkeypatch):
+    """ADVICE r5 high, pinned end-to-end: jnp.abs(INT32_MIN) wraps to
+    INT32_MIN (negative) and used to PASS the packed range gate, so key
+    -2^31 shifted left wrapped to packed key 0 and silently joined as a
+    phantom key-0 group with no overflow flag. The int64-domain range
+    check must flag it instead, and the driver's retry must land on a
+    correct general-kernel run (same contract as any out-of-range key)."""
+    calls = _fused_calls(monkeypatch)
+    INT32_MIN = -(1 << 31)
+    # build key INT32_MIN + probe key 0: the ADVICE repro — before the fix
+    # probe rows with key 0 joined the INT32_MIN build row as key 0
+    probe = _mk([LL, LL], [[0, 0, 5, INT32_MIN], [10, 20, 30, 40]])
+    build = _mk([LL, LL], [[INT32_MIN, 5], [7, 8]])
+    dag = _dag([AggDesc("sum", (col(1, LL),)), AggDesc("count", ())])
+    got = run_dag_on_chunks(dag, [probe, build], group_capacity=64)
+    want = run_dag_reference(dag, [probe, build])
+    assert canon(got.rows()) == canon(want)
+    assert "packed" in calls, "packed path must run (and overflow-retry)"
+    # sanity on the oracle itself: key 0 must NOT appear (no build row 0),
+    # and the INT32_MIN probe row joins its real build row
+    keys = {r[-1][1] for r in canon(want)}
+    assert 0 not in keys and INT32_MIN in keys and 5 in keys
+
+
+def test_membership_chain_int32_min_payload_key(monkeypatch):
+    """The same wrap through membership_chain (the 3-table packed chain):
+    an INT32_MIN key on the chain's inner join must not alias key 0."""
+    import jax.numpy as jnp
+
+    from tidb_tpu.ops.joinagg import membership_chain
+
+    INT32_MIN = -(1 << 31)
+    outer = jnp.asarray([0, INT32_MIN, 7], jnp.int64)
+    inner = jnp.asarray([INT32_MIN, 7], jnp.int64)
+    ok = jnp.ones(3, bool)
+    iok = jnp.ones(2, bool)
+    payload = jnp.asarray([1, 2, 3], jnp.int64)
+    _pay, _ok_out, overflow = membership_chain(outer, ok, inner, iok, payload)
+    # out-of-range key must raise the overflow flag -> general-kernel retry
+    assert bool(overflow)
+
+
 def test_packed_wide_key_range_falls_back(monkeypatch):
     """Keys spanning more than 2^30 trip the packed range check; the
     driver's retry lands on a correct general-path run."""

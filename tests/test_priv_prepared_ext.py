@@ -83,6 +83,26 @@ def test_user_lifecycle_and_grants(env):
     root.execute("DROP USER IF EXISTS 'alice'")
 
 
+def test_user_name_with_backslash_mirrors_cleanly(env):
+    """ADVICE r5 low, pinned: the CREATE/DROP USER mirror SQL is built by
+    string concatenation and the lexer honors backslash escapes — a name
+    ending in a lone backslash used to swallow the closing quote, break
+    the mirrored statement, and leave mysql.user missing the row (the
+    failure was silently swallowed). Backslashes must escape too."""
+    store, cat, root = env
+    name = "back\\slash\\"  # embedded AND trailing backslash
+    root.execute("CREATE USER 'back\\\\slash\\\\' IDENTIFIED BY 'pw'")
+    rows = root.execute("SELECT User, Host FROM `mysql.user`").values()
+    assert [name, "%"] in rows, rows
+    # re-run under IF NOT EXISTS: delete-then-insert must keep ONE row
+    root.execute("CREATE USER IF NOT EXISTS 'back\\\\slash\\\\'")
+    rows = root.execute("SELECT User FROM `mysql.user`").values()
+    assert rows.count([name]) == 1
+    root.execute("DROP USER 'back\\\\slash\\\\'")
+    rows = root.execute("SELECT User FROM `mysql.user`").values()
+    assert [name] not in rows
+
+
 def test_global_and_db_grants(env):
     store, cat, root = env
     root.execute("CREATE USER 'carol'")

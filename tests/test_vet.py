@@ -361,7 +361,20 @@ class TestDataflowEngine:
 class TestJaxAudit:
     def test_catalog_covers_every_builder_path(self):
         names = {n for n, _dag, _nb in jaxaudit.live_catalog()}
-        assert names == {"selection", "hashagg", "streamagg", "topn", "hashjoin"}
+        assert names == {"selection", "hashagg", "streamagg", "topn", "hashjoin",
+                         "partial_scalar_agg", "partial_hashagg"}
+
+    def test_mesh_variants_audited(self):
+        """The mesh-tier shard_map programs are walked too: every catalog
+        shape the dispatch planner would route to the mesh gets a
+        mesh-{kind} trace through the jaxpr checks."""
+        from tidb_tpu.distsql.planner import mesh_merge_kind
+
+        kinds = {n: mesh_merge_kind(dag) for n, dag, _nb in jaxaudit.live_catalog()}
+        assert kinds["partial_scalar_agg"] == "scalar"
+        assert kinds["partial_hashagg"] == "group"
+        assert kinds["topn"] == "topn"
+        assert kinds["hashagg"] is None  # Complete mode stays off-mesh
 
     def test_live_catalog_is_clean(self):
         assert jaxaudit.run() == []

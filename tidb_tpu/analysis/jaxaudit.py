@@ -204,12 +204,26 @@ def live_catalog() -> list:
         (scan, Join(build=(_scan(32, I),), probe_keys=(col(0, I),),
                     build_keys=(col(0, I),), join_type="inner")),
         output_offsets=(0, 1, 2, 3))
+    # partial-mode shapes: what the dispatch planner's MESH tier runs —
+    # audited as shard_map programs too (mesh_merge_kind gates which)
+    partial_scalar = DAGRequest(
+        (scan, Aggregation(group_by=(),
+                           aggs=(AggDesc("sum", (col(1, I),)),
+                                 AggDesc("count", ())), partial=True)),
+        output_offsets=(0, 1))
+    partial_hashagg = DAGRequest(
+        (scan, Aggregation(group_by=(col(0, I),),
+                           aggs=(AggDesc("sum", (col(1, I),)),
+                                 AggDesc("count", ())), partial=True)),
+        output_offsets=(0, 1, 2))
     return [
         ("selection", sel, 1),
         ("hashagg", hashagg, 1),
         ("streamagg", streamagg, 1),
         ("topn", topn, 1),
         ("hashjoin", join, 2),
+        ("partial_scalar_agg", partial_scalar, 1),
+        ("partial_hashagg", partial_hashagg, 1),
     ]
 
 
@@ -280,8 +294,44 @@ def audit_live() -> list:
                 single_out = closed.out_avals
             else:
                 findings.extend(_check_vmap_axis(name, single_out, closed.out_avals, anchor))
+        findings.extend(_audit_mesh_variant(name, dag, n_batches, anchor))
     _LIVE_MEMO = list(findings)
     return findings
+
+
+def _audit_mesh_variant(name: str, dag, n_batches: int, anchor) -> list:
+    """Trace the MESH-tier shard_map variant (on-device psum of the
+    batched partials) for every catalog shape the dispatch planner would
+    route there, and walk its jaxpr through the same f64/host-callback/
+    const checks — iter_eqns recurses the shard_map body like any other
+    sub-jaxpr. Devices: whatever this process has (1 in the CLI, 8 under
+    the test mesh) — the program specializes to the count either way."""
+    import jax
+
+    from ..distsql.planner import mesh_merge_kind
+    from ..exec.builder import build_program
+
+    kind = mesh_merge_kind(dag)
+    if kind is None:
+        return []
+    variant = f"{name}/mesh-{kind}"
+    n_dev = min(len(jax.devices()), _VMAP_BATCH)
+    lanes = -(-_VMAP_BATCH // n_dev) * n_dev
+    try:
+        cd = build_program(
+            dag, tuple(_CAPACITY for _ in range(n_batches)),
+            group_capacity=_GROUP_CAPACITY,
+            mesh_lanes=lanes, mesh_devices=n_dev, mesh_kind=kind)
+        from ..chunk.device import to_stacked_device_batch
+
+        ch, _I = _int_chunk()
+        stacked = to_stacked_device_batch([ch] * lanes, _CAPACITY)
+        aux = _batches(n_batches, False)[1:]
+        closed = jax.make_jaxpr(cd.fn)(stacked, *aux)
+    except Exception as exc:  # noqa: BLE001 — a trace failure IS a finding
+        return [Finding(anchor[0], anchor[1], PASS,
+                        f"program {variant!r} failed to trace: {exc}")]
+    return audit_jaxpr(variant, closed, anchor)
 
 
 def _check_vmap_axis(name: str, single_avals, vmap_avals, anchor) -> list:

@@ -476,6 +476,9 @@ def encode_cop_request(req, _aux_index=None) -> bytes:
     w.i32(-1 if req.small_groups is None else req.small_groups)
     w.i32(req.peer_store)
     w.bool_(req.replica_read)
+    w.bool_(req.mesh)
+    # i64: the tidb_tpu_mesh_min_rows sysvar range (up to 1<<40) exceeds i32
+    w.i64(req.mesh_min_rows)
     return w.done()
 
 
@@ -502,10 +505,13 @@ def decode_cop_request(b: bytes, _aux_table: list | None = None):
     smg = r.i32()
     peer_store = r.i32()
     replica_read = r.bool_()
+    mesh = r.bool_() if r.i < len(r.b) else False
+    mesh_min_rows = r.i64() if r.i < len(r.b) else 0
     return CopRequest(dag, ranges, start_ts, region_id, epoch, aux,
                       None if paging < 0 else paging,
                       None if smg < 0 else smg,
-                      peer_store=peer_store, replica_read=replica_read)
+                      peer_store=peer_store, replica_read=replica_read,
+                      mesh=mesh, mesh_min_rows=mesh_min_rows)
 
 
 def encode_cop_response(resp) -> bytes:
@@ -530,6 +536,7 @@ def encode_cop_response(resp) -> bytes:
             w.blob(rg.start)
             w.blob(rg.end)
     w.i32(int(getattr(resp, "batched", 0)))
+    w.i32(int(getattr(resp, "mesh_merged", 0)))
     return w.done()
 
 
@@ -548,7 +555,9 @@ def decode_cop_response(b: bytes):
     if r.bool_():
         last_range = [KeyRange(r.blob(), r.blob()) for _ in range(r.i32())]
     batched = r.i32() if r.i < len(r.b) else 0
-    return CopResponse(chunk, region_error, other_error, summaries, last_range, batched)
+    mesh_merged = r.i32() if r.i < len(r.b) else 0
+    return CopResponse(chunk, region_error, other_error, summaries, last_range, batched,
+                       mesh_merged)
 
 
 # ----------------------------------------------------- batched cop frames

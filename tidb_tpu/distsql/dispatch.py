@@ -227,6 +227,12 @@ class KVRequest:
     replica_read: str = "leader"  # tidb_replica_read: leader / follower /
     # closest-replica — which peer of each region serves the cop task
     # (ref: sessionctx ReplicaRead -> kvrpcpb.Context.replica_read)
+    mesh: bool | None = None  # mesh dispatch tier (tidb_enable_tpu_mesh):
+    # None/True lets the planner shard eligible partial-agg/TopN shapes
+    # over the device mesh and psum-merge partial states ON DEVICE; False
+    # pins the request to the vmap/pool tiers (distsql/planner.py)
+    mesh_min_rows: int = 0  # tidb_tpu_mesh_min_rows: data-size floor the
+    # planner applies before attempting the mesh tier
 
 
 @dataclass
@@ -278,9 +284,34 @@ def _build_tasks(store: TPUStore, ranges: list) -> list[CopTask]:
 def select_stream(store: TPUStore, req: KVRequest):
     """Sequential per-task chunk generator — the bounded-memory dispatch
     the degraded OOM path uses (one region's result live at a time;
-    ref: copr worker pool degraded to a single in-order worker)."""
+    ref: copr worker pool degraded to a single in-order worker).
+
+    The mesh tier applies here too (the planner's call): eligible
+    partial-agg shapes run one store batch at a time, each merged on
+    device, and the stream yields the per-store merged chunks — still
+    bounded by one store's stacked batch. The low-memory degrade path
+    pins `mesh=False` and keeps the strict one-region-at-a-time shape."""
+    from .planner import choose_tier
+
     scan_kind = _scan_kind(req)
-    for task in _build_tasks(store, req.ranges):
+    tasks = _build_tasks(store, req.ranges)
+    if choose_tier(store, req, tasks).tier == "mesh":
+        results: list = [None] * len(tasks)
+        summaries_by_task: list = [[] for _ in tasks]
+        ctx = _route_ctx(store) if req.replica_read != "leader" else None
+        by_store: dict[int, list] = {}
+        for i, t in enumerate(tasks):
+            by_store.setdefault(_route_task(store, req, t, ctx=ctx),
+                                []).append((i, t))
+        for sid, entries in by_store.items():
+            _run_store_batch(store, req, sid, entries, results,
+                             summaries_by_task, None, scan_kind, mesh=True)
+            for i, _t in entries:
+                for c in results[i] or []:
+                    if c is not None:
+                        yield c, summaries_by_task[i]
+        return
+    for task in tasks:
         summaries: list = []
         for c in _run_one_task(store, req, task, summaries, scan_kind=scan_kind):
             if c is not None:
@@ -531,10 +562,14 @@ def _run_one_task(store, req, task, summaries, retries=MAX_RETRY,
 
 
 def _run_store_batch(store, req, sid, entries, results, summaries_by_task,
-                     dispatch_span, scan_kind) -> dict:
+                     dispatch_span, scan_kind, mesh: bool = False) -> dict:
     """ONE batched dispatch for all of a store's region tasks (ref:
     copr/batch_coprocessor.go — a TiFlash store's regions travel in one
-    request): the store stacks the regions and drives one vmapped launch.
+    request): the store stacks the regions and drives one vmapped launch —
+    or, when the planner chose the MESH tier (`mesh`), shards the stacked
+    lanes over the device mesh and merges the partial states on device
+    (the store degrades mesh -> vmap on ineligibility/overflow, so the
+    contract here is identical either way).
     `sid` is the ROUTED target peer (the leader for every lane under
     tidb_replica_read='leader'; a follower group otherwise). A region
     that comes back with a region_error (stale epoch after a concurrent
@@ -556,7 +591,8 @@ def _run_store_batch(store, req, sid, entries, results, summaries_by_task,
                 store, req, t, summaries_by_task[i],
                 dispatch_span=dispatch_span, scan_kind=scan_kind,
             )
-        return {"batches": 0, "regions": 0, "launches_saved": 0}
+        return {"batches": 0, "regions": 0, "launches_saved": 0,
+                "mesh_batches": 0, "mesh_lanes": 0}
     creqs = []
     for i, t in entries:
         if req.checker is not None:
@@ -570,12 +606,16 @@ def _run_store_batch(store, req, sid, entries, results, summaries_by_task,
             peer_store=sid,
             replica_read=(req.replica_read != "leader"
                           and sid != store.cluster.leader_of(t.region_id)),
+            mesh=mesh, mesh_min_rows=req.mesh_min_rows,
         ))
     t_batch = _time.monotonic()
-    stats = {"batches": 0, "regions": 0, "launches_saved": 0}
+    stats = {"batches": 0, "regions": 0, "launches_saved": 0,
+             "mesh_batches": 0, "mesh_lanes": 0}
     batch_ids: set = set()
+    mesh_ids: set = set()
     with tracing.span("distsql.batch_cop", parent=dispatch_span,
-                      batch_size=len(entries)) as bsp:
+                      batch_size=len(entries),
+                      tier="mesh" if mesh else "batch") as bsp:
         if req.use_wire:
             from ..codec.wire import decode_batch_cop_response, encode_batch_cop_request
 
@@ -614,6 +654,11 @@ def _run_store_batch(store, req, sid, entries, results, summaries_by_task,
             if resp.batched:
                 stats["regions"] += 1
                 batch_ids.add(resp.batched)
+                if resp.mesh_merged:
+                    # this lane's partial state rode the on-device psum —
+                    # one merged state per store, no per-region host merge
+                    stats["mesh_lanes"] += 1
+                    mesh_ids.add(resp.batched)
             sums.append(resp.exec_summaries)
             results[i] = [resp.chunk]
             with tracing.span("distsql.cop_task", region_id=t.region_id,
@@ -626,8 +671,11 @@ def _run_store_batch(store, req, sid, entries, results, summaries_by_task,
             store.breakers.record_success(sid)
         stats["batches"] = len(batch_ids)
         stats["launches_saved"] = max(stats["regions"] - len(batch_ids), 0)
+        stats["mesh_batches"] = len(mesh_ids)
         if bsp is not None:
             bsp.set("launches_saved", stats["launches_saved"])
+            if stats["mesh_lanes"]:
+                bsp.set("mesh_lanes_merged", stats["mesh_lanes"])
         metrics.DISTSQL_TASK_DURATION.labels(scan_kind).observe(
             _time.monotonic() - t_batch
         )
@@ -636,6 +684,7 @@ def _run_store_batch(store, req, sid, entries, results, summaries_by_task,
 
 def select(store: TPUStore, req: KVRequest) -> SelectResult:
     from ..util import tracing
+    from .planner import choose_tier
 
     tasks = _build_tasks(store, req.ranges)
     results: list = [None] * len(tasks)
@@ -655,13 +704,20 @@ def select(store: TPUStore, req: KVRequest) -> SelectResult:
         return _run_one_task(store, req, task, summaries_by_task[i],
                              dispatch_span=dispatch_span, scan_kind=scan_kind)
 
-    if req.batch_cop and len(tasks) > 1 and req.paging_size is None:
-        # batch coprocessor: ONE batched dispatch per STORE — the store
-        # stacks its regions and runs one vmapped XLA launch instead of N
-        # serialized per-region launches (ref: batch_coprocessor.go
-        # grouping regions per TiFlash store, balanced by the PD's
-        # authoritative placement map). Paging requests never batch: the
-        # per-page resume cursor is inherently per-region sequential state.
+    # ONE execution planner picks the tier by data size and topology
+    # (distsql/planner.py): single launch -> vmapped store batch -> mesh
+    # shard_map with on-device psum of the partial states. batch and mesh
+    # share the per-store grouping below; mesh marks its cop requests so
+    # the store merges on device.
+    decision = choose_tier(store, req, tasks)
+    if decision.tier in ("batch", "mesh"):
+        # batched dispatch: ONE launch per STORE — the store stacks its
+        # regions and runs one vmapped XLA launch (the mesh tier further
+        # shards those lanes over the device mesh) instead of N serialized
+        # per-region launches (ref: batch_coprocessor.go grouping regions
+        # per TiFlash store, balanced by the PD's authoritative placement
+        # map). Paging requests never batch: the per-page resume cursor is
+        # inherently per-region sequential state.
         by_store: dict[int, list] = {}
         ctx = _route_ctx(store) if req.replica_read != "leader" else None
         for i, t in enumerate(tasks):
@@ -673,7 +729,8 @@ def select(store: TPUStore, req: KVRequest) -> SelectResult:
 
         def run_batch(sid, entries):
             return _run_store_batch(store, req, sid, entries, results,
-                                    summaries_by_task, dispatch_span, scan_kind)
+                                    summaries_by_task, dispatch_span, scan_kind,
+                                    mesh=decision.tier == "mesh")
 
         with ThreadPoolExecutor(max_workers=max(len(by_store), 1)) as pool:
             futs = [pool.submit(run_batch, sid, entries)
@@ -683,6 +740,8 @@ def select(store: TPUStore, req: KVRequest) -> SelectResult:
             "batches": sum(s["batches"] for s in per_store),
             "regions": sum(s["regions"] for s in per_store),
             "launches_saved": sum(s["launches_saved"] for s in per_store),
+            "mesh_batches": sum(s["mesh_batches"] for s in per_store),
+            "mesh_lanes": sum(s["mesh_lanes"] for s in per_store),
         }
     elif req.concurrency > 1 and len(tasks) > 1:
         with ThreadPoolExecutor(max_workers=req.concurrency) as pool:

@@ -177,10 +177,17 @@ def execute_root(
     checker=None,
     backoff_weight: int = 2,
     replica_read: str = "leader",
+    mesh: bool | None = None,
+    mesh_min_rows: int = 0,
 ) -> Chunk:
     """Run a logical (Complete-mode) DAG over the store: split, dispatch the
     pushdown half per region, merge at root. The caller-visible result is
     identical to running the whole DAG over all rows at once.
+
+    mesh (tidb_enable_tpu_mesh) lets the dispatch planner shard eligible
+    partial-agg/TopN pushdowns over the device mesh and merge the partial
+    states ON DEVICE (psum over the region axis) — the root's Final merge
+    then consumes ONE state per store instead of R per-region partials.
 
     paging_size applies only when the pushdown half is row-local (the store
     rejects paged aggregation/TopN/Limit); otherwise it is ignored here.
@@ -198,6 +205,7 @@ def execute_root(
             store, dag, ranges, start_ts, aux_chunks, concurrency, cache,
             group_capacity, paging_size, batch_cop, summary_sink, tracker,
             low_memory, small_groups, checker, backoff_weight, replica_read,
+            mesh, mesh_min_rows,
         )
         if sp is not None:
             sp.set("rows", out.num_rows())
@@ -208,7 +216,7 @@ def _execute_root(
     store, dag, ranges, start_ts, aux_chunks, concurrency, cache,
     group_capacity, paging_size, batch_cop, summary_sink, tracker,
     low_memory, small_groups, checker, backoff_weight=2,
-    replica_read="leader",
+    replica_read="leader", mesh=None, mesh_min_rows=0,
 ) -> Chunk:
     plan = split_dag(dag)
     if low_memory and plan.root_dag is not None:
@@ -227,6 +235,7 @@ def _execute_root(
             aux_chunks=aux_chunks or [], paging_size=paging_size,
             batch_cop=batch_cop, small_groups=small_groups, checker=checker,
             backoff_weight=backoff_weight, replica_read=replica_read,
+            mesh=mesh, mesh_min_rows=mesh_min_rows,
         ),
     )
     if summary_sink is not None:
@@ -284,7 +293,10 @@ def _execute_root_lowmem(store, plan: RootPlan, ranges, start_ts, aux_chunks, ca
     p2 = _partial2_dag(plan)
     if p2 is None:
         return None
-    req = KVRequest(plan.push_dag, ranges, start_ts, concurrency=1, aux_chunks=aux_chunks)
+    # mesh=False: the whole point here is ONE region's result live at a
+    # time — a mesh batch would stack every region back into memory
+    req = KVRequest(plan.push_dag, ranges, start_ts, concurrency=1,
+                    aux_chunks=aux_chunks, mesh=False)
     acc: Chunk | None = None
     for chunk, _sums in select_stream(store, req):
         if tracker is not None:

@@ -514,6 +514,18 @@ def _check_join_key_types(pkeys: list[CompVal], bkeys: list[CompVal]):
             raise TypeError("join key signedness mismatch (insert casts)")
 
 
+def _pack_cols(cols: list[CompVal]) -> list[tuple]:
+    """CompVals -> the program's packed output tuples: (value, null) per
+    column, raw string bytes + lengths riding along when present."""
+    packed = []
+    for c in cols:
+        if c.raw is not None:
+            packed.append((c.value, c.null, c.raw[0], c.raw[1]))
+        else:
+            packed.append((c.value, c.null))
+    return packed
+
+
 def build_program(
     dag: DAGRequest,
     capacities,
@@ -524,6 +536,9 @@ def build_program(
     unique_joins: bool = True,
     summaries: bool = True,
     vmap_batch: int | None = None,
+    mesh_lanes: int | None = None,
+    mesh_devices: int | None = None,
+    mesh_kind: str | None = None,
 ) -> CompiledDAG:
     """Compile the whole DAG tree (probe pipeline + all join build
     pipelines) into one fused XLA program over a tuple of device batches.
@@ -538,7 +553,20 @@ def build_program(
     broadcast join operand every region task carries. All outputs (packed
     columns, valid, n_rows, the overflow flags, ex_rows) gain a leading
     region axis; overflow is therefore PER REGION and the driver can retry
-    only the lanes that overflowed."""
+    only the lanes that overflowed.
+
+    mesh_lanes=R builds the MESH variant (the dispatch planner's top tier):
+    the region-stacked batch additionally SHARDS its leading axis over a
+    `mesh_devices`-wide 1-D device mesh under shard_map, each device vmaps
+    the per-region program over its local lanes, and the per-region results
+    merge ON DEVICE per `mesh_kind` — partial aggregate states psum/pmin/
+    pmax-reduced over the region axis ("scalar"), group-state tables
+    all_gathered and re-aggregated in merge mode ("group"), or top-k
+    candidates all_gathered and re-topped ("topn") — so the program returns
+    ONE merged result instead of R per-region partials (SURVEY §3.1/§5).
+    Mesh outputs: (merged packed cols, merged valid, per-lane ex_rows
+    [R, n_exec], overflow scalar); overflow is GLOBAL — the driver falls
+    back to the vmapped tier, whose per-lane ladder takes over."""
     if isinstance(capacities, int):
         capacities = (capacities,)
     capacities = tuple(capacities)
@@ -550,13 +578,7 @@ def build_program(
         state = _TraceState(summaries)
         cursor = [0]
         cols, valid, _ = _run_pipeline(dag.executors, batches, cursor, group_capacity, join_capacity, state, topn_full, small_groups, unique_joins, out_offsets=dag.output_offsets)
-        outs = [cols[i] for i in dag.output_offsets]
-        packed = []
-        for c in outs:
-            if c.raw is not None:
-                packed.append((c.value, c.null, c.raw[0], c.raw[1]))
-            else:
-                packed.append((c.value, c.null))
+        packed = _pack_cols([cols[i] for i in dag.output_offsets])
         n_out = valid.sum()
         # summaries off: no constant/empty-shaped stand-in — both a
         # 0-length output and a folded-constant output have SIGSEGV'd the
@@ -564,12 +586,133 @@ def build_program(
         ex = jnp.stack(state.ex_rows) if state.ex_rows else n_out[None].astype(jnp.int64)
         return packed, valid, n_out, (state.group_overflow, state.join_overflow, state.topn_overflow), ex
 
-    if vmap_batch is not None:
+    if mesh_lanes is not None:
+        jit_fn = _build_mesh_fn(dag, program, n_scans, mesh_lanes,
+                                mesh_devices or 1, mesh_kind, group_capacity)
+    elif vmap_batch is not None:
         # region axis on the probe batch only; aux/build batches broadcast
         jit_fn = jax.jit(jax.vmap(program, in_axes=(0,) + (None,) * (n_scans - 1)))
     else:
         jit_fn = jax.jit(program)
     return CompiledDAG(jit_fn, dag.output_fts(), capacities, group_capacity, join_capacity)
+
+
+def _build_mesh_fn(dag: DAGRequest, program, n_scans: int, lanes: int,
+                   n_devices: int, kind: str, group_capacity: int):
+    """shard_map wrapper: vmap the per-region program over each device's
+    local lanes, then merge the per-region results on device (psum of
+    partial states / merge-mode re-group / re-top-k) — the mesh tier's
+    program body. `lanes` must divide over `n_devices` (the store pads the
+    region axis with empty lanes)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.compat import shard_map
+    from ..parallel.mesh import REGION_AXIS, merge_packed_states, region_mesh
+
+    assert kind in ("scalar", "group", "topn"), f"unknown mesh kind {kind!r}"
+    assert lanes % n_devices == 0, "mesh lanes must divide over the devices"
+    mesh = region_mesh(n_devices)
+    last = dag.executors[-1]
+    out_fts = dag.output_fts()
+
+    def device_fn(local, *aux):
+        packed, valid, _n, ovfs, ex = jax.vmap(lambda b: program(b, *aux))(local)
+        local_ovf = ovfs[0].any() | ovfs[1].any() | ovfs[2].any()
+        if kind == "scalar":
+            # the north-star collective: partial states psum/pmin/pmax-
+            # reduced over the region axis (parallel/mesh.py merge seam)
+            merged = [tuple(t) for t in merge_packed_states(list(last.aggs), packed)]
+            mvalid = jnp.ones(1, bool)
+            m_ovf = jnp.bool_(False)
+        else:
+            cols, gvalid = _gather_mesh_outputs(packed, valid, out_fts)
+            if kind == "group":
+                out_cols, mvalid, m_ovf = _mesh_merge_group(
+                    last, out_fts, cols, gvalid, group_capacity)
+            else:
+                out_cols, mvalid, m_ovf = _mesh_merge_topn(last, out_fts, cols, gvalid)
+            merged = _pack_cols(out_cols)
+        ovf = jax.lax.pmax((local_ovf | m_ovf).astype(jnp.int32), REGION_AXIS) > 0
+        return merged, mvalid, ex, ovf
+
+    fn = shard_map(
+        device_fn,
+        mesh=mesh,
+        # prefix specs: the whole stacked probe batch shards its leading
+        # region axis; aux (join build) batches replicate to every device
+        in_specs=(P(REGION_AXIS),) + (P(),) * (n_scans - 1),
+        # merged cols / valid / overflow are replicated in fact (psum /
+        # all_gather-then-identical-local-work) but not statically
+        # inferrable by the vma check; ex_rows keep their region axis
+        out_specs=(P(), P(), P(REGION_AXIS), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def _gather_mesh_outputs(packed, valid, out_fts):
+    """Flatten the vmapped per-lane outputs [R_local, L, ...] to rows and
+    all_gather them over the mesh: every device ends with the SAME
+    [R_total*L] row block (device-major == region stack == task order), so
+    the merge stage below computes a replicated result with no further
+    communication. Raw string bytes ride whole — byte-exact, no packed-word
+    truncation."""
+    from ..parallel.mesh import REGION_AXIS
+
+    cols = []
+    for out, ft in zip(packed, out_fts):
+        flat = []
+        for a in out:
+            rows = a.reshape((-1,) + a.shape[2:])
+            g = jax.lax.all_gather(rows, REGION_AXIS)
+            flat.append(g.reshape((-1,) + g.shape[2:]))
+        if len(out) == 4:
+            cols.append(CompVal(flat[0], flat[1], ft, raw=(flat[2], flat[3])))
+        else:
+            cols.append(CompVal(flat[0], flat[1], ft))
+    gvalid = jax.lax.all_gather(valid.reshape(-1), REGION_AXIS).reshape(-1)
+    return cols, gvalid
+
+
+def _mesh_merge_group(agg, state_fts, cols, valid, group_capacity: int):
+    """Device-side merge of the gathered per-region group tables: the root
+    Final merge's Partial2 re-group (root.py _merge_aggregation, partial
+    output) traced INTO the mesh program — the output schema is the push
+    DAG's partial schema again, so one merged table per store replaces R
+    per-region tables while the root's Final pass runs unchanged."""
+    from dataclasses import replace as _replace
+
+    from ..distsql.root import _merge_aggregation
+
+    p2 = _replace(_merge_aggregation(agg), partial=True)
+    comp = ExprCompiler(state_fts)
+    gvals = comp.run(list(p2.group_by), cols)
+    garg_exprs = [a for d in p2.aggs for a in d.args]
+    avals = comp.run(garg_exprs, cols) if garg_exprs else []
+    aggs = []
+    k = 0
+    for d in p2.aggs:
+        aggs.append((d, avals[k: k + len(d.args)]))
+        k += len(d.args)
+    res = group_aggregate(gvals, aggs, valid, group_capacity, merge=True)
+    new_cols: list[CompVal] = []
+    for (d, av), st in zip(aggs, res.states):
+        new_cols.extend(_agg_result_cols(d, av, st, res.group_valid, True))
+    new_cols.extend(_gather(gvals, res.group_rep))
+    return new_cols, res.group_valid, res.overflow
+
+
+def _mesh_merge_topn(ex, fts, cols, valid):
+    """Device-side re-top-k over the gathered per-region candidates
+    (global top-k ⊆ union of per-region top-k): the order expressions
+    recompute over the candidate rows — TopN preserves its input schema,
+    so the same exprs apply. full_sort: the candidate block is tiny
+    (R*k rows) and the exact variant never overflows."""
+    comp = ExprCompiler(fts)
+    order_vals = comp.run([e for e, _ in ex.order_by], cols)
+    by = list(zip(order_vals, [d for _, d in ex.order_by]))
+    idx, out_valid, _ovf = topn(by, valid, ex.limit, full_sort=True)
+    return _gather(cols, idx), out_valid, jnp.bool_(False)
 
 
 def _agg_result_cols(a, av: list[CompVal], st, group_valid, partial: bool) -> list[CompVal]:
@@ -623,9 +766,13 @@ class ProgramCache:
         small_groups: int | None = None,
         unique_joins: bool = True,
         vmap_batch: int | None = None,
+        mesh_lanes: int | None = None,
+        mesh_devices: int | None = None,
+        mesh_kind: str | None = None,
     ) -> CompiledDAG:
         return self.get_info(dag, capacities, group_capacity, join_capacity,
-                             topn_full, small_groups, unique_joins, vmap_batch)[0]
+                             topn_full, small_groups, unique_joins, vmap_batch,
+                             mesh_lanes, mesh_devices, mesh_kind)[0]
 
     def get_info(
         self,
@@ -637,6 +784,9 @@ class ProgramCache:
         small_groups: int | None = None,
         unique_joins: bool = True,
         vmap_batch: int | None = None,
+        mesh_lanes: int | None = None,
+        mesh_devices: int | None = None,
+        mesh_kind: str | None = None,
     ) -> tuple:
         """(program, cache_hit, compile_ns) — the attribution triple the
         exec summaries and the TRACE span tree surface (ref: the
@@ -652,7 +802,10 @@ class ProgramCache:
         # pallas mode is read at TRACE time (env + backend): a program
         # traced under one mode must not serve another (mismatched
         # buffer counts at execution)
-        key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, vmap_batch, pallas_mode())
+        # mesh programs are specialized to their lane count AND device
+        # count (shard_map shapes both into the trace); mesh_kind is
+        # derivable from the fingerprint but cheap to carry explicitly
+        key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, vmap_batch, pallas_mode(), mesh_lanes, mesh_devices, mesh_kind)
         prog = self._cache.get(key)
         if prog is not None:
             with self._stats_mu:
@@ -666,13 +819,16 @@ class ProgramCache:
                 self.compiles += 1
             metrics.PROGRAM_COMPILES.inc()
             t0 = _t.perf_counter_ns()
-            prog = build_program(dag, capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, vmap_batch=vmap_batch)
+            prog = build_program(dag, capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, vmap_batch=vmap_batch,
+                                 mesh_lanes=mesh_lanes, mesh_devices=mesh_devices, mesh_kind=mesh_kind)
             compile_ns = _t.perf_counter_ns() - t0
             metrics.PROGRAM_COMPILE_DURATION.observe(compile_ns / 1e9)
             if sp is not None:
                 sp.set("compile_ns", compile_ns)
                 if vmap_batch is not None:
                     sp.set("batch_size", vmap_batch)
+                if mesh_lanes is not None:
+                    sp.set("mesh_lanes", mesh_lanes)
         self._cache[key] = prog
         metrics.PROGRAM_CACHE_ENTRIES.set(len(self._cache))
         return prog, False, compile_ns

@@ -207,6 +207,60 @@ def drive_batched_program_info(
     return per_region, info
 
 
+def drive_mesh_program_info(
+    cache: ProgramCache,
+    dag: DAGRequest,
+    stacked,
+    aux_batches,
+    group_capacity: int,
+    kind: str,
+    mesh_devices: int,
+    join_capacity: int | None = None,
+    small_groups: int | None = None,
+):
+    """ONE shard_map launch over a region-stacked batch — the device half
+    of the MESH dispatch tier: the stacked lanes shard over the device
+    mesh, each device vmaps the fused program over its local regions, and
+    the per-region partial results merge ON DEVICE (psum of partial
+    aggregate states over the region axis / merge-mode re-group / re-top-k
+    per `kind`) so the caller gets ONE merged chunk instead of R
+    per-region partials.
+
+    Returns (chunk, lane_counts, info): `chunk` is the merged result (None
+    when the program's global overflow flag fired — the caller degrades to
+    the vmapped tier, whose per-lane capacity ladder takes over);
+    lane_counts[b] is lane b's per-executor produced-row counts (the same
+    honest per-region numbers the vmap tier reports); info is the shared
+    {"cache_hit", "compile_ns"} attribution."""
+    import time as _time
+
+    from ..util import metrics
+
+    R = int(stacked.row_valid.shape[0])
+    cap = int(stacked.row_valid.shape[1])
+    caps = (cap,) + tuple(b.capacity for b in aux_batches)
+    jc = join_capacity or max(caps)
+    prog, hit, build_ns = cache.get_info(
+        dag, caps, group_capacity, jc, False, small_groups, True,
+        mesh_lanes=R, mesh_devices=mesh_devices, mesh_kind=kind,
+    )
+    t0 = _time.perf_counter_ns()
+    metrics.PROGRAM_LAUNCHES.inc()
+    merged, mvalid, ex_rows, ovf = prog.fn(stacked, *aux_batches)
+    overflow = bool(np.asarray(ovf))
+    info = {"cache_hit": hit, "compile_ns": 0}
+    if not hit:
+        # the flag fetch above blocked on the result: first-call time is
+        # trace+compile, same attribution as drive_program_info
+        info["compile_ns"] = build_ns + (_time.perf_counter_ns() - t0)
+    ex_np = np.asarray(ex_rows)
+    lane_counts = [[int(x) for x in ex_np[b]] for b in range(R)]
+    if overflow:
+        return None, lane_counts, info
+    chunk = decode_outputs(merged, np.asarray(mvalid), prog.out_fts)
+    return chunk, lane_counts, info
+
+
 def _group_key_partition(chunk: Chunk, key_cols: list[int], n_parts: int, salt: int = 0) -> list[Chunk]:
     """Split rows by a host-side hash of the named columns: equal keys land
     in the same part, so per-part aggregation results are disjoint. `salt`

@@ -32,11 +32,16 @@ dynamically; fixed capacity + retry is the TPU analog).
 
 Layout: every input lane is int32 shaped [N/128, 128] (int64 values ride
 as bitcast hi/lo pairs — Mosaic has no 64-bit vectors). Exact integer
-sums come from 4x12-bit limb accumulation of the biased value (v + 2^46):
-per-lane-column int32 accumulators stay below 2^31 for any N < 2^31, and
-the XLA epilogue reconstructs the int64 totals as
+sums come from 4x12-bit limb accumulation of the biased value (v + 2^46),
+and the XLA epilogue reconstructs the int64 totals as
 sum(limb_l << 12l) - nn_count * 2^46. Values at or beyond +/-2^46 raise
-the overflow flag.
+the overflow flag. The per-lane-column int32 accumulators bound the ROW
+count, not just the values: each of the N/128 rows in a lane column can
+add up to 2^12-1 per limb, so the accumulator reaches ~N*2^5 and
+silently wraps past int32 around N ~ 2^26 (~67M rows). Eligibility is
+therefore gated on N < MAX_ROWS (2^26); larger batches ride the XLA
+dense/sort kernels, whose int64 accumulation has no such bound
+(ADVICE r5 medium — the old docstring claimed safety for any N < 2^31).
 
 The whole pallas_call is traced under jax.enable_x64(False): this
 platform's remote Mosaic compiler rejects 64-bit grid/index arithmetic,
@@ -63,6 +68,10 @@ MAX_COMBOS = 6        # distinct (value, null) argument combos
 NH = 4                # independent 32-bit hash chains (128-bit identity)
 NL = 4                # 12-bit limbs: covers |v| < 2^46 after biasing
 BIAS = 1 << 46        # value bias making every in-range addend non-negative
+# int32 limb-accumulator row bound: (N/128 rows per lane column) * (2^12-1
+# max limb) must stay below 2^31 -> N < ~2^26.06; gate at 2^26 (module
+# docstring "Layout" paragraph; ADVICE r5 medium)
+MAX_ROWS = 1 << 26
 _ALLOWED = frozenset({"count", "sum", "avg"})
 
 
@@ -146,6 +155,12 @@ def dense_pallas_eligible(group_bys, aggs, merge: bool) -> bool:
     the XLA dense/sort kernels. The gate is a performance router, never a
     semantics change."""
     if merge or not group_bys:
+        return False
+    # row-count bound BEFORE any value work: the 12-bit limb accumulators
+    # silently wrap past int32 at ~2^26 rows (see MAX_ROWS) — shape-only
+    # check, so ineligible giants never materialize key folds
+    n = group_bys[0].null.shape[0]
+    if n >= MAX_ROWS:
         return False
     if _key_words(group_bys) is None:
         return False

@@ -235,7 +235,11 @@ def _pack_keys(both, ok, side):
     its one batched overflow any() (-> the general-kernel retry, exactly
     as rebased range overflow always did)."""
     k32 = both.astype(jnp.int32)
-    in_range = (both == k32.astype(jnp.int64)) & (jnp.abs(k32) < (_PK_RANGE - 2))
+    # range check in int64: jnp.abs(k32) wraps for INT32_MIN (abs returns
+    # INT32_MIN itself, which passes < 2^30-2), so key -2^31 would pack to
+    # pk 0 and silently join as phantom key 0 (ADVICE r5 high). `both` is
+    # already int64 — |key| in that domain is exact for every int32 value.
+    in_range = (both == k32.astype(jnp.int64)) & (jnp.abs(both) < (_PK_RANGE - 2))
     usable = ok & in_range
     pk = jnp.where(
         usable,

@@ -23,13 +23,11 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from .compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..chunk.device import DeviceBatch
 from ..exec.dag import Aggregation, DAGRequest, Selection
-from ..exec.executor import decode_outputs
 from ..expr.compile import CompVal, ExprCompiler, normalize_device_column
 from ..ops import apply_selection, group_aggregate
 from ..ops.aggregate import GatherState, finalize_agg
@@ -253,23 +251,10 @@ def run_sharded_grouped_agg(
         return agg_exchange_phases(agg, input_fts, cvals, valid, n_parts, group_capacity, bcap)
 
     spec_batch = jax.tree.map(lambda _: P(REGION_AXIS), stacked)
-    n_group = len(agg.group_by)
-    n_out_cols = len(agg.aggs) + n_group
-    out_spec = [P(REGION_AXIS)] * (1 + 2 * n_out_cols) + [P()]
-    fn = shard_map(device_fn, mesh=mesh, in_specs=(spec_batch,), out_specs=tuple(out_spec), check_vma=False)
-    outs = jax.jit(fn)(stacked)
-    group_valid = np.asarray(outs[0]).reshape(-1)
-    overflow = bool(np.asarray(outs[-1]).reshape(-1)[0])
-    flat_out = outs[1:-1]
+    from .mesh import decode_group_mesh_outputs, group_mesh_out_spec
 
-    # decode: [agg results..., group keys...] with Complete-mode fts
-    out_fts = [d.ft for d in agg.aggs] + [g.ft for g in agg.group_by]
-    packed = []
-    for i, ft in enumerate(out_fts):
-        # out_specs P(REGION_AXIS) already concatenated the device tables
-        # along axis 0: [D*G_cap] (or [D*G_cap, W+1] for string keys)
-        v = np.asarray(flat_out[2 * i])
-        nl = np.asarray(flat_out[2 * i + 1]).reshape(-1)
-        packed.append((v, nl))
-    chunk = decode_outputs(packed, group_valid, out_fts)
-    return chunk, overflow
+    fn = shard_map(device_fn, mesh=mesh, in_specs=(spec_batch,), out_specs=group_mesh_out_spec(agg), check_vma=False)
+    outs = jax.jit(fn)(stacked)
+    # decode: [agg results..., group keys...] with Complete-mode fts —
+    # the shared seam (mesh.py) both grouped paths use
+    return decode_group_mesh_outputs(outs, agg)

@@ -191,25 +191,11 @@ def run_sharded_join_agg(
     from .compat import shard_map
     from jax.sharding import PartitionSpec as P
 
+    from .mesh import decode_group_mesh_outputs, group_mesh_out_spec
+
     spec_p = jax.tree.map(lambda _: P(REGION_AXIS), stacked_probe)
     spec_bs = tuple(jax.tree.map(lambda _: P(REGION_AXIS), sb) for sb in stacked_builds)
-    n_out_cols = len(agg.aggs) + len(agg.group_by)
-    out_spec = [P(REGION_AXIS)] * (1 + 2 * n_out_cols) + [P()]
-    fn = shard_map(device_fn, mesh=mesh, in_specs=(spec_p, *spec_bs), out_specs=tuple(out_spec), check_vma=False)
+    fn = shard_map(device_fn, mesh=mesh, in_specs=(spec_p, *spec_bs), out_specs=group_mesh_out_spec(agg), check_vma=False)
     outs = jax.jit(fn)(stacked_probe, *stacked_builds)
-
-    import numpy as np
-
-    from ..exec.executor import decode_outputs
-
-    group_valid = np.asarray(outs[0]).reshape(-1)
-    overflow = bool(np.asarray(outs[-1]).reshape(-1)[0])
-    flat_out = outs[1:-1]
-    out_fts = [d.ft for d in agg.aggs] + [g.ft for g in agg.group_by]
-    packed = []
-    for i, ft in enumerate(out_fts):
-        v = np.asarray(flat_out[2 * i])
-        nl = np.asarray(flat_out[2 * i + 1]).reshape(-1)
-        packed.append((v, nl))
-    chunk = decode_outputs(packed, group_valid, out_fts)
-    return chunk, overflow
+    # decode via the shared seam (mesh.py) — same layout as grouped.py
+    return decode_group_mesh_outputs(outs, agg)

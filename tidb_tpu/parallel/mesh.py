@@ -10,22 +10,27 @@ merge loop, which is the BASELINE.json north star:
 
     "per-region partial aggregates are psum-reduced over the ICI mesh
      before final merge"
+
+This module owns the SHARED merge seam: `partial_merge_plan` +
+`merge_packed_states` (psum for sum/count/avg/moments, pmin/pmax with the
+flipped unsigned domain, all_gather for bit/first states) are consumed both
+by the standalone `run_sharded_partial_agg` entry point and by
+`exec/builder.py`'s mesh-tier programs, so the standard `distsql.select`
+dispatch and the parallel/sql.py mesh_select path merge states with ONE
+implementation. Region stacking likewise delegates to the chunk layer's
+`to_stacked_device_batch` — the same host-side stacking the batch
+coprocessor uses — instead of a second device-side stack.
 """
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
-from ..chunk import Chunk, to_device_batch
-from ..chunk.device import DeviceBatch, DeviceColumn
-from ..exec.dag import Aggregation, DAGRequest
-from ..expr.compile import ExprCompiler, normalize_device_column
-from ..ops import GatherState, apply_selection, scalar_aggregate
+from ..chunk import Chunk
+from ..chunk.device import DeviceBatch, to_stacked_device_batch
 
 REGION_AXIS = "region"
 
@@ -41,143 +46,129 @@ def stack_region_batches(chunks: list[Chunk], capacity: int | None = None, n_tot
 
     All regions pad to a common capacity and common string widths so the
     stacked arrays are rectangular; `n_total` (>= len(chunks)) additionally
-    pads the region axis so R is divisible by the mesh size.
+    pads the region axis with empty lanes so R is divisible by the mesh
+    size. Delegates to the chunk layer's `to_stacked_device_batch` — ONE
+    stacking implementation serves the batch coprocessor, the mesh tier
+    and this entry point (host-side np.stack, one HBM transfer per column).
     """
     cap = capacity or max(1, max(c.num_rows() for c in chunks))
-    # common string width per column
-    str_widths: dict[int, int] = {}
-    for c in chunks:
-        for ci, col in enumerate(c.columns):
-            if col.is_varlen():
-                w = int((col.offsets[1:] - col.offsets[:-1]).max()) if len(col) else 1
-                str_widths[ci] = max(str_widths.get(ci, 1), w)
-    batches = [to_device_batch(c, capacity=cap, str_widths=str_widths or None) for c in chunks]
-    R = n_total or len(batches)
-    while len(batches) < R:
-        batches.append(to_device_batch(Chunk.empty(chunks[0].field_types()), capacity=cap, str_widths=str_widths or None))
-
-    def stack(*xs):
-        return jnp.stack(xs)
-
-    return jax.tree.map(stack, *batches)
+    fts = chunks[0].field_types()
+    total = n_total or len(chunks)
+    padded = list(chunks) + [Chunk.empty(fts) for _ in range(total - len(chunks))]
+    return to_stacked_device_batch(padded, cap)
 
 
-def run_sharded_partial_agg(dag: DAGRequest, stacked: DeviceBatch, mesh: Mesh):
+def run_sharded_partial_agg(dag, stacked: DeviceBatch, mesh: Mesh):
     """Scalar-aggregation pushdown over a region-sharded mesh.
 
     DAG shape: TableScan [Selection] Aggregation(group_by=(), partial=True).
-    Each device: vmap the fused selection over its local regions, reduce the
-    partial states across local regions, then psum across the mesh — every
-    device ends with the global partial states (the final merge is a single
-    host-side finalize).
+    Each device vmaps the fused per-region program over its local regions,
+    then the partial states merge across the mesh (`merge_packed_states`:
+    psum for additive states, pmin/pmax for extremes, all_gather for
+    bit/first states) — every device ends with the global partial states.
 
-    Returns list of per-agg state arrays (each [1] after the global merge).
+    The per-region pipeline is the builder's own trace (`exec/builder.py`
+    build_program(mesh_lanes=...)), not a second hand-rolled interpreter —
+    the duplicated scan/selection/agg walk this module used to carry is
+    retired onto that shared seam.
+
+    Returns the flat partial-state columns [(value[1], null[1]), ...].
     """
-    executors = dag.executors
-    agg = executors[-1]
-    assert isinstance(agg, Aggregation) and not agg.group_by, "sharded scalar agg only"
-    input_fts = [c.ft for c in dag.scan().columns]
+    from dataclasses import replace as _replace
 
-    def per_region(cols_and_valid):
-        cols, valid = cols_and_valid
-        fts = input_fts
-        cvals = [normalize_device_column(c) for c in cols]
-        for ex in executors[1:-1]:
-            comp = ExprCompiler(fts)
-            from ..exec.dag import Selection as Sel
+    from ..distsql.planner import mesh_merge_kind
+    from ..exec.builder import build_program
+    from ..exec.dag import current_schema_fts
 
-            if isinstance(ex, Sel):
-                conds = comp.run(list(ex.conditions), cvals)
-                valid = apply_selection(valid, conds)
-            else:
-                raise TypeError(f"sharded pipeline supports scan+selection+agg, got {ex}")
-        comp = ExprCompiler(input_fts)
-        arg_exprs = [a for desc in agg.aggs for a in desc.args]
-        avals = comp.run(arg_exprs, cvals) if arg_exprs else []
-        aggs = []
-        k = 0
-        for desc in agg.aggs:
-            aggs.append((desc, avals[k : k + len(desc.args)]))
-            k += len(desc.args)
-        states, _ovf = scalar_aggregate(aggs, valid, merge=agg.merge)
-        # (scalar-path overflow only arises from DISTINCT hash collisions,
-        # which the mesh path rejects upstream — _ovf stays False here)
-        # flatten to arrays: per agg, per state col: (value[1], null[1]);
-        # first_row comes back as a GatherState — materialize its [has,
-        # value] wire state here (numeric only on the mesh path)
-        flat = []
-        for (desc, avs), st in zip(aggs, states):
-            if isinstance(st, GatherState):
-                vcol = avs[-1]
-                if vcol.value.ndim != 1:
-                    raise NotImplementedError(
-                        f"string-valued gather aggregate {desc.name!r} (first_row/min/max) over the mesh"
-                    )
-                val = jnp.where(st.has, vcol.value[st.idx], jnp.zeros((), vcol.value.dtype))
-                nl = jnp.where(st.has, vcol.null[st.idx], True)
-                flat.append((st.has.astype(jnp.int64), jnp.zeros(1, bool)))
-                flat.append((val, nl))
-            else:
-                for v, nl in st:
-                    flat.append((v, nl))
-        return flat
+    # this entry point always returns EVERY partial-state column — widen
+    # the offsets to the full partial schema (callers pass scan-shaped
+    # offsets; the merge plan is positional over the state columns)
+    n_state = len(current_schema_fts(dag.executors))
+    dag = _replace(dag, output_offsets=tuple(range(n_state)))
+    # the scalar merge plan is positional over flat [1]-shaped states — a
+    # grouped DAG's per-region group tables are NOT key-aligned across
+    # lanes and must fail fast, as this entry point always did. String
+    # gather states trip the planner gate statically here (the in-trace
+    # merge would raise the same NotImplementedError class).
+    last = dag.executors[-1]
+    from ..exec.dag import Aggregation as _Agg
 
-    # merge plan per aggregate (the schema in expr/agg.py partial_fts:
-    # count->[cnt], sum->[sum], avg->[cnt,sum], first_row->[has,val], ...).
-    # Column entries are (op, unsigned): unsigned BIGINT min/max states are
-    # raw two's-complement int64 (ops/aggregate.py sign-flip trick), so the
-    # mesh merge must compare them in the flipped domain too. first_row's
-    # two state columns merge JOINTLY (value selected by the has column).
-    merge_plan: list[tuple] = []  # ("col", op, unsigned) | ("first_row",)
-    for desc in agg.aggs:
+    assert isinstance(last, _Agg) and not last.group_by, "sharded scalar agg only"
+    if mesh_merge_kind(dag) != "scalar":
+        raise NotImplementedError(
+            "string-valued gather aggregate (first_row/min/max) over the mesh"
+        )
+    R = int(stacked.row_valid.shape[0])
+    cap = int(stacked.row_valid.shape[1])
+    prog = build_program(
+        dag, (cap,), mesh_lanes=R, mesh_devices=int(mesh.devices.size),
+        mesh_kind="scalar",
+    )
+    merged, _valid, _ex, _ovf = prog.fn(stacked)
+    return [tuple(out) for out in merged]
+
+
+# --------------------------------------------------------- the merge seam
+
+def partial_merge_plan(aggs) -> list[tuple]:
+    """Merge plan per aggregate (the schema in expr/agg.py partial_fts:
+    count->[cnt], sum->[sum], avg->[cnt,sum], first_row->[has,val],
+    stddev/var->[cnt,sum,sumsq], ...).
+
+    Column entries are ("col", op, unsigned): unsigned BIGINT min/max
+    states are raw two's-complement int64 (ops/aggregate.py sign-flip
+    trick), so the mesh merge must compare them in the flipped domain too.
+    first_row's two state columns merge JOINTLY (value selected by the has
+    column) via the ("first_row",) entry consuming both."""
+    plan: list[tuple] = []
+    for desc in aggs:
         sfts = desc.partial_fts()
-        if desc.name in ("count", "sum", "avg", "bit_xor"):
-            # avg states are [count, sum] — both additive; bit_xor merge is xor
+        if desc.name in ("count", "sum", "avg", "bit_xor",
+                         "stddev_pop", "stddev_samp", "var_pop", "var_samp"):
+            # avg states are [count, sum], moment states [count, sum,
+            # sumsq] — all additive; bit_xor merge is xor
             op = "sum" if desc.name != "bit_xor" else "xor"
-            merge_plan.extend(("col", op, False) for _ in sfts)
+            plan.extend(("col", op, False) for _ in sfts)
         elif desc.name in ("min", "max"):
-            merge_plan.extend(("col", desc.name, ft.is_unsigned() and ft.is_int()) for ft in sfts)
+            plan.extend(("col", desc.name, ft.is_unsigned() and ft.is_int()) for ft in sfts)
         elif desc.name in ("bit_and", "bit_or"):
-            merge_plan.extend(("col", "and" if desc.name == "bit_and" else "or", False) for _ in sfts)
+            plan.extend(("col", "and" if desc.name == "bit_and" else "or", False) for _ in sfts)
         elif desc.name == "first_row":
-            merge_plan.append(("first_row",))
+            plan.append(("first_row",))
         else:
             raise TypeError(f"no mesh merge for aggregate {desc.name!r}")
-
-    def device_fn(local: DeviceBatch):
-        # local: [R_local, cap] pytree
-        flat = jax.vmap(lambda c, v: per_region((c, v)))(local.cols, local.row_valid)
-        merged = []
-        k = 0
-        for entry in merge_plan:
-            if entry[0] == "first_row":
-                merged.extend(_merge_first_row(flat[k], flat[k + 1], REGION_AXIS))
-                k += 2
-            else:
-                _, op, unsigned = entry
-                v, nl = flat[k]
-                merged.append(_merge_state(op, v, nl, REGION_AXIS, unsigned=unsigned))
-                k += 1
-        return merged
-
-    from .compat import shard_map
-
-    spec_batch = jax.tree.map(lambda _: P(REGION_AXIS), stacked)
-    out_spec = [(P(), P())] * _n_state_cols(agg)
-    fn = shard_map(
-        device_fn,
-        mesh=mesh,
-        in_specs=(spec_batch,),
-        out_specs=out_spec,
-        # first/bit states merge via all_gather + identical local reduce:
-        # replicated in fact, but not statically inferrable by the vma check
-        check_vma=False,
-    )
-    return jax.jit(fn)(stacked)
+    return plan
 
 
-def _n_state_cols(agg: Aggregation) -> int:
-    return sum(len(d.partial_fts()) for d in agg.aggs)
+def merge_packed_states(aggs, packed, axis: str = REGION_AXIS) -> list[tuple]:
+    """Merge a vmapped partial-agg program's packed outputs across the
+    mesh. `packed` is the per-lane output list — one (value[R_local, 1],
+    null[R_local, 1]) pair per partial-state column, in `partial_merge_plan`
+    order (exactly `exec/builder.py`'s packing for a scalar partial-agg
+    DAG). Returns the globally merged [(value[1], null[1]), ...]."""
+    plan = partial_merge_plan(aggs)
+    merged: list[tuple] = []
+    k = 0
+    for entry in plan:
+        if entry[0] == "first_row":
+            has_out, val_out = packed[k], packed[k + 1]
+            if len(val_out) != 2 or val_out[0].ndim != 2:
+                raise NotImplementedError(
+                    "string-valued gather aggregate (first_row/min/max) over the mesh"
+                )
+            merged.extend(_merge_first_row(
+                (has_out[0], has_out[1]), (val_out[0], val_out[1]), axis))
+            k += 2
+            continue
+        _, op, unsigned = entry
+        out = packed[k]
+        if len(out) != 2 or out[0].ndim != 2:
+            raise NotImplementedError(
+                "string-valued gather aggregate (first_row/min/max) over the mesh"
+            )
+        merged.append(_merge_state(op, out[0], out[1], axis, unsigned=unsigned))
+        k += 1
+    return merged
 
 
 def _merge_state(op: str, v, nl, axis: str, unsigned: bool = False):
@@ -249,3 +240,32 @@ def _merge_first_row(has_state, val_state, axis: str):
     val = jnp.where(any_has & ~null, val, jnp.zeros((), v.dtype))
     null = jnp.where(any_has, null, True)
     return [(any_has.astype(jnp.int64), jnp.zeros_like(null)), (val, null)]
+
+
+def decode_group_mesh_outputs(outs, agg):
+    """Shared host-side decode for the grouped shard_map programs
+    (grouped.py / joinmesh.py): flat output tuple [group_valid,
+    (value, null)*, overflow] with out_specs P(REGION_AXIS) having already
+    concatenated the per-device group tables along axis 0. Returns
+    (chunk, overflow) in the Complete-mode layout [aggs..., group keys...].
+    """
+    from ..exec.executor import decode_outputs
+
+    group_valid = np.asarray(outs[0]).reshape(-1)
+    overflow = bool(np.asarray(outs[-1]).reshape(-1)[0])
+    flat_out = outs[1:-1]
+    out_fts = [d.ft for d in agg.aggs] + [g.ft for g in agg.group_by]
+    packed = []
+    for i, _ft in enumerate(out_fts):
+        v = np.asarray(flat_out[2 * i])
+        nl = np.asarray(flat_out[2 * i + 1]).reshape(-1)
+        packed.append((v, nl))
+    return decode_outputs(packed, group_valid, out_fts), overflow
+
+
+def group_mesh_out_spec(agg):
+    """out_specs for the grouped shard_map programs' flat output tuple."""
+    from jax.sharding import PartitionSpec as P
+
+    n_out_cols = len(agg.aggs) + len(agg.group_by)
+    return tuple([P(REGION_AXIS)] * (1 + 2 * n_out_cols) + [P()])

@@ -190,6 +190,17 @@ def ast_digest(stmt) -> str:
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:32]
 
 
+def _sql_str_escape(s: str) -> str:
+    """Escape a value for embedding in a single-quoted SQL literal.
+
+    The lexer honors BOTH backslash escapes and doubled quotes
+    (parser/lexer.py), so doubling quotes alone is not enough: a value
+    ending in a lone backslash would swallow the closing quote and break
+    out of the literal (ADVICE r5 low — the CREATE/DROP USER mirror SQL).
+    Backslashes must double FIRST, then quotes."""
+    return s.replace("\\", "\\\\").replace("'", "''")
+
+
 class SQLError(ValueError):
     """User-facing statement error. `code` is the MySQL error number the
     wire server puts in the ERR packet (ref: pkg/errno; 1105 = generic
@@ -358,8 +369,11 @@ class Session:
         }
         if stmt.scope == "global":
             try:
-                o = stmt.target_sql.replace("'", "''")
-                b = stmt.hinted_sql.replace("'", "''")
+                # same escape contract as the user mirror: backslashes
+                # must double BEFORE quotes or a trailing \ breaks out of
+                # the literal and the binding silently fails to mirror
+                o = _sql_str_escape(stmt.target_sql)
+                b = _sql_str_escape(stmt.hinted_sql)
                 self.execute(
                     "insert into mysql.bind_info (original_sql, bind_sql, default_db, "
                     f"status, source, sql_digest) values ('{o}', '{b}', '{self.db}', "
@@ -689,7 +703,7 @@ class Session:
                     # simple.go executeCreateUser writes the row directly);
                     # delete-then-insert keeps IF NOT EXISTS re-runs at one
                     # row, and quotes in names must be SQL-escaped
-                    ne, he = name.replace("'", "''"), host.replace("'", "''")
+                    ne, he = _sql_str_escape(name), _sql_str_escape(host)
                     try:
                         self.execute(
                             f"delete from `mysql.user` where User = '{ne}' and Host = '{he}'"
@@ -709,7 +723,7 @@ class Session:
             try:
                 for name, host in stmt.users:
                     self.catalog.privileges.drop_user(name, host, stmt.if_exists)
-                    ne, he = name.replace("'", "''"), host.replace("'", "''")
+                    ne, he = _sql_str_escape(name), _sql_str_escape(host)
                     try:
                         self.execute(
                             f"delete from `mysql.user` where User = '{ne}' and Host = '{he}'"
@@ -1429,6 +1443,8 @@ class Session:
                                 else None
                             ),
                             batch_cop=self.sysvars.get_bool("tidb_allow_batch_cop"),
+                            mesh=self.sysvars.get_bool("tidb_enable_tpu_mesh"),
+                            mesh_min_rows=self.sysvars.get_int("tidb_tpu_mesh_min_rows"),
                             summary_sink=self._explain_sink,
                             checker=self._runaway_checker(),
                             backoff_weight=self.sysvars.get_int("tidb_backoff_weight"),
@@ -2893,6 +2909,17 @@ class Session:
             saved = sum(b.get("launches_saved", 0) for b in batch_stats)
             out.append([Datum.string("batch_cop"), Datum.i64(regions), Datum.i64(batches),
                         Datum.NULL, Datum.NULL, Datum.string(f"saved={saved}"), Datum.NULL])
+            mesh_lanes = sum(b.get("mesh_lanes", 0) for b in batch_stats)
+            if mesh_lanes:
+                # mesh-tier attribution: rows=region lanes whose partial
+                # states psum-merged ON DEVICE, tasks=shard_map launches —
+                # the store answered ONE merged state per launch, so the
+                # root merge saw `launches` rows instead of `lanes`
+                mesh_batches = sum(b.get("mesh_batches", 0) for b in batch_stats)
+                out.append([Datum.string("mesh_cop"), Datum.i64(mesh_lanes),
+                            Datum.i64(mesh_batches), Datum.NULL, Datum.NULL,
+                            Datum.string(f"merged={mesh_lanes}->{mesh_batches}"),
+                            Datum.NULL])
         out.append([Datum.string("result"), Datum.i64(len(out_rows)), Datum.i64(1),
                     Datum.NULL, Datum.NULL, Datum.NULL, Datum.NULL])
         return Result(columns=["executor", "rows", "tasks", "time", "compile", "cache", "bytes"], rows=out)

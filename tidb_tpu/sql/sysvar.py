@@ -71,6 +71,9 @@ DEFINITIONS = {
         # all_to_all exchange -> Final); needs >= 2 devices at runtime
         # (ref: TiDBAllowMPPExecution / enforce-mpp engine selection)
         SysVar("tidb_enable_tpu_mesh", "ON", "both", _bool_validator),
+        # data-size floor for the mesh DISPATCH tier (distsql/planner.py):
+        # below this estimated row count the vmapped batch tier serves
+        SysVar("tidb_tpu_mesh_min_rows", "0", "both", _int_validator(0, 1 << 40)),
         # ref: sysvar.go:1956 TiDBDistSQLScanConcurrency
         SysVar("tidb_distsql_scan_concurrency", "4", "both", _int_validator(1, 256)),
         # ref: sysvar.go:2080 TiDBMaxChunkSize

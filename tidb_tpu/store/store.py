@@ -16,6 +16,7 @@ the distsql layer exercises the same retry/re-split path as the reference
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from dataclasses import dataclass, field, replace
@@ -69,6 +70,13 @@ class CopRequest:
     replica_read: bool = False  # follower read: a non-leader peer may
     # serve IF its safe_ts covers start_ts, else DataIsNotReady
     # (ref: kvrpcpb.Context.replica_read)
+    mesh: bool = False  # the dispatch planner chose the MESH tier for this
+    # store batch: shard the stacked lanes over the device mesh and merge
+    # the per-region partial states on device (psum over the region axis)
+    # instead of returning R per-region partials (distsql/planner.py)
+    mesh_min_rows: int = 0  # tidb_tpu_mesh_min_rows carried to the store:
+    # the AUTHORITATIVE data-size floor, applied to the group's actually
+    # decoded row total (the client's estimate only gated the attempt)
 
 
 @dataclass
@@ -97,6 +105,10 @@ class CopResponse:
     # the cop cache, an overflow fall-out, or a single-path degrade); the
     # value identifies the launch within its batch_coprocessor call, so the
     # dispatch layer can count distinct launches for launches_saved
+    mesh_merged: int = 0  # nonzero = this lane's partial state was merged
+    # ON DEVICE with its group's other lanes (psum over the region axis);
+    # the value is the number of lanes the one merged state covers — the
+    # group's FIRST lane carries the merged chunk, the rest answer empty
 
 
 def _fault_matches(value, store_id: int) -> bool:
@@ -899,6 +911,7 @@ class TPUStore:
                 req.dag.fingerprint(),
                 req.start_ts,
                 req.small_groups,
+                bool(req.mesh),
                 tuple(self._chunk_token(c) for c in req.aux_chunks),
             )
             groups.setdefault(key, []).append((i, req, region))
@@ -907,8 +920,149 @@ class TPUStore:
                 i, req, _region = entries[0]
                 responses[i] = self.coprocessor(req, group_capacity)
                 continue
+            if entries[0][1].mesh and self._run_cop_mesh(entries, responses, group_capacity):
+                continue  # merged on device; else degrade to the vmap tier
             self._run_cop_batch(entries, responses, group_capacity)
         return responses
+
+    # data-size floor for the mesh tier on ACTUAL decoded rows (the
+    # client's estimate only gated the attempt): below it the vmapped
+    # batch tier serves — a shard_map launch is not worth its compile
+    # for a handful of rows. Env-tunable for benches.
+    MESH_MIN_GROUP_ROWS = int(os.environ.get("TIDB_TPU_MESH_MIN_ROWS", "0"))
+
+    def _run_cop_mesh(self, entries, responses, group_capacity: int) -> bool:
+        """ONE shard_map launch for a same-DAG group of region tasks (the
+        dispatch planner's MESH tier): decode every lane, stack to the
+        group's max pow2 capacity, pad the region axis onto the device
+        mesh, and merge the per-region partial states ON DEVICE — psum
+        over the region axis for additive aggregate states, pmin/pmax for
+        extremes, a merge-mode re-group for GROUP BY tables, a re-top-k
+        for TopN. The group's first lane answers with the ONE merged
+        chunk; the rest answer empty with the same mesh_merged marker, so
+        the root-side merge consumes a single state per store.
+
+        Returns True when every lane was answered; False degrades the
+        whole group to the vmapped batch tier (ineligible DAG, too few
+        rows, overflow, or any trace/launch failure) — which owns the
+        per-lane capacity ladder and the oracle fallback."""
+        import jax
+
+        from ..distsql.planner import mesh_merge_kind
+        from ..exec.dag import executor_walk
+        from ..exec.executor import drive_mesh_program_info
+        from ..util import metrics, tracing
+
+        req0 = entries[0][1]
+        dag = req0.dag
+        kind = mesh_merge_kind(dag)
+        if kind is None:
+            return False
+        t0 = time.monotonic_ns()
+        try:
+            with tracing.span("cop.mesh_decode", regions=len(entries)) as dsp:
+                chunks = [
+                    self.region_chunk(region, req.ranges, dag, req.start_ts)
+                    for (_i, req, region) in entries
+                ]
+                if dsp is not None:
+                    dsp.set("bytes_to_device", sum(ch.nbytes() for ch in chunks))
+                aux_batches = [self._aux_batch(c) for c in req0.aux_chunks]
+        except Exception:  # noqa: BLE001 — degrade, never lose the group
+            return False
+        floor = max(self.MESH_MIN_GROUP_ROWS, req0.mesh_min_rows)
+        if sum(ch.num_rows() for ch in chunks) < floor:
+            # data-size tier rule: small groups ride vmap (counted like
+            # every other mesh decline so dashboards can tell "declined"
+            # from "never attempted")
+            metrics.MESH_COP_FALLBACKS.inc()
+            return False
+        caps = [_pow2(max(ch.num_rows(), 1)) for ch in chunks]
+        cap = max(caps)
+        # skew guard (#review): every lane pads to the group MAX capacity,
+        # so one post-split giant among small regions would inflate the
+        # stacked footprint toward lanes*max — the exact hazard the vmap
+        # tier's pow2 BUCKETING exists for. When padding would waste >4x
+        # the honest per-lane footprint, degrade to the bucketed tier.
+        if cap * len(caps) > 4 * sum(caps):
+            metrics.MESH_COP_FALLBACKS.inc()
+            return False
+        n_devs = len(jax.devices())
+        D = min(n_devs, len(chunks))
+        R_pad = -(-len(chunks) // D) * D  # empty lanes pad the region axis
+        fts = chunks[0].field_types()
+        lanes = list(chunks) + [Chunk.empty(fts) for _ in range(R_pad - len(chunks))]
+        try:
+            with tracing.span("cop.mesh_execute", regions=len(entries),
+                              devices=D, kind=kind) as xsp:
+                stacked = to_stacked_device_batch(lanes, cap)
+                merged, lane_counts, info = drive_mesh_program_info(
+                    self.programs, dag, stacked, aux_batches, group_capacity,
+                    kind, D, small_groups=req0.small_groups,
+                )
+                if xsp is not None:
+                    xsp.set("cache_hit", info["cache_hit"])
+        except Exception:  # noqa: BLE001 — degrade, never lose the group
+            metrics.MESH_COP_FALLBACKS.inc()
+            return False
+        if merged is None:
+            # global overflow flag: the vmapped tier's PER-LANE ladder
+            # isolates the overflowing region instead
+            metrics.MESH_COP_FALLBACKS.inc()
+            return False
+        elapsed = time.monotonic_ns() - t0
+        share = elapsed // max(len(entries), 1)
+        walk = executor_walk(dag.executors)
+        out_fts = merged.field_types()
+        metrics.MESH_COP_BATCHES.inc()
+        for k, (i, req, region) in enumerate(entries):
+            metrics.MESH_COP_LANES.inc()
+            # the first lane carries the one merged state; the rest answer
+            # empty — concatenation at root sees exactly one row block per
+            # store, the "no per-region host merge" contract
+            out_chunk = merged if k == 0 else Chunk.empty(out_fts)
+            summaries = self._lane_attribution(
+                region, chunks[k], out_chunk.nbytes() if k == 0 else 0,
+                lane_counts[k], share,
+                compile_ns=info["compile_ns"] if k == 0 else 0,
+                cache_hit=info["cache_hit"] if k == 0 else True, walk=walk,
+            )
+            # NOT cop-cached: the merged state covers the whole group, not
+            # one region's data version — a later request with a different
+            # lane set must not inherit it
+            responses[i] = CopResponse(
+                chunk=out_chunk, exec_summaries=summaries, batched=1,
+                mesh_merged=len(entries),
+            )
+        return True
+
+    def _lane_attribution(self, region, in_chunk, out_bytes: int, counts,
+                          share: int, compile_ns: int, cache_hit: bool,
+                          walk) -> list:
+        """Shared per-lane attribution for the vmapped-bucket and mesh
+        launch loops: PD read flow, cop metrics, and the ExecSummary list
+        (the fused program's time shared across the lane's executors;
+        bytes attribute to the data movers — scan in, final executor
+        out). Keeping ONE copy means EXPLAIN ANALYZE / flow accounting
+        changes cannot drift between the two batched tiers."""
+        from ..util import metrics
+
+        self.pd.flow.record_read(region.region_id, in_chunk.nbytes(),
+                                 in_chunk.num_rows())
+        metrics.COP_REQUESTS.inc()
+        metrics.COP_DURATION.observe(share / 1e9)
+        in_b = in_chunk.nbytes()
+        summaries = [
+            ExecSummary(
+                time_processed_ns=share, num_produced_rows=r,
+                time_compile_ns=compile_ns, cache_hit=cache_hit,
+                num_bytes=in_b if j == 0 else (out_bytes if j == len(counts) - 1 else 0),
+            )
+            for j, r in enumerate(counts)
+        ]
+        for ex, r in zip(walk, counts):
+            metrics.COP_EXECUTOR_ROWS.labels(type(ex).__name__.lower()).inc(r)
+        return summaries
 
     def _run_cop_batch(self, entries, responses, group_capacity: int) -> None:
         """Decode a same-DAG group of region tasks, bucket by shared pow2
@@ -993,31 +1147,20 @@ class TPUStore:
                 responses[i] = self.coprocessor(req, group_capacity)
                 continue
             chunk, ex_rows = res
+            metrics.BATCH_COP_REGIONS.inc()
             # read flow ONLY for lanes the batch actually served — fall-out
             # lanes (and whole-bucket degrades) record theirs inside the
-            # single path, so the PD never sees a region's read twice
-            self.pd.flow.record_read(region.region_id, ch.nbytes(), ch.num_rows())
-            metrics.COP_REQUESTS.inc()
-            metrics.BATCH_COP_REGIONS.inc()
-            metrics.COP_DURATION.observe(share / 1e9)
+            # single path, so the PD never sees a region's read twice.
             # compile time belongs to the ONE shared program: the first lane
             # carries it, the rest are cache hits by construction
-            compile_ns = info["compile_ns"] if served == 0 else 0
-            cache_hit = info["cache_hit"] if served == 0 else True
+            summaries = self._lane_attribution(
+                region, ch, chunk.nbytes(), ex_rows, share,
+                compile_ns=info["compile_ns"] if served == 0 else 0,
+                cache_hit=info["cache_hit"] if served == 0 else True, walk=walk,
+            )
             served += 1
-            in_b, out_b = ch.nbytes(), chunk.nbytes()
-            summaries = [
-                ExecSummary(
-                    time_processed_ns=share, num_produced_rows=r,
-                    time_compile_ns=compile_ns, cache_hit=cache_hit,
-                    num_bytes=in_b if k == 0 else (out_b if k == len(ex_rows) - 1 else 0),
-                )
-                for k, r in enumerate(ex_rows)
-            ]
-            for ex, r in zip(walk, ex_rows):
-                metrics.COP_EXECUTOR_ROWS.labels(type(ex).__name__.lower()).inc(r)
             resp = CopResponse(chunk=chunk, exec_summaries=summaries, batched=batch_id)
-            self._cop_cache_put(req, resp, flow=(in_b, ch.num_rows()), write_ver=write_ver)
+            self._cop_cache_put(req, resp, flow=(ch.nbytes(), ch.num_rows()), write_ver=write_ver)
             responses[i] = resp
         if served > 1:
             metrics.BATCH_COP_LAUNCHES_SAVED.inc(served - 1)
