@@ -971,6 +971,145 @@ def _cdc_main():
     }))
 
 
+def _htap_main():
+    """BENCH_HTAP=1: the heavy mixed-traffic scenario (ISSUE 12; ref:
+    TiDB VLDB'20 §6's CH-benCHmark-style OLTP+OLAP interference study) —
+    an OLTP write mix and concurrent OLAP aggregation scans run together,
+    once with the columnar replica OFF (every scan rides the row-store
+    cop path, invalidating its caches against the writes) and once ON
+    (engine routing sends scans to the replica). Reports OLTP p50/p99
+    under both, replica scan throughput (rows/sec through served scans),
+    and the freshness lag p50/p99 sampled at each pd tick. Hermetic CPU."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    import random
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tidb_tpu.codec import tablecodec
+    from tidb_tpu.sql.session import Session
+    from tidb_tpu.util import metrics
+
+    n_stores, n_regions, seed_rows = 4, 8, 2000
+    n_writes = int(os.environ.get("BENCH_HTAP_WRITES", "240"))
+    tick_every = 8
+    s = Session()
+    s.execute("CREATE TABLE htap_t (id BIGINT PRIMARY KEY, v BIGINT, g BIGINT)")
+    for lo in range(0, seed_rows, 500):
+        s.execute("INSERT INTO htap_t VALUES " + ",".join(
+            f"({i},{(i * 31) % 97},{i % 8})" for i in range(lo, min(lo + 500, seed_rows))))
+    tid = s.catalog.table("htap_t").table_id
+    for i in range(1, n_regions):
+        s.store.cluster.split(tablecodec.encode_row_key(tid, i * seed_rows // n_regions))
+    s.store.cluster.set_stores(n_stores)
+    s.store.cluster.scatter()
+    s.execute("ALTER TABLE htap_t SET COLUMNAR REPLICA 1")
+    s.store.pd.tick()  # birth scan + first fold
+
+    olap_sqls = [
+        "SELECT g, count(*), sum(v) FROM htap_t GROUP BY g ORDER BY g",
+        "SELECT max(v), min(v), count(*) FROM htap_t WHERE v < 60",
+        "SELECT id, v FROM htap_t ORDER BY v DESC, id LIMIT 20",
+    ]
+    for q in olap_sqls:  # warm both engines' program caches
+        s.execute(q)
+        s.execute("SET tidb_isolation_read_engines = 'tpu'")
+        s.execute(q)
+        s.execute("SET tidb_isolation_read_engines = 'tpu,columnar'")
+
+    next_id = [seed_rows]  # shared across phases: inserted ids never reuse
+
+    def one_phase(engines: str) -> dict:
+        """OLTP writer (main thread, timed per statement) + one OLAP
+        scanner thread on its own session — the shared-store testkit
+        pattern. Returns the phase report."""
+        olap = Session(store=s.store, catalog=s.catalog)
+        olap.execute(f"SET tidb_isolation_read_engines = '{engines}'")
+        stop = threading.Event()
+        olap_stats = {"scans": 0, "rows": 0, "errors": 0}
+
+        def scanner():
+            k = 0
+            while not stop.is_set():
+                try:
+                    r = olap.execute(olap_sqls[k % len(olap_sqls)])
+                    olap_stats["scans"] += 1
+                    olap_stats["rows"] += len(r.rows)
+                except Exception:  # noqa: BLE001 — typed retryable noise
+                    olap_stats["errors"] += 1
+                k += 1
+
+        rng = random.Random(23)
+        lat_ms: list[float] = []
+        lags: list[int] = []
+        th = threading.Thread(target=scanner, daemon=True)
+        scans0 = metrics.COLUMNAR_SCANS.value
+        t_phase = time.perf_counter()
+        th.start()
+        try:
+            for i in range(n_writes):
+                roll = rng.randrange(4)
+                if roll == 0:
+                    sql = f"INSERT INTO htap_t VALUES ({next_id[0]},{rng.randrange(97)},{next_id[0] % 8})"
+                    next_id[0] += 1
+                elif roll in (1, 2):
+                    sql = f"UPDATE htap_t SET v = {rng.randrange(97)} WHERE id = {rng.randrange(next_id[0])}"
+                else:
+                    sql = f"DELETE FROM htap_t WHERE id = {rng.randrange(next_id[0])}"
+                t0 = time.perf_counter()
+                s.execute(sql)
+                lat_ms.append((time.perf_counter() - t0) * 1000.0)
+                if (i + 1) % tick_every == 0:
+                    # sample freshness BEFORE the tick: the lag a reader
+                    # arriving now would see (post-tick lag is 0 by
+                    # construction — the tick just advanced the frontier)
+                    for v in s.store.columnar.views():
+                        lags.append(v["resolved_ts_lag"])
+                    s.store.pd.tick()
+        finally:
+            stop.set()
+            th.join(timeout=10)
+        wall = time.perf_counter() - t_phase
+        lat = sorted(lat_ms)
+        lag = sorted(lags)
+
+        def pct(xs, p):
+            return xs[min(int(len(xs) * p), len(xs) - 1)] if xs else 0
+
+        return {
+            "oltp_p50_ms": round(pct(lat, 0.50), 3),
+            "oltp_p99_ms": round(pct(lat, 0.99), 3),
+            "oltp_stmts_per_sec": round(n_writes / max(wall, 1e-9), 1),
+            "olap_scans": olap_stats["scans"],
+            "olap_rows_per_sec": round(olap_stats["rows"] / max(wall, 1e-9), 1),
+            "olap_errors": olap_stats["errors"],
+            "replica_scans_served": int(metrics.COLUMNAR_SCANS.value - scans0),
+            "freshness_lag_p50": pct(lag, 0.50),
+            "freshness_lag_p99": pct(lag, 0.99),
+        }
+
+    off = one_phase("tpu")
+    on = one_phase("tpu,columnar")
+    print(json.dumps({
+        "metric": "htap_mixed_traffic",
+        "compile_s": round(_compile_seconds(), 2),
+        "rows": seed_rows,
+        "regions": n_regions,
+        "stores": n_stores,
+        "writes_per_phase": n_writes,
+        "replica_off": off,
+        "replica_on": on,
+        "oltp_p99_ratio_on_vs_off": round(
+            on["oltp_p99_ms"] / max(off["oltp_p99_ms"], 1e-9), 3),
+    }))
+
+
 def _mesh_main():
     """BENCH_MESH=1: host-merge vs on-device-psum dispatch (ISSUE 11) —
     the same scalar-aggregate scan over a PD-split table, dispatched (a)
@@ -1085,6 +1224,9 @@ def main():
         return
     if os.environ.get("BENCH_CDC"):
         _cdc_main()
+        return
+    if os.environ.get("BENCH_HTAP"):
+        _htap_main()
         return
     if os.environ.get("BENCH_PD_SKEW"):
         _pd_skew_main()

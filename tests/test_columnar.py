@@ -1,0 +1,648 @@
+"""HTAP columnar replica (ISSUE 12): delta+stable layers fed by the
+changefeed, background compaction on the pd.columnar tick, engine
+routing via tidb_isolation_read_engines with typed-staleness fallback,
+the mid-feed DDL guard, the columnar/* failpoints, and the HTAP chaos
+acceptance (ref: TiDB VLDB'20's TiFlash + DeltaTree design)."""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.sql.session import Session, SQLError
+from tidb_tpu.util import failpoint, metrics
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def norm(v):
+    return None if v is None else str(v)
+
+
+def make_replicated(rows=40):
+    s = Session()
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT, g BIGINT)")
+    if rows:
+        s.execute("INSERT INTO t VALUES " + ",".join(
+            f"({i},{(i * 7) % 13},{i % 3})" for i in range(rows)))
+    s.execute("ALTER TABLE t SET COLUMNAR REPLICA 1")
+    s.store.pd.tick()  # birth incremental scan + first compaction
+    return s
+
+
+def both_engines(s, sql):
+    """(routed result, row-store result) back to back — single-threaded,
+    so both see the same snapshot."""
+    s.execute("SET tidb_isolation_read_engines = 'tpu,columnar'")
+    got = s.execute(sql).values()
+    s.execute("SET tidb_isolation_read_engines = 'tpu'")
+    want = s.execute(sql).values()
+    s.execute("SET tidb_isolation_read_engines = 'tpu,columnar'")
+    return got, want
+
+
+# ------------------------------------------------------------ engine routing
+
+class TestEngineRouting:
+    def test_aggregate_scan_rides_the_replica_and_matches_row_store(self):
+        s = make_replicated()
+        sc0 = metrics.COLUMNAR_SCANS.value
+        got, want = both_engines(
+            s, "SELECT g, count(*), sum(v) FROM t GROUP BY g ORDER BY g")
+        assert got == want
+        assert metrics.COLUMNAR_SCANS.value == sc0 + 1
+
+    def test_topn_rides_the_replica(self):
+        s = make_replicated()
+        sc0 = metrics.COLUMNAR_SCANS.value
+        got, want = both_engines(
+            s, "SELECT id, v FROM t ORDER BY v DESC, id LIMIT 7")
+        assert got == want
+        assert metrics.COLUMNAR_SCANS.value == sc0 + 1
+
+    def test_range_scan_with_agg_routes_and_agrees(self):
+        s = make_replicated()
+        sc0 = metrics.COLUMNAR_SCANS.value
+        got, want = both_engines(
+            s, "SELECT count(*), max(v) FROM t WHERE id BETWEEN 5 AND 25")
+        assert got == want
+        assert metrics.COLUMNAR_SCANS.value > sc0
+
+    def test_point_get_and_row_local_scans_never_route(self):
+        s = make_replicated()
+        sc0 = metrics.COLUMNAR_SCANS.value
+        s.execute("SELECT * FROM t WHERE id = 3")
+        s.execute("SELECT id, v FROM t WHERE v > 4 ORDER BY id")
+        assert metrics.COLUMNAR_SCANS.value == sc0
+
+    def test_in_txn_reads_stay_on_the_row_store(self):
+        s = make_replicated()
+        sc0 = metrics.COLUMNAR_SCANS.value
+        s.execute("BEGIN")
+        r = s.execute("SELECT count(*) FROM t").values()
+        s.execute("COMMIT")
+        assert r == [[40]]
+        assert metrics.COLUMNAR_SCANS.value == sc0
+
+    def test_partitioned_table_routes_across_pids(self):
+        s = Session()
+        s.execute("CREATE TABLE pt (a BIGINT PRIMARY KEY, v BIGINT) "
+                  "PARTITION BY HASH(a) PARTITIONS 3")
+        s.execute("INSERT INTO pt VALUES " + ",".join(
+            f"({i},{i % 11})" for i in range(30)))
+        s.execute("ALTER TABLE pt SET COLUMNAR REPLICA 1")
+        s.store.pd.tick()
+        sc0 = metrics.COLUMNAR_SCANS.value
+        got, want = both_engines(s, "SELECT count(*), sum(v) FROM pt")
+        assert got == want
+        assert metrics.COLUMNAR_SCANS.value == sc0 + 1
+
+    def test_join_probe_on_replica_matches(self):
+        s = make_replicated()
+        s.execute("CREATE TABLE d (g BIGINT PRIMARY KEY, name VARCHAR(8))")
+        s.execute("INSERT INTO d VALUES (0,'a'),(1,'b'),(2,'c')")
+        s.store.pd.tick()
+        got, want = both_engines(
+            s, "SELECT t.g, d.name, count(*) FROM t JOIN d ON t.g = d.g "
+               "GROUP BY t.g, d.name ORDER BY t.g")
+        assert got == want
+
+    def test_explain_analyze_keeps_the_cop_path(self):
+        s = make_replicated()
+        sc0 = metrics.COLUMNAR_SCANS.value
+        r = s.execute("EXPLAIN ANALYZE SELECT g, count(*) FROM t GROUP BY g")
+        assert metrics.COLUMNAR_SCANS.value == sc0  # attribution needs cop
+        assert any("push" in str(row[0]) for row in r.values())
+
+    def test_trace_has_columnar_scan_span(self):
+        s = make_replicated()
+        r = s.execute("TRACE SELECT g, count(*) FROM t GROUP BY g").values()
+        assert any("columnar.scan" in str(row[0]) for row in r)
+
+
+# ------------------------------------- sysvar validation (ISSUE 12 satellite)
+
+class TestIsolationReadEnginesSysvar:
+    def test_unknown_engine_rejected_at_set_time(self):
+        s = Session()
+        with pytest.raises(SQLError, match="unknown isolation read engine"):
+            s.execute("SET tidb_isolation_read_engines = 'bogus'")
+        with pytest.raises(SQLError, match="unknown isolation read engine"):
+            s.execute("SET GLOBAL tidb_isolation_read_engines = 'tpu,nope'")
+
+    def test_reference_names_normalize_to_this_builds_engines(self):
+        s = Session()
+        s.execute("SET tidb_isolation_read_engines = 'tikv,tiflash,tidb'")
+        assert s.execute("SELECT @@tidb_isolation_read_engines").values() == [["tpu,columnar"]]
+        s.execute("SET SESSION tidb_isolation_read_engines = 'TiFlash'")
+        assert s.execute("SELECT @@tidb_isolation_read_engines").values() == [["columnar"]]
+
+    def test_empty_engine_list_rejected(self):
+        s = Session()
+        with pytest.raises(SQLError, match="at least one engine"):
+            s.execute("SET tidb_isolation_read_engines = ''")
+
+    def test_default_is_normalized(self):
+        s = Session()
+        assert s.execute("SELECT @@tidb_isolation_read_engines").values() == [["tpu,columnar"]]
+
+
+# --------------------------------------------- mounter -> scan parity matrix
+
+class TestTypeMatrixParity:
+    def test_every_column_type_survives_delta_compaction_and_scan(self):
+        """mounter -> delta -> compaction -> stable scan reproduces the
+        row store byte for byte over the full type matrix, NULLs
+        included (ISSUE 12 satellite; the cdc mounter-parity test's
+        columnar sibling)."""
+        s = Session()
+        s.execute("""CREATE TABLE m (
+            id BIGINT PRIMARY KEY, i INT, u BIGINT UNSIGNED, f FLOAT,
+            d DOUBLE, dec DECIMAL(10,2), dt DATETIME, da DATE,
+            j JSON, e ENUM('a','b','c'), cs VARCHAR(16) COLLATE utf8mb4_general_ci,
+            vb VARBINARY(16))""")
+        s.execute("INSERT INTO m VALUES "
+                  "(1, -5, 18446744073709551610, 1.5, 2.25, '12345.67', "
+                  "'2024-02-29 12:34:56', '2024-02-29', '{\"k\": [1, 2]}', 'b', 'Ab', x'00ff10'),"
+                  "(2, NULL, NULL, NULL, NULL, NULL, NULL, NULL, NULL, NULL, NULL, NULL),"
+                  "(3, 7, 0, -0.5, 1e10, '-0.01', '1999-12-31 23:59:59', '1970-01-01', "
+                  "'[true, null]', 'c', 'zz', x'')")
+        s.execute("ALTER TABLE m SET COLUMNAR REPLICA 1")
+        s.store.pd.tick()
+        meta = s.catalog.table("m")
+        t = s.store.columnar.table_for(meta.table_id)
+        assert t.view()["stable_rows"] == 3 and t.view()["delta_rows"] == 0
+        chunk, _batch = t.scan(t.frontier()[0], None)
+        got = [[norm(None if d.is_null() else d.val) for d in chunk.row(i)]
+               for i in range(chunk.num_rows())]
+        want = [[norm(v) for v in row]
+                for row in s.execute("SELECT * FROM m ORDER BY id").values()]
+        assert got == want
+
+    def test_delete_and_overwrite_fold_in_compaction(self):
+        s = make_replicated(rows=10)
+        s.execute("UPDATE t SET v = 100 WHERE id = 3")
+        s.execute("UPDATE t SET v = 200 WHERE id = 3")
+        s.execute("DELETE FROM t WHERE id = 4")
+        s.store.pd.tick()
+        meta = s.catalog.table("t")
+        t = s.store.columnar.table_for(meta.table_id)
+        v = t.view()
+        assert v["delta_rows"] == 0  # everything folded
+        assert v["stable_rows"] == 9  # 10 - 1 delete
+        chunk, _ = t.scan(t.frontier()[0], None)
+        by_id = {chunk.row(i)[0].val: chunk.row(i)[1].val
+                 for i in range(chunk.num_rows())}
+        assert by_id[3] == 200  # overwrite folded to the LATEST version
+        assert 4 not in by_id  # delete folded away
+        got, want = both_engines(s, "SELECT count(*), sum(v) FROM t")
+        assert got == want
+
+    def test_delta_overlay_serves_before_compaction(self):
+        """Applied-but-not-folded changes (compact-stall) serve through
+        the delta overlay, still byte-identical to the row store."""
+        s = make_replicated(rows=10)
+        with failpoint.enabled("columnar/compact-stall"):
+            s.execute("UPDATE t SET v = 999 WHERE id = 2")
+            s.execute("DELETE FROM t WHERE id = 5")
+            s.execute("INSERT INTO t VALUES (77, 7, 1)")
+            s.store.pd.tick()  # advances the frontier, skips the fold
+            meta = s.catalog.table("t")
+            t = s.store.columnar.table_for(meta.table_id)
+            assert t.view()["delta_rows"] > 0
+            got, want = both_engines(
+                s, "SELECT count(*), sum(v), max(v) FROM t")
+            assert got == want
+        s.store.pd.tick()
+        assert t.view()["delta_rows"] == 0  # disarmed: the fold catches up
+
+
+# ----------------------------------------------------------------- staleness
+
+class TestStaleness:
+    def test_scan_beyond_frontier_falls_back_not_torn(self):
+        """A write the frontier has not resolved yet: the routed query
+        answers from the ROW STORE (counted fallback) — correct data,
+        never a torn columnar prefix (ISSUE 12 satellite)."""
+        s = make_replicated(rows=10)
+        fb0 = metrics.COLUMNAR_FALLBACKS.value
+        sc0 = metrics.COLUMNAR_SCANS.value
+        s.execute("INSERT INTO t VALUES (50, 9, 0)")  # no tick: frontier lags
+        got, want = both_engines(s, "SELECT count(*), sum(v) FROM t")
+        assert got == want
+        assert got[0][0] == 11
+        assert str(got[0][1]) == str(sum((i * 7) % 13 for i in range(10)) + 9)
+        assert metrics.COLUMNAR_FALLBACKS.value > fb0
+        assert metrics.COLUMNAR_SCANS.value == sc0
+        s.store.pd.tick()  # frontier catches up: the replica serves again
+        got2, _ = both_engines(s, "SELECT count(*), sum(v) FROM t")
+        assert got2 == got
+        assert metrics.COLUMNAR_SCANS.value > sc0
+
+    def test_in_flight_write_blocks_the_frontier_shortcut(self):
+        """The applied>=max_committed equivalence shortcut must be
+        proven under a quiescent WriteGuard double-sample: a writer
+        inside its [commit-ts draw .. apply] window has a ts drawn but
+        nothing in kv yet, so serving at the frontier could miss its
+        commit (review finding) — the routed read must fall back."""
+        s = make_replicated(rows=8)
+        fb0 = metrics.COLUMNAR_FALLBACKS.value
+        sc0 = metrics.COLUMNAR_SCANS.value
+        with s.store.cdc.guard.writing():  # an in-flight write bracket
+            got, want = both_engines(s, "SELECT count(*), sum(v) FROM t")
+        assert got == want
+        assert metrics.COLUMNAR_SCANS.value == sc0
+        assert metrics.COLUMNAR_FALLBACKS.value > fb0
+        # quiescent again: the shortcut serves
+        got2, _ = both_engines(s, "SELECT count(*), sum(v) FROM t")
+        assert got2 == got
+        assert metrics.COLUMNAR_SCANS.value > sc0
+
+    def test_rename_table_keeps_replica_attached_and_disposable(self):
+        """RENAME TABLE mutates meta.name in place: the replica registry
+        is keyed by table id, so routing follows the new name and
+        REPLICA 0 under the new name really drops the feed (no orphaned
+        GC safepoint; review finding)."""
+        s = make_replicated(rows=12)
+        s.execute("ALTER TABLE t RENAME TO u")
+        s.store.pd.tick()
+        assert s.store.columnar.views()[0]["table"] == "u"
+        sc0 = metrics.COLUMNAR_SCANS.value
+        got, want = both_engines(s, "SELECT count(*), sum(v) FROM u")
+        assert got == want
+        assert metrics.COLUMNAR_SCANS.value > sc0
+        s.execute("ALTER TABLE u SET COLUMNAR REPLICA 0")
+        assert s.execute("SHOW COLUMNAR TABLES").values() == []
+        assert s.execute("SHOW CHANGEFEEDS").values() == []  # feed dropped,
+        # its GC-safepoint pin released with it
+        s.execute("ALTER TABLE u SET COLUMNAR REPLICA 1")  # re-enable works
+        s.store.pd.tick()
+        assert len(s.execute("SHOW CHANGEFEEDS").values()) == 1
+
+    def test_stale_read_below_compaction_floor_falls_back(self):
+        """tidb_snapshot older than the stable floor: the overwritten
+        versions were folded away, so the replica declines and the row
+        store's MVCC serves the historical read."""
+        s = make_replicated(rows=6)
+        old = s.store.kv.max_committed()
+        s.execute("UPDATE t SET v = 500 WHERE id = 1")
+        s.store.pd.tick()  # folds the overwrite; floor moves past `old`
+        fb0 = metrics.COLUMNAR_FALLBACKS.value
+        s.execute(f"SET tidb_snapshot = '{old}'")
+        r = s.execute("SELECT max(v), count(*) FROM t").values()
+        s.execute("SET tidb_snapshot = ''")
+        assert r[0][1] == 6 and r[0][0] < 500  # pre-update snapshot
+        assert metrics.COLUMNAR_FALLBACKS.value > fb0
+
+
+# ------------------------------------------- mid-feed DDL guard (satellite)
+
+class TestSchemaDriftGuard:
+    def test_alter_mid_feed_parks_instead_of_mounting(self):
+        from tidb_tpu.cdc import MemorySink
+
+        s = Session()
+        s.execute("CREATE TABLE g (id BIGINT PRIMARY KEY, v BIGINT)")
+        meta = s.catalog.table("g")
+        feed = s.store.cdc.create("gf", MemorySink(), s.catalog,
+                                  table_ids={meta.table_id}, start_ts=0)
+        s.execute("INSERT INTO g VALUES (1, 10)")
+        s.store.cdc.tick()
+        assert len(feed.sink.rows()) == 1
+        s.execute("ALTER TABLE g ADD COLUMN w BIGINT DEFAULT 7")
+        s.execute("INSERT INTO g VALUES (2, 20, 21)")
+        s.store.cdc.tick()
+        v = feed.view(s.store)
+        assert v["state"] == "error"
+        assert "schema drift" in v["error"]
+        assert len(feed.sink.rows()) == 1  # nothing mounted on drift
+        checkpoint_held = v["checkpoint_ts"]
+        s.store.cdc.tick()
+        assert feed.view(s.store)["checkpoint_ts"] == checkpoint_held
+
+    def test_resume_restamps_and_replays(self):
+        from tidb_tpu.cdc import MemorySink
+
+        s = Session()
+        s.execute("CREATE TABLE g (id BIGINT PRIMARY KEY, v BIGINT)")
+        meta = s.catalog.table("g")
+        feed = s.store.cdc.create("gf", MemorySink(), s.catalog,
+                                  table_ids={meta.table_id}, start_ts=0)
+        s.execute("INSERT INTO g VALUES (1, 10)")
+        s.store.cdc.tick()
+        s.execute("ALTER TABLE g ADD COLUMN w BIGINT DEFAULT 7")
+        s.execute("INSERT INTO g VALUES (2, 20, 21)")
+        s.store.cdc.tick()
+        assert feed.view(s.store)["state"] == "error"
+        s.store.cdc.resume("gf")  # the operator accepts the new schema
+        s.store.cdc.tick()
+        v = feed.view(s.store)
+        assert v["state"] == "normal" and v["error"] == ""
+        rows = feed.sink.rows()
+        assert [r.handle for r in rows] == [1, 2]
+        assert dict(rows[1].columns)["w"].val == 21  # mounted on NEW schema
+
+    def test_resume_after_unrelated_park_still_catches_drift(self):
+        """RESUME only acknowledges a drift the operator actually SAW
+        (the park reason was SchemaDriftError). A feed paused before the
+        ALTER keeps its birth stamps across resume, so the old-shape
+        backlog still parks instead of silently mounting against the
+        new catalog (review finding)."""
+        from tidb_tpu.cdc import MemorySink
+
+        s = Session()
+        s.execute("CREATE TABLE g (id BIGINT PRIMARY KEY, v BIGINT)")
+        meta = s.catalog.table("g")
+        feed = s.store.cdc.create("gf", MemorySink(), s.catalog,
+                                  table_ids={meta.table_id}, start_ts=0)
+        s.execute("INSERT INTO g VALUES (1, 10)")
+        s.store.cdc.pause("gf")  # parked for an UNRELATED reason
+        s.execute("ALTER TABLE g ADD COLUMN w BIGINT DEFAULT 7")
+        s.store.cdc.resume("gf")  # must NOT absorb the drift
+        s.store.cdc.tick()
+        v = feed.view(s.store)
+        assert v["state"] == "error"
+        assert "schema drift" in v["error"]
+        assert feed.sink.rows() == []  # nothing mounted on the new catalog
+        s.store.cdc.resume("gf")  # NOW the drift was seen: acknowledged
+        s.store.cdc.tick()
+        assert feed.view(s.store)["state"] == "normal"
+        assert [r.handle for r in feed.sink.rows()] == [1]
+
+    def test_parked_columnar_feed_degrades_scans_to_row_store(self):
+        s = make_replicated(rows=8)
+        s.execute("ALTER TABLE t ADD COLUMN extra BIGINT DEFAULT 0")
+        s.execute("INSERT INTO t VALUES (90, 1, 1, 5)")
+        s.store.pd.tick()  # the columnar feed parks on drift
+        assert s.store.columnar.views()[0]["state"] == "error"
+        fb0 = metrics.COLUMNAR_FALLBACKS.value
+        got, want = both_engines(s, "SELECT count(*), sum(extra) FROM t")
+        assert got == want
+        assert got[0][0] == 9 and str(got[0][1]) == "5"
+        # the replica held the OLD schema: routed-then-declined fallback
+        assert metrics.COLUMNAR_FALLBACKS.value > fb0
+
+    def test_partition_moving_update_keeps_the_row(self):
+        """An UPDATE that moves a row across partitions emits delete(old
+        pid) + put(new pid) at the SAME commit ts, and the value-less
+        delete fans to every pid — the fold's put-wins-ties rule must
+        keep the new partition's live row (review finding)."""
+        s = Session()
+        s.execute("CREATE TABLE pm (id BIGINT, p BIGINT, v BIGINT) "
+                  "PARTITION BY HASH(p) PARTITIONS 4")
+        s.execute("INSERT INTO pm VALUES (1, 3, 10), (2, 1, 20), (3, 2, 30)")
+        s.execute("ALTER TABLE pm SET COLUMNAR REPLICA 1")
+        s.store.pd.tick()
+        # move DOWN in pid order: the new pid's put sorts before the old
+        # pid's delete in the (ts, key) batch, so without put-wins-ties
+        # the fanned tombstone erases the freshly moved row
+        s.execute("UPDATE pm SET p = 0 WHERE id = 1")
+        s.store.pd.tick()
+        got, want = both_engines(
+            s, "SELECT count(*), sum(p), sum(v) FROM pm")
+        assert got == want
+        assert got[0][0] == 3  # the moved row survived the tombstone fan
+
+    def test_post_resume_new_shape_rows_park_with_rebuild_reason(self):
+        """After a column DDL parks the columnar feed, RESUME re-stamps —
+        but the replica's layers are frozen at the OLD row shape, so the
+        sink parks again with the rebuild instruction instead of
+        applying misaligned rows (review finding); a 0-then-1 replica
+        toggle rebuilds and serves again."""
+        s = make_replicated(rows=4)
+        s.execute("ALTER TABLE t ADD COLUMN extra BIGINT DEFAULT 0")
+        s.execute("INSERT INTO t VALUES (90, 1, 1, 5)")
+        s.store.pd.tick()  # parks on schema drift
+        assert s.store.columnar.views()[0]["state"] == "error"
+        s.store.columnar.resume_all()  # operator accepts the new schema
+        s.store.pd.tick()  # replays — the sink refuses the new shape
+        v = s.store.columnar.views()[0]
+        assert v["state"] == "error"
+        assert "rebuild" in s.execute("SHOW CHANGEFEEDS").values()[0][9]
+        s.execute("ALTER TABLE t SET COLUMNAR REPLICA 0")
+        s.execute("ALTER TABLE t SET COLUMNAR REPLICA 1")  # the rebuild
+        s.store.pd.tick()
+        sc0 = metrics.COLUMNAR_SCANS.value
+        got, want = both_engines(s, "SELECT count(*), sum(extra) FROM t")
+        assert got == want
+        assert metrics.COLUMNAR_SCANS.value > sc0
+
+    def test_index_ddl_does_not_park(self):
+        s = make_replicated(rows=8)
+        s.execute("CREATE INDEX iv ON t (v)")
+        s.execute("INSERT INTO t VALUES (90, 1, 1)")
+        s.store.pd.tick()
+        assert s.store.columnar.views()[0]["state"] == "normal"
+
+
+# ------------------------------------------------------------------ surfaces
+
+class TestSurfaces:
+    def test_show_columnar_tables_and_disable(self):
+        s = make_replicated()
+        rows = s.execute("SHOW COLUMNAR TABLES").values()
+        assert len(rows) == 1
+        tbl, state, pids, delta, stable = rows[0][:5]
+        assert (tbl, state, pids, delta, stable) == ("t", "normal", 1, 0, 40)
+        s.execute("ALTER TABLE t SET COLUMNAR REPLICA 1")  # idempotent
+        assert len(s.execute("SHOW COLUMNAR TABLES").values()) == 1
+        s.execute("ALTER TABLE t SET COLUMNAR REPLICA 0")
+        assert s.execute("SHOW COLUMNAR TABLES").values() == []
+        assert s.execute("SHOW CHANGEFEEDS").values() == []  # feed dropped
+
+    def test_tiflash_spelling_accepted(self):
+        s = Session()
+        s.execute("CREATE TABLE ft (id BIGINT PRIMARY KEY, v BIGINT)")
+        s.execute("ALTER TABLE ft SET TIFLASH REPLICA 1")
+        assert s.execute("SHOW COLUMNAR TABLES").values()[0][0] == "ft"
+
+    def test_http_columnar_routes(self):
+        import json
+        import urllib.request
+
+        from tidb_tpu.server.http_api import StatusServer
+
+        s = make_replicated()
+        srv = StatusServer(s).start_background()
+        try:
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}{path}") as r:
+                    return r.status, json.loads(r.read())
+
+            code, body = get("/columnar/api/v1/tables")
+            assert code == 200 and body[0]["table"] == "t"
+            assert body[0]["stable_rows"] == 40
+            code, body = get("/columnar/api/v1/tables/t")
+            assert code == 200 and body["state"] == "normal"
+            try:
+                code, _ = get("/columnar/api/v1/tables/nope")
+            except urllib.error.HTTPError as exc:
+                code = exc.code
+            assert code == 404
+        finally:
+            srv.close()
+
+    def test_columnar_metric_families_pass_scrape_check(self):
+        """scrape_check tier-1 coverage of the tidb_tpu_columnar_*
+        families (ISSUE 12 satellite)."""
+        s = make_replicated()
+        both_engines(s, "SELECT count(*) , sum(v) FROM t")
+        text = metrics.REGISTRY.dump()
+        for family in (
+            "tidb_tpu_columnar_applied_events_total",
+            "tidb_tpu_columnar_compactions_total",
+            "tidb_tpu_columnar_scans_total",
+            "tidb_tpu_columnar_fallbacks_total",
+            "tidb_tpu_columnar_resolved_ts_lag",
+        ):
+            assert f"# TYPE {family}" in text, family
+        assert 'tidb_tpu_columnar_resolved_ts_lag{table="t"}' in text
+        from scrape_check import validate
+
+        assert validate(text) == []
+
+    def test_trace_has_pd_columnar_phase(self):
+        s = make_replicated()
+        s.store.pd.tick()
+        root = s.store.pd.last_tick_root
+        assert any(c.name == "pd.columnar" for c in root.children)
+
+
+# ---------------------------------------------------------------- failpoints
+
+class TestFailpoints:
+    def test_apply_stall_parks_feed_and_resume_replays(self):
+        s = make_replicated(rows=6)
+        with failpoint.enabled("columnar/apply-stall"):
+            s.execute("INSERT INTO t VALUES (60, 3, 0)")
+            s.store.pd.tick()
+            v = s.store.columnar.views()[0]
+            assert v["state"] == "error"
+        s.store.columnar.resume_all()
+        s.store.pd.tick()
+        v = s.store.columnar.views()[0]
+        assert v["state"] == "normal"
+        assert v["stable_rows"] == 7  # the stalled write replayed
+        got, want = both_engines(s, "SELECT count(*), sum(v) FROM t")
+        assert got == want
+
+    def test_compact_stall_grows_delta_then_drains(self):
+        s = make_replicated(rows=6)
+        with failpoint.enabled("columnar/compact-stall"):
+            s.execute("INSERT INTO t VALUES (61, 4, 1)")
+            s.store.pd.tick()
+            assert s.store.columnar.views()[0]["delta_rows"] > 0
+        s.store.pd.tick()
+        v = s.store.columnar.views()[0]
+        assert v["delta_rows"] == 0 and v["stable_rows"] == 7
+
+
+# ------------------------------------------------------------ lockwatch storm
+
+def test_columnar_lockwatch_storm():
+    """Compaction (pd tick) vs the apply path (writers) vs engine-routed
+    scanners vs region splits under the runtime lockset detector: zero
+    lock-order cycles, zero unguarded annotated accesses (ISSUE 12
+    satellite)."""
+    from tidb_tpu.analysis import lockwatch
+    from tidb_tpu.codec import tablecodec
+
+    with lockwatch.watching() as w:
+        src = Session()
+        src.execute("CREATE TABLE lw (id BIGINT PRIMARY KEY, v BIGINT, g BIGINT)")
+        src.execute("INSERT INTO lw VALUES " + ",".join(
+            f"({i},{i},{i % 4})" for i in range(64)))
+        src.store.cluster.set_stores(4)
+        src.store.cluster.scatter()
+        src.execute("ALTER TABLE lw SET COLUMNAR REPLICA 1")
+        src.store.pd.tick()
+        tid = src.catalog.table("lw").table_id
+        stop = threading.Event()
+        errors: list = []
+
+        def writer():
+            w_sess = Session(store=src.store, catalog=src.catalog)
+            k = 1000
+            while not stop.is_set():
+                try:
+                    w_sess.execute(f"INSERT INTO lw VALUES ({k}, {k}, {k % 4})")
+                    w_sess.execute(f"UPDATE lw SET v = v + 1 WHERE id = {k - 1000}")
+                    k += 1
+                except SQLError:
+                    pass
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+                    return
+
+        def ticker():
+            while not stop.is_set():
+                try:
+                    src.store.pd.tick()  # pd.cdc + pd.columnar phases
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+                    return
+
+        def scanner():
+            r_sess = Session(store=src.store, catalog=src.catalog)
+            while not stop.is_set():
+                try:
+                    r_sess.execute("SELECT g, count(*), sum(v) FROM lw GROUP BY g")
+                except SQLError:
+                    pass
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+                    return
+
+        def splitter():
+            i = 0
+            while not stop.is_set():
+                try:
+                    src.store.cluster.split(
+                        tablecodec.encode_row_key(tid, (i * 7) % 64))
+                    regions = src.store.cluster.regions()
+                    if len(regions) > 6:
+                        src.store.cluster.merge(regions[0].region_id)
+                    i += 1
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+                    return
+
+        threads = [threading.Thread(target=f, daemon=True)
+                   for f in (writer, ticker, scanner, scanner, splitter)]
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        for _ in range(4):
+            src.store.pd.tick()  # drain
+    rep = w.report()
+    assert rep["cycles"] == [], rep["cycles"]
+    assert rep["violations"] == [], "\n".join(rep["violations"])
+    assert not errors, errors
+    assert rep["edges"], "lockwatch saw no lock nesting at all"
+
+
+# -------------------------------------------------- HTAP chaos acceptance
+
+def test_htap_chaos_storm_acceptance():
+    """ISSUE 12 acceptance: a live changefeed feeds the columnar replica
+    under splits/merges/leader transfers/a store outage and the
+    columnar/* + cdc/* failpoints; every engine-routed analytical query
+    is byte-identical to the row-store oracle at the same snapshot, the
+    replica's resolved-ts lag drains to 0 after the storm, and zero
+    untyped errors escape."""
+    from chaos import run_htap_storm
+
+    report = run_htap_storm(seed=13, statements=100)
+    assert report["wrong_results"] == [], report["wrong_results"]
+    assert report["untyped_errors"] == [], report["untyped_errors"]
+    assert report["columnar_scans"] > 0, report
+    assert report["lag_drained"], report["tables"]
+    assert report["feeds_normal"], report["tables"]
+    assert report["delta_drained"], report["tables"]
+    assert report["applied_events"] > 0

@@ -362,7 +362,8 @@ class TestJaxAudit:
     def test_catalog_covers_every_builder_path(self):
         names = {n for n, _dag, _nb in jaxaudit.live_catalog()}
         assert names == {"selection", "hashagg", "streamagg", "topn", "hashjoin",
-                         "partial_scalar_agg", "partial_hashagg"}
+                         "partial_scalar_agg", "partial_hashagg",
+                         "columnar_scan"}
 
     def test_mesh_variants_audited(self):
         """The mesh-tier shard_map programs are walked too: every catalog

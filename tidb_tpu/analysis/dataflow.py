@@ -86,6 +86,18 @@ CDC_ROOTS = (
     ("server/http_api.py", "StatusServer", "_cdc_route"),
     ("cdc/hub.py", "ChangefeedHub", "tick"),
 )
+# columnar replica entry points (ISSUE 12 satellite): the engine-routed
+# read path, the compaction tick, the apply sink, and the HTTP view are
+# ESCAPE and BACKOFF roots — typed staleness must never spin or
+# raw-sleep (the data_not_ready wait rides a Backoffer budget) and no
+# bare error may escape. NOT snapshot roots: the replica reads typed
+# delta/stable layers, never MVCC kv at a latest-version ts.
+COLUMNAR_ROOTS = (
+    ("columnar/route.py", None, "try_columnar_select"),
+    ("columnar/replica.py", "ColumnarReplica", "compact_tick"),
+    ("columnar/sink.py", "ColumnarSink", "write"),
+    ("server/http_api.py", "StatusServer", "_columnar_route"),
+)
 SESSION_BOUNDARIES = (("sql/session.py", "Session", "execute"),)
 
 # directories whose exception classes form the "typed request-path error"
@@ -882,7 +894,7 @@ def _is_time_sleep(call: ast.Call, graph: CallGraph, fi: FuncInfo) -> bool:
 
 def run_backoff(files: list[SourceFile]) -> list:
     graph = graph_for(files)
-    roots = graph.request_roots(extra=CDC_ROOTS)
+    roots = graph.request_roots(extra=CDC_ROOTS + COLUMNAR_ROOTS)
     if not roots:
         return []
     _compute_backoff_consulters(graph)
@@ -932,7 +944,7 @@ class EscapeAnalysis:
         self._sub_memo: dict = {}
         # escape only matters in the cone of the roots and the boundary
         reach = graph.reachable(
-            graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS)
+            graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS)
             + graph.boundaries())
         work = [graph.funcs[q] for q in sorted(reach)]
         rounds = 0
@@ -1202,7 +1214,7 @@ def _mapped_types(graph: CallGraph, boundary: FuncInfo) -> set:
 
 def run_escape(files: list[SourceFile]) -> list:
     graph = graph_for(files)
-    roots = graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS)
+    roots = graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS)
     boundaries = graph.boundaries()
     if not roots and not boundaries:
         return []
@@ -1248,7 +1260,7 @@ def run_escape(files: list[SourceFile]) -> list:
     # reachability must narrow nothing the lexical rule guaranteed)
     for sf in graph.files:
         rel = sf.rel.replace(os.sep, "/")
-        if not any(rel.startswith(f"tidb_tpu/{d}/") for d in ("distsql", "store", "pd", "cdc")):
+        if not any(rel.startswith(f"tidb_tpu/{d}/") for d in ("distsql", "store", "pd", "cdc", "columnar")):
             continue
         for node in ast.walk(sf.tree):
             if not (isinstance(node, ast.Raise) and node.exc is not None):

@@ -51,6 +51,8 @@ DESCRIPTIONS = {
     "cdc/puller-drop": "drops a changefeed's live log deliveries — the span is marked lost and recovered by an incremental scan from the checkpoint at the next tick (the TiCDC re-subscribe path); nothing is lost, only late",
     "cdc/resolved-stuck": "pins every changefeed's resolved-ts watermarks — the frontier stops advancing (and the checkpoint with it) until disarmed; emission stays gated so downstream still only sees complete prefixes",
     "cdc/sink-stall": "skips a tick's sink emission — the sorter keeps the backlog and the emitted checkpoint holds until the stall clears",
+    "columnar/apply-stall": "wedges the columnar replica's apply sink — the feeding changefeed parks in `error` with the backlog re-queued below its held checkpoint; RESUME (ColumnarReplica.resume_all) replays it, absorbed by the idempotent delta fold",
+    "columnar/compact-stall": "skips the pd.columnar tick's delta-to-stable compaction — delta layers grow and the stable floor stops advancing; scans keep serving through the delta overlay",
     "pd/heartbeat-lost": "drops one tick's region-heartbeat interval on the floor (a lost heartbeat stream)",
     "pd/operator-timeout": "force-expires every pending PD operator at the next tick's dispatch phase",
     "replica/apply-lag": "wedges armed follower stores' apply loop — their safe_ts stops advancing, so replica reads at newer snapshots answer DataIsNotReady until disarmed (per-store arming)",

@@ -216,6 +216,16 @@ def live_catalog() -> list:
                            aggs=(AggDesc("sum", (col(1, I),)),
                                  AggDesc("count", ())), partial=True)),
         output_offsets=(0, 1, 2))
+    # the columnar-replica scan shape (ISSUE 12): the WHOLE logical plan
+    # — scan -> selection -> complete aggregation — runs as one program
+    # over the replica's device-resident stable chunk (columnar/route.py
+    # `_run`), no partial/final split, no region axis
+    columnar_scan = DAGRequest(
+        (scan, Selection((func("gt", I, col(1, I), lit(2, I)),)),
+         Aggregation(group_by=(col(0, I),),
+                     aggs=(AggDesc("sum", (col(1, I),)),
+                           AggDesc("count", ())))),
+        output_offsets=(0, 1, 2))
     return [
         ("selection", sel, 1),
         ("hashagg", hashagg, 1),
@@ -224,6 +234,7 @@ def live_catalog() -> list:
         ("hashjoin", join, 2),
         ("partial_scalar_agg", partial_scalar, 1),
         ("partial_hashagg", partial_hashagg, 1),
+        ("columnar_scan", columnar_scan, 1),
     ]
 
 

@@ -4,11 +4,12 @@ resolved-ts frontier, rowcodec mounter, pluggable sinks."""
 
 from .events import RowEvent
 from .hub import Changefeed, ChangefeedError, ChangefeedHub, WriteGuard
-from .mounter import Mounter
+from .mounter import Mounter, SchemaDriftError
 from .sink import FileSink, MemorySink, SessionReplaySink, Sink, SinkError, open_sink
 
 __all__ = [
     "RowEvent", "Changefeed", "ChangefeedError", "ChangefeedHub", "WriteGuard",
-    "Mounter", "FileSink", "MemorySink", "SessionReplaySink", "Sink",
+    "Mounter", "SchemaDriftError", "FileSink", "MemorySink",
+    "SessionReplaySink", "Sink",
     "SinkError", "open_sink",
 ]

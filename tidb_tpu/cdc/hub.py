@@ -50,7 +50,7 @@ import time
 from contextlib import contextmanager
 
 from ..store.region import KEY_MAX
-from .mounter import Mounter
+from .mounter import Mounter, SchemaDriftError
 from .sink import Sink, SinkError, open_sink
 
 
@@ -102,6 +102,10 @@ class Changefeed:
         self.catalog = catalog
         self.mounter = Mounter(catalog)
         self.table_ids = frozenset(table_ids) if table_ids is not None else None
+        # birth schema snapshot (ISSUE 12 satellite): every subscribed
+        # table's row-shape version is stamped NOW; a mid-feed ALTER
+        # parks the feed instead of mounting old rows on the new catalog
+        self.mounter.stamp_tables(self.table_ids)
         self.start_ts = start_ts
         self._mu = threading.Lock()
         self.state = "normal"  # guarded_by: _mu
@@ -223,12 +227,25 @@ class Changefeed:
             for ts, k, _v in batch:
                 del self._pending[(k, ts)]
         rows, skipped = [], 0
-        for ts, k, v in batch:
-            ev = self.mounter.mount(k, v, ts)
-            if ev is None:
-                skipped += 1
-            else:
-                rows.append(ev)
+        try:
+            for ts, k, v in batch:
+                ev = self.mounter.mount(k, v, ts)
+                if ev is None:
+                    skipped += 1
+                else:
+                    rows.append(ev)
+        except SchemaDriftError as exc:
+            # a mid-feed ALTER: park with the typed reason and re-queue
+            # the WHOLE batch below the held checkpoint — nothing mounts
+            # against the drifted catalog, nothing is lost. RESUME
+            # re-stamps (the operator accepting the new schema) and the
+            # sorter redelivers (sinks dedupe by (key, commit_ts))
+            with self._mu:
+                self.state = "error"
+                self.last_error = f"{type(exc).__name__}: {exc}"
+                for ts, k, v in batch:
+                    self._pending[(k, ts)] = v
+            return 0
         t0 = time.monotonic()
         try:
             with tracing.span("cdc.flush", changefeed=self.name,
@@ -305,11 +322,22 @@ class Changefeed:
         tick's incremental scan replays (checkpoint, now] — the pause
         window — before the frontier moves (ref: TiCDC resume doing an
         incremental catch-up from the checkpoint)."""
+        drift_park = False
         with self._mu:
             if self.state in ("paused", "error"):
+                drift_park = self.last_error.startswith("SchemaDriftError")
                 self.state = "normal"
                 self.last_error = ""
                 self._lost.extend(self._full_spans())
+        if drift_park:
+            # RESUME doubles as the schema acknowledgment ONLY when the
+            # park reason WAS the drift: the operator saw the typed
+            # reason and accepted the new shape. A feed parked for an
+            # unrelated reason (pause, a sink failure) keeps its birth
+            # stamps — an ALTER that landed while it was parked must
+            # still park it at the next mount, never mount the old-shape
+            # backlog against the new catalog silently (review finding)
+            self.mounter.restamp()
 
     def view(self, store) -> dict:
         with self._mu:

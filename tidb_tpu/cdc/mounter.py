@@ -18,19 +18,44 @@ from ..codec.rowcodec import decode_row_to_datum_map, fill_origin_default
 from .events import RowEvent
 
 
+class SchemaDriftError(RuntimeError):
+    """A table's ROW-SHAPE schema version moved under a live changefeed
+    (ISSUE 12 satellite; ref: TiCDC's schema-tracker keeping a snapshot
+    per schema version — without one, a mid-feed ALTER would silently
+    mount old row bytes against the NEW catalog and corrupt the mirror).
+    The feed parks in `error` with this as the typed reason; RESUME
+    re-stamps to the current schema (the operator's acknowledgment)."""
+
+    def __init__(self, table: str, stamped: int, current: int):
+        super().__init__(
+            f"schema drift: table {table!r} changed mid-feed "
+            f"(stamped version {stamped}, now {current}) — "
+            f"RESUME the changefeed to accept the new schema")
+        self.table = table
+        self.stamped = stamped
+        self.current = current
+
+
 class Mounter:
     """Decodes change values against a catalog snapshot. The pid->meta
-    map rebuilds whenever the catalog version moves (DDL between events:
-    rows mount against the CURRENT schema, the reference's behavior for
-    a changefeed without a schema-tracker snapshot)."""
+    map rebuilds whenever the catalog version moves. Each table's
+    ROW-SHAPE version (`TableMeta.schema_version`) is STAMPED the first
+    time the mounter sees it (or up front via `stamp_tables`); a row
+    arriving after the version moved raises SchemaDriftError instead of
+    silently mounting against the new catalog — the feed's park signal."""
 
     def __init__(self, catalog):
         self.catalog = catalog
         self._mu = threading.Lock()
         self._by_pid: dict = {}  # physical table id -> TableMeta; guarded_by: _mu
         self._cat_version = -1  # guarded_by: _mu
+        self._stamps: dict = {}  # table_id -> schema_version at first sight; guarded_by: _mu
 
     def _meta_for(self, pid: int):
+        """-> (meta, stamped schema version) — (None, 0) for an unknown
+        pid. ONE critical section covers the map refresh, the lookup AND
+        the first-sight stamp (a second acquisition per event would
+        double-lock the CDC hot mount loop; review finding)."""
         with self._mu:
             if self._cat_version != self.catalog.version:
                 by_pid: dict = {}
@@ -43,18 +68,47 @@ class Mounter:
                         by_pid[p] = meta
                 self._by_pid = by_pid
                 self._cat_version = self.catalog.version
-            return self._by_pid.get(pid)
+            meta = self._by_pid.get(pid)
+            if meta is None:
+                return None, 0
+            return meta, self._stamps.setdefault(meta.table_id, meta.schema_version)
+
+    def stamp_tables(self, table_ids=None) -> None:
+        """Record the CURRENT row-shape version of every (subscribed)
+        table — the feed's birth schema snapshot. Tables first seen later
+        stamp lazily in mount()."""
+        for name in self.catalog.tables():
+            try:
+                meta = self.catalog.table(name)
+            except Exception:  # noqa: BLE001 — a racing DROP TABLE
+                continue
+            if table_ids is not None and meta.table_id not in table_ids and not any(
+                    p in table_ids for p in meta.physical_ids()):
+                continue
+            with self._mu:
+                self._stamps.setdefault(meta.table_id, meta.schema_version)
+
+    def restamp(self) -> None:
+        """Drop every stamp (RESUME's schema acknowledgment): the next
+        mount re-stamps at the then-current version and the feed carries
+        on against the NEW catalog."""
+        with self._mu:
+            self._stamps.clear()
 
     def mount(self, key: bytes, value: bytes | None, commit_ts: int) -> RowEvent | None:
         """One raw change -> RowEvent, or None when the key is not a row
-        of a known table (index entry, meta keyspace, dropped table)."""
+        of a known table (index entry, meta keyspace, dropped table).
+        Raises SchemaDriftError when the row's table changed shape since
+        the feed stamped it — the caller parks the feed, never mounts."""
         try:
             pid, handle = tablecodec.decode_row_key(key)
         except ValueError:
             return None  # index/meta key: derived data, the caller skips
-        meta = self._meta_for(pid)
+        meta, stamped = self._meta_for(pid)
         if meta is None:
             return None
+        if meta.schema_version != stamped:
+            raise SchemaDriftError(meta.name, stamped, meta.schema_version)
         if value is None:
             return RowEvent(meta.name, meta.table_id, handle, "delete", commit_ts)
         fts_by_id = {c.col_id: c.ft for c in meta.columns}

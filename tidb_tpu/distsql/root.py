@@ -179,10 +179,18 @@ def execute_root(
     replica_read: str = "leader",
     mesh: bool | None = None,
     mesh_min_rows: int = 0,
+    isolation_engines: tuple = ("tpu",),
 ) -> Chunk:
     """Run a logical (Complete-mode) DAG over the store: split, dispatch the
     pushdown half per region, merge at root. The caller-visible result is
     identical to running the whole DAG over all rows at once.
+
+    isolation_engines (tidb_isolation_read_engines) is the engine-routing
+    consult (ref: kv.StoreType{TiKV,TiFlash} selection): when it includes
+    `columnar` and the plan is an eligible analytical scan, the WHOLE DAG
+    runs over the columnar replica's device-resident chunks at the same
+    snapshot — no split, no per-region dispatch — with a typed-staleness
+    fallback to the row store when the replica's frontier lags.
 
     mesh (tidb_enable_tpu_mesh) lets the dispatch planner shard eligible
     partial-agg/TopN pushdowns over the device mesh and merge the partial
@@ -205,7 +213,7 @@ def execute_root(
             store, dag, ranges, start_ts, aux_chunks, concurrency, cache,
             group_capacity, paging_size, batch_cop, summary_sink, tracker,
             low_memory, small_groups, checker, backoff_weight, replica_read,
-            mesh, mesh_min_rows,
+            mesh, mesh_min_rows, isolation_engines,
         )
         if sp is not None:
             sp.set("rows", out.num_rows())
@@ -217,7 +225,26 @@ def _execute_root(
     group_capacity, paging_size, batch_cop, summary_sink, tracker,
     low_memory, small_groups, checker, backoff_weight=2,
     replica_read="leader", mesh=None, mesh_min_rows=0,
+    isolation_engines=("tpu",),
 ) -> Chunk:
+    if "columnar" in isolation_engines:
+        # engine routing (ISSUE 12): eligible analytical scans ride the
+        # columnar replica; None = not ours / frontier lagged after the
+        # data_not_ready wait — the row store serves as if never routed
+        from ..columnar.route import try_columnar_select
+
+        served = try_columnar_select(
+            store, dag, ranges, start_ts, aux_chunks or [], cache=cache,
+            group_capacity=group_capacity, small_groups=small_groups,
+            backoff_weight=backoff_weight, checker=checker,
+        )
+        if served is not None:
+            if summary_sink is not None:
+                # dict entries are dispatch attribution, filtered from the
+                # per-task summary lists by EXPLAIN ANALYZE (same contract
+                # as batch_stats)
+                summary_sink.append({"columnar": {"rows": served.num_rows()}})
+            return served
     plan = split_dag(dag)
     if low_memory and plan.root_dag is not None:
         folded = _execute_root_lowmem(store, plan, ranges, start_ts, aux_chunks or [], cache, group_capacity, tracker)

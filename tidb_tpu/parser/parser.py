@@ -2283,6 +2283,16 @@ class Parser:
                 else:
                     self.eat_kw("TO") or self.eat_kw("AS")
                     specs.append(A.AlterTableSpec("rename", new_name=self.ident()))
+            elif self.at_kw("SET"):
+                # ALTER TABLE t SET {COLUMNAR | TIFLASH} REPLICA n (ref:
+                # TiDB's `SET TIFLASH REPLICA` DDL — ours attaches the
+                # changefeed-fed columnar replica tier, ISSUE 12)
+                self.next()
+                if not self.eat_kw("COLUMNAR", "TIFLASH"):
+                    raise ParseError(f"expected COLUMNAR or TIFLASH after SET at {self._where()}")
+                self.expect_kw("REPLICA")
+                n = int(self.expect_number())
+                specs.append(A.AlterTableSpec("set_columnar_replica", options={"count": n}))
             elif self.at_kw("ATTRIBUTES"):
                 self.next()
                 self.eat_op("=")
@@ -2577,6 +2587,12 @@ class Parser:
             # ours reports the PD's region->store map + scheduling state)
             self.eat_kw("LABELS")
             s.kind = "placement"
+        elif self.eat_kw("COLUMNAR"):
+            # SHOW COLUMNAR TABLES (ISSUE 12; ref: information_schema
+            # .tiflash_replica): per-table delta rows, stable chunks, and
+            # the applied resolved-ts frontier of the columnar replica
+            self.expect_kw("TABLES")
+            s.kind = "columnar"
         elif self.eat_kw("TABLE"):
             self.expect_kw("STATUS")
             s.kind = "table_status"

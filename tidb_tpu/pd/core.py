@@ -411,6 +411,15 @@ class PlacementDriver:
                 emitted = hub.tick() if hub is not None else 0
                 if csp is not None:
                     csp.set("events_emitted", emitted)
+            with tracing.span("pd.columnar") as osp:
+                # the columnar replica's compaction driver (ISSUE 12):
+                # fold each table's delta into its device-resident stable
+                # chunks and refresh the freshness gauges — AFTER pd.cdc
+                # so this tick's flushed frontier is foldable immediately
+                rep = getattr(self.store, "columnar", None)
+                folded = rep.compact_tick() if rep is not None else 0
+                if osp is not None:
+                    osp.set("rows_folded", folded)
             with tracing.span("pd.schedule") as ssp:
                 proposed = 0
                 for sched in self.checkers + self.schedulers:

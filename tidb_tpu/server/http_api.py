@@ -18,6 +18,9 @@ pkg/server/handler/tikvhandler — docs/tidb_http_api.md):
   GET /pd/api/v1/operators             PD view: pending + recent operators
   GET /cdc/api/v1/changefeeds          changefeed list (state, frontier)
   GET /cdc/api/v1/changefeeds/{name}   one changefeed's detail
+  GET /columnar/api/v1/tables          columnar replica tables (delta rows,
+                                       stable chunks, applied resolved-ts)
+  GET /columnar/api/v1/tables/{name}   one columnar table's detail
 
 The /pd/api/v1 prefix mirrors the reference PD's HTTP API (pd
 server/api/router.go) and /cdc/api/v1 mirrors TiCDC's open API — both
@@ -162,6 +165,8 @@ class StatusServer:
             }
         if len(parts) >= 4 and parts[:3] == ["cdc", "api", "v1"]:
             return self._cdc_route(parts[3:])
+        if len(parts) >= 4 and parts[:3] == ["columnar", "api", "v1"]:
+            return self._columnar_route(parts[3:])
         if len(parts) == 4 and parts[:3] == ["pd", "api", "v1"]:
             pd = getattr(s.store, "pd", None)
             if pd is None:
@@ -202,6 +207,22 @@ class StatusServer:
                 return 404, {"error": "no MVCC versions for that handle"}
             return 200, {"handle": h, "versions": out}
         return 404, {"error": f"unknown path {path!r} (see docs/tidb_http_api.md routes)"}
+
+    def _columnar_route(self, parts: list):
+        """/columnar/api/v1/tables[/{name}] (ISSUE 12; the TiFlash-analog
+        of information_schema.tiflash_replica as an HTTP view): per-table
+        delta rows, stable chunks, and the applied resolved-ts frontier.
+        A vet request-path root: state reads stay typed and total."""
+        rep = getattr(self.session.store, "columnar", None)
+        if rep is None or parts[0] != "tables":
+            return 404, {"error": "unknown columnar route (tables)"}
+        views = rep.views()
+        if len(parts) == 1:
+            return 200, views
+        for v in views:
+            if v["table"] == parts[1]:
+                return 200, v
+        return 404, {"error": f"columnar table {parts[1]!r} not found"}
 
     def _cdc_route(self, parts: list):
         """/cdc/api/v1/changefeeds[/{name}] (ref: TiCDC's open API

@@ -172,6 +172,12 @@ class TableMeta:
     partition: "PartitionInfo | None" = None  # RANGE/HASH partitioning
     foreign_keys: list = field(default_factory=list)  # [FKMeta] (ref:
     # meta/model FKInfo; checked at DML by executor/foreign_key.go analog)
+    # per-table ROW-SHAPE version: bumped by column DDL (add/drop/modify/
+    # rename) but not by index or placement changes. Changefeeds stamp it
+    # at birth and park on drift instead of silently mounting old rows
+    # against a new catalog (ISSUE 12 satellite; ref: TiCDC's
+    # schema-tracker snapshot keyed by schema version)
+    schema_version: int = 0
 
     def __post_init__(self):
         if self.next_col_id <= 0:

@@ -135,8 +135,26 @@ def alter_table(session, stmt: A.AlterTableStmt):
         elif action == "rename":
             run_job(session.catalog, "rename table", meta.name, query,
                     lambda s=spec: _rename_table(session.catalog, meta, s.new_name or s.name))
+        elif action == "set_columnar_replica":
+            # ALTER TABLE t SET COLUMNAR REPLICA n (ref: TiDB's SET
+            # TIFLASH REPLICA DDL creating learner replicas): n >= 1
+            # attaches the changefeed-fed columnar replica, 0 detaches it
+            run_job(session.catalog, "set columnar replica", meta.name, query,
+                    lambda s=spec: _set_columnar_replica(session, meta, s.options.get("count", 1)))
         else:
             raise DDLError(f"ALTER TABLE action {action!r} not supported yet")
+
+
+def _set_columnar_replica(session, meta, count: int):
+    from ..cdc import ChangefeedError
+
+    try:
+        if count > 0:
+            session.store.columnar.enable_table(session.catalog, meta)
+        else:
+            session.store.columnar.disable_table(meta)
+    except ChangefeedError as exc:
+        raise DDLError(str(exc)) from exc
 
 
 def _add_column(session, meta, spec: A.AlterTableSpec):
@@ -171,6 +189,7 @@ def _add_column(session, meta, spec: A.AlterTableSpec):
                     generated_stored=getattr(cd, "generated_stored", False),
                     decl=decl_text(cd.type))
     meta.columns.insert(pos, cm)
+    meta.schema_version += 1  # row-shape change: changefeeds park on drift
     session.catalog.version += 1
 
 
@@ -189,6 +208,7 @@ def _drop_column(session, meta, name: str):
     meta.columns = [c for c in meta.columns if c.name != name]
     if len(meta.columns) == before:
         raise DDLError(f"unknown column {name!r}")
+    meta.schema_version += 1  # row-shape change: changefeeds park on drift
     session.catalog.version += 1
 
 
@@ -214,6 +234,7 @@ def _modify_column(session, meta, spec: A.AlterTableSpec):
     if renaming:
         _rename_column(session, meta, old_name, cd.name)
         return
+    meta.schema_version += 1  # row-shape change: changefeeds park on drift
     session.catalog.version += 1
 
 
@@ -229,6 +250,7 @@ def _rename_column(session, meta, old: str, new: str):
         meta.handle_col = new
     if meta.partition is not None and meta.partition.col == old:
         meta.partition.col = new
+    meta.schema_version += 1  # row-shape change: changefeeds park on drift
     session.catalog.version += 1
 
 

@@ -442,6 +442,19 @@ class Session:
             return ts
         return self.store.next_ts()
 
+    def _read_engines(self) -> tuple:
+        """tidb_isolation_read_engines as a normalized tuple (the sysvar
+        validator already rejected unknown names and folded the reference
+        aliases). In-transaction reads and EXPLAIN ANALYZE runs strip the
+        columnar replica: a txn must see its own snapshot/buffer on the
+        authoritative row store, and ANALYZE wants the per-region summary
+        attribution only the cop path produces (ref: TiDB routing
+        in-transaction reads to TiKV regardless of the engine list)."""
+        engines = tuple(self.sysvars.get("tidb_isolation_read_engines").split(","))
+        if self.txn is not None or self._explain_sink is not None:
+            engines = tuple(e for e in engines if e != "columnar") or ("tpu",)
+        return engines
+
     def _pin_read_ts(self) -> int:
         """_read_ts, registered against GC for the statement's duration so a
         background run_gc tick cannot collect the version this read is
@@ -1417,7 +1430,25 @@ class Session:
                     chunk = self._select_via_oracle(plan, ranges, aux, ts)
                 else:
                     chunk = None
-                    if self._explain_sink is None and self.sysvars.get_bool("tidb_enable_tpu_mesh"):
+                    engines = self._read_engines()
+
+                    def _columnar_routed():
+                        # engine routing (ISSUE 12): when the columnar
+                        # replica is this plan's engine, the whole-plan
+                        # mesh shortcut must not preempt it — the consult
+                        # itself lives in execute_root. Evaluated LAST in
+                        # the mesh condition so the eligibility walk only
+                        # runs when a mesh attempt is actually on the
+                        # table (review finding: no double walk when mesh
+                        # is off or EXPLAIN ANALYZE pinned the cop path)
+                        from ..columnar.route import columnar_would_serve
+
+                        return columnar_would_serve(
+                            self.store, plan.dag, ranges, engines)
+
+                    if (self._explain_sink is None
+                            and self.sysvars.get_bool("tidb_enable_tpu_mesh")
+                            and not _columnar_routed()):
                         # EXPLAIN ANALYZE wants per-executor summaries,
                         # which only the per-region path produces
                         # MPP analog: eligible GROUP BY plans run as ONE
@@ -1449,6 +1480,7 @@ class Session:
                             checker=self._runaway_checker(),
                             backoff_weight=self.sysvars.get_int("tidb_backoff_weight"),
                             replica_read=self.sysvars.get("tidb_replica_read"),
+                            isolation_engines=engines,
                         )
                         try:
                             chunk = execute_root(
@@ -2751,6 +2783,28 @@ class Session:
                     Datum.string(pd.scheduling_state(r["region_id"])),
                 ])
             return Result(columns=["Target", "Placement", "Scheduling_State"], rows=rows)
+        if kind == "columnar":
+            # SHOW COLUMNAR TABLES (ISSUE 12; ref: information_schema
+            # .tiflash_replica): one row per replicated table — feed
+            # state, delta/stable layer sizes, and the applied
+            # resolved-ts frontier the scan-readiness gate consults
+            rows = []
+            for v in self.store.columnar.views():
+                if not _show_like(stmt, v["table"]):
+                    continue
+                rows.append([
+                    Datum.string(v["table"]), Datum.string(v["state"]),
+                    Datum.i64(v["pids"]), Datum.i64(v["delta_rows"]),
+                    Datum.i64(v["stable_rows"]), Datum.i64(v["stable_chunks"]),
+                    Datum.i64(v["applied_ts"]), Datum.i64(v["stable_ts"]),
+                    Datum.i64(v["resolved_ts_lag"]), Datum.i64(v["compactions"]),
+                ])
+            return Result(
+                columns=["Table", "State", "Pids", "Delta_rows", "Stable_rows",
+                         "Stable_chunks", "Applied_ts", "Stable_ts",
+                         "Resolved_lag", "Compactions"],
+                rows=rows,
+            )
         if kind == "changefeeds":
             # SHOW CHANGEFEEDS (ref: TiCDC `cli changefeed list`): one row
             # per feed with its state, frontier, and emission counts

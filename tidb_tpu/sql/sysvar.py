@@ -38,6 +38,35 @@ def _snapshot_validator(v: str) -> str:
     return t
 
 
+# the engines THIS build actually has: the TPU row store and the HTAP
+# columnar replica. The reference's engine names are accepted as aliases
+# and normalized (tikv/tidb -> the row store, tiflash -> columnar), so
+# reference-tuned `SET tidb_isolation_read_engines = 'tikv,tiflash'`
+# statements keep working (ref: sysvar.go TiDBIsolationReadEngines
+# validation against config.IsolationRead.Engines).
+_ENGINE_ALIASES = {
+    "tpu": "tpu", "tikv": "tpu", "tidb": "tpu",
+    "columnar": "columnar", "tiflash": "columnar",
+}
+
+
+def _engines_validator(v: str) -> str:
+    names = [t.strip().lower() for t in v.split(",") if t.strip()]
+    if not names:
+        raise SysVarError(
+            "tidb_isolation_read_engines needs at least one engine (tpu, columnar)")
+    out: list = []
+    for n in names:
+        e = _ENGINE_ALIASES.get(n)
+        if e is None:
+            raise SysVarError(
+                f"unknown isolation read engine {n!r} (this build has: tpu, "
+                f"columnar; tikv/tidb/tiflash accepted as aliases)")
+        if e not in out:
+            out.append(e)
+    return ",".join(out)
+
+
 def _int_validator(lo: int, hi: int):
     def check(v: str) -> str:
         try:
@@ -136,7 +165,11 @@ DEFINITIONS = {
         SysVar("tidb_enable_clustered_index", "ON", "both"),
         SysVar("tidb_analyze_version", "2", "both", _int_validator(1, 2)),
         SysVar("tidb_enable_chunk_rpc", "ON", "session", _bool_validator),
-        SysVar("tidb_isolation_read_engines", "tikv,tiflash,tidb,tpu", "session"),
+        # which engines may serve reads (ref: sysvar.go
+        # TiDBIsolationReadEngines): the tpu row store and/or the HTAP
+        # columnar replica — validated at SET time, reference names
+        # normalized, unknown names rejected (ISSUE 12 satellite)
+        SysVar("tidb_isolation_read_engines", "tpu,columnar", "both", _engines_validator),
         SysVar("tidb_opt_correlation_threshold", "0.9", "both"),
         SysVar("tidb_opt_limit_push_down_threshold", "100", "both", _int_validator(0, 1 << 30)),
         SysVar("tidb_opt_distinct_agg_push_down", "OFF", "both", _bool_validator),

@@ -161,6 +161,12 @@ class TPUStore:
         from ..cdc import ChangefeedHub
 
         self.cdc = ChangefeedHub(self)
+        # the HTAP columnar replica tier (ISSUE 12): per-table delta+stable
+        # column stores fed by changefeeds, compacted by the pd.columnar
+        # tick phase, routed to by tidb_isolation_read_engines
+        from ..columnar import ColumnarReplica
+
+        self.columnar = ColumnarReplica(self)
         self.txn = TxnEngine(self.kv, on_commit=self._bump_write_ver,
                              on_apply=self.record_applied_writes,
                              pre_apply=self._check_write_quorum,

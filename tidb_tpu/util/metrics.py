@@ -363,6 +363,22 @@ CDC_SINK_FLUSH = REGISTRY.histogram(
 CDC_RECOVERY_SCANS = REGISTRY.counter(
     "tidb_tpu_cdc_recovery_scans_total", "incremental re-scans after a lost subscription, pause resume, or changefeed birth")
 
+# HTAP columnar replica (tidb_tpu/columnar) — the TiFlash-analog tier
+# (ref: tiflash_* metrics: apply throughput, delta compaction counts, the
+# replica freshness gauges)
+COLUMNAR_APPLIED = REGISTRY.counter(
+    "tidb_tpu_columnar_applied_events_total", "mounted row events applied into columnar delta layers")
+COLUMNAR_COMPACTIONS = REGISTRY.counter(
+    "tidb_tpu_columnar_compactions_total", "delta-to-stable compaction passes that folded rows")
+COLUMNAR_SCANS = REGISTRY.counter(
+    "tidb_tpu_columnar_scans_total", "analytical queries served by the columnar replica")
+COLUMNAR_FALLBACKS = REGISTRY.counter(
+    "tidb_tpu_columnar_fallbacks_total", "engine-routed queries that fell back to the row store (frontier lag, floored snapshot, schema drift)")
+COLUMNAR_RESOLVED_LAG = REGISTRY.gauge_vec(
+    "tidb_tpu_columnar_resolved_ts_lag", "latest commit watermark minus the replica's applied resolved frontier, per table (ts units)",
+    labelnames=("table",),
+)
+
 # placement driver (tidb_tpu/pd) — its own pd_ namespace, like the
 # reference PD process exposing pd_scheduler_*/pd_hotspot_* families
 PD_REGION_HEARTBEATS = REGISTRY.counter("pd_region_heartbeat_total", "region heartbeat snapshots absorbed by the PD")

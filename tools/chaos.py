@@ -50,6 +50,8 @@ FAULT_POINTS = (
     "cdc/puller-drop",
     "cdc/resolved-stuck",
     "cdc/sink-stall",
+    "columnar/apply-stall",
+    "columnar/compact-stall",
 )
 
 
@@ -504,9 +506,167 @@ def run_cdc_storm(seed: int = 11, statements: int = 160,
     }
 
 
+# --------------------------------------------------- the HTAP storm phase
+# (ISSUE 12 acceptance: OLTP DML churns a sharded cluster whose tables
+# carry a live columnar replica while the schedule throws splits, merges,
+# leader transfers, a store outage, and the cdc/* + columnar/* failpoints;
+# every engine-routed analytical query must return results byte-identical
+# to the row-store oracle at the same snapshot, the replica's resolved-ts
+# lag must drain to 0 after the storm, and zero untyped errors escape)
+
+
+def htap_schedule(n: int) -> dict[int, list[tuple]]:
+    """Topology churn + the columnar failpoints, with a clean tail."""
+    def at(frac: float) -> int:
+        return max(int(n * frac), 1)
+
+    sched: dict[int, list[tuple]] = {}
+
+    def add(i, *action):
+        sched.setdefault(i, []).append(tuple(action))
+
+    add(at(0.06), "split")
+    add(at(0.10), "arm", "columnar/compact-stall", True)  # delta grows,
+    add(at(0.20), "disarm", "columnar/compact-stall")  # overlay serves
+    add(at(0.24), "transfer")
+    add(at(0.28), "arm", "columnar/apply-stall", True)  # feed parks in
+    add(at(0.34), "disarm", "columnar/apply-stall")  # error; scans fall
+    add(at(0.34), "resume_columnar")  # back, RESUME replays the backlog
+    add(at(0.38), "down", 1)  # store outage: reads fail over, the shared
+    add(at(0.46), "up", 1)  # log keeps feeding the replica
+    add(at(0.50), "arm", "cdc/resolved-stuck", True)  # frontier pins ->
+    add(at(0.58), "disarm", "cdc/resolved-stuck")  # staleness fallbacks
+    add(at(0.62), "arm", "cdc/puller-drop", True)
+    add(at(0.68), "disarm", "cdc/puller-drop")
+    add(at(0.72), "merge")
+    add(at(0.76), "arm", "cdc/sink-stall", True)
+    add(at(0.80), "disarm", "cdc/sink-stall")
+    add(at(0.82), "transfer")
+    # past at(0.82): clean tail — the replica must drain to lag 0
+    return sched
+
+
+def run_htap_storm(seed: int = 13, statements: int = 200,
+                   tick_every: int = 6) -> dict:
+    """The HTAP chaos acceptance (ISSUE 12): chaos_t/chaos_d carry a
+    columnar replica (ALTER ... SET COLUMNAR REPLICA 1) while the mixed
+    DML+read workload runs under the storm. Every read runs TWICE back to
+    back — engine-routed (tpu,columnar) then row-store-forced (tpu) — and
+    the single-threaded workload guarantees both see the same snapshot,
+    so the pair must be byte-identical. The mirror-equality oracle is the
+    consistency gate; `main` additionally asserts the replica served real
+    scans, lag drained to 0, and the feeds ended `normal`."""
+    from tidb_tpu.sql.session import Session, SQLError
+    from tidb_tpu.util import failpoint as fp
+    from tidb_tpu.util import metrics
+
+    sess = _fill_session(split_regions=True)
+    sess.execute("ALTER TABLE chaos_t SET COLUMNAR REPLICA 1")
+    sess.execute("ALTER TABLE chaos_d SET COLUMNAR REPLICA 1")
+    sess.store.pd.tick()  # birth incremental scans backfill + first fold
+    tid = sess.catalog.table("chaos_t").table_id
+
+    workload = build_cdc_workload(seed, statements)
+    schedule = htap_schedule(statements)
+    ok = typed = 0
+    wrong: list = []
+    untyped: list = []
+    scans0 = metrics.COLUMNAR_SCANS.value
+    falls0 = metrics.COLUMNAR_FALLBACKS.value
+    applied0 = metrics.COLUMNAR_APPLIED.value
+
+    def run_one(sql: str):
+        """-> (values | None, error | None); typed errors count, untyped
+        errors are the bug class this harness hunts."""
+        nonlocal typed
+        try:
+            return sess.execute(sql).values(), None
+        except SQLError as exc:
+            if getattr(exc, "code", 0) in (9005, 1105, 3024, 1317):
+                typed += 1
+                return None, "typed"
+            return None, f"SQLError: {exc}"
+        except Exception as exc:  # noqa: BLE001 — the bug class we hunt
+            return None, f"{type(exc).__name__}: {exc}"
+
+    try:
+        for i, sql in enumerate(workload):
+            _apply_htap(schedule.get(i, ()), sess, fp, tid)
+            if sql.lstrip().upper().startswith("SELECT"):
+                # the mirror-equality oracle: routed vs row-store, same
+                # snapshot (single-threaded — no write between the pair)
+                sess.execute("SET tidb_isolation_read_engines = 'tpu,columnar'")
+                got, err1 = run_one(sql)
+                sess.execute("SET tidb_isolation_read_engines = 'tpu'")
+                want, err2 = run_one(sql)
+                for err in (err1, err2):
+                    if err not in (None, "typed"):
+                        untyped.append({"stmt": i, "sql": sql, "error": err[:200]})
+                if got is not None and want is not None:
+                    if got != want:
+                        wrong.append({"stmt": i, "sql": sql,
+                                      "got": repr(got)[:200],
+                                      "want": repr(want)[:200]})
+                    else:
+                        ok += 1
+            else:
+                _, err = run_one(sql)
+                if err is None:
+                    ok += 1
+                elif err != "typed":
+                    untyped.append({"stmt": i, "sql": sql, "error": err[:200]})
+            if (i + 1) % tick_every == 0:
+                sess.store.pd.tick()
+    finally:
+        for name in FAULT_POINTS:
+            fp.disable(name)
+        for sid in range(N_STORES):
+            sess.store.set_up(sid)
+    # drain: with every fault cleared (and parked feeds resumed) the
+    # replica must converge — delta folds, lag reaches 0, feeds normal
+    sess.store.columnar.resume_all()
+    views = []
+    for _ in range(12):
+        sess.store.pd.tick()
+        views = sess.store.columnar.views()
+        if all(v["state"] == "normal" and v["resolved_ts_lag"] == 0
+               and v["delta_rows"] == 0 for v in views):
+            break
+    return {
+        "seed": seed,
+        "statements": statements,
+        "ok": ok,
+        "typed_errors": typed,
+        "wrong_results": wrong,
+        "untyped_errors": untyped,
+        "columnar_scans": int(metrics.COLUMNAR_SCANS.value - scans0),
+        "columnar_fallbacks": int(metrics.COLUMNAR_FALLBACKS.value - falls0),
+        "applied_events": int(metrics.COLUMNAR_APPLIED.value - applied0),
+        "tables": views,
+        "lag_drained": all(v["resolved_ts_lag"] == 0 for v in views),
+        "feeds_normal": all(v["state"] == "normal" for v in views),
+        "delta_drained": all(v["delta_rows"] == 0 for v in views),
+    }
+
+
+def _apply_htap(actions, sess, fp, tid) -> None:
+    for action in actions:
+        if action[0] == "resume_columnar":
+            sess.store.columnar.resume_all()
+        else:
+            _apply_cdc([action], sess, fp, tid)
+
+
 def main():
     seed = int(sys.argv[1]) if len(sys.argv) > 1 else 7
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    if os.environ.get("CHAOS_HTAP"):
+        report = run_htap_storm(seed if len(sys.argv) > 1 else 13, n)
+        print(json.dumps(report, indent=2, default=str))
+        bad = (report["wrong_results"] or report["untyped_errors"]
+               or not report["lag_drained"] or not report["feeds_normal"]
+               or report["columnar_scans"] == 0)
+        sys.exit(1 if bad else 0)
     if os.environ.get("CHAOS_CDC"):
         report = run_cdc_storm(seed if len(sys.argv) > 1 else 11, n)
         print(json.dumps(report, indent=2, default=str))
