@@ -34,8 +34,11 @@ class TestSpillPartitioned:
         agg = Aggregation(group_by=(col(0, LL),), aggs=(AggDesc("count", ()), AggDesc("sum", (col(1, LL),))))
         dag = DAGRequest((scan, agg), output_offsets=(0, 1, 2))
         before = metrics.SPILL_PARTITIONS.value
-        # group_capacity=4, 3 retries -> caps at 256 < 500 groups
-        out = run_dag_on_chunks(dag, [ch], group_capacity=4, oracle_fallback=False)
+        # max_retries=0 pins the SPILL machinery: with retries allowed the
+        # ladder's need hint (the sort kernel's true group count) would
+        # resolve 500 groups on the second dispatch without ever spilling
+        out = run_dag_on_chunks(dag, [ch], group_capacity=4, max_retries=0,
+                                oracle_fallback=False)
         assert metrics.SPILL_PARTITIONS.value > before, "spill path did not run"
         ref = run_dag_reference(dag, [ch])
         got = sorted((int(r[0].val), int(str(r[1].val)), int(r[2].val)) for r in out.rows())

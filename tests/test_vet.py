@@ -360,9 +360,9 @@ class TestDataflowEngine:
 
 class TestJaxAudit:
     def test_catalog_covers_every_builder_path(self):
-        names = {n for n, _dag, _nb in jaxaudit.live_catalog()}
+        names = {n for n, _dag, _nb, _caps in jaxaudit.live_catalog()}
         assert names == {"selection", "hashagg", "streamagg", "topn", "hashjoin",
-                         "partial_scalar_agg", "partial_hashagg",
+                         "radix_join", "partial_scalar_agg", "partial_hashagg",
                          "columnar_scan"}
 
     def test_mesh_variants_audited(self):
@@ -371,10 +371,11 @@ class TestJaxAudit:
         mesh-{kind} trace through the jaxpr checks."""
         from tidb_tpu.distsql.planner import mesh_merge_kind
 
-        kinds = {n: mesh_merge_kind(dag) for n, dag, _nb in jaxaudit.live_catalog()}
+        kinds = {n: mesh_merge_kind(dag) for n, dag, _nb, _caps in jaxaudit.live_catalog()}
         assert kinds["partial_scalar_agg"] == "scalar"
         assert kinds["partial_hashagg"] == "group"
         assert kinds["topn"] == "topn"
+        assert kinds["radix_join"] == "group"  # the radix join meshes too
         assert kinds["hashagg"] is None  # Complete mode stays off-mesh
 
     def test_live_catalog_is_clean(self):

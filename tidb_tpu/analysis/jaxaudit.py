@@ -56,6 +56,7 @@ _CONST_LIMIT_BYTES = 4096
 
 _VMAP_BATCH = 3
 _CAPACITY = 8
+_RADIX_CAPACITY = 512  # probe capacity satisfying the radix ratio gate
 _GROUP_CAPACITY = 16
 
 
@@ -180,7 +181,7 @@ def _scan(table_id: int, I):
 def live_catalog() -> list:
     """(name, dag, n_batches) for every exec-op builder path — the
     acceptance set: selection, hashagg, streamagg, topn, hashjoin."""
-    from ..exec.dag import Aggregation, DAGRequest, Join, Selection, TopN
+    from ..exec.dag import Aggregation, ColumnInfo, DAGRequest, Join, Selection, TableScan, TopN
     from ..expr import AggDesc, col, func, lit
 
     _ch, I = _int_chunk()
@@ -204,6 +205,19 @@ def live_catalog() -> list:
         (scan, Join(build=(_scan(32, I),), probe_keys=(col(0, I),),
                     build_keys=(col(0, I),), join_type="inner")),
         output_offsets=(0, 1, 2, 3))
+    # the radix-partitioned join path (ISSUE 13): planner-proven unique
+    # build + int keys routes through ops/radix_join.py when the
+    # build/probe capacity ratio passes — the probe batch is padded wide
+    # (RADIX_CAPACITY) so the gate holds at catalog scale; the grouped
+    # tail makes the mesh variant ("group" kind) trace too
+    radix_join = DAGRequest(
+        (TableScan(33, (ColumnInfo(1, I), ColumnInfo(2, I))),
+         Join(build=(_scan(34, I),), probe_keys=(col(0, I),),
+              build_keys=(col(0, I),), join_type="inner",
+              build_unique=True),
+         Aggregation(group_by=(col(1, I),),
+                     aggs=(AggDesc("sum", (col(2, I),)),), partial=True)),
+        output_offsets=(0, 1))
     # partial-mode shapes: what the dispatch planner's MESH tier runs —
     # audited as shard_map programs too (mesh_merge_kind gates which)
     partial_scalar = DAGRequest(
@@ -227,41 +241,51 @@ def live_catalog() -> list:
                            AggDesc("count", ())))),
         output_offsets=(0, 1, 2))
     return [
-        ("selection", sel, 1),
-        ("hashagg", hashagg, 1),
-        ("streamagg", streamagg, 1),
-        ("topn", topn, 1),
-        ("hashjoin", join, 2),
-        ("partial_scalar_agg", partial_scalar, 1),
-        ("partial_hashagg", partial_hashagg, 1),
-        ("columnar_scan", columnar_scan, 1),
+        ("selection", sel, 1, None),
+        ("hashagg", hashagg, 1, None),
+        ("streamagg", streamagg, 1, None),
+        ("topn", topn, 1, None),
+        ("hashjoin", join, 2, None),
+        # probe batch padded wide so the radix build/probe ratio gate
+        # holds — the trace goes through ops/radix_join.py, not the
+        # monolithic kernel (assert: its program carries no 4-operand
+        # merge sort; the audit checks f64/host/consts/stability)
+        ("radix_join", radix_join, 2, (_RADIX_CAPACITY, _CAPACITY)),
+        ("partial_scalar_agg", partial_scalar, 1, None),
+        ("partial_hashagg", partial_hashagg, 1, None),
+        ("columnar_scan", columnar_scan, 1, None),
     ]
 
 
-def _batches(n_batches: int, vmap: bool):
+def _entry_caps(n_batches: int, caps) -> tuple:
+    return tuple(caps) if caps else tuple(_CAPACITY for _ in range(n_batches))
+
+
+def _batches(n_batches: int, vmap: bool, caps=None):
     from ..chunk import to_device_batch
     from ..chunk.device import to_stacked_device_batch
 
+    caps = _entry_caps(n_batches, caps)
     ch, _I = _int_chunk()
     if vmap:
-        probe = to_stacked_device_batch([ch] * _VMAP_BATCH, _CAPACITY)
+        probe = to_stacked_device_batch([ch] * _VMAP_BATCH, caps[0])
     else:
-        probe = to_device_batch(ch, capacity=_CAPACITY)
-    aux = [to_device_batch(ch, capacity=_CAPACITY) for _ in range(n_batches - 1)]
+        probe = to_device_batch(ch, capacity=caps[0])
+    aux = [to_device_batch(ch, capacity=c) for c in caps[1:]]
     return [probe] + aux
 
 
-def _make_builder(dag, n_batches: int, vmap: bool):
+def _make_builder(dag, n_batches: int, vmap: bool, caps=None):
     """A `make` thunk for audit_stability: a fresh build_program each
     call — exactly what a ProgramCache miss does."""
     from ..exec.builder import build_program
 
     def make():
         cd = build_program(
-            dag, tuple(_CAPACITY for _ in range(n_batches)),
+            dag, _entry_caps(n_batches, caps),
             group_capacity=_GROUP_CAPACITY,
             vmap_batch=_VMAP_BATCH if vmap else None)
-        return cd.fn, _batches(n_batches, vmap)
+        return cd.fn, _batches(n_batches, vmap, caps)
     return make
 
 
@@ -279,11 +303,11 @@ def audit_live() -> list:
     findings: list = []
     import jax
 
-    for name, dag, n_batches in live_catalog():
+    for name, dag, n_batches, caps in live_catalog():
         single_out = None
         for vmap in (False, True):
             variant = f"{name}/{'vmap' if vmap else 'single'}"
-            make = _make_builder(dag, n_batches, vmap)
+            make = _make_builder(dag, n_batches, vmap, caps)
             try:
                 if vmap:
                     # the stability double-build already ran on the single
@@ -305,12 +329,12 @@ def audit_live() -> list:
                 single_out = closed.out_avals
             else:
                 findings.extend(_check_vmap_axis(name, single_out, closed.out_avals, anchor))
-        findings.extend(_audit_mesh_variant(name, dag, n_batches, anchor))
+        findings.extend(_audit_mesh_variant(name, dag, n_batches, anchor, caps))
     _LIVE_MEMO = list(findings)
     return findings
 
 
-def _audit_mesh_variant(name: str, dag, n_batches: int, anchor) -> list:
+def _audit_mesh_variant(name: str, dag, n_batches: int, anchor, caps=None) -> list:
     """Trace the MESH-tier shard_map variant (on-device psum of the
     batched partials) for every catalog shape the dispatch planner would
     route there, and walk its jaxpr through the same f64/host-callback/
@@ -326,18 +350,19 @@ def _audit_mesh_variant(name: str, dag, n_batches: int, anchor) -> list:
     if kind is None:
         return []
     variant = f"{name}/mesh-{kind}"
+    entry_caps = _entry_caps(n_batches, caps)
     n_dev = min(len(jax.devices()), _VMAP_BATCH)
     lanes = -(-_VMAP_BATCH // n_dev) * n_dev
     try:
         cd = build_program(
-            dag, tuple(_CAPACITY for _ in range(n_batches)),
+            dag, entry_caps,
             group_capacity=_GROUP_CAPACITY,
             mesh_lanes=lanes, mesh_devices=n_dev, mesh_kind=kind)
         from ..chunk.device import to_stacked_device_batch
 
         ch, _I = _int_chunk()
-        stacked = to_stacked_device_batch([ch] * lanes, _CAPACITY)
-        aux = _batches(n_batches, False)[1:]
+        stacked = to_stacked_device_batch([ch] * lanes, entry_caps[0])
+        aux = _batches(n_batches, False, caps)[1:]
         closed = jax.make_jaxpr(cd.fn)(stacked, *aux)
     except Exception as exc:  # noqa: BLE001 — a trace failure IS a finding
         return [Finding(anchor[0], anchor[1], PASS,

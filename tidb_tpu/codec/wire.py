@@ -529,6 +529,9 @@ def encode_cop_response(resp) -> bytes:
         w.i64(sm.time_compile_ns)
         w.bool_(sm.cache_hit)
         w.i64(sm.num_bytes)
+        w.i64(sm.radix_partitions)
+        w.i64(sm.radix_rung)
+        w.i64(sm.radix_escapes)
     w.bool_(resp.last_range is not None)
     if resp.last_range is not None:
         w.i32(len(resp.last_range))
@@ -548,7 +551,8 @@ def decode_cop_response(b: bytes):
     region_error = r.s() or None
     other_error = r.s() or None
     summaries = [
-        ExecSummary(r.i64(), r.i64(), r.i64(), r.i64(), r.bool_(), r.i64())
+        ExecSummary(r.i64(), r.i64(), r.i64(), r.i64(), r.bool_(), r.i64(),
+                    r.i64(), r.i64(), r.i64())
         for _ in range(r.i32())
     ]
     last_range = None

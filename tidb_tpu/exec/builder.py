@@ -60,6 +60,12 @@ class CompiledDAG:
     capacities: tuple  # one per scan, canonical order (dag.collect_scans)
     group_capacity: int
     join_capacity: int
+    # radix-join attribution, filled AT TRACE TIME (first execution): the
+    # partition count / per-partition build capacity the plan chose; empty
+    # when no Join rode the radix kernel.  The drivers read it after the
+    # call to emit the `join_radix` span/summary (partitions, rung,
+    # escapes) — see exec/executor.py.
+    radix_info: dict = None  # type: ignore[assignment]
 
 
 class _TraceState:
@@ -79,8 +85,26 @@ class _TraceState:
         self.group_overflow = jnp.bool_(False)
         self.join_overflow = jnp.bool_(False)
         self.topn_overflow = jnp.bool_(False)
+        # capacity NEED hints riding next to the flags (exec/ladder.py):
+        # the true group count / join fan-out when a kernel knows it, so
+        # the retry driver re-dispatches the exact (precompiled) rung in
+        # the SAME device fetch that read the overflow flag
+        self.group_need = jnp.int64(0)
+        self.join_need = jnp.int64(0)
+        # radix-join attribution: escaped-row count (EXPLAIN/TRACE)
+        self.radix_escapes = jnp.int64(0)
+        self.radix_meta: dict = {}  # filled at trace time (partitions)
+        self.radix_joins = True  # builder knob: False = monolithic only
         self.summaries = summaries
         self.ex_rows: list = []
+
+    def note_group(self, need):
+        if need is not None:
+            self.group_need = jnp.maximum(self.group_need, need.astype(jnp.int64))
+
+    def note_join(self, need):
+        if need is not None:
+            self.join_need = jnp.maximum(self.join_need, need.astype(jnp.int64))
 
     def rows(self, arr_or_scalar):
         """Record a produced-row count (lazy: no-op when summaries off).
@@ -241,9 +265,13 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
                     state.rows(valid)
                     ei += 2
                     continue
-            res = hash_join(bkeys, pkeys, bvalid, valid, join_capacity, ex.join_type,
-                            build_unique=ex.build_unique and unique_joins)
+            res = _trace_radix_join(ex, bkeys, pkeys, bvalid, valid,
+                                    join_capacity, state, unique_joins)
+            if res is None:
+                res = hash_join(bkeys, pkeys, bvalid, valid, join_capacity, ex.join_type,
+                                build_unique=ex.build_unique and unique_joins)
             state.join_overflow = state.join_overflow | res.overflow
+            state.note_join(res.need)
             if ex.join_type in ("semi", "anti"):
                 # probe schema preserved, rows filtered by match-existence
                 valid = res.out_valid
@@ -290,6 +318,7 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
             if ex.group_by:
                 res = group_aggregate(gvals, aggs, valid, group_capacity, merge=ex.merge, small_groups=small_groups, stream=ex.stream)
                 state.group_overflow = state.group_overflow | res.overflow
+                state.note_group(res.need)
                 for (a, av), st in zip(aggs, res.states):
                     new_cols.extend(_agg_result_cols(a, av, st, res.group_valid, ex.partial))
                 new_cols.extend(_gather(gvals, res.group_rep))
@@ -309,6 +338,45 @@ def _run_pipeline(executors, batches, cursor, group_capacity, join_capacity, sta
         ei += 1
 
     return cols, valid, fts
+
+
+def _trace_radix_join(ex, bkeys, pkeys, bvalid, valid, join_capacity, state: _TraceState, unique_joins: bool):
+    """Route an eligible Join through the radix-partitioned kernel
+    (ops/radix_join.py); None = take the monolithic kernel.  Eligibility
+    is decided SHAPE-ONLY — join shape, planner-proven unique build,
+    single int-class key word, build/probe capacity ratio — before any
+    value work, mirroring the packed-chain gate's contract."""
+    from ..ops.radix_join import radix_hash_join, radix_plan
+
+    if not (state.radix_joins and ex.build_unique and unique_joins):
+        return None
+    if ex.join_type not in ("inner", "left_outer", "semi", "anti"):
+        return None
+    if len(bkeys) != 1 or len(pkeys) != 1:
+        return None
+    if not (_single_word(bkeys[0]) and _single_word(pkeys[0])):
+        return None
+    if bkeys[0].eval_type == "real" or pkeys[0].eval_type == "real":
+        return None  # float keys: NaN/-0.0 classes stay on the sort kernel
+    plan = radix_plan(bvalid.shape[0], valid.shape[0], join_capacity)
+    if plan is None:
+        return None
+    from ..ops.radix_join import probe_strategy
+
+    mode = probe_strategy(*plan[:3])
+    res, escapes = radix_hash_join(
+        bkeys, pkeys, bvalid, valid, ex.join_type, join_capacity, plan,
+        strategy=mode,
+    )
+    state.radix_escapes = state.radix_escapes + escapes
+    # attribution reports what EXECUTED: the search strategy probes one
+    # un-partitioned sorted build table (partitions=1, no escape hatch).
+    # Program-level, first-radix-join-wins — the escape counter above
+    # still totals across every radix join in the program
+    state.radix_meta.setdefault("partitions", 1 if mode == "search" else plan[0])
+    state.radix_meta.setdefault("part_cap", plan[1])
+    state.radix_meta.setdefault("strategy", mode)
+    return res
 
 
 def _single_word(k: CompVal) -> bool:
@@ -539,6 +607,7 @@ def build_program(
     mesh_lanes: int | None = None,
     mesh_devices: int | None = None,
     mesh_kind: str | None = None,
+    radix_joins: bool = True,
 ) -> CompiledDAG:
     """Compile the whole DAG tree (probe pipeline + all join build
     pipelines) into one fused XLA program over a tuple of device batches.
@@ -574,8 +643,11 @@ def build_program(
     assert len(capacities) == n_scans, f"need {n_scans} batch capacities, got {len(capacities)}"
     join_capacity = join_capacity or max(capacities)
 
+    radix_info: dict = {}
+
     def program(*batches):
         state = _TraceState(summaries)
+        state.radix_joins = radix_joins
         cursor = [0]
         cols, valid, _ = _run_pipeline(dag.executors, batches, cursor, group_capacity, join_capacity, state, topn_full, small_groups, unique_joins, out_offsets=dag.output_offsets)
         packed = _pack_cols([cols[i] for i in dag.output_offsets])
@@ -584,7 +656,13 @@ def build_program(
         # 0-length output and a folded-constant output have SIGSEGV'd the
         # tunneled TPU compiler; reuse the (data-dependent) row count
         ex = jnp.stack(state.ex_rows) if state.ex_rows else n_out[None].astype(jnp.int64)
-        return packed, valid, n_out, (state.group_overflow, state.join_overflow, state.topn_overflow), ex
+        radix_info.update(state.radix_meta)  # trace-time side channel
+        # the flag tuple carries the capacity NEED hints and the radix
+        # escape count so the retry driver / attribution read them in the
+        # SAME device fetch as the overflow flags (no extra round-trip)
+        ovfs = (state.group_overflow, state.join_overflow, state.topn_overflow,
+                state.group_need, state.join_need, state.radix_escapes)
+        return packed, valid, n_out, ovfs, ex
 
     if mesh_lanes is not None:
         jit_fn = _build_mesh_fn(dag, program, n_scans, mesh_lanes,
@@ -594,7 +672,8 @@ def build_program(
         jit_fn = jax.jit(jax.vmap(program, in_axes=(0,) + (None,) * (n_scans - 1)))
     else:
         jit_fn = jax.jit(program)
-    return CompiledDAG(jit_fn, dag.output_fts(), capacities, group_capacity, join_capacity)
+    return CompiledDAG(jit_fn, dag.output_fts(), capacities, group_capacity, join_capacity,
+                       radix_info=radix_info)
 
 
 def _build_mesh_fn(dag: DAGRequest, program, n_scans: int, lanes: int,
@@ -618,6 +697,9 @@ def _build_mesh_fn(dag: DAGRequest, program, n_scans: int, lanes: int,
     def device_fn(local, *aux):
         packed, valid, _n, ovfs, ex = jax.vmap(lambda b: program(b, *aux))(local)
         local_ovf = ovfs[0].any() | ovfs[1].any() | ovfs[2].any()
+        # radix escape total over the region axis (join_radix attribution
+        # — the mesh tier reports it like the other tiers)
+        radix_esc = jax.lax.psum(ovfs[5].sum(), REGION_AXIS)
         if kind == "scalar":
             # the north-star collective: partial states psum/pmin/pmax-
             # reduced over the region axis (parallel/mesh.py merge seam)
@@ -633,7 +715,7 @@ def _build_mesh_fn(dag: DAGRequest, program, n_scans: int, lanes: int,
                 out_cols, mvalid, m_ovf = _mesh_merge_topn(last, out_fts, cols, gvalid)
             merged = _pack_cols(out_cols)
         ovf = jax.lax.pmax((local_ovf | m_ovf).astype(jnp.int32), REGION_AXIS) > 0
-        return merged, mvalid, ex, ovf
+        return merged, mvalid, ex, ovf, radix_esc
 
     fn = shard_map(
         device_fn,
@@ -641,10 +723,11 @@ def _build_mesh_fn(dag: DAGRequest, program, n_scans: int, lanes: int,
         # prefix specs: the whole stacked probe batch shards its leading
         # region axis; aux (join build) batches replicate to every device
         in_specs=(P(REGION_AXIS),) + (P(),) * (n_scans - 1),
-        # merged cols / valid / overflow are replicated in fact (psum /
-        # all_gather-then-identical-local-work) but not statically
-        # inferrable by the vma check; ex_rows keep their region axis
-        out_specs=(P(), P(), P(REGION_AXIS), P()),
+        # merged cols / valid / overflow / escape count are replicated in
+        # fact (psum / all_gather-then-identical-local-work) but not
+        # statically inferrable by the vma check; ex_rows keep their
+        # region axis
+        out_specs=(P(), P(), P(REGION_AXIS), P(), P()),
         check_vma=False,
     )
     return jax.jit(fn)
@@ -769,10 +852,11 @@ class ProgramCache:
         mesh_lanes: int | None = None,
         mesh_devices: int | None = None,
         mesh_kind: str | None = None,
+        radix_joins: bool = True,
     ) -> CompiledDAG:
         return self.get_info(dag, capacities, group_capacity, join_capacity,
                              topn_full, small_groups, unique_joins, vmap_batch,
-                             mesh_lanes, mesh_devices, mesh_kind)[0]
+                             mesh_lanes, mesh_devices, mesh_kind, radix_joins)[0]
 
     def get_info(
         self,
@@ -787,6 +871,7 @@ class ProgramCache:
         mesh_lanes: int | None = None,
         mesh_devices: int | None = None,
         mesh_kind: str | None = None,
+        radix_joins: bool = True,
     ) -> tuple:
         """(program, cache_hit, compile_ns) — the attribution triple the
         exec summaries and the TRACE span tree surface (ref: the
@@ -805,7 +890,7 @@ class ProgramCache:
         # mesh programs are specialized to their lane count AND device
         # count (shard_map shapes both into the trace); mesh_kind is
         # derivable from the fingerprint but cheap to carry explicitly
-        key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, vmap_batch, pallas_mode(), mesh_lanes, mesh_devices, mesh_kind)
+        key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, vmap_batch, pallas_mode(), mesh_lanes, mesh_devices, mesh_kind, radix_joins)
         prog = self._cache.get(key)
         if prog is not None:
             with self._stats_mu:
@@ -820,7 +905,7 @@ class ProgramCache:
             metrics.PROGRAM_COMPILES.inc()
             t0 = _t.perf_counter_ns()
             prog = build_program(dag, capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, vmap_batch=vmap_batch,
-                                 mesh_lanes=mesh_lanes, mesh_devices=mesh_devices, mesh_kind=mesh_kind)
+                                 mesh_lanes=mesh_lanes, mesh_devices=mesh_devices, mesh_kind=mesh_kind, radix_joins=radix_joins)
             compile_ns = _t.perf_counter_ns() - t0
             metrics.PROGRAM_COMPILE_DURATION.observe(compile_ns / 1e9)
             if sp is not None:

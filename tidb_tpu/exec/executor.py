@@ -91,30 +91,57 @@ def drive_program(cache: ProgramCache, dag: DAGRequest, batches, group_capacity:
     return chunk, counts
 
 
+def _radix_attribution(prog, jc: int, radix_esc, info: dict):
+    """`join_radix` attribution (ISSUE 13 satellite): a TRACE span under
+    the ambient cop.execute/session span plus an info entry the store
+    folds into the exec summaries for EXPLAIN ANALYZE.  The escape count
+    arrived in the same device fetch as the overflow flags."""
+    ri = prog.radix_info or {}
+    if not ri:
+        return
+    from ..util import tracing
+
+    esc = int(radix_esc)
+    with tracing.span("exec.join_radix", partitions=ri.get("partitions"),
+                      rung=jc, escapes=esc, strategy=ri.get("strategy")):
+        pass
+    info["radix"] = {"partitions": ri.get("partitions", 0), "rung": jc,
+                     "escapes": esc, "strategy": ri.get("strategy")}
+
+
 def drive_program_info(cache: ProgramCache, dag: DAGRequest, batches, group_capacity: int, max_retries: int = 3, join_capacity: int | None = None, small_groups: int | None = None):
     """drive_program plus the compile/cache attribution triple:
     (chunk, counts, {"cache_hit", "compile_ns"}) — jit defers the XLA
     compile to the first call, so a fresh program's first execution time
     counts as compile time (trace+compile dominate it by orders of
-    magnitude)."""
+    magnitude).
+
+    Capacities snap to the LADDER RUNGS (exec/ladder.py) so programs are
+    keyed by a small precompilable capacity set, and an overflow retry
+    consults the program's NEED hints — the true group count / join
+    fan-out that rode the same device fetch as the flags — to re-dispatch
+    the exact rung: a warm ladder makes every retry a ProgramCache hit
+    (zero recompiles, pinned in tests/test_radix_join.py)."""
     import time as _time
 
     from ..util import metrics
+    from .ladder import overflow_step, rung_for
 
     if not isinstance(batches, (list, tuple)):
         batches = [batches]
     caps = tuple(b.capacity for b in batches)
-    gc = group_capacity
-    jc = join_capacity or max(caps)
+    gc = rung_for(group_capacity)
+    jc = rung_for(join_capacity or max(caps))
     tf = False
     smg = small_groups
     uj = True
+    rj = True
     info = {"cache_hit": True, "compile_ns": 0}
     for _ in range(max_retries + 1):
-        prog, hit, build_ns = cache.get_info(dag, caps, gc, jc, tf, smg, uj)
+        prog, hit, build_ns = cache.get_info(dag, caps, gc, jc, tf, smg, uj, radix_joins=rj)
         t0 = _time.perf_counter_ns()
         metrics.PROGRAM_LAUNCHES.inc()
-        packed, valid, n, (g_ovf, j_ovf, t_ovf), ex_rows = prog.fn(*batches)
+        packed, valid, n, (g_ovf, j_ovf, t_ovf, g_need, j_need, radix_esc), ex_rows = prog.fn(*batches)
         g_ovf, j_ovf, t_ovf = bool(g_ovf), bool(j_ovf), bool(t_ovf)
         if not hit:
             info["cache_hit"] = False
@@ -122,20 +149,18 @@ def drive_program_info(cache: ProgramCache, dag: DAGRequest, batches, group_capa
             info["compile_ns"] += build_ns + (_time.perf_counter_ns() - t0)
         if not g_ovf and not j_ovf and not t_ovf:
             counts = [int(x) for x in np.asarray(ex_rows)]
+            _radix_attribution(prog, jc, radix_esc, info)
             return decode_outputs(packed, valid, prog.out_fts), counts, info
         if g_ovf:
-            # drop a wrong stats hint AND grow capacity in the same retry:
-            # the driver cannot tell whether the dense kernel ran (the agg
-            # mix may have been ineligible), so doing both never wastes a
-            # retry on a byte-identical program
+            # also drop a wrong stats hint in the same retry: the driver
+            # cannot tell whether the dense kernel ran (the agg mix may
+            # have been ineligible), so doing both never wastes a retry
+            # on a byte-identical program
             smg = None
-            gc *= 4
-        if j_ovf:
-            # join overflow can mean out-capacity, a violated unique-build
-            # hint, or a hash collision: grow capacity (which also re-salts
-            # the hash) AND drop the unique hint in the same retry
+        gc, jc, drop = overflow_step(gc, jc, g_ovf, j_ovf, int(g_need), int(j_need))
+        if drop:
             uj = False
-            jc *= 4
+            rj = False
         if t_ovf:
             tf = True  # TopN candidate overflow: exact full-sort variant
     raise OverflowRetryError("DAG overflow not resolved after retries")
@@ -179,16 +204,18 @@ def drive_batched_program_info(
 
     from ..util import metrics
 
+    from .ladder import rung_for
+
     B = int(stacked.row_valid.shape[0])
     cap = int(stacked.row_valid.shape[1])
     caps = (cap,) + tuple(b.capacity for b in aux_batches)
-    jc = join_capacity or max(caps)
+    jc = rung_for(join_capacity or max(caps))
     prog, hit, build_ns = cache.get_info(
-        dag, caps, group_capacity, jc, False, small_groups, True, vmap_batch=B
+        dag, caps, rung_for(group_capacity), jc, False, small_groups, True, vmap_batch=B
     )
     t0 = _time.perf_counter_ns()
     metrics.PROGRAM_LAUNCHES.inc()
-    packed, valid, n, (g_ovf, j_ovf, t_ovf), ex_rows = prog.fn(stacked, *aux_batches)
+    packed, valid, n, (g_ovf, j_ovf, t_ovf, _g_need, _j_need, radix_esc), ex_rows = prog.fn(stacked, *aux_batches)
     g_ovf, j_ovf, t_ovf = np.asarray(g_ovf), np.asarray(j_ovf), np.asarray(t_ovf)
     info = {"cache_hit": hit, "compile_ns": 0}
     if not hit:
@@ -198,12 +225,25 @@ def drive_batched_program_info(
     valid_np = np.asarray(valid)
     ex_np = np.asarray(ex_rows)
     per_region: list = []
+    esc_np = np.asarray(radix_esc)
+    served_esc = 0
+    esc_by_lane: list = []
     for b in range(B):
         if bool(g_ovf[b]) or bool(j_ovf[b]) or bool(t_ovf[b]):
             per_region.append(None)
+            esc_by_lane.append(0)
             continue
+        served_esc += int(esc_np[b])
+        esc_by_lane.append(int(esc_np[b]))
         chunk = decode_outputs(_slice_region(packed, b), valid_np[b], prog.out_fts)
         per_region.append((chunk, [int(x) for x in ex_np[b]]))
+    _radix_attribution(prog, jc, served_esc, info)
+    if "radix" in info:
+        # per-lane escape counts, aligned with per_region: the batched
+        # store attributes each lane's OWN escapes to its summaries
+        # (stamping the batch total per lane would multiply it in
+        # EXPLAIN ANALYZE's cross-summary sum)
+        info["radix"]["escapes_by_lane"] = esc_by_lane
     return per_region, info
 
 
@@ -236,17 +276,19 @@ def drive_mesh_program_info(
 
     from ..util import metrics
 
+    from .ladder import rung_for
+
     R = int(stacked.row_valid.shape[0])
     cap = int(stacked.row_valid.shape[1])
     caps = (cap,) + tuple(b.capacity for b in aux_batches)
-    jc = join_capacity or max(caps)
+    jc = rung_for(join_capacity or max(caps))
     prog, hit, build_ns = cache.get_info(
-        dag, caps, group_capacity, jc, False, small_groups, True,
+        dag, caps, rung_for(group_capacity), jc, False, small_groups, True,
         mesh_lanes=R, mesh_devices=mesh_devices, mesh_kind=kind,
     )
     t0 = _time.perf_counter_ns()
     metrics.PROGRAM_LAUNCHES.inc()
-    merged, mvalid, ex_rows, ovf = prog.fn(stacked, *aux_batches)
+    merged, mvalid, ex_rows, ovf, radix_esc = prog.fn(stacked, *aux_batches)
     overflow = bool(np.asarray(ovf))
     info = {"cache_hit": hit, "compile_ns": 0}
     if not hit:
@@ -257,6 +299,7 @@ def drive_mesh_program_info(
     lane_counts = [[int(x) for x in ex_np[b]] for b in range(R)]
     if overflow:
         return None, lane_counts, info
+    _radix_attribution(prog, jc, np.asarray(radix_esc), info)
     chunk = decode_outputs(merged, np.asarray(mvalid), prog.out_fts)
     return chunk, lane_counts, info
 

@@ -1,0 +1,84 @@
+"""Shape-stable capacity ladder (ISSUE 13 tentpole #2).
+
+Every data-dependent capacity knob (group table, join out-capacity /
+radix escape buffer) used to grow multiplicatively from a per-query
+seed — `max(n // 4, 128)`-style — so two queries of slightly different
+sizes, or one query's overflow retry, each traced and compiled a brand
+new XLA program.  Sort-heavy join programs compile in minutes on the
+tunneled TPU backend, which made the retry ladder the dominant cost of
+the first q3-class join (ROADMAP: 131s compile, overflow assert in
+round 3).
+
+The fix is a SMALL geometric rung set: every requested capacity snaps UP
+to the nearest power-of-two rung >= RUNG_BASE, and overflow retries move
+rung to rung instead of multiplying the seed.  Capacities then take a
+handful of distinct values per batch shape, so ProgramCache keys
+collapse onto a precompilable set and a retry re-dispatches an
+already-compiled program (asserted via ProgramCache stats in
+tests/test_radix_join.py).  The executor pairs the ladder with the
+programs' NEED HINTS (exec/builder.py: true group count / join fan-out
+riding next to the overflow flags) so a retry jumps straight to the
+correct rung — one recompile-free re-dispatch instead of a 4x-growth
+walk (the "no host round-trip wasted" half of the contract: the need
+travels in the same device fetch as the overflow flag).
+"""
+
+from __future__ import annotations
+
+RUNG_BASE = 64  # smallest rung; DEFAULT_GROUP_CAPACITY (4096) is on-ladder
+RUNG_MAX = 1 << 30  # sanity ceiling — beyond this the spill path owns it
+
+
+def rung_for(n: int) -> int:
+    """Smallest power-of-two rung >= max(n, RUNG_BASE)."""
+    c = RUNG_BASE
+    while c < n and c < RUNG_MAX:
+        c *= 2
+    return c
+
+
+def next_rung(c: int, factor: int = 4) -> int:
+    """The retry rung when no need hint is available: one geometric step
+    (x4 keeps the historical growth rate, expressed in rungs)."""
+    return rung_for(max(c, RUNG_BASE) * factor)
+
+
+def overflow_step(gc: int, jc: int, g_ovf: bool, j_ovf: bool,
+                  g_need: int, j_need: int) -> tuple:
+    """ONE overflow-retry policy step — shared by the executor driver and
+    both bench loops so the bench certifies the policy production runs
+    (BENCH_JOIN's retry_recompiles_after_warm number is only meaningful
+    if the loops agree).  Returns (gc, jc, drop_join_hints):
+
+      * a need hint ABOVE the current rung is a pure capacity miss — jump
+        straight to its rung and keep every fast-path hint;
+      * otherwise (violated unique-build hint, hash collision, dense-table
+        stop) capacity growth alone cannot help: step the ladder — which
+        also re-salts — and, for the join knob, tell the caller to drop
+        the unique-build/radix hints in the same retry.
+    """
+    if g_ovf:
+        # at the RUNG_MAX ceiling this no longer moves and the retries
+        # exhaust into OverflowRetryError — the spill path owns it there
+        gc = rung_for(g_need) if g_need > gc else next_rung(gc)
+    drop_join_hints = False
+    if j_ovf:
+        hinted = rung_for(j_need) if j_need > jc else 0
+        if hinted > jc:
+            jc = hinted
+        else:
+            # no rung can move (hintless, hint <= rung, or the RUNG_MAX
+            # ceiling saturated the jump): the retry must still CHANGE
+            # the program — drop the hints and step (re-salt)
+            drop_join_hints = True
+            jc = next_rung(jc)
+    return gc, jc, drop_join_hints
+
+
+def rungs_up_to(n: int) -> list[int]:
+    """Every rung from RUNG_BASE through rung_for(n) — the precompile set
+    bench.py warms so overflow retries never trace a new program."""
+    out = [RUNG_BASE]
+    while out[-1] < n and out[-1] < RUNG_MAX:
+        out.append(out[-1] * 2)
+    return out

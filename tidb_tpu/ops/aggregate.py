@@ -79,6 +79,12 @@ class GroupAggResult:
     n_groups: jax.Array
     overflow: jax.Array
     states: list
+    # capacity NEED hint (exec/ladder.py): the TRUE distinct-group count
+    # when the kernel knows it even past capacity (the sort kernel's
+    # segment count), so an overflow retry re-dispatches the exact
+    # precompiled rung; None/0 = unknown (dense kernels stop inserting at
+    # capacity) and the driver steps the ladder geometrically
+    need: jax.Array | None = None
 
 
 @dataclass
@@ -719,7 +725,8 @@ def group_aggregate(
         else:
             out_states.append([(v[order], nl[order]) for v, nl in st])
 
-    return GroupAggResult(group_rep, group_valid, jnp.minimum(n_groups, group_capacity), overflow, out_states)
+    return GroupAggResult(group_rep, group_valid, jnp.minimum(n_groups, group_capacity), overflow, out_states,
+                          need=n_groups.astype(jnp.int64))
 
 
 def scalar_aggregate(aggs: list, row_valid: jax.Array, merge: bool = False, salt: int = 1):

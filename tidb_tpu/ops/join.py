@@ -52,6 +52,12 @@ class JoinResult:
     n_out: jax.Array
     overflow: jax.Array
     probe_identity: bool = False
+    # capacity NEED hint riding next to the overflow flag (exec/ladder.py):
+    # when overflow is a pure out-capacity miss, `need` is the join
+    # capacity that clears it and the retry driver jumps straight to that
+    # rung; 0 = growth will not help (hash collision / violated
+    # unique-build hint) and the driver takes the conservative dual action
+    need: jax.Array | None = None
 
 
 def merge_lo_hi(sorted_hay, hay_counted, queries):
@@ -215,6 +221,9 @@ def hash_join(
     offsets = jnp.cumsum(counts) - counts  # start slot per probe row
     total = counts.sum()
     overflow = overflow | (total > out_capacity)
+    # out-capacity need: exact (the prefix sum already computed the true
+    # fan-out); zero when the overflow came from a collision check above
+    need = jnp.where(total > out_capacity, total.astype(jnp.int64), jnp.int64(0))
 
     slot = jnp.arange(out_capacity)
     # which probe row does each output slot belong to
@@ -236,4 +245,5 @@ def hash_join(
         out_valid=out_valid,
         n_out=total,
         overflow=overflow,
+        need=need,
     )

@@ -1,0 +1,356 @@
+"""Radix-partitioned hash join — the ISSUE 13 tentpole (ref: the
+reference's radix-hashjoin design doc, docs/design/2018-09-21-radix-hashjoin.md;
+pkg/executor/join/hash_join_v2.go partitioned build).
+
+The monolithic kernel (ops/join.py) pays three full-size multi-operand
+sorts per join: the build lexsort, merge_lo_hi's combined 4-operand sort
+over nb+np, and the inverse sort back to probe order — at production row
+counts the sorts ARE the join, and the single monolithic program is also
+the 131s-compile shape the ROADMAP calls out.  This kernel partitions
+BOTH sides by radix bits of the salted key hash into P independent
+sub-joins, each against a fixed, cache-friendly build table:
+
+  1. partition ids from the key hash's low bits (ops/seg.py hash_words,
+     salted by the join-capacity rung so a ladder retry re-shuffles a
+     pathological clustering);
+  2. placement by ONE cheap 2-operand int32 sort per side (partition id +
+     row index) — sorted order is partition-major, so the [P, cap] tables
+     are plain clipped-window gathers, no scatter ever touches an
+     [N]-sized array;
+  3. per-partition probe, strategy-routed at trace time (probe_strategy —
+     the backend is in the ProgramCache key via pallas_mode): the Pallas
+     probe kernel (ops/join_pallas.py) sweeps each partition's build
+     table in VMEM/SMEM when the shape gate passes; the TPU XLA fallback
+     is a dense broadcast compare fused into its two reductions
+     (first-match slot, match count); CPU-class backends skip the tables
+     and binary-search the sorted build side per probe ("search" — the
+     ~log(nb) cheap host gathers beat every O(N log N) sort XLA-CPU
+     would otherwise pay, and the probe rows never leave original order);
+  4. a SKEW ESCAPE HATCH: any partition whose build side outgrows
+     part_cap or whose probe side outgrows probe_cap is excluded from the
+     tables and its rows are compacted into fixed escape buffers (tiny
+     searchsorted over the P+1 partition offsets — no extra sort) that
+     the GENERAL sorted-merge kernel (merge_lo_hi) joins at esc_cap size;
+     escape overflow raises the join-overflow flag with a NEED hint so
+     the retry driver re-dispatches the next precompiled rung.
+
+Only the planner-proven unique-build single-word int-class equi-join
+shape rides this path (inner/left_outer/semi/anti), and only when the
+probe side dominates (build*8 <= probe capacity — the canonical
+small-build hash join, TPC-H Q3's shape); everything else stays on the
+monolithic kernel.  An opportunistic fast path, never a semantics
+change: the unique-build contract is runtime-verified per partition
+(match fan-out > 1 raises overflow, same as ops/join.py), and NULL keys
+never match (pid pins past the last partition).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..expr.compile import CompVal
+from .join import JoinResult, _key_matrix, merge_lo_hi
+from .keys import lexsort
+from .seg import I64_MAX, hash_words
+
+# plan knobs (static; every program is keyed by the derived plan via its
+# capacities + join-capacity rung, so these never recompile per query)
+MAX_PARTS = 1 << 16
+PART_CAP_MIN = 128
+PROBE_CAP_MIN = 8
+ESC_CAP_MIN = 1024
+ESC_DIV = 16          # esc_cap = join_capacity // ESC_DIV (rung-scaled)
+BUILD_RATIO = 8       # eligible when nb_cap * BUILD_RATIO <= np_cap
+
+
+def _pow2(n: int) -> int:
+    c = 1
+    while c < n:
+        c *= 2
+    return c
+
+
+def radix_plan(nb_cap: int, np_cap: int, join_capacity: int):
+    """(n_parts, part_cap, probe_cap, esc_cap) — all static, derived from
+    the batch capacities and the join-capacity RUNG, or None when the
+    shape is build-heavy (the monolithic kernel wins there: the dense
+    probe's work is probe_rows * part_cap, and a big build side forces
+    part_cap past the cache-friendly budget)."""
+    if nb_cap * BUILD_RATIO > np_cap:
+        return None
+    # target ~32 build rows per partition (4x slack under PART_CAP_MIN),
+    # bounded so the probe table keeps >= 8 slots per partition
+    p_hi = min(MAX_PARTS, max(_pow2(np_cap // PROBE_CAP_MIN + 1) // 2, 2))
+    n_parts = min(max(_pow2(max(nb_cap, 1) // 32), 2), p_hi)
+    part_cap = max(PART_CAP_MIN, _pow2(-(-4 * nb_cap // n_parts)))
+    probe_cap = max(PROBE_CAP_MIN, _pow2(-(-2 * np_cap // n_parts)))
+    esc_cap = min(_pow2(max(nb_cap, np_cap)),
+                  max(ESC_CAP_MIN, join_capacity // ESC_DIV))
+    return n_parts, part_cap, probe_cap, esc_cap
+
+
+def _partition(pid, n_parts: int, cap: int, n: int):
+    """Cluster rows by partition id with one stable 2-operand int32 sort;
+    returns (tbl_idx [P, cap] int32 row indices, in_part mask, count [P],
+    order_pid, order_idx, start [P]).  Rows with pid == n_parts (unusable)
+    sort last and never enter a table."""
+    iota = jnp.arange(n, dtype=jnp.int32)
+    order_pid, order_idx = jax.lax.sort((pid, iota), num_keys=1)
+    start = jnp.searchsorted(
+        order_pid, jnp.arange(n_parts + 1, dtype=jnp.int32)
+    ).astype(jnp.int32)
+    count = start[1:] - start[:-1]
+    rows = start[:-1, None] + jnp.arange(cap, dtype=jnp.int32)[None, :]
+    in_part = jnp.arange(cap, dtype=jnp.int32)[None, :] < count[:, None]
+    tbl_idx = order_idx[jnp.clip(rows, 0, n - 1)]
+    return tbl_idx, in_part, count, order_pid, order_idx, start
+
+
+def _escape_rows(order_idx, start, count, esc_part, n_parts: int, esc_cap: int, n: int):
+    """Compact the rows of escaped partitions (contiguous runs in the
+    partition-sorted order) into a fixed [esc_cap] buffer: buffer slot k
+    maps back through a searchsorted over the P+1 escape offsets — P is
+    tiny, so this costs no extra [N] pass.  Returns (buf_idx int32
+    original-row indices, slot_ok, n_esc int32)."""
+    esc_cnt = jnp.where(esc_part, count, 0).astype(jnp.int32)
+    off = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(esc_cnt)])
+    n_esc = off[-1]
+    k = jnp.arange(esc_cap, dtype=jnp.int32)
+    p_of = jnp.clip(
+        jnp.searchsorted(off, k, side="right").astype(jnp.int32) - 1,
+        0, n_parts - 1,
+    )
+    pos = start[p_of] + (k - off[p_of])
+    slot_ok = k < n_esc
+    buf_idx = order_idx[jnp.clip(pos, 0, n - 1)]
+    return buf_idx, slot_ok, n_esc
+
+
+def _probe_tables_xla(b_key_tbl, b_slot_ok, p_key_tbl, p_slot_ok, part_cap: int):
+    """Dense per-partition probe: first matching build slot (part_cap =
+    none) and the unique-contract fan-out check, as two fused reductions
+    over the broadcast compare."""
+    eq = (p_key_tbl[:, :, None] == b_key_tbl[:, None, :]) & b_slot_ok[:, None, :]
+    slotv = jnp.where(
+        eq, jnp.arange(part_cap, dtype=jnp.int32)[None, None, :],
+        jnp.int32(part_cap),
+    )
+    bpos = slotv.min(axis=-1)
+    nmatch = eq.sum(axis=-1, dtype=jnp.int32)
+    dup = jnp.any((nmatch > 1) & p_slot_ok)
+    return bpos, dup
+
+
+def probe_strategy(n_parts: int, part_cap: int, probe_cap: int) -> str:
+    """Trace-time probe-strategy switch, decided shape-only (the same
+    routing class as dense_pallas's pallas_mode gate; the backend and
+    pallas mode are both in the ProgramCache key):
+
+      "pallas-tpu"/"pallas-interpret"  partitioned VMEM/SMEM probe kernel
+      "dense"   partitioned broadcast-compare (TPU XLA fallback: VPU-rate
+                elementwise work, zero [N]-sized gathers)
+      "search"  sorted-build binary-search probe (CPU-class backends:
+                ~log(nb) cheap gathers per probe beat every O(N log N)
+                sort XLA-CPU would otherwise pay; TPU never takes this —
+                its per-gather cost is the documented ~16ns floor)
+    """
+    from .join_pallas import pallas_probe_eligible
+
+    mode = pallas_probe_eligible(n_parts, part_cap, probe_cap)
+    if mode:
+        return f"pallas-{mode}"
+    if jax.default_backend() == "tpu":
+        return "dense"
+    return "search"
+
+
+def _probe_search(bw, b_usable, pw, p_usable, nb: int):
+    """CPU-class probe: sort the SMALL build side once (the monolithic
+    kernel pays this too), then binary-search every probe key against it
+    — no combined merge sort, no inverse sort, probe rows stay in place.
+    Returns (build_idx int32 [np] (-1 = none), dup flag)."""
+    top = I64_MAX
+    bk_m = jnp.where(b_usable, bw, top)
+    perm = lexsort([bk_m], extra_key=(~b_usable).astype(jnp.int64))
+    sw = bk_m[perm]
+    nb_usable = b_usable.sum().astype(jnp.int32)
+    lo = jnp.searchsorted(sw, pw, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(sw, pw, side="right").astype(jnp.int32)
+    hi = jnp.minimum(hi, nb_usable)  # unusable tail never matches
+    matched = (hi > lo) & p_usable
+    dup = jnp.any(((hi - lo) > 1) & matched)
+    build_idx = jnp.where(
+        matched, perm[jnp.clip(lo, 0, nb - 1)].astype(jnp.int32), jnp.int32(-1)
+    )
+    return build_idx, dup
+
+
+def _probe_partitioned(bw, b_usable, pw, p_usable, plan: tuple,
+                       join_capacity: int, mode: str):
+    """The partitioned-table probe (pallas / dense): radix-cluster both
+    sides, probe each partition against its fixed-capacity build table,
+    and route over-full partitions through the escape hatch.  Returns
+    (build_idx [np] original-order, matched, overflow, need, escapes)."""
+    n_parts, part_cap, probe_cap, esc_cap = plan
+    nb, np_ = bw.shape[0], pw.shape[0]
+    P = n_parts
+
+    # partition ids from the salted hash; unusable rows pin to P (sort last)
+    salt = join_capacity
+    b_pid = jnp.where(
+        b_usable, (hash_words([bw], salt) & jnp.int64(P - 1)).astype(jnp.int32),
+        jnp.int32(P),
+    )
+    p_pid = jnp.where(
+        p_usable, (hash_words([pw], salt) & jnp.int64(P - 1)).astype(jnp.int32),
+        jnp.int32(P),
+    )
+    b_tbl_idx, b_in, b_count, _b_opid, b_oidx, b_start = _partition(b_pid, P, part_cap, nb)
+    p_tbl_idx, p_in, p_count, p_opid, p_oidx, p_start = _partition(p_pid, P, probe_cap, np_)
+
+    # the skew escape hatch: an over-full partition (either side) leaves
+    # the tables entirely and rides the general kernel below
+    esc_part = (b_count > part_cap) | (p_count > probe_cap)
+    b_slot_ok = b_in & ~esc_part[:, None]
+    p_slot_ok = p_in & ~esc_part[:, None]
+    b_key_tbl = bw[b_tbl_idx]
+    p_key_tbl = pw[p_tbl_idx]
+
+    if mode.startswith("pallas"):
+        from .join_pallas import probe_tables_pallas
+
+        bpos, dup = probe_tables_pallas(
+            b_key_tbl, b_slot_ok, p_key_tbl, p_slot_ok,
+            interpret=(mode == "pallas-interpret"),
+        )
+    else:
+        bpos, dup = _probe_tables_xla(b_key_tbl, b_slot_ok, p_key_tbl, p_slot_ok, part_cap)
+    b_orig_tbl = jnp.take_along_axis(
+        b_tbl_idx, jnp.clip(bpos, 0, part_cap - 1), axis=1
+    )
+    matched_tbl = (bpos < part_cap) & p_slot_ok
+
+    # ---- escape sub-join: general sorted-merge at esc_cap size ----------
+    b_buf, b_ok_e, nbe = _escape_rows(b_oidx, b_start, b_count, esc_part, P, esc_cap, nb)
+    p_buf, p_ok_e, npe = _escape_rows(p_oidx, p_start, p_count, esc_part, P, esc_cap, np_)
+    bke = jnp.where(b_ok_e, bw[b_buf], I64_MAX)
+    perm = lexsort([bke], extra_key=(~b_ok_e).astype(jnp.int64))
+    sw = bke[perm]
+    usable_sorted = jnp.arange(esc_cap, dtype=jnp.int32) < jnp.minimum(nbe, esc_cap)
+    pke = pw[p_buf]
+    lo, hi = merge_lo_hi(sw, usable_sorted, pke)
+    m_e = (hi > lo) & p_ok_e
+    dup_e = jnp.any(((hi - lo) > 1) & m_e)
+    b_orig_e = b_buf[perm[jnp.clip(lo, 0, esc_cap - 1)]]
+
+    esc_over = (nbe > esc_cap) | (npe > esc_cap)
+    escapes = (jnp.minimum(nbe, esc_cap) + jnp.minimum(npe, esc_cap)).astype(jnp.int64)
+    # the rung that sizes esc_cap past the observed escape count — the
+    # retry driver re-dispatches it directly (a precompiled rung when the
+    # ladder is warm), instead of stepping blind
+    need = jnp.where(
+        esc_over,
+        jnp.maximum(nbe, npe).astype(jnp.int64) * ESC_DIV,
+        jnp.int64(0),
+    )
+
+    # ---- back to original probe order -----------------------------------
+    # sorted-probe-space results: row at sorted position s sits in table
+    # slot (pid, s - start[pid]) unless its partition escaped
+    s = jnp.arange(np_, dtype=jnp.int32)
+    pid_c = jnp.clip(p_opid, 0, P - 1)
+    r = s - p_start[pid_c]
+    in_tbl = (p_opid < P) & (r < probe_cap) & ~esc_part[pid_c]
+    flat = pid_c * probe_cap + jnp.clip(r, 0, probe_cap - 1)
+    res_sorted = jnp.where(
+        in_tbl & matched_tbl.reshape(-1)[flat],
+        b_orig_tbl.reshape(-1)[flat].astype(jnp.int32),
+        jnp.int32(-1),
+    )
+    # inverse sort (2-operand int32) restores the probe-identity layout
+    _, build_idx = jax.lax.sort((p_oidx, res_sorted), num_keys=1)
+    # escape overlay: a small fixed-size scatter (esc_cap slots, distinct
+    # targets, invalid slots dropped out of range)
+    tgt = jnp.where(p_ok_e, p_buf, jnp.int32(np_))
+    esc_val = jnp.where(m_e, b_orig_e.astype(jnp.int32), jnp.int32(-1))
+    build_idx = build_idx.at[tgt].set(esc_val, mode="drop")
+
+    matched = build_idx >= 0
+    overflow = dup | dup_e | esc_over
+    return build_idx, matched, overflow, need, escapes
+
+
+def radix_hash_join(
+    build_keys: list[CompVal],
+    probe_keys: list[CompVal],
+    build_valid,
+    probe_valid,
+    join_type: str,
+    join_capacity: int,
+    plan: tuple,
+    strategy: str | None = None,
+):
+    """Unique-build equi-join over the radix-partitioned tables.
+
+    Same output contract as ops/join.py's build_unique branch
+    (probe_identity layout: output slot j IS probe row j), so the builder
+    consumes the result through the identical code path.  Returns
+    (JoinResult, escapes int32) — escapes is the escaped-row count the
+    EXPLAIN ANALYZE / TRACE `join_radix` attribution reports.  The
+    JoinResult's `need` hint carries the join-capacity rung that would
+    clear an escape-buffer overflow (0 = growth will not help: a violated
+    unique-build contract — the driver drops the hint instead)."""
+    n_parts, part_cap, probe_cap, esc_cap = plan
+    bkeys, b_usable = _key_matrix(build_keys, build_valid)
+    pkeys, p_usable = _key_matrix(probe_keys, probe_valid)
+    assert len(bkeys) == 1 and len(pkeys) == 1, "radix join needs single-word keys"
+    bw, pw = bkeys[0], pkeys[0]
+    assert not jnp.issubdtype(bw.dtype, jnp.floating), "radix join is int-class only"
+    nb, np_ = bw.shape[0], pw.shape[0]
+    P = n_parts
+    mode = strategy or probe_strategy(P, part_cap, probe_cap)
+
+    if mode == "search":
+        # CPU-class backends: the partition tables buy nothing (no SMEM
+        # to localize into) — the sorted-build binary-search probe skips
+        # the combined merge sort AND the inverse sort outright
+        build_idx, dup = _probe_search(bw, b_usable, pw, p_usable, nb)
+        matched = build_idx >= 0
+        overflow = dup
+        need = jnp.int64(0)
+        escapes = jnp.int64(0)
+    else:
+        build_idx, matched, overflow, need, escapes = _probe_partitioned(
+            bw, b_usable, pw, p_usable, plan, join_capacity, mode,
+        )
+
+    if join_type == "semi":
+        keep = probe_valid & matched
+        return JoinResult(
+            probe_idx=jnp.arange(np_, dtype=jnp.int32),
+            build_idx=jnp.full(np_, -1, jnp.int32),
+            build_null=jnp.ones(np_, bool),
+            out_valid=keep, n_out=keep.sum(), overflow=overflow, need=need,
+        ), escapes
+    if join_type == "anti":
+        keep = probe_valid & ~matched
+        return JoinResult(
+            probe_idx=jnp.arange(np_, dtype=jnp.int32),
+            build_idx=jnp.full(np_, -1, jnp.int32),
+            build_null=jnp.ones(np_, bool),
+            out_valid=keep, n_out=keep.sum(), overflow=overflow, need=need,
+        ), escapes
+
+    out_valid = (probe_valid & matched) if join_type == "inner" else probe_valid
+    build_null = ~matched
+    return JoinResult(
+        probe_idx=jnp.arange(np_, dtype=jnp.int32),
+        build_idx=build_idx,
+        build_null=build_null & out_valid,
+        out_valid=out_valid,
+        n_out=out_valid.sum(),
+        overflow=overflow,
+        need=need,
+        probe_identity=True,
+    ), escapes

@@ -104,7 +104,7 @@ def run_sharded_partial_agg(dag, stacked: DeviceBatch, mesh: Mesh):
         dag, (cap,), mesh_lanes=R, mesh_devices=int(mesh.devices.size),
         mesh_kind="scalar",
     )
-    merged, _valid, _ex, _ovf = prog.fn(stacked)
+    merged, _valid, _ex, _ovf, _esc = prog.fn(stacked)
     return [tuple(out) for out in merged]
 
 

@@ -2955,6 +2955,22 @@ class Session:
             for e in rp.root_dag.executors[1:]:
                 out.append([Datum.string(f"root[{type(e).__name__}]"), Datum.NULL, Datum.i64(1),
                             Datum.NULL, Datum.NULL, Datum.NULL, Datum.NULL])
+        # radix-join attribution (ISSUE 13): partitions/rung from the
+        # compiled plan, escapes = skew rows the escape hatch routed
+        # through the general kernel, summed over the tasks that rode it
+        rx_tasks = rx_esc = rx_parts = rx_rung = 0
+        for task_summaries in sink:
+            for s in task_summaries:
+                if getattr(s, "radix_partitions", 0):
+                    rx_tasks += 1
+                    rx_parts = max(rx_parts, s.radix_partitions)
+                    rx_rung = max(rx_rung, s.radix_rung)
+                    rx_esc += s.radix_escapes
+        if rx_tasks:
+            out.append([Datum.string("join_radix"), Datum.i64(rx_parts),
+                        Datum.i64(rx_tasks), Datum.NULL, Datum.NULL,
+                        Datum.string(f"rung={rx_rung} escapes={rx_esc}"),
+                        Datum.NULL])
         if batch_stats:
             # batched coprocessor attribution: rows=regions batch-served,
             # tasks=vmapped launches, cache column carries launches saved

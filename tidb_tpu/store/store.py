@@ -92,6 +92,13 @@ class ExecSummary:
     time_compile_ns: int = 0  # 0 on a cache hit
     cache_hit: bool = False  # the fused program came from the cache
     num_bytes: int = 0
+    # radix-join attribution (ISSUE 13): set on Join executors whose task
+    # rode the radix-partitioned kernel — partition count, the join
+    # capacity RUNG the program compiled at, and the skew-escape row
+    # count; 0/0/0 = monolithic kernel (EXPLAIN ANALYZE `join_radix` row)
+    radix_partitions: int = 0
+    radix_rung: int = 0
+    radix_escapes: int = 0
 
 
 @dataclass
@@ -109,6 +116,26 @@ class CopResponse:
     # ON DEVICE with its group's other lanes (psum over the region axis);
     # the value is the number of lanes the one merged state covers — the
     # group's FIRST lane carries the merged chunk, the rest answer empty
+
+
+def _apply_radix_attribution(summaries: list, walk, info) -> None:
+    """Fold the driver's `join_radix` attribution (exec/executor.py
+    _radix_attribution: partitions / capacity rung / skew escapes) onto
+    the FIRST Join executor's summary — the triple is PROGRAM-level (one
+    escape total, one plan per compiled program), so stamping every Join
+    would multiply it in EXPLAIN ANALYZE's cross-summary sum; the summary
+    indexes align with the executor walk, same as the row counts."""
+    ri = info.get("radix") if isinstance(info, dict) else None
+    if not ri:
+        return
+    from ..exec.dag import Join as _Join
+
+    for i, ex in enumerate(walk):
+        if isinstance(ex, _Join) and i < len(summaries):
+            summaries[i].radix_partitions = int(ri.get("partitions") or 0)
+            summaries[i].radix_rung = int(ri.get("rung") or 0)
+            summaries[i].radix_escapes = int(ri.get("escapes") or 0)
+            return
 
 
 def _fault_matches(value, store_id: int) -> bool:
@@ -849,6 +876,7 @@ class TPUStore:
             )
             for i, r in enumerate(ex_rows)
         ]
+        _apply_radix_attribution(summaries, walk, info)
         for ex, r in zip(walk, ex_rows):
             metrics.COP_EXECUTOR_ROWS.labels(type(ex).__name__.lower()).inc(r)
         resp = CopResponse(chunk=chunk, exec_summaries=summaries, last_range=last_range)
@@ -1032,6 +1060,9 @@ class TPUStore:
                 lane_counts[k], share,
                 compile_ns=info["compile_ns"] if k == 0 else 0,
                 cache_hit=info["cache_hit"] if k == 0 else True, walk=walk,
+                # the carrier lane owns the merged result — it carries the
+                # group-total join_radix attribution too
+                radix_info=info if k == 0 else None,
             )
             # NOT cop-cached: the merged state covers the whole group, not
             # one region's data version — a later request with a different
@@ -1044,7 +1075,7 @@ class TPUStore:
 
     def _lane_attribution(self, region, in_chunk, out_bytes: int, counts,
                           share: int, compile_ns: int, cache_hit: bool,
-                          walk) -> list:
+                          walk, radix_info=None) -> list:
         """Shared per-lane attribution for the vmapped-bucket and mesh
         launch loops: PD read flow, cop metrics, and the ExecSummary list
         (the fused program's time shared across the lane's executors;
@@ -1066,6 +1097,8 @@ class TPUStore:
             )
             for j, r in enumerate(counts)
         ]
+        if radix_info:
+            _apply_radix_attribution(summaries, walk, radix_info)
         for ex, r in zip(walk, counts):
             metrics.COP_EXECUTOR_ROWS.labels(type(ex).__name__.lower()).inc(r)
         return summaries
@@ -1146,7 +1179,7 @@ class TPUStore:
         walk = executor_walk(dag.executors)
         metrics.BATCH_COP_BATCHES.inc()
         served = 0
-        for (i, req, region), ch, res in zip(entries, chunks, per_region):
+        for lane, ((i, req, region), ch, res) in enumerate(zip(entries, chunks, per_region)):
             if res is None:
                 # this lane's group/join/topn capacity overflowed: only it
                 # rides the single-request retry ladder
@@ -1159,10 +1192,20 @@ class TPUStore:
             # single path, so the PD never sees a region's read twice.
             # compile time belongs to the ONE shared program: the first lane
             # carries it, the rest are cache hits by construction
+            lane_info = info
+            if info.get("radix"):
+                # each lane's summaries carry its OWN escape count (the
+                # batch total would multiply across EXPLAIN's summary sum)
+                by_lane = info["radix"].get("escapes_by_lane") or []
+                lane_info = {"radix": dict(
+                    info["radix"],
+                    escapes=by_lane[lane] if lane < len(by_lane) else 0,
+                )}
             summaries = self._lane_attribution(
                 region, ch, chunk.nbytes(), ex_rows, share,
                 compile_ns=info["compile_ns"] if served == 0 else 0,
                 cache_hit=info["cache_hit"] if served == 0 else True, walk=walk,
+                radix_info=lane_info,
             )
             served += 1
             resp = CopResponse(chunk=chunk, exec_summaries=summaries, batched=batch_id)
