@@ -1298,6 +1298,217 @@ def _join_bench_main():
     }))
 
 
+def _concurrent_main():
+    """BENCH_CONCURRENT=1: the production front door under concurrency
+    (ISSUE 15) — N threaded sessions (default 256) of mixed point-get /
+    index-scan / write traffic against ONE shared store + catalog.
+    Reports p50/p99 statement latency and the plan-cache hit rate with
+    the cache OFF vs ON (the parse+plan-skip payoff), then a saturation
+    burst against a small admission gate: every shed must be the typed
+    ServerIsBusy (MySQL 9003) and every statement must eventually
+    succeed on the Backoffer server_busy budget — zero untyped errors.
+    Finally the seeded chaos storm runs with the admission failpoint
+    flickering, proving shedding never corrupts results (oracle
+    byte-clean). Hermetic CPU."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    import random
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tidb_tpu.codec import tablecodec
+    from tidb_tpu.sql.session import Session, SQLError
+    from tidb_tpu.util import metrics
+    from tidb_tpu.util.backoff import Backoffer
+
+    n_sessions = int(os.environ.get("BENCH_CONCURRENT_SESSIONS", "256"))
+    n_stmts = int(os.environ.get("BENCH_CONCURRENT_STMTS", "12"))
+    seed_rows, n_regions, n_stores = 4096, 8, 4
+
+    s = Session()
+    s.execute("CREATE TABLE conc_t (id BIGINT PRIMARY KEY, v BIGINT, "
+              "k VARCHAR(24), KEY iv (v))")
+    for lo in range(0, seed_rows, 512):
+        s.execute("INSERT INTO conc_t VALUES " + ",".join(
+            f"({i},{(i * 31) % 997},'k{i % 64}')"
+            for i in range(lo, min(lo + 512, seed_rows))))
+    tid = s.catalog.table("conc_t").table_id
+    for i in range(1, n_regions):
+        s.store.cluster.split(
+            tablecodec.encode_row_key(tid, i * seed_rows // n_regions))
+    s.store.cluster.set_stores(n_stores)
+    s.store.cluster.scatter()
+    # warm the compiled-kernel layer so BOTH phases measure the session
+    # tier, not XLA compiles (the ProgramCache is below the plan cache):
+    # every scan shape the workload can draw compiles here, once
+    log("concurrent: warming compiled scan shapes...")
+    for lo_v in (100, 200, 300, 400):
+        s.execute(f"SELECT k FROM conc_t WHERE v >= {lo_v} AND "
+                  f"v < {lo_v + 50} ORDER BY v LIMIT 5")
+    next_id = [seed_rows]
+
+    def session_worker(sid, enable_cache, lat_out, err_out):
+        rng = random.Random(1000 + sid)
+        sess = Session(store=s.store, catalog=s.catalog)
+        sess.execute(f"SET tidb_enable_plan_cache = {'ON' if enable_cache else 'OFF'}")
+        base = next_id[0] + sid * n_stmts  # private insert keyspace
+        my_lat = []
+        for j in range(n_stmts):
+            roll = rng.randrange(10)
+            if roll < 6:  # repeated-statement OLTP mix: mostly point gets
+                sql = f"SELECT v FROM conc_t WHERE id = {rng.randrange(seed_rows)}"
+            elif roll < 8:
+                # scans draw from a SMALL literal set: selection consts
+                # bake into the compiled program (the ProgramCache keys
+                # them), so a bounded set keeps BOTH phases measuring the
+                # session tier, not XLA compiles — and repeated OLTP
+                # traffic repeats its hot ranges anyway
+                lo_v = (rng.randrange(4) + 1) * 100
+                sql = (f"SELECT k FROM conc_t WHERE v >= {lo_v} AND "
+                       f"v < {lo_v + 50} ORDER BY v LIMIT 5")
+            elif roll < 9:
+                sql = (f"INSERT INTO conc_t VALUES ({base + j},"
+                       f"{rng.randrange(997)},'w{sid % 64}')")
+            else:
+                sql = (f"UPDATE conc_t SET v = {rng.randrange(997)} "
+                       f"WHERE id = {rng.randrange(seed_rows)}")
+            t0 = time.perf_counter()
+            try:
+                sess.execute(sql)
+            except Exception as exc:  # noqa: BLE001 — classified below
+                err_out.append(f"{type(exc).__name__}: {str(exc)[:120]}")
+            my_lat.append((time.perf_counter() - t0) * 1000.0)
+        lat_out.extend(my_lat)  # one append per worker: cheap + thread-safe
+
+    def pct(xs, p):
+        return xs[min(int(len(xs) * p), len(xs) - 1)] if xs else 0.0
+
+    def one_phase(enable_cache):
+        lat, errs = [], []
+        h0 = metrics.PLAN_CACHE_HITS.value
+        m0 = metrics.PLAN_CACHE_MISSES.value
+        threads = [
+            threading.Thread(target=session_worker,
+                             args=(i, enable_cache, lat, errs), daemon=True)
+            for i in range(n_sessions)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        next_id[0] += n_sessions * n_stmts
+        lat.sort()
+        hits = metrics.PLAN_CACHE_HITS.value - h0
+        misses = metrics.PLAN_CACHE_MISSES.value - m0
+        return {
+            "p50_ms": round(pct(lat, 0.50), 3),
+            "p99_ms": round(pct(lat, 0.99), 3),
+            "stmts_per_sec": round(len(lat) / max(wall, 1e-9), 1),
+            "hit_rate": round(hits / max(hits + misses, 1), 4),
+            "errors": errs[:5],
+        }
+
+    log(f"concurrent: {n_sessions} sessions x {n_stmts} stmts, cache off...")
+    off = one_phase(False)
+    log("concurrent: cache on...")
+    on = one_phase(True)
+
+    # ---- saturation burst: a tiny gate with NO queue — arrivals past
+    # max_inflight shed immediately, everyone retries on the budget
+    gate = s.store.admission
+    gate.configure(max_inflight=2, session_queue=0, queue_wait_ms=0.2,
+                   shed_backoff_ms=2)
+    burst_n = min(n_sessions, 64)
+    shed0 = sum(metrics.REGISTRY.labeled_samples(
+        "tidb_tpu_admission_shed_total").values())
+    untyped: list = []
+    unrecovered = [0]
+
+    def burst_worker(sid):
+        sess = Session(store=s.store, catalog=s.catalog)
+        rng = random.Random(sid)
+        for _ in range(4):
+            bo = Backoffer(budget_ms=8000)
+            # the burst statement is a SCAN: its device dispatch releases
+            # the GIL mid-flight, so statements genuinely overlap and the
+            # tiny gate saturates (point gets finish inside one GIL slice
+            # and would never stack up in-process)
+            lo_v = (rng.randrange(4) + 1) * 100
+            while True:
+                try:
+                    sess.execute(
+                        f"SELECT k FROM conc_t WHERE v >= {lo_v} AND "
+                        f"v < {lo_v + 50} ORDER BY v LIMIT 5")
+                    break
+                except SQLError as exc:
+                    if exc.code != 9003:
+                        untyped.append(f"SQLError {exc.code}: {str(exc)[:120]}")
+                        break
+                    try:
+                        bo.backoff("server_busy",
+                                   suggested_ms=getattr(exc, "backoff_ms", 0))
+                    except Exception:  # noqa: BLE001 — budget exhausted
+                        unrecovered[0] += 1
+                        break
+                except Exception as exc:  # noqa: BLE001 — the bug class
+                    untyped.append(f"{type(exc).__name__}: {str(exc)[:120]}")
+                    break
+
+    log(f"concurrent: saturation burst ({burst_n} sessions vs max_inflight=2, no queue)...")
+    threads = [threading.Thread(target=burst_worker, args=(i,), daemon=True)
+               for i in range(burst_n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    gate.configure(max_inflight=0)
+    sheds = sum(metrics.REGISTRY.labeled_samples(
+        "tidb_tpu_admission_shed_total").values()) - shed0
+
+    # ---- chaos oracle with the admission failpoint flickering: shed
+    # statements are typed (9003, counted retryable) and every answered
+    # statement is byte-equal to the fault-free oracle
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tools"))
+    import chaos as chaos_mod
+
+    rep = chaos_mod.run_chaos(
+        seed=7, statements=int(os.environ.get("BENCH_CONCURRENT_CHAOS", "80")),
+        admission_flicker=0.1)
+
+    print(json.dumps({
+        "metric": "concurrent_front_door",
+        "compile_s": round(_compile_seconds(), 2),
+        "sessions": n_sessions,
+        "stmts_per_session": n_stmts,
+        "rows": seed_rows,
+        "regions": n_regions,
+        "stores": n_stores,
+        "cache_off": off,
+        "cache_on": on,
+        "p50_ratio_off_vs_on": round(off["p50_ms"] / max(on["p50_ms"], 1e-9), 2),
+        "burst": {
+            "sessions": burst_n,
+            "sheds": int(sheds),
+            "untyped_errors": untyped[:5],
+            "unrecovered": unrecovered[0],
+        },
+        "chaos": {
+            "ok": rep["ok"],
+            "typed_errors": rep["typed_errors"],
+            "wrong_results": rep["wrong_results"],
+            "untyped_errors": rep["untyped_errors"],
+        },
+    }))
+
+
 def _mesh_main():
     """BENCH_MESH=1: host-merge vs on-device-psum dispatch (ISSUE 11) —
     the same scalar-aggregate scan over a PD-split table, dispatched (a)
@@ -1404,6 +1615,9 @@ def _mesh_main():
 def main():
     import os
 
+    if os.environ.get("BENCH_CONCURRENT"):
+        _concurrent_main()
+        return
     if os.environ.get("BENCH_JOIN"):
         _join_bench_main()
         return

@@ -98,13 +98,29 @@ COLUMNAR_ROOTS = (
     ("columnar/sink.py", "ColumnarSink", "write"),
     ("server/http_api.py", "StatusServer", "_columnar_route"),
 )
+# production front door (ISSUE 15): the admission gate's two entry
+# points are ESCAPE and BACKOFF roots — a shed must leave as the typed
+# AdmissionShed (mapped to MySQL 9003 at the session boundary) and the
+# gate's bounded queue wait must never spin or raw-sleep. The plan-cache
+# consult/serve seam is an ESCAPE-only root (below): its cone reaches
+# the planner/parser, whose scanning loops are not retry loops — but no
+# bare error may escape a cache hit any more than a cold plan. NOT
+# snapshot roots: the cache serves templates, never MVCC reads (those
+# happen below dispatch, already policed).
+FRONT_DOOR_ROOTS = (
+    ("server/admission.py", "AdmissionGate", "admit"),
+    ("server/admission.py", "AdmissionGate", "before_dispatch"),
+)
+FRONT_DOOR_ESCAPE_ROOTS = (
+    ("sql/session.py", "Session", "_plan_cache_begin"),
+)
 SESSION_BOUNDARIES = (("sql/session.py", "Session", "execute"),)
 
 # directories whose exception classes form the "typed request-path error"
 # family the boundary check tracks (store region/txn errors, dispatch
 # errors, backoff exhaustion, replication faults)
 _FAMILY_DIRS = ("distsql", "store", "replication")
-_FAMILY_FILES = ("util/backoff.py",)
+_FAMILY_FILES = ("util/backoff.py", "server/admission.py")
 
 # taint facts
 REQ = "REQ"  # a request-carrying object (KVRequest/CopRequest/...)
@@ -894,7 +910,7 @@ def _is_time_sleep(call: ast.Call, graph: CallGraph, fi: FuncInfo) -> bool:
 
 def run_backoff(files: list[SourceFile]) -> list:
     graph = graph_for(files)
-    roots = graph.request_roots(extra=CDC_ROOTS + COLUMNAR_ROOTS)
+    roots = graph.request_roots(extra=CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS)
     if not roots:
         return []
     _compute_backoff_consulters(graph)
@@ -944,7 +960,7 @@ class EscapeAnalysis:
         self._sub_memo: dict = {}
         # escape only matters in the cone of the roots and the boundary
         reach = graph.reachable(
-            graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS)
+            graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS)
             + graph.boundaries())
         work = [graph.funcs[q] for q in sorted(reach)]
         rounds = 0
@@ -1214,7 +1230,7 @@ def _mapped_types(graph: CallGraph, boundary: FuncInfo) -> set:
 
 def run_escape(files: list[SourceFile]) -> list:
     graph = graph_for(files)
-    roots = graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS)
+    roots = graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS)
     boundaries = graph.boundaries()
     if not roots and not boundaries:
         return []

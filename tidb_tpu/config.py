@@ -20,6 +20,14 @@ class Config:
     paging_size: int | None = None
     # memory
     mem_quota_query: int = 1 << 30
+    mem_quota_session: int = 0  # 0 = unlimited; parents every query tracker
+    # admission control (ISSUE 15; ref: the server-side token limits) —
+    # bridged onto the store's AdmissionGate at boot; 0 = unlimited
+    admission_max_inflight: int = 0
+    admission_session_queue: int = 4
+    admission_queue_wait_ms: float = 50.0
+    admission_shed_backoff_ms: int = 5
+    admission_max_dispatch: int = 0
     # observability
     enable_metrics: bool = True
     slow_query_threshold_ms: int = 300

@@ -294,6 +294,8 @@ def select_stream(store: TPUStore, req: KVRequest):
     from .planner import choose_tier
 
     scan_kind = _scan_kind(req)
+    with _admission_guard(store):
+        pass  # saturation answered before any task is built (ISSUE 15)
     tasks = _build_tasks(store, req.ranges)
     if choose_tier(store, req, tasks).tier == "mesh":
         results: list = [None] * len(tasks)
@@ -682,7 +684,27 @@ def _run_store_batch(store, req, sid, entries, results, summaries_by_task,
     return stats
 
 
+def _admission_guard(store):
+    """Dispatch-tier admission (ISSUE 15): when the gate's dispatch lane
+    is saturated (or the server/admission-full failpoint is armed), the
+    request is refused with a typed ServerIsBusy-style shed BEFORE any
+    cop task is built — the store never starts work it would drop. The
+    returned token is a context manager releasing the dispatch slot."""
+    from contextlib import nullcontext
+
+    gate = getattr(store, "admission", None)
+    return gate.before_dispatch() if gate is not None else nullcontext()
+
+
 def select(store: TPUStore, req: KVRequest) -> SelectResult:
+    from ..util import tracing
+    from .planner import choose_tier
+
+    with _admission_guard(store):
+        return _select_admitted(store, req)
+
+
+def _select_admitted(store: TPUStore, req: KVRequest) -> SelectResult:
     from ..util import tracing
     from .planner import choose_tier
 

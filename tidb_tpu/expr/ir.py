@@ -140,7 +140,8 @@ def lit(v, ft: FieldType) -> Const:
     if ft.is_float():
         return Const(Datum.f64(float(v)), ft)
     if ft.is_string():
-        return Const(Datum.string(str(v)), ft)
+        # keep str subclasses intact (plan-cache slot tags, plancache.SlotStr)
+        return Const(Datum.string(v if isinstance(v, str) else str(v)), ft)
     if ft.is_time():
         t = v if isinstance(v, MyTime) else MyTime.parse(str(v), max(ft.decimal, 0))
         return Const(Datum.time(t), ft)

@@ -15,15 +15,24 @@ class ExprNode:
 
 @dataclass
 class Literal(ExprNode):
-    """NULL / int / float-as-Decimal / string literal (ref: ast ValueExpr)."""
+    """NULL / int / float-as-Decimal / string literal (ref: ast ValueExpr).
+
+    `pos` is the source byte offset of the masked lexer token this
+    literal came from (-1: synthesized, not a maskable token; -2: an
+    uncacheable multi-token/transformed shape) — the plan cache's literal
+    SLOT ordinal derives from it (sql/plancache.py), matching the
+    token-order normalization the statement digest uses. Excluded from
+    ast_digest (Literal nodes mask whole)."""
 
     value: object  # None | int | Decimal-string tuple | str | bytes
     kind: str  # "null" | "int" | "float" | "decimal" | "str" | "hex" | "bool"
+    pos: int = -1
 
 
 @dataclass
 class ParamMarker(ExprNode):
     index: int
+    pos: int = -1  # source byte offset of the '?' token (plan-cache slot)
 
 
 @dataclass

@@ -560,10 +560,10 @@ class Parser:
         t = self.peek()
         if t.kind is T.NUMBER:
             self.next()
-            return A.Literal(int(t.text), "int")
+            return A.Literal(int(t.text), "int", pos=t.pos)
         if t.kind is T.PARAM:
             self.next()
-            p = A.ParamMarker(self.n_params)
+            p = A.ParamMarker(self.n_params, pos=t.pos)
             self.n_params += 1
             return p
         raise ParseError(f"expected LIMIT count at {self._where()}")
@@ -888,7 +888,7 @@ class Parser:
                 ptok = self.next()
                 if ptok.kind is not T.STRING:
                     raise ParseError(f"expected JSON path string at {self._where()}")
-                node = A.FuncCall("json_extract", [node, A.Literal(ptok.text, "str")])
+                node = A.FuncCall("json_extract", [node, A.Literal(ptok.text, "str", pos=ptok.pos)])
                 if unq:
                     node = A.FuncCall("json_unquote", [node])
             else:
@@ -903,11 +903,13 @@ class Parser:
             and self.peek(1).kind is T.STRING
         ):
             self.next()
-            return A.Literal(self.next().text, "str")
+            s = self.next()
+            return A.Literal(s.text, "str", pos=s.pos)
         # hex/bit literals: X'1A2B', B'1010' (ref: parser.y HexLiteral/BitLiteral)
         if t.kind is T.IDENT and t.upper == "N" and self.peek(1).kind is T.STRING:
             self.next()
-            return A.Literal(self.next().text, "str")
+            s = self.next()
+            return A.Literal(s.text, "str", pos=s.pos)
         if (
             t.kind is T.IDENT
             and t.upper in ("X", "B")
@@ -919,20 +921,22 @@ class Parser:
                 v = int(raw, 16 if t.upper == "X" else 2) if raw else 0
             except ValueError:
                 raise ParseError(f"bad {t.upper}-literal at {self._where()}")
-            return A.Literal(v, "int")
+            return A.Literal(v, "int", pos=-2)  # value != token text: not slot-bindable
         if t.kind is T.NUMBER:
             self.next()
             if "." in t.text or "e" in t.text.lower():
                 kind = "float" if ("e" in t.text.lower()) else "decimal"
-                return A.Literal(t.text, kind)
-            return A.Literal(int(t.text), "int")
+                return A.Literal(t.text, kind, pos=t.pos)
+            return A.Literal(int(t.text), "int", pos=t.pos)
         if t.kind is T.STRING:
             self.next()
-            # adjacent string literal concat 'a' 'b'
-            text = t.text
+            # adjacent string literal concat 'a' 'b' (a multi-token literal
+            # cannot bind by slot position: pos sentinel -2)
+            text, pos = t.text, t.pos
             while self.peek().kind is T.STRING:
                 text += self.next().text
-            return A.Literal(text, "str")
+                pos = -2
+            return A.Literal(text, "str", pos=pos)
         if t.kind is T.HEX:
             self.next()
             h = t.text[2:]
@@ -941,7 +945,7 @@ class Parser:
             return A.Literal(bytes.fromhex(h), "hex")
         if t.kind is T.PARAM:
             self.next()
-            p = A.ParamMarker(self.n_params)
+            p = A.ParamMarker(self.n_params, pos=t.pos)
             self.n_params += 1
             return p
         if t.kind is T.OP and t.text == "(":
@@ -1006,7 +1010,7 @@ class Parser:
             if kw in ("DATE", "TIME", "TIMESTAMP") and self.peek(1).kind is T.STRING:
                 self.next()
                 s = self.next()
-                return A.FuncCall("cast_literal_" + kw.lower(), [A.Literal(s.text, "str")])
+                return A.FuncCall("cast_literal_" + kw.lower(), [A.Literal(s.text, "str", pos=s.pos)])
             return self.column_or_func()
         raise ParseError(f"unexpected {self._where()}")
 

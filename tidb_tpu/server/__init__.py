@@ -1,6 +1,21 @@
-"""MySQL wire protocol server (ref: pkg/server)."""
+"""MySQL wire protocol server (ref: pkg/server).
 
-from .client import MiniClient
-from .server import MySQLServer, split_statements
+Lazily re-exported (PEP 562): the store tier imports
+`server.admission` for its AdmissionGate, and eagerly importing the wire
+server here would cycle back through sql -> store."""
 
-__all__ = ["MySQLServer", "MiniClient", "split_statements"]
+__all__ = ["MySQLServer", "MiniClient", "split_statements",
+           "AdmissionGate", "AdmissionShed"]
+
+
+def __getattr__(name):
+    if name == "MiniClient":
+        from .client import MiniClient
+        return MiniClient
+    if name in ("MySQLServer", "split_statements"):
+        from . import server as _server
+        return getattr(_server, name)
+    if name in ("AdmissionGate", "AdmissionShed"):
+        from . import admission as _admission
+        return getattr(_admission, name)
+    raise AttributeError(name)

@@ -1,0 +1,183 @@
+"""Admission control — the server tier's load-shedding front door (ref:
+TiDB's server-side connection/token limits + TiKV's ServerIsBusy
+backpressure: when the store saturates, new work is REFUSED with a typed
+wait hint instead of queueing until something wedges).
+
+One `AdmissionGate` per store (every session and the dispatch layer of a
+server consult the same gate):
+
+  * `admit()` bounds concurrently EXECUTING statements (`max_inflight`).
+    A statement arriving at a full gate waits in a bounded PER-SESSION
+    queue (`session_queue` deep, `queue_wait_ms` long); past either bound
+    it is SHED: a typed `AdmissionShed{backoff_ms}` whose message is the
+    wire `server_is_busy` string, so `parse_region_error` classifies it
+    and clients retry on the existing Backoffer `server_busy` budget
+    (the PR-6 taxonomy ride).
+  * `before_dispatch()` answers the same shed BEFORE any cop task is
+    built when the dispatch tier itself saturates (`max_dispatch`
+    concurrent distsql dispatches) — the store never sees work it would
+    have to drop mid-flight.
+
+The `server/admission-full` failpoint forces the saturated answer, so
+tests and the chaos harness can exercise shedding without real load.
+Defaults are fully open (0 = unlimited): embedded/test sessions pay one
+lock-free-ish check per statement and nothing else.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..store.errors import ServerIsBusy
+from ..util import failpoint, metrics
+
+
+class AdmissionShed(RuntimeError):
+    """Statement refused at the admission gate. `backoff_ms` is the
+    suggested client wait (rides the message in the wire server_is_busy
+    format, so parse_region_error -> ServerIsBusy{backoff_ms} and the
+    Backoffer honors it as a floor on the server_busy budget)."""
+
+    def __init__(self, backoff_ms: int, where: str = "admission"):
+        super().__init__(str(ServerIsBusy.make(-1, backoff_ms)) + f" ({where})")
+        self.backoff_ms = backoff_ms
+        self.where = where
+
+
+class AdmissionGate:
+    """Bounded statement admission + dispatch saturation check."""
+
+    def __init__(self, max_inflight: int = 0, session_queue: int = 4,
+                 queue_wait_ms: float = 50.0, shed_backoff_ms: int = 5,
+                 max_dispatch: int = 0, now_fn=time.monotonic):
+        self.max_inflight = max_inflight  # 0 = unlimited
+        self.session_queue = session_queue
+        self.queue_wait_ms = queue_wait_ms
+        self.shed_backoff_ms = shed_backoff_ms
+        self.max_dispatch = max_dispatch  # 0 = unlimited
+        self._now = now_fn
+        self._cv = threading.Condition()  # ONE lock: gate counters + waiters
+        self._inflight = 0  # guarded_by: _cv
+        self._dispatching = 0  # guarded_by: _cv
+        self._queued: dict = {}  # session id -> queued count; guarded_by: _cv
+
+    def configure(self, max_inflight: int | None = None,
+                  session_queue: int | None = None,
+                  queue_wait_ms: float | None = None,
+                  shed_backoff_ms: int | None = None,
+                  max_dispatch: int | None = None):
+        with self._cv:
+            if max_inflight is not None:
+                self.max_inflight = max_inflight
+            if session_queue is not None:
+                self.session_queue = session_queue
+            if queue_wait_ms is not None:
+                self.queue_wait_ms = queue_wait_ms
+            if shed_backoff_ms is not None:
+                self.shed_backoff_ms = shed_backoff_ms
+            if max_dispatch is not None:
+                self.max_dispatch = max_dispatch
+            self._cv.notify_all()
+
+    def _shed(self, where: str) -> AdmissionShed:
+        metrics.ADMISSION_SHED.labels(where).inc()
+        return AdmissionShed(self.shed_backoff_ms, where)
+
+    # ---------------------------------------------------- statement gate
+    def admit(self, session_id) -> "_AdmitToken":
+        """Enter the statement gate (context manager). Raises
+        AdmissionShed when saturated past this session's queue bound or
+        queue wait — BEFORE any parse/plan/dispatch work happens."""
+        if failpoint.eval("server/admission-full"):
+            raise self._shed("gate")
+        if self.max_inflight <= 0:
+            return _AdmitToken(self, counted=False)
+        with self._cv:
+            if self._inflight < self.max_inflight:
+                self._inflight += 1
+                metrics.ADMISSION_ADMITTED.inc()
+                metrics.ADMISSION_INFLIGHT.set(self._inflight)
+                return _AdmitToken(self, counted=True)
+            q = self._queued.get(session_id, 0)
+            if q >= self.session_queue:
+                raise self._shed("queue_full")
+            self._queued[session_id] = q + 1
+            metrics.ADMISSION_QUEUE_WAITS.inc()
+            deadline = self._now() + self.queue_wait_ms / 1000.0
+            try:
+                while self._inflight >= self.max_inflight > 0:
+                    left = deadline - self._now()
+                    if left <= 0:
+                        raise self._shed("queue_timeout")
+                    self._cv.wait(left)
+            finally:
+                n = self._queued.get(session_id, 1) - 1
+                if n <= 0:
+                    self._queued.pop(session_id, None)
+                else:
+                    self._queued[session_id] = n
+            self._inflight += 1
+            metrics.ADMISSION_ADMITTED.inc()
+            metrics.ADMISSION_INFLIGHT.set(self._inflight)
+            return _AdmitToken(self, counted=True)
+
+    def _release(self):
+        with self._cv:
+            self._inflight -= 1
+            metrics.ADMISSION_INFLIGHT.set(self._inflight)
+            self._cv.notify()
+
+    # ----------------------------------------------------- dispatch gate
+    def before_dispatch(self) -> "_DispatchToken":
+        """Saturation check at the distsql dispatch seam — answers the
+        typed shed BEFORE building cop tasks (the store never starts work
+        it would drop). Unlimited by default."""
+        if failpoint.eval("server/admission-full"):
+            raise self._shed("dispatch")
+        if self.max_dispatch <= 0:
+            return _DispatchToken(self, counted=False)
+        with self._cv:
+            if self._dispatching >= self.max_dispatch:
+                raise self._shed("dispatch")
+            self._dispatching += 1
+        return _DispatchToken(self, counted=True)
+
+    def _release_dispatch(self):
+        with self._cv:
+            self._dispatching -= 1
+
+    def view(self) -> dict:
+        with self._cv:
+            return {
+                "max_inflight": self.max_inflight,
+                "inflight": self._inflight,
+                "dispatching": self._dispatching,
+                "queued": sum(self._queued.values()),
+            }
+
+
+class _AdmitToken:
+    def __init__(self, gate: AdmissionGate, counted: bool):
+        self._gate, self._counted = gate, counted
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self._counted:
+            self._gate._release()
+        return False
+
+
+class _DispatchToken:
+    def __init__(self, gate: AdmissionGate, counted: bool):
+        self._gate, self._counted = gate, counted
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self._counted:
+            self._gate._release_dispatch()
+        return False

@@ -351,6 +351,12 @@ class Catalog:
 
         self.stmtlog = StmtLog()  # slow-query log + statement summary
         # (domain-level: shared by every session of this catalog)
+        from .plancache import PlanCache
+
+        self.plan_cache = PlanCache()  # digest-keyed plan templates
+        # (ISSUE 15; instance-level like the reference's plan cache)
+        self.bindings_rev = 0  # bumped on GLOBAL binding changes: cached
+        # plans were built under a binding view and re-validate against it
 
     def _alloc_id(self) -> int:  # requires: _lock
         v = self._next_id

@@ -60,6 +60,12 @@ class PlannedQuery:
     # aggregation onto the sort-free dense kernel (ops/aggregate.py);
     # a wrong promise overflows and falls back, never corrupts
     small_groups: int | None = None
+    # how the scan ranges were derived — the plan cache's re-bind RECIPE
+    # (ISSUE 15): ("full",) | ("handle", col) | ("index", index_id, col) |
+    # ("lookup", index_id, col) | ("partition",) | ("index_merge",).
+    # On a dag-tier hit, ranger re-runs over the bound conjuncts for the
+    # named column — TiDB's rebuildRange-at-EXECUTE analog.
+    range_src: tuple = ("full",)
 
 
 # --------------------------------------------------------------------------
@@ -827,10 +833,14 @@ def _lower_literal(n: A.Literal) -> Expr:
             return lit(None, new_longlong())
         return Const(d, datum_ft(d))
     if n.kind in ("int", "bool"):
-        v = int(n.value)
+        # keep int subclasses intact: the plan cache's slot-tagged
+        # literals (plancache.SlotInt) must survive lowering so the
+        # install-time audit can find every re-bindable Const
+        v = n.value if (isinstance(n.value, int)
+                        and not isinstance(n.value, bool)) else int(n.value)
         if -(1 << 63) <= v < (1 << 63):
             return lit(v, new_longlong())
-        return lit(v, new_longlong(unsigned=True))
+        return lit(int(v), new_longlong(unsigned=True))
     if n.kind == "decimal":
         text = str(n.value)
         scale = len(text.split(".", 1)[1]) if "." in text else 0
@@ -841,7 +851,8 @@ def _lower_literal(n: A.Literal) -> Expr:
     if n.kind == "float":
         return lit(float(str(n.value)), new_double())
     if n.kind == "str":
-        return lit(str(n.value), new_varchar(max(len(str(n.value)), 1)))
+        v = n.value if isinstance(n.value, str) else str(n.value)
+        return lit(v, new_varchar(max(len(v), 1)))
     if n.kind == "hex":
         # hex literals are VARBINARY values (ref: pkg/parser/ast/expressions.go
         # hexadecimal literal -> binary collation), NOT latin1 text: byte
@@ -1390,6 +1401,7 @@ def _plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None, 
     probe_meta, probe_alias = trefs[0].meta, trefs[0].alias
     scan_ranges = None
     access_path = "table"
+    range_src = ("full",)
     probe_scan = TableScan(probe_meta.table_id, probe_meta.scan_columns())
 
     if len(trefs) == 1 and probe_meta.indices:
@@ -1425,6 +1437,7 @@ def _plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None, 
             probe_scan = IndexScan(probe_meta.table_id, idx.index_id, icols)
             scan_ranges = index_ranges_from_intervals(probe_meta.table_id, idx.index_id, ivs)
             access_path = f"index({idx.name})"
+            range_src = ("index", idx.index_id, first.name)
             # rebind resolution to the index entry schema
             trefs = [_TableRef(virtual, probe_alias, 0)]
             scope = _Scope(trefs)
@@ -1436,6 +1449,7 @@ def _plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None, 
         if ivs is not None:
             scan_ranges = handle_ranges_from_intervals(probe_meta.table_id, ivs)
             access_path = "table-range"
+            range_src = ("handle", hcol.name)
 
     if probe_meta.partition is not None and access_path in ("table", "table-range"):
         # partition pruning (ref: rule_partition_processor.go): intervals
@@ -1454,6 +1468,7 @@ def _plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None, 
         else:
             scan_ranges = [r for p in pruned for r in full_table_ranges(p.pid)]
         access_path += f" partitions({','.join(p.name for p in pruned)})"
+        range_src = ("partition",)
 
     lookup = None
     if access_path == "table" and len(trefs) == 1 and probe_meta.indices:
@@ -1496,6 +1511,7 @@ def _plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None, 
             _, idx, ivs = best
             lookup = (idx.index_id, index_ranges_from_intervals(probe_meta.table_id, idx.index_id, ivs))
             access_path = f"index_lookup({idx.name})"
+            range_src = ("lookup", idx.index_id, probe_meta.col(idx.col_names[0]).name)
 
     lookup_merge = None
     if (
@@ -1535,6 +1551,7 @@ def _plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None, 
                 ]
                 names_ = ",".join(i.name for i, _ in parts)
                 access_path = f"index_merge(union:{names_})"
+                range_src = ("index_merge",)
                 break
 
     # ---- probe pipeline
@@ -1767,6 +1784,7 @@ def _plan_select(stmt: A.SelectStmt, catalog: Catalog, mat: dict | None = None, 
     return PlannedQuery(
         dag, probe_meta, build_tables, names,
         offset=offset_n or 0, ranges=scan_ranges, access_path=access_path,
+        range_src=range_src,
         lookup=lookup,
         lookup_merge=lookup_merge,
         small_groups=_ndv_group_hint(dag, trefs, catalog),

@@ -290,13 +290,30 @@ class Session:
         self.db = "test"  # current database (USE switches; catalog keys
         # for non-default databases are "db.table")
         self._bootstrap_mysql_schema()
-        self.prepared: dict[str, object] = {}  # PREPARE name -> AST template
+        self.prepared: dict[str, object] = {}  # PREPARE name -> template record
         self._explain_sink: list | None = None  # EXPLAIN ANALYZE summaries
+        # --- production front door (ISSUE 15) -------------------------
+        self._stmt_probe = None  # plan-cache probe for the current top stmt
+        self._last_sql = ""  # raw text of the current top statement
+        self._last_plan_cache = None  # (status, reason, tier) of last consult
+        self._record_digest = None  # (norm, digest) the stmt log records under
+        self._bindings_rev = 0  # session-binding revision (plan-cache key part)
         if config is not None:
             # instance config seeds session sysvars (ref: setGlobalVars
             # bridging config -> sysvar defaults, cmd/tidb-server/main.go:654)
             self.sysvars.set("tidb_distsql_scan_concurrency", str(config.distsql_scan_concurrency))
             self.sysvars.set("tidb_mem_quota_query", str(config.mem_quota_query))
+            self.sysvars.set("tidb_mem_quota_session", str(config.mem_quota_session))
+            # admission control onto the store's gate (ISSUE 15)
+            gate = getattr(self.store, "admission", None)
+            if gate is not None:
+                gate.configure(
+                    max_inflight=config.admission_max_inflight,
+                    session_queue=config.admission_session_queue,
+                    queue_wait_ms=config.admission_queue_wait_ms,
+                    shed_backoff_ms=config.admission_shed_backoff_ms,
+                    max_dispatch=config.admission_max_dispatch,
+                )
             if config.paging_size:
                 self.sysvars.set("tidb_enable_paging", "ON")
                 self.sysvars.set("tidb_max_chunk_size", str(config.paging_size))
@@ -350,6 +367,11 @@ class Session:
         store = self.catalog.bindings if stmt.scope == "global" else self._session_bindings()
         if stmt.action == "drop":
             store.pop(digest, None)
+            # binding changes re-key/invalidate cached plans (ISSUE 15)
+            if stmt.scope == "global":
+                self.catalog.bindings_rev += 1
+            else:
+                self._bindings_rev += 1
             if stmt.scope == "global":
                 try:
                     self.execute(
@@ -367,6 +389,10 @@ class Session:
             "original": stmt.target_sql, "bind": stmt.hinted_sql,
             "ast": stmt.hinted, "scope": stmt.scope, "db": self.db,
         }
+        if stmt.scope == "global":
+            self.catalog.bindings_rev += 1
+        else:
+            self._bindings_rev += 1
         if stmt.scope == "global":
             try:
                 # same escape contract as the user mirror: backslashes
@@ -623,51 +649,106 @@ class Session:
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> Result:
-        """Parse + execute one statement, feeding the slow-query log and
-        statement summary (ref: ExecStmt.Exec wrapping + LogSlowQuery,
-        adapter.go:458/1580; pkg/util/stmtsummary Add)."""
+        """Parse + execute one statement through the admission gate,
+        feeding the slow-query log and statement summary (ref:
+        ExecStmt.Exec wrapping + LogSlowQuery, adapter.go:458/1580;
+        pkg/util/stmtsummary Add). ONE lexer pass up front builds the
+        plan-cache probe AND the normalized digest the statement log
+        reuses — the hot path lexes once (ISSUE 15)."""
         import time as _time
+        from contextlib import nullcontext
 
         from ..util import metrics, tracing
+        from .plancache import StmtProbe, stmt_kind_reason
 
         t0 = _time.perf_counter()
         c0 = _time.thread_time()
         self._last_plan_digest = ""
         stmt_type = "invalid"
+        probe = StmtProbe.from_sql(sql)
+        saved = (self._stmt_probe, self._last_sql, self._record_digest)
+        self._stmt_probe, self._last_sql = probe, sql
+        self._record_digest = (probe.normalized, probe.digest) if probe else None
+        gate = getattr(self.store, "admission", None)
         try:
-            with tracing.span("session.parse", sql=sql[:256]):
-                stmt = parse_one(sql)
-            stmt_type = type(stmt).__name__.removesuffix("Stmt").lower()
-            res = self.execute_stmt(stmt)
-        except Exception as exc:
-            from ..distsql.dispatch import CopInternalError, RegionUnavailableError
-            from ..distsql.runaway import QueryKilledError
+            try:
+                # admission gate: saturated servers shed HERE, before any
+                # parse/plan/dispatch work happens (typed ServerIsBusy)
+                with (gate.admit(id(self)) if gate is not None else nullcontext()):
+                    res = self._plan_cache_text_serve(probe)
+                    if res is not None:
+                        # parse-free hit: the digest-keyed entry served the
+                        # statement with literal values bound straight from
+                        # the lexer's masked tokens — no parse, no plan
+                        stmt_type = "select"
+                    else:
+                        with tracing.span("session.parse", sql=sql[:256]):
+                            stmt = parse_one(sql)
+                        stmt_type = type(stmt).__name__.removesuffix("Stmt").lower()
+                        if isinstance(stmt, A.ExplainStmt):
+                            # the cache probe of EXPLAIN [ANALYZE] <stmt> is
+                            # the INNER statement's — it shares entries with
+                            # its direct form (satellite: attributable rows)
+                            self._stmt_probe = StmtProbe.inner_probe(sql, "explain")
+                        elif isinstance(stmt, A.TraceStmt):
+                            self._stmt_probe = StmtProbe.inner_probe(sql, "trace")
+                        elif (probe is not None
+                              and not isinstance(stmt, (A.PrepareStmt, A.ExecuteStmt,
+                                                        A.DeallocateStmt))):
+                            reason = stmt_kind_reason(stmt)
+                            if reason is not None:
+                                # the probe belongs to THIS statement's text:
+                                # a non-SELECT kind must drop it before any
+                                # nested _run_select (INSERT..SELECT, CREATE
+                                # VIEW) could install the inner select under
+                                # the OUTER statement's digest — a later
+                                # digest-equal statement would then serve
+                                # rows instead of running the DML
+                                self._stmt_probe = None
+                                if self.sysvars.get_bool("tidb_enable_plan_cache"):
+                                    metrics.PLAN_CACHE_DECLINES.labels(reason).inc()
+                                    self._last_plan_cache = ("decline", reason, "")
+                        res = self.execute_stmt(stmt)
+            except Exception as exc:
+                from ..distsql.dispatch import CopInternalError, RegionUnavailableError
+                from ..distsql.runaway import QueryKilledError
+                from ..server.admission import AdmissionShed
 
-            metrics.STATEMENTS.labels(stmt_type, "error").inc()
-            self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, 0, False, str(exc),
+                metrics.STATEMENTS.labels(stmt_type, "error").inc()
+                self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, 0, False, str(exc),
+                                  cpu_ms=(_time.thread_time() - c0) * 1e3)
+                if isinstance(exc, AdmissionShed):
+                    # shed at the front door: MySQL 9003 "TiKV server busy"
+                    # with the suggested wait riding the wire-format message,
+                    # so clients classify via parse_region_error and retry on
+                    # the existing server_busy Backoffer budget (PR-6 ride)
+                    err = SQLError(str(exc), code=9003)
+                    err.backoff_ms = exc.backoff_ms
+                    raise err from exc
+                if isinstance(exc, QueryKilledError):
+                    # 3024 ER_QUERY_TIMEOUT (deadline) vs 1317 ER_QUERY_INTERRUPTED
+                    # (KILL QUERY) — same split the reference makes
+                    code = 3024 if getattr(exc, "timeout", False) else 1317
+                    raise SQLError(str(exc), code=code) from exc
+                if isinstance(exc, RegionUnavailableError):
+                    # every backoff budget spent / every store unhealthy:
+                    # MySQL 9005 (ref: errno.ErrRegionUnavailable), not a bare
+                    # RuntimeError that reads like an engine bug
+                    raise SQLError(f"Region is unavailable: {exc}", code=9005) from exc
+                if isinstance(exc, QuorumLostError):
+                    # a write refused on quorum loss (ROADMAP PR-8 follow-on):
+                    # the same 9005 the read path's exhausted budgets surface
+                    raise SQLError(f"Region is unavailable: {exc}", code=9005) from exc
+                if isinstance(exc, CopInternalError):
+                    raise SQLError(str(exc), code=1105) from exc
+                raise
+            metrics.STATEMENTS.labels(stmt_type, "ok").inc()
+            rows = len(res.rows) if getattr(res, "rows", None) else getattr(res, "affected", 0)
+            self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, rows, True,
                               cpu_ms=(_time.thread_time() - c0) * 1e3)
-            if isinstance(exc, QueryKilledError):
-                # 3024 ER_QUERY_TIMEOUT (deadline) vs 1317 ER_QUERY_INTERRUPTED
-                # (KILL QUERY) — same split the reference makes
-                code = 3024 if getattr(exc, "timeout", False) else 1317
-                raise SQLError(str(exc), code=code) from exc
-            if isinstance(exc, RegionUnavailableError):
-                # every backoff budget spent / every store unhealthy:
-                # MySQL 9005 (ref: errno.ErrRegionUnavailable), not a bare
-                # RuntimeError that reads like an engine bug
-                raise SQLError(f"Region is unavailable: {exc}", code=9005) from exc
-            if isinstance(exc, QuorumLostError):
-                # a write refused on quorum loss (ROADMAP PR-8 follow-on):
-                # the same 9005 the read path's exhausted budgets surface
-                raise SQLError(f"Region is unavailable: {exc}", code=9005) from exc
-            if isinstance(exc, CopInternalError):
-                raise SQLError(str(exc), code=1105) from exc
-            raise
-        metrics.STATEMENTS.labels(stmt_type, "ok").inc()
-        rows = len(res.rows) if getattr(res, "rows", None) else getattr(res, "affected", 0)
-        self._record_stmt(sql, (_time.perf_counter() - t0) * 1e3, rows, True,
-                          cpu_ms=(_time.thread_time() - c0) * 1e3)
-        return res
+            return res
+        finally:
+            self._stmt_probe, self._last_sql, self._record_digest = saved
 
     def _record_stmt(self, sql: str, dur_ms: float, rows: int, ok: bool, err: str = "", cpu_ms: float = 0.0):
         try:
@@ -681,6 +762,11 @@ class Session:
                 summary_enabled=self.sysvars.get_bool("tidb_enable_stmt_summary"),
                 cpu_ms=cpu_ms,
                 plan_digest=getattr(self, "_last_plan_digest", ""),
+                # EXECUTE records under the UNDERLYING prepared statement's
+                # digest (set by _execute_prepared), joining its summary row
+                # instead of orphaning on the "EXECUTE s" shape; direct
+                # statements reuse the probe's digest — one lex per stmt
+                norm_digest=getattr(self, "_record_digest", None),
             )
         except Exception:  # noqa: BLE001 — observability must never fail a query
             pass
@@ -697,8 +783,16 @@ class Session:
         if isinstance(stmt, A.PrepareStmt):
             # validate now; EXECUTE deep-copies the template per run (the
             # rewrite passes mutate ASTs; ref: plan_cache.go prepared-stmt
-            # cache — the XLA ProgramCache is the compiled-plan layer here)
-            self.prepared[stmt.name.lower()] = parse_one(stmt.sql)
+            # cache). The text + probe ride along so EXECUTE shares the
+            # plan-cache entries and summary row of the DIRECT statement:
+            # the prepared text normalizes with '?' markers exactly where
+            # literals mask (ISSUE 15)
+            from .plancache import StmtProbe
+
+            self.prepared[stmt.name.lower()] = {
+                "ast": parse_one(stmt.sql), "sql": stmt.sql,
+                "probe": StmtProbe.from_sql(stmt.sql),
+            }
             return Result()
         if isinstance(stmt, A.ExecuteStmt):
             return self._execute_prepared(stmt)
@@ -1118,16 +1212,27 @@ class Session:
         parameter markers from user variables (ref: executor/prepared.go)."""
         import copy
 
-        tpl = self.prepared.get(stmt.name.lower())
-        if tpl is None:
+        rec = self.prepared.get(stmt.name.lower())
+        if rec is None:
             raise SQLError(f"unknown prepared statement {stmt.name!r}")
-        ast2 = copy.deepcopy(tpl)
+        ast2 = copy.deepcopy(rec["ast"])
         params = [self._value_literal(self.user_vars.get(v.lower())) for v in stmt.using]
         n_used = self._bind_params(ast2, params)
         if n_used != len(params):
             raise SQLError(
                 f"prepared statement {stmt.name!r} expects {n_used} parameters, got {len(params)}"
             )
+        probe = rec.get("probe")
+        if probe is not None:
+            # ride the statement summary under the UNDERLYING statement's
+            # digest (ISSUE 15 satellite), and — for SELECT templates
+            # only — the plan cache too: the bound literals carry their
+            # marker token positions, so the slot audit and re-binding
+            # work exactly as for the textual form. A prepared DML's
+            # nested select must NOT inherit the probe (its digest names
+            # the whole DML text, not the inner select).
+            self._record_digest = (probe.normalized, probe.digest)
+            self._stmt_probe = probe if isinstance(ast2, A.SelectStmt) else None
         return self.execute_stmt(ast2)
 
     def _bind_params(self, node, params: list) -> int:
@@ -1140,11 +1245,13 @@ class Session:
                 # markers carry their LEXICAL position (parser assigns it),
                 # which is the binding order MySQL uses — field traversal
                 # order here may differ (e.g. Limit stores count before
-                # offset)
+                # offset). The bound literal inherits the marker's token
+                # offset so the plan cache's slot collection sees it.
                 seen[0] = max(seen[0], x.index + 1)
                 if x.index >= len(params):
-                    return A.Literal(None, "null")
-                return params[x.index]
+                    return A.Literal(None, "null", pos=x.pos)
+                v = params[x.index]
+                return A.Literal(v.value, v.kind, pos=x.pos)
             return None
 
         def walk_seq(v):
@@ -1308,6 +1415,230 @@ class Session:
         return self._run_select(stmt, parent_rw)
 
     def _run_select(self, stmt: A.SelectStmt, parent_rw) -> tuple:
+        """Top-level SELECT entry: consult the digest-keyed plan cache
+        first (ISSUE 15) — a hit re-binds the hot statement's literals
+        into the cached template and skips parse+plan; a miss runs the
+        normal pipeline and installs a slotted template on success.
+        Nested queries (parent_rw set) never consult: their results feed
+        a parent statement that owns the cache decision."""
+        probe = self._take_probe() if parent_rw is None else None
+        if probe is None:
+            return self._run_select_inner(stmt, parent_rw)
+        served, pending = self._plan_cache_begin(probe, stmt)
+        if served is not None:
+            return served
+        out = self._run_select_inner(stmt, parent_rw)
+        if pending is not None:
+            self._plan_cache_install(probe, pending)
+        return out
+
+    def _take_probe(self):
+        p, self._stmt_probe = self._stmt_probe, None
+        return p
+
+    # ------------------------------------------- plan cache (ISSUE 15)
+    def _plan_cache_key(self, probe, kinds: str) -> tuple:
+        """digest + db + literal-kind signature + plan-relevant sysvar
+        fingerprint + session-binding revision. Schema drift and GLOBAL
+        binding changes are validations on the entry, not key parts."""
+        from .plancache import sysvar_fingerprint
+
+        return (probe.digest, self.db, kinds,
+                sysvar_fingerprint(self.sysvars), self._bindings_rev)
+
+    def _plan_cache_text_serve(self, probe) -> Result | None:
+        """The parse-free fast path (ref: TiDB's non-prepared plan cache
+        keyed on the normalized digest): when the probe's digest already
+        has a validated entry under the current db/kinds/sysvar/binding
+        key, serve the statement by binding the lexer's masked-token
+        values into the cached template — lexer-only, no parse, no plan.
+        Returns None on any miss or ineligibility; the parse path then
+        runs and counts its own miss/decline. Session-state declines
+        (txn, stale read) re-check here because they vary per statement;
+        structural shape was proven at install time and transfers to
+        every digest-equal statement."""
+        from ..util import metrics, tracing
+        from . import plancache as _pc
+
+        if (probe is None or probe.has_param or probe.has_var
+                or probe.multi_stmt or probe.n_masked == 0
+                or not self.sysvars.get_bool("tidb_enable_plan_cache")
+                or self.txn is not None
+                or self.sysvars.get("tidb_snapshot")):
+            # n_masked == 0 shapes stay on the parse path: binding cannot
+            # distinguish them from DDL/EXPLAIN/SET text anyway, and the
+            # entry lookup would land on keys the install path never fills
+            return None
+        key = self._plan_cache_key(probe, probe.slot_kinds)
+        entry = self.catalog.plan_cache.lookup(
+            key, self.catalog, self.catalog.bindings_rev)
+        if entry is None:
+            return None
+        with tracing.span("session.plan_cache") as sp:
+            try:
+                self._check_privileges(entry.template)
+                out = self._plan_cache_execute(entry, list(probe.slot_values))
+            except _pc.RebindError:
+                return None  # recipe could not re-bind: replan cold
+            metrics.PLAN_CACHE_HITS.inc()
+            self._last_plan_cache = ("hit", "", entry.tier)
+            self._stmt_probe = None  # consumed: nested paths never re-consult
+            if sp is not None:
+                sp.set("status", "hit")
+                sp.set("tier", entry.tier)
+        names, _fts, rows = out
+        if not entry.has_limit:
+            ssl = self.sysvars.get_int("sql_select_limit")
+            if ssl < (1 << 64) - 1:
+                rows = rows[:ssl]
+        return Result(columns=names, rows=rows, fts=_fts)
+
+    def _plan_cache_begin(self, probe, stmt):
+        """Returns (served result, install ticket): a HIT serves the
+        statement with parse+plan skipped; a MISS returns the ticket
+        (key + pristine template copy) the success path installs; a
+        DECLINE returns neither and counts its typed reason."""
+        import copy as _copy
+
+        from ..util import metrics, tracing
+        from . import plancache as _pc
+
+        if not self.sysvars.get_bool("tidb_enable_plan_cache"):
+            self._last_plan_cache = ("off", "", "")
+            return None, None
+        with tracing.span("session.plan_cache") as sp:
+            reason = _pc.shape_decline(stmt, self, probe)
+            values = kinds = None
+            if reason is None:
+                try:
+                    values, kinds = _pc.live_slot_values(stmt, probe.n_masked)
+                except _pc.RebindError:
+                    reason = "literal_shape"
+            if reason is not None:
+                metrics.PLAN_CACHE_DECLINES.labels(reason).inc()
+                self._last_plan_cache = ("decline", reason, "")
+                if sp is not None:
+                    sp.set("status", "decline")
+                    sp.set("reason", reason)
+                return None, None
+            key = self._plan_cache_key(probe, kinds)
+            entry = self.catalog.plan_cache.lookup(
+                key, self.catalog, self.catalog.bindings_rev)
+            if entry is not None:
+                try:
+                    out = self._plan_cache_execute(entry, values)
+                except _pc.RebindError:
+                    out = None  # recipe could not re-bind: replan cold
+                if out is not None:
+                    metrics.PLAN_CACHE_HITS.inc()
+                    self._last_plan_cache = ("hit", "", entry.tier)
+                    if sp is not None:
+                        sp.set("status", "hit")
+                        sp.set("tier", entry.tier)
+                    return out, None
+            metrics.PLAN_CACHE_MISSES.inc()
+            self._last_plan_cache = ("miss", "", "")
+            if sp is not None:
+                sp.set("status", "miss")
+            return None, (key, _copy.deepcopy(stmt))
+
+    def _plan_cache_execute(self, entry, values) -> tuple:
+        """Serve a statement from a cached template. pointget re-executes
+        the key-read fast path from the bound AST; dag re-binds Consts +
+        ranges into the cached physical plan and goes straight to
+        dispatch; ast re-plans the bound template (parse skipped)."""
+        from . import plancache as _pc
+
+        if entry.tier == "dag":
+            plan = _pc.rebind_plan(entry, values, self.catalog)
+            return self._execute_planned(plan)
+        bound = _pc.bind_template(entry.template, values)
+        if entry.tier == "pointget":
+            det = self._point_get_detect(bound, {})
+            if det is not None:
+                return self._exec_point_get(bound, *det)
+        return self._run_select_inner(bound, None)
+
+    def _plan_cache_install(self, probe, pending) -> None:
+        """Build + install the slotted template after the cold statement
+        succeeded (one extra plan pass per digest, amortized over hits).
+        Best-effort: an uncacheable shape counts a typed decline and the
+        statement's result stands."""
+        import copy as _copy
+
+        from ..util import metrics
+        from . import plancache as _pc
+
+        key, tpl = pending
+        try:
+            kinds = _pc.wrap_slots(tpl, probe.n_masked)
+            fps = {}
+            for nm in _referenced_tables(tpl):
+                try:
+                    meta = self.catalog.table(nm)
+                except CatalogError:
+                    continue
+                fps[meta.name] = _pc.table_fingerprint(meta)
+            tier, plan2 = "ast", None
+            range_src, probe_name, build_names = ("full",), "", ()
+            if self._point_get_detect(tpl, {}) is not None:
+                tier = "pointget"
+            else:
+                try:
+                    tpl2 = _copy.deepcopy(tpl)
+                    rw = self._new_rewriter(None)
+                    rw.rewrite_select(tpl2)
+                    if not rw.mat_dict():
+                        plan2 = plan_select(
+                            tpl2, self.catalog,
+                            enable_index_merge=self.sysvars.get_bool(
+                                "tidb_enable_index_merge"),
+                        )
+                except Exception:  # noqa: BLE001 — planner balked at the
+                    plan2 = None  # slotted copy: ast tier still skips parse
+                if plan2 is not None and self._dag_tier_ok(plan2, kinds,
+                                                           probe.n_masked):
+                    tier = "dag"
+                    range_src = getattr(plan2, "range_src", None) or ("full",)
+                    probe_name = plan2.probe_table.name
+                    build_names = tuple(m.name for m in plan2.build_tables)
+                else:
+                    plan2 = None
+            entry = _pc.PlanCacheEntry(
+                tier=tier, template=tpl, n_slots=probe.n_masked, kinds=kinds,
+                table_fps=fps, catalog_version=self.catalog.version,
+                bindings_rev=self.catalog.bindings_rev,
+                has_limit=tpl.limit is not None,
+                plan=plan2, range_src=range_src, probe_name=probe_name,
+                build_names=build_names,
+            )
+            pc = self.catalog.plan_cache
+            pc.capacity = self.sysvars.get_int("tidb_plan_cache_size")
+            pc.put(key, entry)
+        except Exception:  # noqa: BLE001 — install is best-effort; the
+            metrics.PLAN_CACHE_DECLINES.labels("uncacheable").inc()
+            self._last_plan_cache = ("decline", "uncacheable", "")
+
+    def _dag_tier_ok(self, plan2, kinds: str, n_slots: int) -> bool:
+        """May this plan be cached at the dag tier (skip parse AND plan)?
+        Requires real tables, no partition pruning / index-merge (their
+        range structure is value-dependent), a recomputable range recipe,
+        and the full literal-slot audit (plancache.audit_dag_slots)."""
+        from . import plancache as _pc
+
+        if plan2.probe_table.table_id < 0 or any(
+                m.table_id < 0 for m in plan2.build_tables):
+            return False
+        if plan2.probe_table.partition is not None or plan2.lookup_merge:
+            return False
+        src = getattr(plan2, "range_src", None)
+        if src is None or src[0] == "partition":
+            return False
+        if plan2.lookup is not None and src[0] != "lookup":
+            return False
+        return _pc.audit_dag_slots(plan2, kinds, n_slots)
+
+    def _run_select_inner(self, stmt: A.SelectStmt, parent_rw) -> tuple:
         from .subquery import SubqueryError
 
         rw = self._new_rewriter(parent_rw)
@@ -1350,12 +1681,19 @@ class Session:
             return fast
         if self.txn is not None and self.txn.row_ops:
             self._shadow_dirty_tables(stmt.from_clause, rw)
-        from ..util.memory import MemTracker, QuotaExceeded
-
         plan = plan_select(
             stmt, self.catalog, mat=rw.mat_dict(),
             enable_index_merge=self.sysvars.get_bool("tidb_enable_index_merge"),
         )
+        return self._execute_planned(plan, rw)
+
+    def _execute_planned(self, plan, rw=None) -> tuple:
+        """Execute a planned SELECT: the dispatch tail shared by the
+        normal pipeline and dag-tier plan-cache hits (which arrive with a
+        re-bound plan and no rewriter — cacheable shapes reference real
+        tables only). Returns (column names, output fts, rows)."""
+        from ..util.memory import MemTracker, QuotaExceeded
+
         # plan digest: access path + executor-shape fingerprint, the join
         # key between slow-log rows and statement summaries (ref:
         # plancodec.NormalizePlan -> plan_digest in the slow log)
@@ -1384,6 +1722,7 @@ class Session:
         tracker = MemTracker(
             "query",
             quota=self.sysvars.get_int("tidb_mem_quota_query") or None,
+            parent=self._session_tracker(),
             action=_evict_action,
         )
         gate_on = self.sysvars.get_bool("tidb_enable_tpu_coprocessor")
@@ -1396,6 +1735,7 @@ class Session:
             if plan.probe_table.table_id < 0:
                 # materialized probe (CTE/derived table): the whole DAG runs
                 # over in-memory chunks — device path or oracle by the gate
+                # (never reached from a plan-cache hit: those shapes decline)
                 probe = rw.registry.chunks[plan.probe_table.name]
                 tracker.consume(probe.nbytes())
                 if gate_on:
@@ -2503,15 +2843,46 @@ class Session:
         return Result()
 
     # ------------------------------------------------------------------
+    def _session_tracker(self):
+        """Per-session memory tracker: every query tracker parents here,
+        so one session's concurrent + accumulated staging shares a quota
+        (tidb_mem_quota_session; 0 = unlimited). The breach action spills
+        the store's device-resident staging caches to host before the
+        cancel fires — the util/memory.py action chain (ISSUE 15)."""
+        from ..util.memory import MemTracker
+
+        t = getattr(self, "_mem_tracker", None)
+        if t is None:
+            def _spill(tr, _n):
+                from ..util import metrics
+
+                self.store.evict_caches()
+                metrics.MEM_EVICTIONS.inc()
+
+            t = self._mem_tracker = MemTracker("session", action=_spill)
+        q = self.sysvars.get_int("tidb_mem_quota_session")
+        t.quota = q or None
+        return t
+
     def _try_point_get(self, stmt: A.SelectStmt, rw) -> tuple | None:
         """PointGet/BatchPointGet fast path (ref: pkg/executor/point_get.go,
         batch_point_get.go; planner TryFastPlan): single real table, WHERE
         pins the integer primary key to constants -> read rows by key,
-        bypassing distsql/coprocessor entirely."""
+        bypassing distsql/coprocessor entirely. Split into shape DETECTION
+        (shared with the plan cache's pointget tier) and EXECUTION."""
+        det = self._point_get_detect(stmt, rw.mat_dict())
+        if det is None:
+            return None
+        return self._exec_point_get(stmt, *det)
+
+    def _point_get_detect(self, stmt: A.SelectStmt, mat) -> tuple | None:
+        """Shape check + handle extraction: (meta, alias, handles, rest
+        conjuncts) when the statement is the point-get shape, else None.
+        Pure — reads the catalog but executes nothing."""
         if (
             not isinstance(stmt.from_clause, A.TableName)
             or stmt.group_by or stmt.having is not None or stmt.distinct
-            or stmt.from_clause.name.lower() in rw.mat_dict()
+            or stmt.from_clause.name.lower() in mat
         ):
             return None
         try:
@@ -2565,6 +2936,11 @@ class Session:
             e = f.expr if isinstance(f, A.SelectField) else f
             if not isinstance(e, A.Star) and (_has_agg(e) or _has_window(e)):
                 return None
+        return meta, alias, handles, rest
+
+    def _exec_point_get(self, stmt: A.SelectStmt, meta, alias, handles, rest) -> tuple:
+        """Execute a detected point get: read the pinned handles, filter
+        the residual conjuncts, evaluate the select list on the host."""
         ts = self._pin_read_ts()
         try:
             rows = []
@@ -2866,6 +3242,7 @@ class Session:
 
     def _explain(self, stmt) -> Result:
         inner = stmt.target
+        probe = self._take_probe()  # the INNER statement's digest probe
         if isinstance(inner, A.SelectStmt):
             bound = self._match_binding(inner)
             if bound is not None:
@@ -2876,7 +3253,21 @@ class Session:
 
         from .subquery import SubqueryError
 
+        # plan-cache attribution (ISSUE 15 satellite): plain EXPLAIN shows
+        # whether the shape is cacheable (typed decline reason otherwise);
+        # EXPLAIN ANALYZE re-arms the probe so the run consults the cache
+        # for real and reports hit/miss in its plan_cache row
+        pc_line = None
+        if (probe is not None and isinstance(inner, A.SelectStmt)
+                and self.sysvars.get_bool("tidb_enable_plan_cache")):
+            from .plancache import shape_decline
+
+            r = shape_decline(inner, self, probe)
+            pc_line = "plan_cache: cacheable" if r is None else f"plan_cache: decline({r})"
         analyze_ast = copy.deepcopy(inner) if getattr(stmt, "analyze", False) else None
+        if (analyze_ast is not None and probe is not None
+                and isinstance(inner, A.SelectStmt)):
+            self._stmt_probe = probe
         rw = self._new_rewriter(None)
         try:
             rw.process_ctes(inner.ctes)
@@ -2900,6 +3291,8 @@ class Session:
         lines += [f"push[{type(e).__name__}]" for e in rp.push_dag.executors]
         if rp.root_dag is not None:
             lines += [f"root[{type(e).__name__}]" for e in rp.root_dag.executors[1:]]
+        if pc_line is not None:
+            lines.append(pc_line)
         return Result(columns=["plan"], rows=[[Datum.string(s)] for s in lines])
 
     def _explain_analyze(self, analyze_ast, rp) -> Result:
@@ -2914,6 +3307,7 @@ class Session:
 
         sink: list = []
         self._explain_sink = sink
+        self._last_plan_cache = None
         try:
             _, _, out_rows = self._run_select(analyze_ast, None)
         finally:
@@ -2990,6 +3384,14 @@ class Session:
                             Datum.i64(mesh_batches), Datum.NULL, Datum.NULL,
                             Datum.string(f"merged={mesh_lanes}->{mesh_batches}"),
                             Datum.NULL])
+        if self._last_plan_cache:
+            # per-statement cache attribution (ISSUE 15 satellite): did
+            # THIS run hit, miss, or decline — and why
+            s, reason, tier = self._last_plan_cache
+            detail = {"hit": f"hit({tier})", "miss": "miss",
+                      "decline": f"decline({reason})", "off": "off"}.get(s, s)
+            out.append([Datum.string("plan_cache"), Datum.NULL, Datum.i64(1),
+                        Datum.NULL, Datum.NULL, Datum.string(detail), Datum.NULL])
         out.append([Datum.string("result"), Datum.i64(len(out_rows)), Datum.i64(1),
                     Datum.NULL, Datum.NULL, Datum.NULL, Datum.NULL])
         return Result(columns=["executor", "rows", "tasks", "time", "compile", "cache", "bytes"], rows=out)

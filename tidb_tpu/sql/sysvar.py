@@ -180,6 +180,17 @@ DEFINITIONS = {
         SysVar("tidb_enable_slow_log", "ON", "both", _bool_validator),
         SysVar("tidb_stmt_summary_max_stmt_count", "3000", "global", _int_validator(1, 1 << 20)),
         SysVar("tidb_enable_stmt_summary", "ON", "both", _bool_validator),
+        # ---- production front door (ISSUE 15) --------------------------
+        # digest-keyed plan cache (ref: tidb_enable_prepared_plan_cache +
+        # the non-prepared plan cache, sysvar.go): repeated statements
+        # re-bind literals into a cached template, skipping parse+plan
+        SysVar("tidb_enable_plan_cache", "ON", "both", _bool_validator),
+        # LRU capacity of the instance plan cache (ref:
+        # tidb_session_plan_cache_size)
+        SysVar("tidb_plan_cache_size", "512", "both", _int_validator(1, 1 << 20)),
+        # per-SESSION memory quota parenting every query tracker (0 =
+        # unlimited; ref: the server/session tracker tree in util/memory)
+        SysVar("tidb_mem_quota_session", "0", "both", _int_validator(0, 1 << 60)),
         # ---- MySQL-compatibility variables -----------------------------
         SysVar("transaction_isolation", "REPEATABLE-READ", "both",
                _enum_validator("read-uncommitted", "read-committed", "repeatable-read", "serializable")),

@@ -223,6 +223,13 @@ class TPUStore:
         from ..distsql.dispatch import BreakerBoard
 
         self.breakers = BreakerBoard()
+        # admission control (ISSUE 15): one gate per store — every session
+        # and the dispatch layer of a server consult it; fully open by
+        # default (0 = unlimited), configured by server config / tests
+        # (runtime import: server/__init__ lazily re-exports, no cycle)
+        from ..server.admission import AdmissionGate
+
+        self.admission = AdmissionGate()
 
     # -- store fault switches (chaos/testing; ref: failpoint-driven store
     # outages in the reference's integration suites) ------------------------

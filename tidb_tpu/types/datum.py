@@ -103,7 +103,10 @@ class Datum:
 
     @classmethod
     def i64(cls, v: int) -> "Datum":
-        return cls(DatumKind.Int64, int(v))
+        # int subclasses pass through intact (bools still normalize): the
+        # plan cache's slot-tagged literals ride Datums through lowering
+        return cls(DatumKind.Int64,
+                   v if (isinstance(v, int) and not isinstance(v, bool)) else int(v))
 
     @classmethod
     def u64(cls, v: int) -> "Datum":

@@ -341,6 +341,29 @@ STATEMENTS = REGISTRY.counter_vec(
     "tidb_tpu_statements_total", "statements executed by type and outcome",
     labelnames=("type", "status"),
 )
+# production front door (ISSUE 15) — digest-keyed plan cache + admission
+PLAN_CACHE_HITS = REGISTRY.counter(
+    "tidb_tpu_plan_cache_hits_total", "statements served from the digest-keyed plan cache (parse+plan skipped)")
+PLAN_CACHE_MISSES = REGISTRY.counter(
+    "tidb_tpu_plan_cache_misses_total", "cacheable statements that planned cold and installed an entry")
+PLAN_CACHE_EVICTIONS = REGISTRY.counter(
+    "tidb_tpu_plan_cache_evictions_total", "plan-cache entries evicted by the LRU capacity bound")
+PLAN_CACHE_DECLINES = REGISTRY.counter_vec(
+    "tidb_tpu_plan_cache_declines_total", "statements declined by the plan cache, by typed reason",
+    labelnames=("reason",),
+)
+PLAN_CACHE_ENTRIES = REGISTRY.gauge(
+    "tidb_tpu_plan_cache_entries", "plan templates resident in the cache")
+ADMISSION_ADMITTED = REGISTRY.counter(
+    "tidb_tpu_admission_admitted_total", "statements admitted through the bounded statement gate")
+ADMISSION_SHED = REGISTRY.counter_vec(
+    "tidb_tpu_admission_shed_total", "statements shed with typed ServerIsBusy backpressure, by gate",
+    labelnames=("where",),
+)
+ADMISSION_QUEUE_WAITS = REGISTRY.counter(
+    "tidb_tpu_admission_queue_waits_total", "statements that waited in a per-session admission queue")
+ADMISSION_INFLIGHT = REGISTRY.gauge(
+    "tidb_tpu_admission_inflight", "statements currently executing inside the admission gate")
 OPEN_TXNS = REGISTRY.gauge("tidb_tpu_open_txns", "transactions currently open")
 NATIVE_DECODES = REGISTRY.counter("tidb_tpu_native_decode_batches_total", "region batches decoded by the C++ rowcodec")
 NATIVE_DECODE_FALLBACKS = REGISTRY.counter("tidb_tpu_native_decode_fallbacks_total", "native decode errors served by the python decoder")

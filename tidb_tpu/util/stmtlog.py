@@ -104,6 +104,7 @@ class StmtLog:
         summary_enabled: bool = True,
         cpu_ms: float = 0.0,
         plan_digest: str = "",
+        norm_digest: tuple[str, str] | None = None,
     ):
         # a FAILED statement leaves a slow-log artifact regardless of the
         # threshold (slow log still enabled) — a fast-failing dispatch
@@ -112,7 +113,11 @@ class StmtLog:
         is_slow = slow_threshold_ms is not None and (duration_ms > slow_threshold_ms or not success)
         if not summary_enabled and not is_slow:
             return  # neither sink wants it: skip the lexer+digest pass
-        norm, digest = normalize_sql(sql)
+        # the session hands its already-computed (normalized, digest) pair
+        # when it lexed the statement anyway (the plan-cache probe), and
+        # EXECUTE hands the UNDERLYING prepared statement's pair so the
+        # run joins that summary row instead of the "execute s" shape
+        norm, digest = norm_digest if norm_digest is not None else normalize_sql(sql)
         now = time.time()
         with self._lock:
             if summary_enabled:

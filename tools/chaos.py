@@ -39,6 +39,7 @@ N_STORES = 4
 # every failpoint the schedule may arm — disarmed wholesale in the
 # `finally` so a crashed run never leaks faults into the next test
 FAULT_POINTS = (
+    "server/admission-full",
     "store/unreachable",
     "store/not-leader",
     "store/server-busy",
@@ -172,11 +173,14 @@ def _apply(actions, sess, fp) -> None:
 
 
 def run_chaos(seed: int = 7, statements: int = 200, fault_rate: float | None = None,
-              tick_every: int = 10) -> dict:
+              tick_every: int = 10, admission_flicker: float = 0.0) -> dict:
     """Run the workload under the fault schedule; returns the invariant
     report. Raises nothing on query failures — failures are CLASSIFIED:
     typed retryable errors are expected under faults, wrong answers and
-    untyped errors are the bugs this harness exists to catch."""
+    untyped errors are the bugs this harness exists to catch.
+    `admission_flicker` one-shot-arms the server/admission-full failpoint
+    before that fraction of statements (ISSUE 15): the shed must surface
+    as typed 9003, never corrupt a later answer."""
     from tidb_tpu.sql.session import SQLError
     from tidb_tpu.util import failpoint as fp
     from tidb_tpu.util import metrics
@@ -211,6 +215,9 @@ def run_chaos(seed: int = 7, statements: int = 200, fault_rate: float | None = N
     try:
         for i, sql in enumerate(workload):
             _apply(schedule.get(i, ()), s, fp)
+            if admission_flicker and rng.random() < admission_flicker:
+                fp.enable("server/admission-full", 1)  # fire once: this
+                # statement sheds at the gate, the next runs normally
             one_shot = fault_rate is not None and rng.random() < fault_rate
             if one_shot:
                 sid = rng.randrange(1, N_STORES)  # store 0 spared: the
@@ -231,7 +238,9 @@ def run_chaos(seed: int = 7, statements: int = 200, fault_rate: float | None = N
             except SQLError as exc:
                 lat_ms.append((time.monotonic() - t0) * 1000.0)
                 code = getattr(exc, "code", 0)
-                if code in (9005, 1105, 3024, 1317):
+                if code in (9005, 1105, 3024, 1317, 9003):
+                    # 9003: admission shed — typed ServerIsBusy backpressure
+                    # (ISSUE 15), retryable on the server_busy budget
                     typed += 1
                     by_code[code] = by_code.get(code, 0) + 1
                 else:
