@@ -1509,6 +1509,216 @@ def _concurrent_main():
     }))
 
 
+def _topsql_main():
+    """BENCH_TOPSQL=1: Top SQL attribution + the cost-classed gate
+    (ISSUE 17). Phase 1 measures the attribution overhead: the same
+    256-session mixed workload with Top SQL OFF vs ON (the tag is one
+    contextvar set + a leaf-locked flush per statement — the bar is
+    <3% on p50). Phase 2 saturates a tiny gate with measured-HEAVY
+    scans while point-gets flow through: flat mode treats both as one
+    unit of load so the points starve behind the scans; cost-classed
+    mode lanes the heavy digests into max_inflight // 4 slots and the
+    point-gets keep their full count — reported as point-get p99 under
+    both modes (the acceptance bar: classed <= 0.5x flat). Every shed
+    in both modes must be the typed 9003. Hermetic CPU."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    import random
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tidb_tpu import topsql
+    from tidb_tpu.codec import tablecodec
+    from tidb_tpu.sql.session import Session, SQLError
+    from tidb_tpu.util import metrics
+    from tidb_tpu.util.backoff import Backoffer
+
+    n_sessions = int(os.environ.get("BENCH_TOPSQL_SESSIONS", "256"))
+    n_stmts = int(os.environ.get("BENCH_TOPSQL_STMTS", "12"))
+    seed_rows, n_regions, n_stores = 4096, 8, 4
+
+    s = Session()
+    s.execute("CREATE TABLE ts_t (id BIGINT PRIMARY KEY, v BIGINT, "
+              "k VARCHAR(24), KEY iv (v))")
+    for lo in range(0, seed_rows, 512):
+        s.execute("INSERT INTO ts_t VALUES " + ",".join(
+            f"({i},{(i * 31) % 997},'k{i % 64}')"
+            for i in range(lo, min(lo + 512, seed_rows))))
+    tid = s.catalog.table("ts_t").table_id
+    for i in range(1, n_regions):
+        s.store.cluster.split(
+            tablecodec.encode_row_key(tid, i * seed_rows // n_regions))
+    s.store.cluster.set_stores(n_stores)
+    s.store.cluster.scatter()
+    log("topsql: warming compiled scan shapes...")
+    for lo_v in (100, 200, 300, 400):
+        s.execute(f"SELECT k FROM ts_t WHERE v >= {lo_v} AND "
+                  f"v < {lo_v + 50} ORDER BY v LIMIT 5")
+
+    def pct(xs, p):
+        return xs[min(int(len(xs) * p), len(xs) - 1)] if xs else 0.0
+
+    # ---- phase 1: attribution overhead, OFF vs ON --------------------
+    def mix_worker(sid, enabled, lat_out):
+        rng = random.Random(1000 + sid)
+        sess = Session(store=s.store, catalog=s.catalog)
+        sess.execute(f"SET tidb_enable_top_sql = {'ON' if enabled else 'OFF'}")
+        my_lat = []
+        for _ in range(n_stmts):
+            roll = rng.randrange(10)
+            if roll < 7:
+                sql = f"SELECT v FROM ts_t WHERE id = {rng.randrange(seed_rows)}"
+            else:
+                lo_v = (rng.randrange(4) + 1) * 100
+                sql = (f"SELECT k FROM ts_t WHERE v >= {lo_v} AND "
+                       f"v < {lo_v + 50} ORDER BY v LIMIT 5")
+            t0 = time.perf_counter()
+            sess.execute(sql)
+            my_lat.append((time.perf_counter() - t0) * 1000.0)
+        lat_out.extend(my_lat)
+
+    def mix_phase(enabled):
+        topsql.COLLECTOR.reset()
+        lat: list = []
+        threads = [threading.Thread(target=mix_worker, args=(i, enabled, lat),
+                                    daemon=True)
+                   for i in range(n_sessions)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        lat.sort()
+        return {
+            "p50_ms": round(pct(lat, 0.50), 3),
+            "p99_ms": round(pct(lat, 0.99), 3),
+            "stmts_per_sec": round(len(lat) / max(wall, 1e-9), 1),
+        }
+
+    log(f"topsql: {n_sessions} sessions x {n_stmts} stmts, attribution off...")
+    off = mix_phase(False)
+    log("topsql: attribution on...")
+    on = mix_phase(True)
+    # attribution conservation over the ON phase: every tagged launch's
+    # device time landed on exactly one digest
+    conserved = topsql.COLLECTOR.totals["device_ns"] == topsql.COLLECTOR.launch_device_ns
+
+    # ---- phase 2: flat vs cost-classed gate under a heavy+point burst
+    s.execute("SET tidb_enable_top_sql = ON")
+    heavy_sql = "SELECT k FROM ts_t WHERE v >= 100 AND v < 150 ORDER BY v LIMIT 5"
+    point_ids = [7, 11, 13]
+    log("topsql: training the cost EWMAs (measured, not guessed)...")
+    for _ in range(4):  # the classes come from MEASURED executions
+        s.execute(heavy_sql)
+        for pid in point_ids:
+            s.execute(f"SELECT v FROM ts_t WHERE id = {pid}")
+
+    gate = s.store.admission
+    n_heavy = int(os.environ.get("BENCH_TOPSQL_HEAVY", "24"))
+    n_point = int(os.environ.get("BENCH_TOPSQL_POINT", "24"))
+
+    def burst_phase(cost_classed):
+        gate.configure(max_inflight=2, session_queue=0, queue_wait_ms=0.2,
+                       shed_backoff_ms=2, cost_classed=cost_classed)
+        stop = threading.Event()
+        point_lat: list = []
+        untyped: list = []
+        sheds0 = sum(metrics.REGISTRY.labeled_samples(
+            "tidb_tpu_admission_shed_total").values())
+
+        def run_retrying(sess, sql, rng):
+            bo = Backoffer(budget_ms=8000)
+            while True:
+                try:
+                    sess.execute(sql)
+                    return
+                except SQLError as exc:
+                    if exc.code != 9003:
+                        untyped.append(f"SQLError {exc.code}: {str(exc)[:100]}")
+                        return
+                    try:
+                        bo.backoff("server_busy",
+                                   suggested_ms=getattr(exc, "backoff_ms", 0))
+                    except Exception:  # noqa: BLE001 — budget gone
+                        return
+                except Exception as exc:  # noqa: BLE001 — the bug class
+                    untyped.append(f"{type(exc).__name__}: {str(exc)[:100]}")
+                    return
+
+        def heavy_worker(sid):
+            sess = Session(store=s.store, catalog=s.catalog)
+            rng = random.Random(sid)
+            while not stop.is_set():
+                run_retrying(sess, heavy_sql, rng)
+
+        def point_worker(sid):
+            sess = Session(store=s.store, catalog=s.catalog)
+            rng = random.Random(500 + sid)
+            my_lat = []
+            for _ in range(8):
+                pid = point_ids[rng.randrange(len(point_ids))]
+                t0 = time.perf_counter()
+                run_retrying(sess, f"SELECT v FROM ts_t WHERE id = {pid}", rng)
+                my_lat.append((time.perf_counter() - t0) * 1000.0)
+            point_lat.extend(my_lat)
+
+        hv = [threading.Thread(target=heavy_worker, args=(i,), daemon=True)
+              for i in range(n_heavy)]
+        pt = [threading.Thread(target=point_worker, args=(i,), daemon=True)
+              for i in range(n_point)]
+        for t in hv:
+            t.start()
+        time.sleep(0.1)  # the scans wedge the gate first
+        for t in pt:
+            t.start()
+        for t in pt:
+            t.join()
+        stop.set()
+        for t in hv:
+            t.join()
+        gate.configure(max_inflight=0, cost_classed=False)
+        point_lat.sort()
+        sheds = sum(metrics.REGISTRY.labeled_samples(
+            "tidb_tpu_admission_shed_total").values()) - sheds0
+        return {
+            "point_p50_ms": round(pct(point_lat, 0.50), 3),
+            "point_p99_ms": round(pct(point_lat, 0.99), 3),
+            "sheds": int(sheds),
+            "untyped_errors": untyped[:5],
+        }
+
+    log(f"topsql: burst {n_heavy} heavy + {n_point} point sessions, flat gate...")
+    flat = burst_phase(False)
+    log("topsql: same burst, cost-classed gate...")
+    classed = burst_phase(True)
+
+    print(json.dumps({
+        "metric": "topsql_attribution",
+        "compile_s": round(_compile_seconds(), 2),
+        "sessions": n_sessions,
+        "stmts_per_session": n_stmts,
+        "rows": seed_rows,
+        "regions": n_regions,
+        "stores": n_stores,
+        "attribution_off": off,
+        "attribution_on": on,
+        "overhead_p50_pct": round(
+            (on["p50_ms"] / max(off["p50_ms"], 1e-9) - 1.0) * 100.0, 2),
+        "device_conservation_exact": bool(conserved),
+        "burst_flat": flat,
+        "burst_cost_classed": classed,
+        "point_p99_ratio_classed_vs_flat": round(
+            classed["point_p99_ms"] / max(flat["point_p99_ms"], 1e-9), 3),
+    }))
+
+
 def _mesh_main():
     """BENCH_MESH=1: host-merge vs on-device-psum dispatch (ISSUE 11) —
     the same scalar-aggregate scan over a PD-split table, dispatched (a)
@@ -1617,6 +1827,9 @@ def main():
 
     if os.environ.get("BENCH_CONCURRENT"):
         _concurrent_main()
+        return
+    if os.environ.get("BENCH_TOPSQL"):
+        _topsql_main()
         return
     if os.environ.get("BENCH_JOIN"):
         _join_bench_main()

@@ -114,6 +114,16 @@ FRONT_DOOR_ROOTS = (
 FRONT_DOOR_ESCAPE_ROOTS = (
     ("sql/session.py", "Session", "_plan_cache_begin"),
 )
+# Top SQL (ISSUE 17): the HTTP reporter view and the PD-tick rotation
+# are ESCAPE and BACKOFF roots — reporter reads must leave typed (a
+# broken window serialization may not 500 as a bare KeyError) and the
+# collector's seal path must never spin or raw-sleep under its leaf
+# lock. NOT snapshot roots: the collector reads its own ring, never
+# MVCC kv.
+TOPSQL_ROOTS = (
+    ("server/http_api.py", "StatusServer", "_topsql_route"),
+    ("topsql/reporter.py", "TopSQLCollector", "rotate"),
+)
 SESSION_BOUNDARIES = (("sql/session.py", "Session", "execute"),)
 
 # directories whose exception classes form the "typed request-path error"
@@ -910,7 +920,7 @@ def _is_time_sleep(call: ast.Call, graph: CallGraph, fi: FuncInfo) -> bool:
 
 def run_backoff(files: list[SourceFile]) -> list:
     graph = graph_for(files)
-    roots = graph.request_roots(extra=CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS)
+    roots = graph.request_roots(extra=CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + TOPSQL_ROOTS)
     if not roots:
         return []
     _compute_backoff_consulters(graph)
@@ -960,7 +970,7 @@ class EscapeAnalysis:
         self._sub_memo: dict = {}
         # escape only matters in the cone of the roots and the boundary
         reach = graph.reachable(
-            graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS)
+            graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS + TOPSQL_ROOTS)
             + graph.boundaries())
         work = [graph.funcs[q] for q in sorted(reach)]
         rounds = 0
@@ -1230,7 +1240,7 @@ def _mapped_types(graph: CallGraph, boundary: FuncInfo) -> set:
 
 def run_escape(files: list[SourceFile]) -> list:
     graph = graph_for(files)
-    roots = graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS)
+    roots = graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS + TOPSQL_ROOTS)
     boundaries = graph.boundaries()
     if not roots and not boundaries:
         return []

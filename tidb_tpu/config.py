@@ -28,6 +28,10 @@ class Config:
     admission_queue_wait_ms: float = 50.0
     admission_shed_backoff_ms: int = 5
     admission_max_dispatch: int = 0
+    # measured-cost admission (ISSUE 17): weigh in-flight statements by
+    # their Top SQL cost class — heavy digests saturate (and shed) at a
+    # fraction of the budget while point-gets keep their full count
+    admission_cost_classed: bool = False
     # observability
     enable_metrics: bool = True
     slow_query_threshold_ms: int = 300

@@ -15,6 +15,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
+from .. import topsql
 from ..chunk import Chunk
 from ..codec import tablecodec
 from ..codec.number import encode_int_cmp
@@ -717,14 +718,18 @@ def _select_admitted(store: TPUStore, req: KVRequest) -> SelectResult:
     # cross-thread span handoff: pool workers don't inherit contextvars,
     # so capture the dispatching thread's span here and parent the
     # per-task spans on it explicitly (pkg/util/tracing's SpanFromContext
-    # handover at the copIterator worker boundary)
+    # handover at the copIterator worker boundary). The Top SQL resource
+    # tag rides the SAME seam: workers adopt the statement's tag so the
+    # store/backoff sinks attribute from pool threads.
     dispatch_span = tracing.current_span()
+    stmt_tag = topsql.current_tag()
     scan_kind = _scan_kind(req)
     batch_stats: dict | None = None
 
     def run_task(i: int, task: CopTask):
-        return _run_one_task(store, req, task, summaries_by_task[i],
-                             dispatch_span=dispatch_span, scan_kind=scan_kind)
+        with topsql.adopt(stmt_tag):
+            return _run_one_task(store, req, task, summaries_by_task[i],
+                                 dispatch_span=dispatch_span, scan_kind=scan_kind)
 
     # ONE execution planner picks the tier by data size and topology
     # (distsql/planner.py): single launch -> vmapped store batch -> mesh
@@ -750,9 +755,10 @@ def _select_admitted(store: TPUStore, req: KVRequest) -> SelectResult:
                                 []).append((i, t))
 
         def run_batch(sid, entries):
-            return _run_store_batch(store, req, sid, entries, results,
-                                    summaries_by_task, dispatch_span, scan_kind,
-                                    mesh=decision.tier == "mesh")
+            with topsql.adopt(stmt_tag):
+                return _run_store_batch(store, req, sid, entries, results,
+                                        summaries_by_task, dispatch_span, scan_kind,
+                                        mesh=decision.tier == "mesh")
 
         with ThreadPoolExecutor(max_workers=max(len(by_store), 1)) as pool:
             futs = [pool.submit(run_batch, sid, entries)

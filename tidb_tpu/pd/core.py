@@ -420,6 +420,16 @@ class PlacementDriver:
                 folded = rep.compact_tick() if rep is not None else 0
                 if osp is not None:
                     osp.set("rows_folded", folded)
+            with tracing.span("topsql.report") as tsp:
+                # Top SQL window rotation (ISSUE 17): the reporter seals
+                # its live window on a clock even when no statement lands
+                # to trigger the lazy rotation — the PD tick is the
+                # process's background heartbeat, same as cdc/columnar
+                from .. import topsql
+
+                sealed = topsql.COLLECTOR.rotate()
+                if tsp is not None:
+                    tsp.set("windows_sealed", sealed)
             with tracing.span("pd.schedule") as ssp:
                 proposed = 0
                 for sched in self.checkers + self.schedulers:

@@ -21,6 +21,10 @@ pkg/server/handler/tikvhandler — docs/tidb_http_api.md):
   GET /columnar/api/v1/tables          columnar replica tables (delta rows,
                                        stable chunks, applied resolved-ts)
   GET /columnar/api/v1/tables/{name}   one columnar table's detail
+  GET /topsql/api/v1/windows           Top SQL reporter windows (top-K
+                                       digests + "(others)" fold per window)
+  GET /topsql/api/v1/digests/{digest}  one digest across windows + its
+                                       measured cost class / EWMA
 
 The /pd/api/v1 prefix mirrors the reference PD's HTTP API (pd
 server/api/router.go) and /cdc/api/v1 mirrors TiCDC's open API — both
@@ -167,6 +171,8 @@ class StatusServer:
             return self._cdc_route(parts[3:])
         if len(parts) >= 4 and parts[:3] == ["columnar", "api", "v1"]:
             return self._columnar_route(parts[3:])
+        if len(parts) >= 4 and parts[:3] == ["topsql", "api", "v1"]:
+            return self._topsql_route(parts[3:])
         if len(parts) == 4 and parts[:3] == ["pd", "api", "v1"]:
             pd = getattr(s.store, "pd", None)
             if pd is None:
@@ -223,6 +229,25 @@ class StatusServer:
             if v["table"] == parts[1]:
                 return 200, v
         return 404, {"error": f"columnar table {parts[1]!r} not found"}
+
+    def _topsql_route(self, parts: list):
+        """/topsql/api/v1/windows and /topsql/api/v1/digests/{digest}
+        (ISSUE 17; ref: TiDB's Top SQL pushed to ng-monitoring — here
+        pulled from the embedded reporter). Serves the SAME
+        `windows_view()` rows information_schema.tidb_top_sql renders,
+        so the two surfaces are byte-consistent by construction. A
+        registered vet request-path root: reporter reads stay typed and
+        total."""
+        from ..topsql import COLLECTOR
+
+        if parts[0] == "windows" and len(parts) == 1:
+            return 200, COLLECTOR.windows_view()
+        if parts[0] == "digests" and len(parts) == 2:
+            view = COLLECTOR.digest_view(parts[1])
+            if not view["windows"] and not view["measured_executions"]:
+                return 404, {"error": f"digest {parts[1]!r} not in any window"}
+            return 200, view
+        return 404, {"error": "unknown topsql route (windows | digests/{digest})"}
 
     def _cdc_route(self, parts: list):
         """/cdc/api/v1/changefeeds[/{name}] (ref: TiCDC's open API

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from .. import topsql
 from ..chunk import Chunk
 from ..codec import tablecodec
 from ..codec.rowcodec import fill_origin_default
@@ -313,6 +314,7 @@ class Session:
                     queue_wait_ms=config.admission_queue_wait_ms,
                     shed_backoff_ms=config.admission_shed_backoff_ms,
                     max_dispatch=config.admission_max_dispatch,
+                    cost_classed=config.admission_cost_classed,
                 )
             if config.paging_size:
                 self.sysvars.set("tidb_enable_paging", "ON")
@@ -669,12 +671,23 @@ class Session:
         saved = (self._stmt_probe, self._last_sql, self._record_digest)
         self._stmt_probe, self._last_sql = probe, sql
         self._record_digest = (probe.normalized, probe.digest) if probe else None
+        # Top SQL resource tag: ONE per statement, riding the probe's
+        # literal-masked digest from the same lexer pass — every layer
+        # below (dispatch workers, store, Backoffer, admission queue)
+        # attributes into it ambiently (ISSUE 17)
+        tag = None
+        if probe is not None and self.sysvars.get_bool("tidb_enable_top_sql"):
+            tag = topsql.ResourceTag(probe.digest, sample_sql=sql[:256])
+        tag_token = topsql.activate(tag)
         gate = getattr(self.store, "admission", None)
         try:
             try:
                 # admission gate: saturated servers shed HERE, before any
-                # parse/plan/dispatch work happens (typed ServerIsBusy)
-                with (gate.admit(id(self)) if gate is not None else nullcontext()):
+                # parse/plan/dispatch work happens (typed ServerIsBusy).
+                # The digest rides along: cost-classed mode weighs the
+                # statement by its measured class
+                with (gate.admit(id(self), digest=probe.digest if probe is not None else None)
+                      if gate is not None else nullcontext()):
                     res = self._plan_cache_text_serve(probe)
                     if res is not None:
                         # parse-free hit: the digest-keyed entry served the
@@ -749,9 +762,30 @@ class Session:
             return res
         finally:
             self._stmt_probe, self._last_sql, self._record_digest = saved
+            topsql.deactivate(tag_token)
 
     def _record_stmt(self, sql: str, dur_ms: float, rows: int, ok: bool, err: str = "", cpu_ms: float = 0.0):
         try:
+
+            # flush the statement's resource tag: host CPU lands here (the
+            # exact thread_time delta — parse+plan+dispatch), the sinks
+            # already accumulated device/compile/backoff/queue; EXECUTE
+            # re-points the digest at the UNDERLYING prepared statement
+            # (same join the stmt log makes via _record_digest)
+            attr = None
+            tag = topsql.current_tag()
+            if tag is not None:
+                rd = getattr(self, "_record_digest", None)
+                if rd is not None:
+                    tag.sql_digest = rd[1]
+                pd_ = getattr(self, "_last_plan_digest", "")
+                if pd_:
+                    tag.plan_digest = pd_
+                attr = tag.finish(int(cpu_ms * 1e6))
+                pc = getattr(self, "_last_plan_cache", None)
+                topsql.COLLECTOR.record_statement(
+                    attr, success=ok,
+                    plan_cache_hit=bool(pc and pc[0] == "hit"))
             thr = None
             if self.sysvars.get_bool("tidb_enable_slow_log"):
                 t = self.sysvars.get_int("tidb_slow_log_threshold")
@@ -767,6 +801,7 @@ class Session:
                 # instead of orphaning on the "EXECUTE s" shape; direct
                 # statements reuse the probe's digest — one lex per stmt
                 norm_digest=getattr(self, "_record_digest", None),
+                attr=attr,
             )
         except Exception:  # noqa: BLE001 — observability must never fail a query
             pass
@@ -993,6 +1028,17 @@ class Session:
                         from . import builtins_host
 
                         builtins_host.BLOCK_ENCRYPTION_MODE = str(val.value)
+                    elif name.lower() == "tidb_enable_top_sql":
+                        # the collector is process-wide (one ledger per
+                        # server, like the reference's single reporter):
+                        # the sysvar bridges to it at SET time
+
+                        topsql.COLLECTOR.configure(
+                            enabled=self.sysvars.get_bool("tidb_enable_top_sql"))
+                    elif name.lower() == "tidb_top_sql_max_statement_count":
+
+                        topsql.COLLECTOR.configure(
+                            top_k=self.sysvars.get_int("tidb_top_sql_max_statement_count"))
             return Result()
         if isinstance(stmt, A.UseStmt):
             db = stmt.db.lower()
@@ -2071,35 +2117,62 @@ class Session:
 
             D = new_double()
             names = ["digest", "digest_text", "exec_count", "sum_latency",
-                     "max_latency", "avg_latency", "sum_rows", "errors", "sample_sql"]
-            fts = [S, new_varchar(1024), I, D, D, D, I, I, new_varchar(256)]
+                     "max_latency", "avg_latency", "sum_rows", "errors",
+                     "avg_device_ns", "max_device_ns", "avg_compile_ns",
+                     "avg_backoff_ms", "avg_queue_ms", "cost_class", "sample_sql"]
+            fts = [S, new_varchar(1024), I, D, D, D, I, I,
+                   D, I, D, D, D, S, new_varchar(256)]
             rows = []
+
             for sm in self.catalog.stmtlog.summary_rows():
+                n = sm.exec_count or 1
                 rows.append([
                     Datum.string(sm.digest), Datum.string(sm.normalized),
                     Datum.i64(sm.exec_count), Datum.f64(sm.sum_latency_ms),
                     Datum.f64(sm.max_latency_ms), Datum.f64(sm.avg_latency_ms),
                     Datum.i64(sm.sum_rows), Datum.i64(sm.errors),
+                    Datum.f64(sm.avg_device_ns), Datum.i64(sm.max_device_ns),
+                    Datum.f64(sm.sum_compile_ns / n),
+                    Datum.f64(sm.sum_backoff_ms / n),
+                    Datum.f64(sm.sum_queue_ms / n),
+                    Datum.string(topsql.COLLECTOR.cost_class(sm.digest)),
                     Datum.string(sm.sample_sql),
                 ])
         elif kind == "tidb_top_sql":
-            # ref: pkg/util/topsql — per-digest CPU attribution, top-N by
-            # cumulative CPU (exact thread-time deltas in-process, where
-            # the reference samples pprof against SQL digests)
+            # ref: pkg/util/topsql/reporter — the windowed per-digest
+            # resource ledger: top-K digests per metric per window plus
+            # the "(others)" fold. Rows come straight from the collector's
+            # ONE serializer (windows_view), the same snapshot
+            # /topsql/api/v1/windows serves — the surfaces cannot drift
             from ..types import new_double
 
             D = new_double()
-            names = ["digest", "digest_text", "exec_count", "sum_cpu_time",
-                     "avg_cpu_time", "sum_latency", "sample_sql"]
-            fts = [S, new_varchar(1024), I, D, D, D, new_varchar(256)]
+            names = ["window_start", "window_end", "live", "digest",
+                     "plan_digest", "cost_class", "exec_count", "cpu_ns",
+                     "device_ns", "compile_ns", "backoff_ms", "queue_ms",
+                     "bytes_to_device", "cop_cache_hits", "plan_cache_hits",
+                     "errors", "sample_sql"]
+            fts = [D, D, I, S, S, S, I, I, I, I, D, D, I, I, I, I,
+                   new_varchar(256)]
             rows = []
-            for sm in self.catalog.stmtlog.top_sql():
-                rows.append([
-                    Datum.string(sm.digest), Datum.string(sm.normalized),
-                    Datum.i64(sm.exec_count), Datum.f64(sm.sum_cpu_ms),
-                    Datum.f64(sm.sum_cpu_ms / sm.exec_count if sm.exec_count else 0.0),
-                    Datum.f64(sm.sum_latency_ms), Datum.string(sm.sample_sql),
-                ])
+            for w in topsql.COLLECTOR.windows_view():
+                digests = list(w["digests"])
+                if w["others"] is not None:
+                    digests.append(w["others"])
+                for r in digests:
+                    cls = ("" if r["digest"] == topsql.OTHERS_DIGEST
+                           else topsql.COLLECTOR.cost_class(r["digest"]))
+                    rows.append([
+                        Datum.f64(w["start"]), Datum.f64(w["end"]),
+                        Datum.i64(1 if w["live"] else 0),
+                        Datum.string(r["digest"]), Datum.string(r["plan_digest"]),
+                        Datum.string(cls), Datum.i64(r["exec_count"]),
+                        Datum.i64(r["cpu_ns"]), Datum.i64(r["device_ns"]),
+                        Datum.i64(r["compile_ns"]), Datum.f64(r["backoff_ms"]),
+                        Datum.f64(r["queue_ms"]), Datum.i64(r["bytes_to_device"]),
+                        Datum.i64(r["cop_cache_hits"]), Datum.i64(r["plan_cache_hits"]),
+                        Datum.i64(r["errors"]), Datum.string(r["sample_sql"]),
+                    ])
         else:
             raise SQLError(f"information_schema.{kind} not supported yet")
         meta = rw.registry.register(names, fts, rows)

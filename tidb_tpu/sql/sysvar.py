@@ -180,6 +180,14 @@ DEFINITIONS = {
         SysVar("tidb_enable_slow_log", "ON", "both", _bool_validator),
         SysVar("tidb_stmt_summary_max_stmt_count", "3000", "global", _int_validator(1, 1 << 20)),
         SysVar("tidb_enable_stmt_summary", "ON", "both", _bool_validator),
+        # ---- Top SQL (ISSUE 17; ref: tidb_enable_top_sql +
+        # tidb_top_sql_max_statement_count, sysvar.go) — per-digest
+        # CPU+device attribution; OFF skips tagging entirely so a
+        # statement pays one sysvar read and nothing else
+        SysVar("tidb_enable_top_sql", "ON", "both", _bool_validator),
+        # top-K digests each reporter window retains per metric before
+        # the "(others)" fold (ref default 200; scaled to in-process)
+        SysVar("tidb_top_sql_max_statement_count", "30", "both", _int_validator(1, 5000)),
         # ---- production front door (ISSUE 15) --------------------------
         # digest-keyed plan cache (ref: tidb_enable_prepared_plan_cache +
         # the non-prepared plan cache, sysvar.go): repeated statements

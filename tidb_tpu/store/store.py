@@ -657,9 +657,11 @@ class TPUStore:
                 return None
             self._cop_cache.pop(key)  # refresh LRU position
             self._cop_cache[key] = ent
+        from ..topsql import record_cop_cache_hit
         from ..util import metrics
 
         metrics.COP_CACHE_HITS.inc()
+        record_cop_cache_hit()  # zero device time by construction: no launch ran
         self.pd.flow.record_read(req.region_id, flow[0], flow[1])
         summaries = [replace(s, cache_hit=True, time_compile_ns=0) for s in resp.exec_summaries]
         return CopResponse(chunk=resp.chunk, exec_summaries=summaries)
@@ -866,6 +868,9 @@ class TPUStore:
                 raise  # surface kernel bugs with a stack when armed
             return CopResponse(other_error=str(exc))
         elapsed = time.monotonic_ns() - t0
+        from ..topsql import record_device
+
+        record_device(elapsed, compile_ns=info["compile_ns"], bytes_to_device=in_bytes)
         # per-executor produced-row counts are real (measured inside the
         # fused program); the time is the whole fused program's — XLA fuses
         # the pipeline into one kernel, so per-operator time does not exist
@@ -1052,7 +1057,15 @@ class TPUStore:
             metrics.MESH_COP_FALLBACKS.inc()
             return False
         elapsed = time.monotonic_ns() - t0
-        share = elapsed // max(len(entries), 1)
+        from ..topsql import record_device, split_by_rows
+
+        # one launch served every lane: attribution splits by each lane's
+        # decoded rows (not an equal share — a 10k-row lane did the work a
+        # 10-row lane did not), and the shares sum EXACTLY to the launch
+        # total so EXPLAIN/Top SQL conservation holds
+        shares = split_by_rows(elapsed, [ch.num_rows() for ch in chunks])
+        record_device(elapsed, compile_ns=info["compile_ns"],
+                      bytes_to_device=sum(ch.nbytes() for ch in chunks))
         walk = executor_walk(dag.executors)
         out_fts = merged.field_types()
         metrics.MESH_COP_BATCHES.inc()
@@ -1064,7 +1077,7 @@ class TPUStore:
             out_chunk = merged if k == 0 else Chunk.empty(out_fts)
             summaries = self._lane_attribution(
                 region, chunks[k], out_chunk.nbytes() if k == 0 else 0,
-                lane_counts[k], share,
+                lane_counts[k], shares[k],
                 compile_ns=info["compile_ns"] if k == 0 else 0,
                 cache_hit=info["cache_hit"] if k == 0 else True, walk=walk,
                 # the carrier lane owns the merged result — it carries the
@@ -1182,7 +1195,14 @@ class TPUStore:
                 responses[i] = self.coprocessor(req, group_capacity)
             return
         elapsed = time.monotonic_ns() - t0
-        share = elapsed // max(len(entries), 1)
+        from ..topsql import record_device, split_by_rows
+
+        # per-lane attribution by decoded rows (exact: shares sum to the
+        # launch total); overflow fall-out lanes keep their share here —
+        # the launch still spent it — and bill their retry separately
+        shares = split_by_rows(elapsed, [ch.num_rows() for ch in chunks])
+        record_device(elapsed, compile_ns=info["compile_ns"],
+                      bytes_to_device=sum(ch.nbytes() for ch in chunks))
         walk = executor_walk(dag.executors)
         metrics.BATCH_COP_BATCHES.inc()
         served = 0
@@ -1209,7 +1229,7 @@ class TPUStore:
                     escapes=by_lane[lane] if lane < len(by_lane) else 0,
                 )}
             summaries = self._lane_attribution(
-                region, ch, chunk.nbytes(), ex_rows, share,
+                region, ch, chunk.nbytes(), ex_rows, shares[lane],
                 compile_ns=info["compile_ns"] if served == 0 else 0,
                 cache_hit=info["cache_hit"] if served == 0 else True, walk=walk,
                 radix_info=lane_info,

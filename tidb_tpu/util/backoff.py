@@ -135,4 +135,7 @@ class Backoffer:
         sp = tracing.current_span()
         if sp is not None:
             sp.set("backoff_ms", round(sp.attrs.get("backoff_ms", 0.0) + slept, 2))
+        from ..topsql import record_backoff
+
+        record_backoff(slept)  # Top SQL: the statement owns its naps
         return slept

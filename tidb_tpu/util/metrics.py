@@ -424,3 +424,33 @@ PD_PLACEMENT_DECISIONS = REGISTRY.counter("pd_placement_decision_total", "placem
 PD_FAILOVERS = REGISTRY.counter("pd_failover_total", "regions failed over off a sick store (leader transfer or placement move)")
 PD_TRANSFER_LEADER = REGISTRY.counter("pd_transfer_leader_total", "region leaderships transferred between peers")
 PD_TICK_DURATION = REGISTRY.histogram("pd_tick_seconds", "PD scheduling tick latency")
+
+# Top SQL resource attribution (tidb_tpu/topsql) — ref: the
+# tidb_topsql_* families of pkg/util/topsql/reporter. Time counters stay
+# in the ledger's native integer units (ns / ms) so the exposition
+# reconciles EXACTLY against the window sums the API serves — converting
+# to seconds would make the cross-surface consistency check float-fuzzy.
+TOPSQL_RECORDS = REGISTRY.counter(
+    "tidb_tpu_topsql_records_total", "finished statements folded into the Top SQL ledger")
+TOPSQL_CPU_NS = REGISTRY.counter(
+    "tidb_tpu_topsql_cpu_ns_total", "host thread-CPU ns attributed to tagged statements")
+TOPSQL_DEVICE_NS = REGISTRY.counter(
+    "tidb_tpu_topsql_device_ns_total", "fused-program device ns attributed to tagged statements")
+TOPSQL_COMPILE_NS = REGISTRY.counter(
+    "tidb_tpu_topsql_compile_ns_total", "program compile ns attributed to tagged statements")
+TOPSQL_BACKOFF_MS = REGISTRY.counter(
+    "tidb_tpu_topsql_backoff_ms_total", "Backoffer sleep ms attributed to tagged statements")
+TOPSQL_QUEUE_MS = REGISTRY.counter(
+    "tidb_tpu_topsql_queue_ms_total", "admission queue wait ms attributed to tagged statements")
+TOPSQL_LAUNCH_DEVICE_NS = REGISTRY.counter(
+    "tidb_tpu_topsql_launch_device_ns_total", "total device ns of launches that ran under a statement tag (the conservation ledger)")
+TOPSQL_WINDOWS_SEALED = REGISTRY.counter(
+    "tidb_tpu_topsql_windows_sealed_total", "Top SQL reporter windows sealed into the ring")
+TOPSQL_OTHERS_FOLDED = REGISTRY.counter(
+    "tidb_tpu_topsql_others_folded_total", "digests folded into a window's (others) row at seal time")
+TOPSQL_LIVE_DIGESTS = REGISTRY.gauge(
+    "tidb_tpu_topsql_live_digests", "distinct digests in the live (unsealed) window")
+TOPSQL_CLASS_DECISIONS = REGISTRY.counter_vec(
+    "tidb_tpu_topsql_class_admissions_total", "cost-classed admission decisions by class",
+    labelnames=("cost_class", "decision"),
+)

@@ -19,7 +19,15 @@ from dataclasses import dataclass, field
 
 def normalize_sql(sql: str) -> tuple[str, str]:
     """(normalized text, hex digest). Literals -> '?', idents lowered —
-    the parser.Normalize/Digest analog."""
+    the parser.Normalize/Digest analog.
+
+    FALLBACK ONLY (ISSUE 17): every statement that went through the
+    session already carries the plan-cache probe's identical pair from
+    its one lexer pass, and `record()` takes it via `norm_digest` — this
+    re-lex serves only direct `record()` callers (tests, tools) and the
+    unlexable-statement path. Slow log, statement summary, Top SQL and
+    the plan cache therefore share ONE digest per statement by
+    construction."""
     from ..parser.lexer import T, tokenize
 
     try:
@@ -76,10 +84,22 @@ class StmtSummary:
     sum_cpu_ms: float = 0.0  # thread CPU time (the Top SQL attribution,
     # ref: pkg/util/topsql/collector — per-digest CPU sampling; in-process
     # the exact thread_time delta replaces statistical sampling)
+    # resource-tag attribution (ISSUE 17): the Top SQL sinks' per-statement
+    # totals, folded here so statements_summary answers avg/max device and
+    # wait costs per digest without a join against the windowed reporter
+    sum_device_ns: int = 0
+    max_device_ns: int = 0
+    sum_compile_ns: int = 0
+    sum_backoff_ms: float = 0.0
+    sum_queue_ms: float = 0.0
 
     @property
     def avg_latency_ms(self) -> float:
         return self.sum_latency_ms / self.exec_count if self.exec_count else 0.0
+
+    @property
+    def avg_device_ns(self) -> float:
+        return self.sum_device_ns / self.exec_count if self.exec_count else 0.0
 
 
 class StmtLog:
@@ -105,6 +125,7 @@ class StmtLog:
         cpu_ms: float = 0.0,
         plan_digest: str = "",
         norm_digest: tuple[str, str] | None = None,
+        attr: dict | None = None,
     ):
         # a FAILED statement leaves a slow-log artifact regardless of the
         # threshold (slow log still enabled) — a fast-failing dispatch
@@ -136,6 +157,12 @@ class StmtLog:
                 s.sum_rows += rows
                 s.errors += 0 if success else 1
                 s.sum_cpu_ms += cpu_ms
+                if attr is not None:  # the statement's resource-tag totals
+                    s.sum_device_ns += attr.get("device_ns", 0)
+                    s.max_device_ns = max(s.max_device_ns, attr.get("device_ns", 0))
+                    s.sum_compile_ns += attr.get("compile_ns", 0)
+                    s.sum_backoff_ms += attr.get("backoff_ms", 0.0)
+                    s.sum_queue_ms += attr.get("queue_ms", 0.0)
                 s.last_seen = now
             if is_slow:
                 self.slow.append(

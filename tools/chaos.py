@@ -173,14 +173,19 @@ def _apply(actions, sess, fp) -> None:
 
 
 def run_chaos(seed: int = 7, statements: int = 200, fault_rate: float | None = None,
-              tick_every: int = 10, admission_flicker: float = 0.0) -> dict:
+              tick_every: int = 10, admission_flicker: float = 0.0,
+              cost_classed: bool = False) -> dict:
     """Run the workload under the fault schedule; returns the invariant
     report. Raises nothing on query failures — failures are CLASSIFIED:
     typed retryable errors are expected under faults, wrong answers and
     untyped errors are the bugs this harness exists to catch.
     `admission_flicker` one-shot-arms the server/admission-full failpoint
     before that fraction of statements (ISSUE 15): the shed must surface
-    as typed 9003, never corrupt a later answer."""
+    as typed 9003, never corrupt a later answer. `cost_classed` runs the
+    storm with Top SQL attribution ON and the admission gate in
+    measured-cost mode (ISSUE 17): every statement classifies + admits
+    through the per-class lanes while faults fly — any shed must still be
+    typed 9003 and the answer oracle must stay clean."""
     from tidb_tpu.sql.session import SQLError
     from tidb_tpu.util import failpoint as fp
     from tidb_tpu.util import metrics
@@ -191,6 +196,13 @@ def run_chaos(seed: int = 7, statements: int = 200, fault_rate: float | None = N
 
     s = _fill_session(split_regions=True)
     store = s.store
+    if cost_classed:
+        # measured-cost admission under the storm: Top SQL tags every
+        # statement, the EWMAs learn live, the gate weighs each admit by
+        # its class — generous inflight so the faults (not the gate) are
+        # what this run stresses; admission_flicker still forces sheds
+        s.execute("SET tidb_enable_top_sql = ON")
+        store.admission.configure(max_inflight=8, cost_classed=True)
     rng = random.Random(seed * 31 + 1)
     schedule = {} if fault_rate is not None else default_schedule(statements)
 
