@@ -1822,9 +1822,142 @@ def _mesh_main():
     }))
 
 
+def _mpp_bench_child():
+    """One BENCH_MPP device count, in its own process (the forced host
+    platform device count must be set before jax imports). Builds the
+    Q3-shape 3-table chain (fact mpp_i split over 8 regions / 4 stores —
+    no single store holds the table), then measures the same GROUP BY
+    chain query (a) on the mpp tier (fragment plan + all_to_all shuffle)
+    and (b) monolithic (mesh+mpp off, single-program root join). Prints
+    one JSON object on the last line."""
+    n_dev = int(os.environ["BENCH_MPP_CHILD"])
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_dev}"
+        ).strip()
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tidb_tpu.codec import tablecodec
+    from tidb_tpu.sql.session import Session
+    from tidb_tpu.util import metrics
+
+    rows = int(os.environ.get("BENCH_MPP_ROWS", "4096"))
+    n_regions, n_stores, reps = 8, 4, 5
+    s = Session()
+    s.execute("CREATE TABLE mpp_c (c_id BIGINT PRIMARY KEY, seg VARCHAR(2))")
+    s.execute("CREATE TABLE mpp_o (o_id BIGINT PRIMARY KEY, ckey BIGINT, odate BIGINT)")
+    s.execute("CREATE TABLE mpp_i (i_id BIGINT PRIMARY KEY, oid BIGINT, v BIGINT)")
+    s.execute("INSERT INTO mpp_c VALUES " + ",".join(
+        f"({i},'{'AB'[i % 2]}')" for i in range(64)))
+    s.execute("INSERT INTO mpp_o VALUES " + ",".join(
+        f"({i},{(i * 2654435761) % 64},{1000 + i % 9})" for i in range(256)))
+    for lo in range(0, rows, 512):
+        s.execute("INSERT INTO mpp_i VALUES " + ",".join(
+            f"({i},{(i * 7919) % 280},{(i * 37) % 101})"
+            for i in range(lo, min(lo + 512, rows))))
+    tid = s.catalog.table("mpp_i").table_id
+    for i in range(1, n_regions):
+        s.store.cluster.split(tablecodec.encode_row_key(tid, i * rows // n_regions))
+    s.store.cluster.set_stores(n_stores)
+    s.store.cluster.scatter()
+    fact_regions = s.store.cluster.regions_in_range(
+        tablecodec.encode_row_key(tid, 0), tablecodec.encode_row_key(tid + 1, 0))
+    fact_stores = {s.store.cluster.store_of(r.region_id) for r in fact_regions}
+    sql = ("SELECT oid, count(*), sum(v) FROM mpp_i JOIN mpp_o ON oid = o_id "
+           "JOIN mpp_c ON ckey = c_id WHERE seg = 'B' AND odate < 1007 "
+           "GROUP BY oid")
+
+    def measure(mpp_on: bool) -> dict:
+        s.execute(f"SET tidb_enable_tpu_mesh = {'ON' if mpp_on else 'OFF'}")
+        s.execute(f"SET tidb_allow_mpp = {'ON' if mpp_on else 'OFF'}")
+        c0 = _compile_seconds()
+        b0 = metrics.MPP_EXCHANGED_BYTES.value
+        m0 = metrics.MPP_SELECTS.value
+        f0 = metrics.MPP_FRAGMENTS.value
+        s.execute(sql)  # warm: compile cost lands here
+        compile_s = _compile_seconds() - c0
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            s.execute(sql)
+            times.append(time.perf_counter() - t0)
+        wall = statistics.median(times)
+        q = reps + 1
+        return {
+            "wall_ms": round(wall * 1e3, 2),
+            "rows_per_s": round(rows / wall),
+            "compile_s": round(compile_s, 2),
+            "exchanged_bytes_per_query": int(
+                (metrics.MPP_EXCHANGED_BYTES.value - b0) / q),
+            "fragments_per_query": (metrics.MPP_FRAGMENTS.value - f0) / q,
+            "served_mpp": bool(metrics.MPP_SELECTS.value - m0),
+        }
+
+    mono = measure(False)
+    mpp = measure(True)
+    print(json.dumps({
+        "devices": n_dev,
+        "rows": rows,
+        "fact_regions": len(fact_regions),
+        "fact_leader_stores": len(fact_stores),
+        "table_larger_than_one_store": len(fact_stores) > 1,
+        "monolithic": mono,
+        "mpp": mpp,
+        "speedup": round(mono["wall_ms"] / max(mpp["wall_ms"], 1e-9), 2),
+    }))
+
+
+def _mpp_main():
+    """BENCH_MPP=1: the ISSUE 18 exchange data plane — the 3-table
+    shuffle-join chain at 2/4/8 mesh devices vs the monolithic
+    single-program join, one subprocess per device count (rows/s,
+    exchanged bytes, compile_s per fragment program). The fact table is
+    split over more stores than any one store holds — the
+    larger-than-one-store case rides every row of the report."""
+    import subprocess
+
+    dev_counts = [int(x) for x in os.environ.get("BENCH_MPP_DEVICES", "2,4,8").split(",")]
+    results = []
+    for n_dev in dev_counts:
+        env = dict(os.environ)
+        env["BENCH_MPP_CHILD"] = str(n_dev)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("BENCH_MPP", None)
+        try:
+            out = subprocess.run(
+                [sys.executable, __file__], env=env,
+                capture_output=True, text=True, timeout=900)
+            rec = json.loads(out.stdout.strip().splitlines()[-1])
+            log(f"  [mpp/{n_dev} devices] monolithic {rec['monolithic']['wall_ms']}ms "
+                f"vs mpp {rec['mpp']['wall_ms']}ms "
+                f"({rec['mpp']['exchanged_bytes_per_query']} B exchanged)")
+            results.append(rec)
+        except Exception as exc:  # noqa: BLE001 — one bad count, not the run
+            log(f"  [mpp/{n_dev} devices] failed: {exc}")
+            results.append({"devices": n_dev, "error": str(exc)[:200]})
+    print(json.dumps({
+        "metric": "mpp_exchange_chain",
+        "by_device_count": results,
+    }))
+
+
 def main():
     import os
 
+    if os.environ.get("BENCH_MPP_CHILD"):
+        _mpp_bench_child()
+        return
+    if os.environ.get("BENCH_MPP"):
+        _mpp_main()
+        return
     if os.environ.get("BENCH_CONCURRENT"):
         _concurrent_main()
         return

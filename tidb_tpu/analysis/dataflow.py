@@ -124,6 +124,16 @@ TOPSQL_ROOTS = (
     ("server/http_api.py", "StatusServer", "_topsql_route"),
     ("topsql/reporter.py", "TopSQLCollector", "rotate"),
 )
+# MPP dispatch (ISSUE 18): the fragment coordinator is an ESCAPE and
+# BACKOFF root — every decline must be a counted fallback or a typed
+# region/staleness error at the boundary (never a bare escape from the
+# wire round-trip or the replica readiness gate), and the data_not_ready
+# wait it inherits from the columnar path must ride a Backoffer budget.
+# NOT a snapshot root: probe scans go through distsql.select / the
+# replica's typed layers, both already policed.
+MPP_ROOTS = (
+    ("mpp/dispatch.py", None, "try_mpp_select"),
+)
 SESSION_BOUNDARIES = (("sql/session.py", "Session", "execute"),)
 
 # directories whose exception classes form the "typed request-path error"
@@ -920,7 +930,7 @@ def _is_time_sleep(call: ast.Call, graph: CallGraph, fi: FuncInfo) -> bool:
 
 def run_backoff(files: list[SourceFile]) -> list:
     graph = graph_for(files)
-    roots = graph.request_roots(extra=CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + TOPSQL_ROOTS)
+    roots = graph.request_roots(extra=CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + TOPSQL_ROOTS + MPP_ROOTS)
     if not roots:
         return []
     _compute_backoff_consulters(graph)
@@ -970,7 +980,7 @@ class EscapeAnalysis:
         self._sub_memo: dict = {}
         # escape only matters in the cone of the roots and the boundary
         reach = graph.reachable(
-            graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS + TOPSQL_ROOTS)
+            graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS + TOPSQL_ROOTS + MPP_ROOTS)
             + graph.boundaries())
         work = [graph.funcs[q] for q in sorted(reach)]
         rounds = 0
@@ -1240,7 +1250,7 @@ def _mapped_types(graph: CallGraph, boundary: FuncInfo) -> set:
 
 def run_escape(files: list[SourceFile]) -> list:
     graph = graph_for(files)
-    roots = graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS + TOPSQL_ROOTS)
+    roots = graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS + TOPSQL_ROOTS + MPP_ROOTS)
     boundaries = graph.boundaries()
     if not roots and not boundaries:
         return []
@@ -1286,7 +1296,7 @@ def run_escape(files: list[SourceFile]) -> list:
     # reachability must narrow nothing the lexical rule guaranteed)
     for sf in graph.files:
         rel = sf.rel.replace(os.sep, "/")
-        if not any(rel.startswith(f"tidb_tpu/{d}/") for d in ("distsql", "store", "pd", "cdc", "columnar")):
+        if not any(rel.startswith(f"tidb_tpu/{d}/") for d in ("distsql", "store", "pd", "cdc", "columnar", "mpp")):
             continue
         for node in ast.walk(sf.tree):
             if not (isinstance(node, ast.Raise) and node.exc is not None):

@@ -53,6 +53,8 @@ DESCRIPTIONS = {
     "cdc/sink-stall": "skips a tick's sink emission — the sorter keeps the backlog and the emitted checkpoint holds until the stall clears",
     "columnar/apply-stall": "wedges the columnar replica's apply sink — the feeding changefeed parks in `error` with the backlog re-queued below its held checkpoint; RESUME (ColumnarReplica.resume_all) replays it, absorbed by the idempotent delta fold",
     "columnar/compact-stall": "skips the pd.columnar tick's delta-to-stable compaction — delta layers grow and the stable floor stops advancing; scans keep serving through the delta overlay",
+    "mpp/dispatch-lost": "loses an MPP task dispatch before launch — the coordinator abandons the fragment run as a counted fallback (MPP_FALLBACKS) and the statement re-dispatches on the non-MPP tiers, byte-identically",
+    "mpp/exchange-stall": "stalls the fragment exchange mid-run — the coordinator abandons the MPP attempt after sourcing the probe scan; a counted fallback, never a torn result",
     "server/admission-full": "forces the admission gate's saturated answer — every statement/dispatch arriving at an armed gate sheds as typed ServerIsBusy{backoff_ms} without consuming a slot, so tests exercise backpressure without real load",
     "pd/heartbeat-lost": "drops one tick's region-heartbeat interval on the floor (a lost heartbeat stream)",
     "pd/operator-timeout": "force-expires every pending PD operator at the next tick's dispatch phase",

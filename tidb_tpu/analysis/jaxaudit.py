@@ -330,8 +330,46 @@ def audit_live() -> list:
             else:
                 findings.extend(_check_vmap_axis(name, single_out, closed.out_avals, anchor))
         findings.extend(_audit_mesh_variant(name, dag, n_batches, anchor, caps))
+    findings.extend(_audit_exchange_variant(anchor))
     _LIVE_MEMO = list(findings)
     return findings
+
+
+def _audit_exchange_variant(anchor) -> list:
+    """Trace the MPP exchange-join shard_map shape (ISSUE 18): the
+    shuffle-join chain — hash-partition both sides, all_to_all, local
+    join, grouped agg phases — as ONE program (mpp/exchange_op.py
+    `exchange_join_program`), walked through the same f64/host-callback/
+    const jaxpr checks; iter_eqns recurses the shard_map body."""
+    import jax
+
+    from ..exec.dag import Aggregation, DAGRequest, Join
+    from ..expr import AggDesc, col
+    from ..mpp.exchange_op import exchange_join_program
+    from ..parallel.mesh import region_mesh, stack_region_batches
+
+    _ch, I = _int_chunk()
+    dag = DAGRequest(
+        (_scan(41, I),
+         Join(build=(_scan(42, I),), probe_keys=(col(0, I),),
+              build_keys=(col(0, I),), join_type="inner"),
+         Aggregation(group_by=(col(1, I),),
+                     aggs=(AggDesc("sum", (col(2, I),)),
+                           AggDesc("count", ())))),
+        output_offsets=(0, 1, 2))
+    variant = "exchange_join/mesh"
+    try:
+        n_dev = len(jax.devices())
+        mesh = region_mesh(n_dev)
+        ch, _I = _int_chunk()
+        stacked_p = stack_region_batches([ch] * n_dev, n_total=n_dev)
+        stacked_b = stack_region_batches([ch] * n_dev, n_total=n_dev)
+        fn = exchange_join_program(dag, mesh, group_capacity=_GROUP_CAPACITY)
+        closed = jax.make_jaxpr(fn)(stacked_p, stacked_b)
+    except Exception as exc:  # noqa: BLE001 — a trace failure IS a finding
+        return [Finding(anchor[0], anchor[1], PASS,
+                        f"program {variant!r} failed to trace: {exc}")]
+    return audit_jaxpr(variant, closed, anchor)
 
 
 def _audit_mesh_variant(name: str, dag, n_batches: int, anchor, caps=None) -> list:

@@ -46,8 +46,12 @@ MESH_MERGEABLE_AGGS = frozenset({
 
 @dataclass(frozen=True)
 class TierDecision:
-    tier: str  # "single" | "pool" | "batch" | "mesh"
-    kind: str | None = None  # mesh merge kind: "scalar" | "group" | "topn"
+    # per-request tiers: "single" | "pool" | "batch" | "mesh"
+    # statement-level tiers (choose_statement_tier): "root" | "mesh" | "mpp"
+    tier: str
+    # mesh merge kind ("scalar" | "group" | "topn") for the request tiers;
+    # exchange plan kind ("agg" | "join") for the statement tiers
+    kind: str | None = None
 
 
 def mesh_merge_kind(dag) -> str | None:
@@ -112,6 +116,44 @@ def estimated_rows(store) -> int:
         return len(store.kv)
     except Exception:  # noqa: BLE001 — a stats miss must never fail dispatch
         return 0
+
+
+def choose_statement_tier(dag, *, allow_mpp: bool, allow_mesh: bool,
+                          columnar_routed) -> TierDecision:
+    """Statement-level tier pick ABOVE execute_root's per-request tiers
+    (ref: mpp_gather.go:40 useMPPExecution — the reference asks "MPP?"
+    once per statement before task planning). Returns:
+
+      "mpp"   plan the statement as an exchange-linked fragment graph
+              (mpp/dispatch.py): fragment planner + wire seam + columnar
+              replica probe sourcing. Joins take this tier even when the
+              columnar replica covers the plan — the fragments SOURCE from
+              the replica instead of ceding the whole statement to it.
+      "mesh"  the whole-plan mesh shortcut (parallel/sql.try_mesh_select)
+              without the fragment/dispatch layer (tidb_allow_mpp=OFF).
+      "root"  no statement-level shortcut: execute_root owns dispatch
+              (its own per-request tiers + columnar engine routing).
+
+    `columnar_routed` is a thunk so the engine-routing walk only runs when
+    a shortcut is actually on the table (review finding on the original
+    mesh gate: no double walk when mesh is off)."""
+    if not allow_mesh or _n_devices() < 2:
+        return TierDecision("root")
+    from ..parallel.sql import mesh_eligible
+
+    kind = mesh_eligible(dag)
+    if kind is None:
+        return TierDecision("root")
+    if allow_mpp and kind == "join":
+        # shuffle joins are the mpp tier's raison d'être: the replica
+        # serves the probe scan INSIDE the fragment plan, so columnar
+        # engine routing must not preempt the statement
+        return TierDecision("mpp", kind)
+    if columnar_routed():
+        # the columnar replica owns this plan (engine routing, ISSUE 12):
+        # the whole-statement shortcut must not preempt it
+        return TierDecision("root")
+    return TierDecision("mpp" if allow_mpp else "mesh", kind)
 
 
 def choose_tier(store, req, tasks) -> TierDecision:

@@ -1,0 +1,33 @@
+"""MPP exchange data plane (ref: pkg/planner/core/fragment.go +
+unistore/cophandler/mpp_exec.go, SURVEY §3.2/§5).
+
+The reference's MPP path cuts an eligible physical plan at every exchange
+boundary into fragments (`fragment.go:116 GenerateRootMPPTasks`), hash-
+partitions rows by join/group key in ExchangeSender, streams partitions
+between peer tasks over per-task tunnels, and re-assembles above
+ExchangeReceiver. The TPU-native mapping (SURVEY §5): the tunnels are ONE
+`jax.lax.all_to_all` over the ICI mesh axis inside a single `shard_map`
+program, the final-merge gather is a passthrough exchange, and fragments
+are launch phases of that one program rather than separate processes.
+
+Three layers, mirroring the reference's split:
+
+  fragment.py     the fragment planner — cuts a shuffle-eligible DAG at
+                  each join/final-agg boundary into ExchangeSender/
+                  ExchangeReceiver-linked fragments with a stable task
+                  topology (DAG analog of GenerateRootMPPTasks).
+  exchange_op.py  the on-device exchange operator — hash partition ids,
+                  scatter-to-bucket packing, the all_to_all collective,
+                  and the shuffle-join device program. `parallel/`'s
+                  grouped/join mesh paths are thin wrappers over this.
+  dispatch.py     the dispatch/coordination layer (DispatchMPPTask
+                  analog) — sources fragment inputs from the columnar
+                  replica's stable chunks when the snapshot is covered
+                  (row-store decode fallback otherwise), round-trips the
+                  fragment frames through the wire codec, and runs the
+                  overflow capacity ladder.
+
+Import submodules directly (`from tidb_tpu.mpp import fragment`); this
+package initializer stays import-light so the `parallel/` compatibility
+shims can load it mid-initialization without a cycle.
+"""

@@ -34,14 +34,26 @@ sub-joins, each against a fixed, cache-friendly build table:
      escape overflow raises the join-overflow flag with a NEED hint so
      the retry driver re-dispatches the next precompiled rung.
 
-Only the planner-proven unique-build single-word int-class equi-join
-shape rides this path (inner/left_outer/semi/anti), and only when the
-probe side dominates (build*8 <= probe capacity — the canonical
-small-build hash join, TPC-H Q3's shape); everything else stays on the
-monolithic kernel.  An opportunistic fast path, never a semantics
-change: the unique-build contract is runtime-verified per partition
-(match fan-out > 1 raises overflow, same as ops/join.py), and NULL keys
-never match (pid pins past the last partition).
+Only the single-word int-class equi-join shape rides this path
+(inner/left_outer/semi/anti), and only when the probe side dominates
+(build*8 <= probe capacity — the canonical small-build hash join, TPC-H
+Q3's shape); everything else stays on the monolithic kernel.  An
+opportunistic fast path, never a semantics change: under the planner's
+unique-build hint the contract is runtime-verified per partition (match
+fan-out > 1 raises overflow, same as ops/join.py), and NULL keys never
+match (pid pins past the last partition).
+
+NON-unique builds (the ISSUE 18 lift, unlocked by the MPP exchange): with
+`build_unique=False` the kernel runs the same prefix-sum output expansion
+as ops/join.py — match COUNTS per probe row (dense per-partition fan-out
+from the broadcast-compare's sum reduction, or searchsorted extents on
+CPU-class backends), cumsum to output offsets, and one merge_searchsorted
+to assign each static output slot its (probe, nth-match) pair.  The
+escape hatch expands too (sorted-merge extents at esc_cap size), and the
+out-capacity NEED hint rides the overflow flag so the retry ladder jumps
+straight to the clearing rung.  The pallas probe kernel only reduces to
+the FIRST matching slot, so non-unique fan-out downgrades pallas to the
+dense tables at trace time.
 """
 
 from __future__ import annotations
@@ -52,7 +64,7 @@ import jax.numpy as jnp
 from ..expr.compile import CompVal
 from .join import JoinResult, _key_matrix, merge_lo_hi
 from .keys import lexsort
-from .seg import I64_MAX, hash_words
+from .seg import I64_MAX, hash_words, merge_searchsorted
 
 # plan knobs (static; every program is keyed by the derived plan via its
 # capacities + join-capacity rung, so these never recompile per query)
@@ -191,7 +203,10 @@ def _probe_partitioned(bw, b_usable, pw, p_usable, plan: tuple,
     """The partitioned-table probe (pallas / dense): radix-cluster both
     sides, probe each partition against its fixed-capacity build table,
     and route over-full partitions through the escape hatch.  Returns
-    (build_idx [np] original-order, matched, overflow, need, escapes)."""
+    (build_idx [np] original-order, matched, dup, esc_over, need,
+    escapes) — `dup` (fan-out > 1 seen) is reported SEPARATELY from the
+    escape-overflow flag: it only violates the unique-build contract, so
+    semi/anti under a non-unique build never flag on it."""
     n_parts, part_cap, probe_cap, esc_cap = plan
     nb, np_ = bw.shape[0], pw.shape[0]
     P = n_parts
@@ -277,8 +292,164 @@ def _probe_partitioned(bw, b_usable, pw, p_usable, plan: tuple,
     build_idx = build_idx.at[tgt].set(esc_val, mode="drop")
 
     matched = build_idx >= 0
-    overflow = dup | dup_e | esc_over
-    return build_idx, matched, overflow, need, escapes
+    return build_idx, matched, dup | dup_e, esc_over, need, escapes
+
+
+def _expand_counts(counts_match, get_kth, probe_valid, out_capacity: int,
+                   join_type: str, base_overflow, base_need):
+    """The prefix-sum output expansion, shared by both non-unique probe
+    modes — an exact mirror of ops/join.py's general path: match counts ->
+    cumsum offsets -> one merge_searchsorted assigns each static output
+    slot its (probe row, nth-match) pair, recovered by `get_kth`."""
+    np_ = probe_valid.shape[0]
+    counts = counts_match
+    if join_type == "left_outer":
+        counts = jnp.where(probe_valid, jnp.maximum(counts, 1), 0)
+    offsets = jnp.cumsum(counts) - counts  # start slot per probe row
+    total = counts.sum()
+    overflow = base_overflow | (total > out_capacity)
+    # out-capacity need: exact (the prefix sum already computed the true
+    # fan-out); escape-buffer need from the caller folds in via maximum
+    need = jnp.where(total > out_capacity, total.astype(jnp.int64), jnp.int64(0))
+    need = jnp.maximum(need, base_need)
+
+    slot = jnp.arange(out_capacity)
+    probe_of = merge_searchsorted((offsets + counts).astype(jnp.int64), slot.astype(jnp.int64), side="right")
+    probe_of = jnp.minimum(probe_of, np_ - 1)
+    nth = (slot - offsets[probe_of]).astype(jnp.int32)
+    build_idx = get_kth(probe_of, nth)
+    out_valid = slot < total
+    real_match = counts_match[probe_of] > 0
+    build_null = ~real_match  # only possible under left_outer fill
+    build_idx = jnp.where(build_null, -1, build_idx)
+    return JoinResult(
+        probe_idx=probe_of,
+        build_idx=build_idx,
+        build_null=build_null & out_valid,
+        out_valid=out_valid,
+        n_out=total,
+        overflow=overflow,
+        need=need,
+    )
+
+
+def _expand_search(bw, b_usable, pw, p_usable, probe_valid, join_type: str,
+                   out_capacity: int):
+    """Non-unique fan-out, CPU-class: sorted-build searchsorted extents
+    give the match COUNT per probe (hi-lo), and the k-th match is the
+    sorted run's k-th row — no partition tables, probe rows in place."""
+    nb = bw.shape[0]
+    bk_m = jnp.where(b_usable, bw, I64_MAX)
+    perm = lexsort([bk_m], extra_key=(~b_usable).astype(jnp.int64))
+    sw = bk_m[perm]
+    nb_usable = b_usable.sum().astype(jnp.int32)
+    lo = jnp.searchsorted(sw, pw, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(sw, pw, side="right").astype(jnp.int32)
+    hi = jnp.minimum(hi, nb_usable)  # unusable tail never matches
+    counts_match = jnp.where(p_usable, jnp.maximum(hi - lo, 0), 0)
+
+    def get_kth(probe_of, nth):
+        pos = jnp.clip(lo[probe_of] + nth, 0, nb - 1)
+        return perm[pos].astype(jnp.int32)
+
+    return _expand_counts(counts_match, get_kth, probe_valid, out_capacity,
+                          join_type, jnp.bool_(False), jnp.int64(0))
+
+
+def _expand_partitioned(bw, b_usable, pw, p_usable, probe_valid, plan: tuple,
+                        join_capacity: int, join_type: str, out_capacity: int):
+    """Non-unique fan-out over the partitioned tables: the dense
+    broadcast-compare's sum reduction IS the per-probe match count, and the
+    k-th matching build slot falls out of a cumsum over the probe's compare
+    row.  Escaped partitions count and expand through the sorted-merge
+    extents at esc_cap size, exactly like the first-match overlay."""
+    n_parts, part_cap, probe_cap, esc_cap = plan
+    nb, np_ = bw.shape[0], pw.shape[0]
+    P = n_parts
+
+    salt = join_capacity
+    b_pid = jnp.where(
+        b_usable, (hash_words([bw], salt) & jnp.int64(P - 1)).astype(jnp.int32),
+        jnp.int32(P),
+    )
+    p_pid = jnp.where(
+        p_usable, (hash_words([pw], salt) & jnp.int64(P - 1)).astype(jnp.int32),
+        jnp.int32(P),
+    )
+    b_tbl_idx, b_in, b_count, _b_opid, b_oidx, b_start = _partition(b_pid, P, part_cap, nb)
+    p_tbl_idx, p_in, p_count, p_opid, p_oidx, p_start = _partition(p_pid, P, probe_cap, np_)
+
+    esc_part = (b_count > part_cap) | (p_count > probe_cap)
+    b_slot_ok = b_in & ~esc_part[:, None]
+    p_slot_ok = p_in & ~esc_part[:, None]
+    b_key_tbl = bw[b_tbl_idx]
+    p_key_tbl = pw[p_tbl_idx]
+
+    # dense compare (fan-out needs EVERY match, not the first-slot
+    # reduction — this is why pallas downgrades to the tables here)
+    eq = (p_key_tbl[:, :, None] == b_key_tbl[:, None, :]) & b_slot_ok[:, None, :] & p_slot_ok[:, :, None]
+    nmatch_tbl = eq.sum(axis=-1, dtype=jnp.int32)  # [P, probe_cap]
+
+    # ---- escape sub-join extents (general sorted-merge at esc_cap) ------
+    b_buf, b_ok_e, nbe = _escape_rows(b_oidx, b_start, b_count, esc_part, P, esc_cap, nb)
+    p_buf, p_ok_e, npe = _escape_rows(p_oidx, p_start, p_count, esc_part, P, esc_cap, np_)
+    bke = jnp.where(b_ok_e, bw[b_buf], I64_MAX)
+    perm_e = lexsort([bke], extra_key=(~b_ok_e).astype(jnp.int64))
+    swe = bke[perm_e]
+    usable_sorted = jnp.arange(esc_cap, dtype=jnp.int32) < jnp.minimum(nbe, esc_cap)
+    pke = pw[p_buf]
+    lo_e, hi_e = merge_lo_hi(swe, usable_sorted, pke)
+    cnt_e = jnp.where(p_ok_e, jnp.maximum(hi_e - lo_e, 0), 0)  # per esc slot
+
+    esc_over = (nbe > esc_cap) | (npe > esc_cap)
+    escapes = (jnp.minimum(nbe, esc_cap) + jnp.minimum(npe, esc_cap)).astype(jnp.int64)
+    base_need = jnp.where(
+        esc_over,
+        jnp.maximum(nbe, npe).astype(jnp.int64) * ESC_DIV,
+        jnp.int64(0),
+    )
+
+    # ---- per-ORIGINAL-probe-row location ---------------------------------
+    # inverse of the partition sort: s_pos[i] = sorted position of row i
+    # (2-operand int32 sort, same trick as the first-match inverse)
+    iota = jnp.arange(np_, dtype=jnp.int32)
+    _, s_pos = jax.lax.sort((p_oidx, iota), num_keys=1)
+    pid_c = jnp.clip(p_pid, 0, P - 1)
+    r = s_pos - p_start[pid_c]  # slot within the partition's sorted run
+    escaped = esc_part[pid_c] & (p_pid < P)
+    in_tbl = (p_pid < P) & ~escaped & (r < probe_cap)
+    flat = pid_c * probe_cap + jnp.clip(r, 0, probe_cap - 1)
+    cnt_tbl_i = nmatch_tbl.reshape(-1)[flat]
+    # escape-buffer position of this probe row (same offsets _escape_rows
+    # packed by); rows past esc_cap count 0 — esc_over already discards
+    esc_cnt_p = jnp.where(esc_part, p_count, 0).astype(jnp.int32)
+    p_off = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(esc_cnt_p)])[:-1]
+    e_i = p_off[pid_c] + r
+    e_ok = escaped & (e_i >= 0) & (e_i < esc_cap)
+    e_c = jnp.clip(e_i, 0, esc_cap - 1)
+    counts_match = jnp.where(
+        in_tbl, cnt_tbl_i, jnp.where(e_ok, cnt_e[e_c], 0)
+    )
+
+    def get_kth(probe_of, nth):
+        pidj = pid_c[probe_of]
+        rj = jnp.clip(r[probe_of], 0, probe_cap - 1)
+        eq_rows = eq[pidj, rj]  # [out_cap, part_cap]
+        cum = jnp.cumsum(eq_rows.astype(jnp.int32), axis=-1)
+        slotv = jnp.where(
+            eq_rows & (cum == nth[:, None] + 1),
+            jnp.arange(part_cap, dtype=jnp.int32)[None, :],
+            jnp.int32(part_cap),
+        )
+        bslot = jnp.clip(slotv.min(axis=-1), 0, part_cap - 1)
+        idx_tbl = b_tbl_idx[pidj, bslot].astype(jnp.int32)
+        pos_e = jnp.clip(lo_e[e_c[probe_of]] + nth, 0, esc_cap - 1)
+        idx_esc = b_buf[perm_e[pos_e]].astype(jnp.int32)
+        return jnp.where(in_tbl[probe_of], idx_tbl, idx_esc)
+
+    res = _expand_counts(counts_match, get_kth, probe_valid, out_capacity,
+                         join_type, esc_over, base_need)
+    return res, escapes
 
 
 def radix_hash_join(
@@ -290,17 +461,24 @@ def radix_hash_join(
     join_capacity: int,
     plan: tuple,
     strategy: str | None = None,
+    build_unique: bool = True,
+    out_capacity: int | None = None,
 ):
-    """Unique-build equi-join over the radix-partitioned tables.
+    """Equi-join over the radix-partitioned tables.
 
-    Same output contract as ops/join.py's build_unique branch
-    (probe_identity layout: output slot j IS probe row j), so the builder
-    consumes the result through the identical code path.  Returns
-    (JoinResult, escapes int32) — escapes is the escaped-row count the
-    EXPLAIN ANALYZE / TRACE `join_radix` attribution reports.  The
+    build_unique=True (default — the planner-proven shape): same output
+    contract as ops/join.py's build_unique branch (probe_identity layout:
+    output slot j IS probe row j), so the builder consumes the result
+    through the identical code path.  build_unique=False (the mpp tier's
+    exchange-fed shape, ISSUE 18): the prefix-sum expansion path, same
+    contract as ops/join.py's general path — `out_capacity` sizes the
+    static output table and is required.
+
+    Returns (JoinResult, escapes int64) — escapes is the escaped-row count
+    the EXPLAIN ANALYZE / TRACE `join_radix` attribution reports.  The
     JoinResult's `need` hint carries the join-capacity rung that would
-    clear an escape-buffer overflow (0 = growth will not help: a violated
-    unique-build contract — the driver drops the hint instead)."""
+    clear an escape-buffer or out-capacity overflow (0 = growth will not
+    help: a violated unique-build contract — the driver drops the hint)."""
     n_parts, part_cap, probe_cap, esc_cap = plan
     bkeys, b_usable = _key_matrix(build_keys, build_valid)
     pkeys, p_usable = _key_matrix(probe_keys, probe_valid)
@@ -311,19 +489,37 @@ def radix_hash_join(
     P = n_parts
     mode = strategy or probe_strategy(P, part_cap, probe_cap)
 
+    if not build_unique and join_type in ("inner", "left_outer"):
+        assert out_capacity is not None, "non-unique radix join needs out_capacity"
+        if mode == "search":
+            return _expand_search(
+                bw, b_usable, pw, p_usable, probe_valid, join_type, out_capacity
+            ), jnp.int64(0)
+        # pallas's probe kernel reduces to the FIRST match only — fan-out
+        # needs every match, so pallas downgrades to the dense tables
+        return _expand_partitioned(
+            bw, b_usable, pw, p_usable, probe_valid, plan, join_capacity,
+            join_type, out_capacity,
+        )
+
+    # first-match probe: the unique-contract fast path, and semi/anti
+    # (which only consume the matched flag — fan-out never changes it)
     if mode == "search":
         # CPU-class backends: the partition tables buy nothing (no SMEM
         # to localize into) — the sorted-build binary-search probe skips
         # the combined merge sort AND the inverse sort outright
         build_idx, dup = _probe_search(bw, b_usable, pw, p_usable, nb)
         matched = build_idx >= 0
-        overflow = dup
+        hard_over = jnp.bool_(False)
         need = jnp.int64(0)
         escapes = jnp.int64(0)
     else:
-        build_idx, matched, overflow, need, escapes = _probe_partitioned(
+        build_idx, matched, dup, hard_over, need, escapes = _probe_partitioned(
             bw, b_usable, pw, p_usable, plan, join_capacity, mode,
         )
+    # dup only violates the unique-build CONTRACT — under a non-unique
+    # build (semi/anti here) it is expected fan-out, not an error
+    overflow = (hard_over | dup) if build_unique else hard_over
 
     if join_type == "semi":
         keep = probe_valid & matched

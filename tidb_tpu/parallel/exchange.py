@@ -1,113 +1,31 @@
-"""Hash-partition exchange over the mesh — the MPP shuffle analog.
-
-The reference's ExchangeSender hash-partitions rows by fnv64 over the
-encoded partition keys into per-task tunnels, and ExchangeReceiver merges
-the streams (ref: unistore/cophandler/mpp_exec.go:609-841 exchSenderExec /
-exchRecvExec; partition modes :669-719). On TPU the tunnels are a single
-`jax.lax.all_to_all` over the mesh axis: each device scatters its rows into
-P send buckets by key hash, the collective transposes buckets across
-devices, and every device ends up owning one hash partition — then local
-group aggregation (or join build/probe) runs on owned rows only.
-
-This is the sequence the scaling-book recipe calls "annotate shardings, let
-XLA insert collectives": the all_to_all is explicit here because the
-partition function is data-dependent (hash of key values).
-"""
+"""Compatibility shim — the exchange operator moved to the MPP subsystem
+(ISSUE 18): `tidb_tpu/mpp/exchange_op.py` is the one home of the hash
+partitioner, the scatter/all_to_all/flatten sequence and the exchange modes
+(hash / broadcast / passthrough). This module keeps the historical import
+path for the mesh-tier callers and tests."""
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+from ..mpp.exchange_op import (  # noqa: F401 — re-exports
+    FNV_OFFSET,
+    FNV_PRIME,
+    broadcast_exchange,
+    exchange_arrays,
+    exchange_compvals,
+    exchange_group_aggregate,
+    hash_partition_ids,
+    passthrough_exchange,
+    scatter_to_buckets,
+)
 
-from ..expr.compile import CompVal
-from ..ops.keys import sort_key_arrays
-
-FNV_OFFSET = np.int64(-3750763034362895579)  # 0xcbf29ce484222325 as i64; numpy: import-time pure
-FNV_PRIME = np.int64(1099511628211)
-
-
-def hash_partition_ids(key_vals: list[CompVal], n_parts: int) -> jax.Array:
-    """Row -> partition id in [0, n_parts) from an FNV-style hash over the
-    normalized key words (NULL hashes to partition of its zeroed words —
-    all NULLs land together, as the reference's encoded-datum hash does)."""
-    h = jnp.broadcast_to(FNV_OFFSET, key_vals[0].null.shape)
-    for kv in key_vals:
-        for w in sort_key_arrays(kv):
-            if jnp.issubdtype(w.dtype, jnp.floating):
-                # real keys stay float in sort_key_arrays (TPU x64 emulation
-                # can't bitcast f64<->s64); a f32 bitcast is supported and
-                # equal doubles hash equal, which is all partitioning needs
-                w = jax.lax.bitcast_convert_type(w.astype(jnp.float32), jnp.int32).astype(jnp.int64)
-            h = (h ^ w) * FNV_PRIME
-    # avoid negative mod
-    return jnp.abs(h % n_parts).astype(jnp.int32)
-
-
-def scatter_to_buckets(cols: list[jax.Array], valid: jax.Array, part: jax.Array, n_parts: int, bucket_cap: int):
-    """Pack rows into [n_parts, bucket_cap] send buffers by partition id.
-
-    Position within a bucket = rank of the row among same-partition rows
-    (prefix count). Returns (bucketed cols, bucket valid, overflow flag).
-    """
-    n = valid.shape[0]
-    part = jnp.where(valid, part, n_parts)  # invalid rows -> ghost bucket
-    onehot = part[:, None] == jnp.arange(n_parts + 1)[None, :]  # [n, P+1]
-    rank = jnp.cumsum(onehot, axis=0) - 1  # rank within partition
-    pos_in_bucket = jnp.take_along_axis(rank, part[:, None], axis=1)[:, 0]
-    counts = onehot.sum(axis=0)[:n_parts]
-    overflow = jnp.any(counts > bucket_cap)
-    flat_pos = part * bucket_cap + jnp.minimum(pos_in_bucket, bucket_cap - 1)
-    total = (n_parts + 1) * bucket_cap
-
-    out_valid = jnp.zeros(total, bool).at[flat_pos].set(valid & (pos_in_bucket < bucket_cap))
-    out_cols = []
-    for c in cols:
-        buf = jnp.zeros((total,) + c.shape[1:], c.dtype)
-        buf = buf.at[flat_pos].set(c)
-        out_cols.append(buf.reshape((n_parts + 1, bucket_cap) + c.shape[1:])[:n_parts])
-    return out_cols, out_valid.reshape(n_parts + 1, bucket_cap)[:n_parts], overflow
-
-
-def broadcast_exchange(mesh_axis: str, cols: list, valid):
-    """Broadcast mode (ref: mpp_exec.go:669 Broadcast partition type, the
-    TiFlash broadcast-join operand path): every device receives EVERY row.
-    Returns ([P*n]-shaped cols, valid) identical on all devices — one
-    all_gather over ICI per column."""
-    out_cols = []
-    for c in cols:
-        g = jax.lax.all_gather(c, mesh_axis, axis=0, tiled=False)  # [P, n, ...]
-        out_cols.append(g.reshape((-1,) + c.shape[1:]))
-    gv = jax.lax.all_gather(valid, mesh_axis, axis=0, tiled=False).reshape(-1)
-    return out_cols, gv
-
-
-def passthrough_exchange(mesh_axis: str, cols: list, valid, target: int = 0):
-    """PassThrough mode (ref: mpp_exec.go:669-719 PassThrough partition
-    type — the root-gather: every task streams all rows to the single
-    collector). All devices' rows land on `target`; other devices keep the
-    buffers (SPMD static shapes) with all-False validity."""
-    out_cols, gv = broadcast_exchange(mesh_axis, cols, valid)
-    me = jax.lax.axis_index(mesh_axis)
-    gv = gv & (me == target)
-    return out_cols, gv
-
-
-def exchange_group_aggregate(mesh_axis: str, key_vals, agg_fn, cols, valid, n_parts: int, bucket_cap: int):
-    """Inside shard_map: hash-exchange rows so each device owns one hash
-    partition, then run `agg_fn(owned_cols, owned_valid)` locally.
-
-    agg_fn receives rows of shape [n_parts * bucket_cap] (all rows of this
-    device's partition gathered from every peer).
-    """
-    part = hash_partition_ids(key_vals, n_parts)
-    bcols, bvalid, overflow = scatter_to_buckets(cols, valid, part, n_parts, bucket_cap)
-    # all_to_all: dim0 currently indexes destination partition; after the
-    # collective it indexes source device, and this device holds only its
-    # own partition's rows (ref: ExchangerTunnel per-task streams)
-    recv_cols = [jax.lax.all_to_all(c, mesh_axis, 0, 0, tiled=False) for c in bcols]
-    recv_valid = jax.lax.all_to_all(bvalid, mesh_axis, 0, 0, tiled=False)
-    flat_cols = [c.reshape((-1,) + c.shape[2:]) for c in recv_cols]
-    flat_valid = recv_valid.reshape(-1)
-    overflow = jax.lax.pmax(overflow.astype(jnp.int32), mesh_axis) > 0
-    return agg_fn(flat_cols, flat_valid), overflow
+__all__ = [
+    "FNV_OFFSET",
+    "FNV_PRIME",
+    "broadcast_exchange",
+    "exchange_arrays",
+    "exchange_compvals",
+    "exchange_group_aggregate",
+    "hash_partition_ids",
+    "passthrough_exchange",
+    "scatter_to_buckets",
+]

@@ -31,7 +31,7 @@ from ..exec.dag import Aggregation, DAGRequest, Selection
 from ..expr.compile import CompVal, ExprCompiler, normalize_device_column
 from ..ops import apply_selection, group_aggregate
 from ..ops.aggregate import GatherState, finalize_agg
-from .exchange import hash_partition_ids, scatter_to_buckets
+from ..mpp.exchange_op import exchange_arrays, hash_partition_ids
 from .mesh import REGION_AXIS
 
 
@@ -110,17 +110,13 @@ def agg_exchange_phases(agg, schema_fts, cvals, valid, n_parts: int, group_capac
             gkey_cols.append((gv.value[res.group_rep], gv.null[res.group_rep]))
     gvalid = res.group_valid
 
-    # -- phase 2: hash-exchange the group-state rows -----------------
+    # -- phase 2: hash-exchange the group-state rows (exchange_op) ----
     key_cvs = [
         CompVal(v, nl, g.ft) for (v, nl), g in zip(gkey_cols, agg.group_by)
     ]
     part = hash_partition_ids(key_cvs, n_parts)
     flat_arrays = [a for v, nl in state_cols + gkey_cols for a in (v, nl)]
-    bufs, bvalid, ex_overflow = scatter_to_buckets(flat_arrays, gvalid, part, n_parts, bcap)
-    recv = [jax.lax.all_to_all(b, REGION_AXIS, 0, 0, tiled=False) for b in bufs]
-    rvalid = jax.lax.all_to_all(bvalid, REGION_AXIS, 0, 0, tiled=False)
-    flat = [r.reshape((-1,) + r.shape[2:]) for r in recv]
-    fvalid = rvalid.reshape(-1)
+    flat, fvalid, ex_overflow = exchange_arrays(flat_arrays, gvalid, part, n_parts, bcap)
 
     # -- phase 3: merge-mode aggregation on the owned partition ------
     n_state = len(state_cols)
@@ -175,11 +171,7 @@ def _distinct_exchange_phases(agg, gvals, aggs, valid, n_parts: int, group_capac
     part = hash_partition_ids(gvals, n_parts)
     row_cvs = list(gvals) + [a for _, avs in aggs for a in avs]
     flat_arrays = [a for cv in row_cvs for a in (cv.value, cv.null)]
-    bufs, bvalid, ex_overflow = scatter_to_buckets(flat_arrays, valid, part, n_parts, bcap)
-    recv = [jax.lax.all_to_all(b, REGION_AXIS, 0, 0, tiled=False) for b in bufs]
-    rvalid = jax.lax.all_to_all(bvalid, REGION_AXIS, 0, 0, tiled=False)
-    flat = [r.reshape((-1,) + r.shape[2:]) for r in recv]
-    fvalid = rvalid.reshape(-1)
+    flat, fvalid, ex_overflow = exchange_arrays(flat_arrays, valid, part, n_parts, bcap)
 
     k = 0
     owned: list[CompVal] = []
@@ -251,10 +243,15 @@ def run_sharded_grouped_agg(
         return agg_exchange_phases(agg, input_fts, cvals, valid, n_parts, group_capacity, bcap)
 
     spec_batch = jax.tree.map(lambda _: P(REGION_AXIS), stacked)
+    from ..mpp.exchange_op import cached_exchange_program
     from .mesh import decode_group_mesh_outputs, group_mesh_out_spec
 
-    fn = shard_map(device_fn, mesh=mesh, in_specs=(spec_batch,), out_specs=group_mesh_out_spec(agg), check_vma=False)
-    outs = jax.jit(fn)(stacked)
+    fn = cached_exchange_program(
+        dag, mesh,
+        lambda: shard_map(device_fn, mesh=mesh, in_specs=(spec_batch,),
+                          out_specs=group_mesh_out_spec(agg), check_vma=False),
+        group_capacity, bcap)
+    outs = fn(stacked)
     # decode: [agg results..., group keys...] with Complete-mode fts —
     # the shared seam (mesh.py) both grouped paths use
     return decode_group_mesh_outputs(outs, agg)

@@ -1,201 +1,36 @@
-"""Hash-shuffle (repartition) join over the mesh — the MPP join analog
-(ref: unistore/cophandler/mpp_exec.go:609-721 exchSenderExec Hash mode with
-joinExec:844 above the receivers; fragment planning fragment.go:116).
+"""Hash-shuffle (repartition) join over the mesh — thin wrapper over the
+MPP exchange data plane (ISSUE 18).
 
-The reference hash-partitions BOTH join sides by the join-key hash across
-TiFlash nodes, joins each partition locally, and aggregates above. The TPU
-shape, as ONE shard_map program per device:
-
-  1. flatten the device's local probe regions / build slices, run the
-     scan expressions + pre-join selections on each side;
-  2. hash-partition both sides by their join keys and `all_to_all` them
-     over the ICI mesh — equal keys land on the same device because both
-     sides hash the same normalized key words (the planner unified the key
-     types, like the reference's hash-join key normalization);
-  3. local hash join (ops/join.py kernel) on the owned partition, then any
-     post-join selections;
-  4. grouped aggregation Partial1 -> group-key exchange -> Final merge —
-     the same phases as grouped.py (shared agg_exchange_phases).
-
-String payload columns ride the exchange as packed compare words (the SQL
-gate rejects strings wider than the word budget, parallel/sql.py)."""
+The device program — hash-partition BOTH join sides by the join-key hash,
+`all_to_all` them over the ICI mesh, join each owned partition locally,
+aggregate above (ref: unistore/cophandler/mpp_exec.go:609-721
+exchSenderExec Hash mode with joinExec:844 above the receivers) — lives in
+`mpp/exchange_op.py` (`run_exchange_join_agg`), and the DAG splitter that
+proves the chain shape lives with the fragment planner
+(`mpp/fragment.py` `split_join_dag`, re-exported here for the historical
+import path). This module keeps the mesh-tier entry point only."""
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
+from ..mpp.fragment import split_join_dag  # noqa: F401 — re-export
 
-from ..chunk.device import DeviceBatch
-from ..exec.dag import Aggregation, DAGRequest, Join, Selection, TableScan
-from ..expr.compile import CompVal, ExprCompiler, normalize_device_column
-from ..ops import apply_selection
-from ..ops.join import hash_join
-from .exchange import hash_partition_ids, scatter_to_buckets
-from .grouped import _flatten_local, agg_exchange_phases
-from .mesh import REGION_AXIS
-
-
-def split_join_dag(dag: DAGRequest):
-    """-> (probe_scan, pre_sels, [(join, post_sels), ...], agg) or None.
-
-    A CHAIN of shuffle joins is eligible (TPC-H Q3's 3-table shape:
-    lineitem ⋈ orders ⋈ customer — each stage re-exchanges the widened
-    schema by the next join key, ref: fragment.go stacking ExchangeSender
-    under each HashJoin). Build sides must be scan [selection]* — a join
-    nested INSIDE a build side still stays off-mesh; the planner
-    right-deepens chains so that shape is the common one."""
-    exs = dag.executors
-    if not exs or not isinstance(exs[0], TableScan):
-        return None
-    i = 1
-    pre = []
-    while i < len(exs) and isinstance(exs[i], Selection):
-        pre.append(exs[i])
-        i += 1
-    stages = []
-    while i < len(exs) and isinstance(exs[i], Join):
-        join = exs[i]
-        i += 1
-        post = []
-        while i < len(exs) and isinstance(exs[i], Selection):
-            post.append(exs[i])
-            i += 1
-        if not join.build or not isinstance(join.build[0], TableScan):
-            return None
-        if not all(isinstance(e, Selection) for e in join.build[1:]):
-            return None
-        stages.append((join, post))
-    if not stages or i != len(exs) - 1 or not isinstance(exs[i], Aggregation):
-        return None
-    return exs[0], pre, stages, exs[i]
-
-
-def _exchange_side(cvals: list[CompVal], valid, part, n_parts: int, bucket_cap: int):
-    """all_to_all one side's rows by partition id; returns (cvals, valid,
-    overflow) for the owned partition."""
-    flat = [a for c in cvals for a in (c.value, c.null)]
-    bufs, bvalid, ovf = scatter_to_buckets(flat, valid, part, n_parts, bucket_cap)
-    recv = [jax.lax.all_to_all(b, REGION_AXIS, 0, 0, tiled=False) for b in bufs]
-    rvalid = jax.lax.all_to_all(bvalid, REGION_AXIS, 0, 0, tiled=False)
-    flat_r = [r.reshape((-1,) + r.shape[2:]) for r in recv]
-    out = [
-        CompVal(flat_r[2 * i], flat_r[2 * i + 1].astype(bool), c.ft)
-        for i, c in enumerate(cvals)
-    ]
-    return out, rvalid.reshape(-1), ovf
-
-
-def _gather_cv(cols: list[CompVal], idx) -> list[CompVal]:
-    out = []
-    for c in cols:
-        if c.value.ndim == 2:
-            out.append(CompVal(c.value[idx, :], c.null[idx], c.ft))
-        else:
-            out.append(CompVal(c.value[idx], c.null[idx], c.ft))
-    return out
+__all__ = ["split_join_dag", "run_sharded_join_agg"]
 
 
 def run_sharded_join_agg(
-    dag: DAGRequest,
-    stacked_probe: DeviceBatch,
+    dag,
+    stacked_probe,
     stacked_builds: list,
     mesh,
     group_capacity: int = 1024,
     scale: int = 1,
 ):
     """Execute scan [sel] (JOIN(scan [sel]) [sel])+ GROUP BY over the mesh;
-    returns (chunk, overflow flag). Output layout matches the single-chip
-    executor: [agg results..., group keys...]. Multi-join chains (TPC-H
-    Q3) re-exchange the widened probe schema at every stage by that
-    stage's join key.
+    returns (chunk, overflow flag). Delegates to the exchange operator —
+    one shuffle-join program serves the mesh tier and the mpp tier."""
+    from ..mpp.exchange_op import run_exchange_join_agg
 
-    Exchange buckets are sized ~2x the per-device fair share (total/n) so
-    per-device post-exchange work stays ~1/n of the table — the point of
-    the repartition; `scale` (grown by the caller's overflow retry)
-    multiplies every data-dependent capacity: exchange buckets for skewed
-    keys and the join out-capacity for fan-out > 1."""
-    parts = split_join_dag(dag)
-    assert parts is not None, "not a shuffle-join DAG shape"
-    probe_scan, pre_sels, stages, agg = parts
-    if not isinstance(stacked_builds, (list, tuple)):
-        stacked_builds = [stacked_builds]
-    assert len(stacked_builds) == len(stages), "one build batch per join stage"
-    pfts = [c.ft for c in probe_scan.columns]
-    n_parts = mesh.devices.size
-
-    def device_fn(lp: DeviceBatch, *lbs):
-        pcols, pvalid = _flatten_local(lp)
-        pc = [normalize_device_column(c) for c in pcols]
-        for ex in pre_sels:
-            conds = ExprCompiler(pfts).run(list(ex.conditions), pc)
-            pvalid = apply_selection(pvalid, conds)
-        # drop raw string bytes: only packed words cross the exchange
-        pc = [CompVal(c.value, c.null, c.ft) for c in pc]
-        schema = list(pfts)
-        valid = pvalid
-        cols = pc
-        extra = jnp.bool_(False)
-
-        for (join, post_sels), lb in zip(stages, lbs):
-            bfts = [c.ft for c in join.build[0].columns]
-            bcols, bvalid = _flatten_local(lb)
-            bc = [normalize_device_column(c) for c in bcols]
-            for ex in join.build[1:]:
-                conds = ExprCompiler(bfts).run(list(ex.conditions), bc)
-                bvalid = apply_selection(bvalid, conds)
-            bc = [CompVal(c.value, c.null, c.ft) for c in bc]
-
-            # hash-partition both sides by THIS stage's join key
-            pkeys = ExprCompiler(schema).run(list(join.probe_keys), cols)
-            bkeys = ExprCompiler(bfts).run(list(join.build_keys), bc)
-            pcap = max(64, 2 * scale * valid.shape[0] // n_parts)
-            bcap_ = max(64, 2 * scale * bvalid.shape[0] // n_parts)
-            pp = hash_partition_ids(pkeys, n_parts)
-            bp = hash_partition_ids(bkeys, n_parts)
-            pc2, pvalid2, povf = _exchange_side(cols, valid, pp, n_parts, pcap)
-            bc2, bvalid2, bovf = _exchange_side(bc, bvalid, bp, n_parts, bcap_)
-
-            # local join on the owned partition (ref: joinExec above receivers)
-            pkeys2 = ExprCompiler(schema).run(list(join.probe_keys), pc2)
-            bkeys2 = ExprCompiler(bfts).run(list(join.build_keys), bc2)
-            res = hash_join(
-                bkeys2, pkeys2, bvalid2, pvalid2,
-                out_capacity=scale * pvalid2.shape[0],
-                join_type=join.join_type,
-                build_unique=join.build_unique,
-            )
-            extra = extra | povf | bovf | res.overflow
-            if join.join_type in ("semi", "anti"):
-                cols = pc2
-                valid = res.out_valid
-            else:
-                nb = bvalid2.shape[0]
-                p_g = pc2 if res.probe_identity else _gather_cv(pc2, res.probe_idx)
-                b_g = _gather_cv(bc2, jnp.clip(res.build_idx, 0, nb - 1))
-                b_g = [CompVal(c.value, c.null | res.build_null, c.ft) for c in b_g]
-                cols = p_g + b_g
-                valid = res.out_valid
-                schema = schema + (
-                    [f.clone_nullable() for f in bfts]
-                    if join.join_type == "left_outer" else bfts
-                )
-            for ex in post_sels:
-                conds = ExprCompiler(schema).run(list(ex.conditions), cols)
-                valid = apply_selection(valid, conds)
-
-        return agg_exchange_phases(
-            agg, schema, cols, valid, n_parts, group_capacity,
-            group_capacity, extra_overflow=extra,
-        )
-
-    from .compat import shard_map
-    from jax.sharding import PartitionSpec as P
-
-    from .mesh import decode_group_mesh_outputs, group_mesh_out_spec
-
-    spec_p = jax.tree.map(lambda _: P(REGION_AXIS), stacked_probe)
-    spec_bs = tuple(jax.tree.map(lambda _: P(REGION_AXIS), sb) for sb in stacked_builds)
-    fn = shard_map(device_fn, mesh=mesh, in_specs=(spec_p, *spec_bs), out_specs=group_mesh_out_spec(agg), check_vma=False)
-    outs = jax.jit(fn)(stacked_probe, *stacked_builds)
-    # decode via the shared seam (mesh.py) — same layout as grouped.py
-    return decode_group_mesh_outputs(outs, agg)
+    return run_exchange_join_agg(
+        dag, stacked_probe, stacked_builds, mesh,
+        group_capacity=group_capacity, scale=scale,
+    )

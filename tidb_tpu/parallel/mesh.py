@@ -31,8 +31,7 @@ from jax.sharding import Mesh
 
 from ..chunk import Chunk
 from ..chunk.device import DeviceBatch, to_stacked_device_batch
-
-REGION_AXIS = "region"
+from ..mpp.exchange_op import REGION_AXIS  # canonical home (ISSUE 18)
 
 
 def region_mesh(n_devices: int | None = None) -> Mesh:

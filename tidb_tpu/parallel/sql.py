@@ -18,23 +18,11 @@ from ..distsql.dispatch import KVRequest, select
 from ..exec.dag import Aggregation, DAGRequest, Selection, TableScan
 
 MESH_SYSVAR = "tidb_enable_tpu_mesh"
-# packed compare words carry the first STRING_WORDS*8 bytes across the
-# exchange; longer strings would silently truncate, so they stay off-mesh.
-# flen counts CHARACTERS (utf8mb4: up to 4 bytes each) and inserts do not
-# enforce it, so the static gate is advisory only — the authoritative check
-# measures actual bytes in the scanned chunks (_chunks_exchange_safe).
-_MAX_EXCH_STR = 32
-
-
-def _chunks_exchange_safe(chunks) -> bool:
-    """No string value in any scanned column exceeds the packed-word width
-    the exchange can carry byte-exactly."""
-    for c in chunks:
-        for col in c.columns:
-            if col.is_varlen() and len(col):
-                if int((col.offsets[1:] - col.offsets[:-1]).max()) > _MAX_EXCH_STR:
-                    return False
-    return True
+# the string width gate is a property of the EXCHANGE, not of this tier —
+# it lives with the fragment planner so every exchange consumer (mesh
+# shortcut, mpp tier) shares one check (historical aliases kept)
+from ..mpp.fragment import MAX_EXCHANGE_STR as _MAX_EXCH_STR  # noqa: E402,F401
+from ..mpp.fragment import chunks_exchange_safe as _chunks_exchange_safe  # noqa: E402,F401
 
 
 def _agg_mesh_ok(agg) -> bool:
@@ -125,84 +113,14 @@ def try_mesh_select(
 
 
 def _mesh_select(store, dag, ranges, start_ts, group_capacity, aux_chunks, kind, devs) -> Chunk | None:
-    from .grouped import run_sharded_grouped_agg
-    from .mesh import region_mesh, stack_region_batches
+    from ..mpp.dispatch import execute_exchange_plan
 
     scan = dag.executors[0]
     scan_dag = DAGRequest((scan,), output_offsets=tuple(range(len(scan.columns))))
     res = select(store, KVRequest(scan_dag, ranges, start_ts))
     chunks = [c for c in res.chunks if c is not None and c.num_rows() > 0]
-    agg = dag.executors[-1]
-    out_fts = agg.output_fts()
-    if not chunks:
-        # zero rows scanned: grouped aggregation of nothing is no groups
-        return Chunk.empty([out_fts[i] for i in dag.output_offsets])
-    if not _chunks_exchange_safe(chunks):
-        return None  # wide strings cannot ride the exchange byte-exactly
-
-    n = len(devs)
-    n_total = ((len(chunks) + n - 1) // n) * n
-    try:
-        stacked = stack_region_batches(chunks, n_total=n_total)
-    except NotImplementedError:
-        return None  # e.g. non-ASCII CI data: the per-region path's
-        # oracle fallback owns it (chunk/device.py guard)
-    mesh = region_mesh(n)
-
-    stacked_builds = None
-    if kind == "join":
-        from .joinmesh import split_join_dag
-
-        n_stages = len(split_join_dag(dag)[2])
-        if len(aux_chunks) < n_stages:
-            return None
-        stacked_builds = []
-        for build in aux_chunks[:n_stages]:
-            if not _chunks_exchange_safe([build]):
-                return None
-            if build.num_rows() == 0:
-                bslices = [build]
-            else:
-                step = (build.num_rows() + n - 1) // n
-                bslices = [
-                    build.slice(i * step, min((i + 1) * step, build.num_rows()))
-                    for i in range(n)
-                    if i * step < build.num_rows()
-                ]
-            try:
-                stacked_builds.append(stack_region_batches(bslices, n_total=n))
-            except NotImplementedError:
-                return None  # non-ASCII CI build data -> per-region path
-
-    # overflow (too many groups / join fan-out / hash collision): retry
-    # with 4x capacity — the capacity also salts the hash, mirroring
-    # drive_program's contract — reusing the scanned chunks, not rescanning
-    gc = group_capacity
-    scale = 1
-    for _ in range(3):
-        try:
-            if kind == "join":
-                from .joinmesh import run_sharded_join_agg
-
-                chunk, overflow = run_sharded_join_agg(
-                    dag, stacked, stacked_builds, mesh, group_capacity=gc, scale=scale
-                )
-            else:
-                chunk, overflow = run_sharded_grouped_agg(dag, stacked, mesh, group_capacity=gc)
-        except NotImplementedError:
-            # an op the device compiler refuses slipped past the static
-            # gate: fall back to the per-region thread-pool path, which
-            # keeps host-only work at root (mirrors store.coprocessor's
-            # oracle fallback)
-            return None
-        if not overflow:
-            from ..util import metrics
-
-            metrics.MESH_SELECTS.inc()
-            cols = [chunk.columns[i] for i in dag.output_offsets]
-            return Chunk(cols)
-        # one overflow flag covers groups, exchange buckets, and join
-        # fan-out: grow every data-dependent capacity together
-        gc *= 4
-        scale *= 4
-    return None  # caller falls back to the per-region path
+    # the stacking / build-slicing / capacity-ladder core is shared with
+    # the mpp tier (mpp/dispatch.py) — one launch plan for the exchange
+    # program regardless of which tier chose it
+    return execute_exchange_plan(dag, chunks, aux_chunks, kind, devs,
+                                 group_capacity=group_capacity)

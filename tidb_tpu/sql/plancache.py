@@ -435,6 +435,7 @@ def table_fingerprint(meta) -> tuple:
 #: the cache key, so a SET simply moves the session onto other entries
 PLAN_SYSVARS = (
     "tidb_enable_tpu_coprocessor", "tidb_enable_tpu_mesh",
+    "tidb_allow_mpp",
     "tidb_allow_batch_cop", "tidb_isolation_read_engines",
     "tidb_enable_index_merge", "sql_mode", "collation_connection",
     "time_zone", "div_precision_increment",

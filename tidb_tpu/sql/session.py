@@ -1832,21 +1832,47 @@ class Session:
                         return columnar_would_serve(
                             self.store, plan.dag, ranges, engines)
 
-                    if (self._explain_sink is None
-                            and self.sysvars.get_bool("tidb_enable_tpu_mesh")
-                            and not _columnar_routed()):
+                    if self._explain_sink is None:
                         # EXPLAIN ANALYZE wants per-executor summaries,
-                        # which only the per-region path produces
-                        # MPP analog: eligible GROUP BY plans run as ONE
-                        # shard_map program over the region mesh
-                        # (ref: fragment.go GenerateRootMPPTasks gate)
-                        from ..parallel.sql import try_mesh_select
+                        # which only the per-region path produces.
+                        # Statement tier (ref: mpp_gather.go:40): "mpp"
+                        # plans exchange-linked fragments through the
+                        # dispatch layer, "mesh" is the whole-plan
+                        # shard_map shortcut, "root" defers to
+                        # execute_root (per-request tiers + columnar)
+                        from ..distsql.planner import choose_statement_tier
 
-                        chunk = try_mesh_select(
-                            self.store, plan.dag, ranges, ts,
-                            group_capacity=self.sysvars.get_int("tidb_tpu_group_capacity"),
-                            aux_chunks=aux,
+                        decision = choose_statement_tier(
+                            plan.dag,
+                            allow_mpp=self.sysvars.get_bool("tidb_allow_mpp"),
+                            allow_mesh=self.sysvars.get_bool("tidb_enable_tpu_mesh"),
+                            columnar_routed=_columnar_routed,
                         )
+                        gc = self.sysvars.get_int("tidb_tpu_group_capacity")
+                        if decision.tier == "mpp":
+                            from ..mpp.dispatch import try_mpp_select
+
+                            chunk = try_mpp_select(
+                                self.store, plan.dag, ranges, ts,
+                                group_capacity=gc,
+                                aux_chunks=aux,
+                                engines=engines,
+                                backoff_weight=self.sysvars.get_int("tidb_backoff_weight"),
+                                checker=self._runaway_checker(),
+                            )
+                        if (chunk is None
+                                and decision.tier in ("mpp", "mesh")
+                                and not (decision.tier == "mpp" and _columnar_routed())):
+                            # mpp declined (counted fallback): the mesh
+                            # shortcut still applies unless the columnar
+                            # replica owns the plan (engine routing)
+                            from ..parallel.sql import try_mesh_select
+
+                            chunk = try_mesh_select(
+                                self.store, plan.dag, ranges, ts,
+                                group_capacity=gc,
+                                aux_chunks=aux,
+                            )
                     if chunk is None:
                         kwargs = dict(
                             start_ts=ts,

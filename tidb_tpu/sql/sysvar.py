@@ -100,6 +100,12 @@ DEFINITIONS = {
         # all_to_all exchange -> Final); needs >= 2 devices at runtime
         # (ref: TiDBAllowMPPExecution / enforce-mpp engine selection)
         SysVar("tidb_enable_tpu_mesh", "ON", "both", _bool_validator),
+        # the MPP tier above the mesh (ISSUE 18): plan eligible statements
+        # as exchange-linked fragment graphs (mpp/fragment.py) dispatched
+        # through the wire seam, probe scans served from the columnar
+        # replica when it covers the snapshot. OFF falls back to the
+        # whole-plan mesh shortcut (ref: sysvar.go TiDBAllowMPPExecution)
+        SysVar("tidb_allow_mpp", "ON", "both", _bool_validator),
         # data-size floor for the mesh DISPATCH tier (distsql/planner.py):
         # below this estimated row count the vmapped batch tier serves
         SysVar("tidb_tpu_mesh_min_rows", "0", "both", _int_validator(0, 1 << 40)),

@@ -402,6 +402,19 @@ COLUMNAR_RESOLVED_LAG = REGISTRY.gauge_vec(
     labelnames=("table",),
 )
 
+# mpp exchange data plane (ISSUE 18; ref: tiflash_coprocessor_* mpp task
+# metrics and the mpp_gather dispatch counters)
+MPP_SELECTS = REGISTRY.counter(
+    "tidb_tpu_mpp_selects_total", "SQL plans executed through the mpp exchange tier")
+MPP_FRAGMENTS = REGISTRY.counter(
+    "tidb_tpu_mpp_fragments_total", "plan fragments cut at exchange boundaries by the fragment planner")
+MPP_TASKS = REGISTRY.counter(
+    "tidb_tpu_mpp_tasks_total", "SPMD fragment tasks dispatched (fragments x mesh width)")
+MPP_FALLBACKS = REGISTRY.counter(
+    "tidb_tpu_mpp_fallbacks_total", "mpp-eligible plans that fell back (dispatch lost, exchange stall, overflow ladder exhausted, stack refusal)")
+MPP_EXCHANGED_BYTES = REGISTRY.counter(
+    "tidb_tpu_mpp_exchanged_bytes_total", "bytes entering the all_to_all exchange (probe + build sides, pre-partition)")
+
 # placement driver (tidb_tpu/pd) — its own pd_ namespace, like the
 # reference PD process exposing pd_scheduler_*/pd_hotspot_* families
 PD_REGION_HEARTBEATS = REGISTRY.counter("pd_region_heartbeat_total", "region heartbeat snapshots absorbed by the PD")

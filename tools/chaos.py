@@ -53,6 +53,8 @@ FAULT_POINTS = (
     "cdc/sink-stall",
     "columnar/apply-stall",
     "columnar/compact-stall",
+    "mpp/dispatch-lost",
+    "mpp/exchange-stall",
 )
 
 
@@ -678,9 +680,207 @@ def _apply_htap(actions, sess, fp, tid) -> None:
             _apply_cdc([action], sess, fp, tid)
 
 
+def _fill_mpp_session():
+    """The sharded 3-table chain cluster (TPC-H Q3 shape): a wide fact
+    table split over N_REGIONS regions and N_STORES stores, two dimension
+    chains, and a columnar replica on the fact table so the mpp probe can
+    source from it mid-storm."""
+    from tidb_tpu.codec import tablecodec
+    from tidb_tpu.sql.session import Session
+
+    s = Session()
+    s.execute("CREATE TABLE mpp_c (c_id BIGINT PRIMARY KEY, seg VARCHAR(2))")
+    s.execute("CREATE TABLE mpp_o (o_id BIGINT PRIMARY KEY, ckey BIGINT, odate BIGINT)")
+    s.execute("CREATE TABLE mpp_i (i_id BIGINT PRIMARY KEY, oid BIGINT, v BIGINT)")
+    s.execute("INSERT INTO mpp_c VALUES " + ",".join(
+        f"({i},'{'AB'[i % 2]}')" for i in range(12)))
+    s.execute("INSERT INTO mpp_o VALUES " + ",".join(
+        f"({i},{i % 12},{1000 + i % 9})" for i in range(48)))
+    s.execute("INSERT INTO mpp_i VALUES " + ",".join(
+        f"({i},{(i * 3) % 52},{(i * 37) % 101})" for i in range(TID_ROWS)))
+    tid = s.catalog.table("mpp_i").table_id
+    for i in range(1, N_REGIONS):
+        s.store.cluster.split(tablecodec.encode_row_key(tid, i * TID_ROWS // N_REGIONS))
+    s.store.cluster.set_stores(N_STORES)
+    s.store.cluster.scatter()
+    s.execute("SET tidb_backoff_weight = 1")
+    s.execute("ALTER TABLE mpp_i SET COLUMNAR REPLICA 1")
+    s.store.pd.tick()
+    return s, tid
+
+
+def build_mpp_workload(seed: int, n: int) -> list[str]:
+    """Exchange-eligible reads (no ORDER BY — Sort pins plans to root)
+    plus seeded DML churn; results compare as sorted row sets."""
+    rng = random.Random(seed)
+    out = []
+    for k in range(n):
+        t = rng.randrange(6)
+        if t == 0:
+            out.append(
+                "SELECT oid, count(*), sum(v) FROM mpp_i "
+                "JOIN mpp_o ON oid = o_id JOIN mpp_c ON ckey = c_id "
+                f"WHERE seg = '{'AB'[rng.randrange(2)]}' AND odate < {1002 + rng.randrange(7)} "
+                "GROUP BY oid")
+        elif t == 1:
+            out.append(
+                "SELECT ckey, count(*), sum(v) FROM mpp_i "
+                "JOIN mpp_o ON oid = ckey GROUP BY ckey")  # non-unique build
+        elif t == 2:
+            out.append(
+                f"SELECT oid, count(*) FROM mpp_i WHERE v < {rng.randrange(20, 90)} GROUP BY oid")
+        elif t == 3:
+            out.append(
+                "SELECT oid, max(v), min(v) FROM mpp_i "
+                "JOIN mpp_o ON oid = o_id GROUP BY oid")
+        elif t == 4:
+            i = rng.randrange(TID_ROWS)
+            out.append(f"UPDATE mpp_i SET v = {rng.randrange(101)} WHERE i_id = {i}")
+        else:
+            out.append(f"SELECT count(*), sum(v) FROM mpp_i WHERE oid >= {rng.randrange(40)}")
+    return out
+
+
+def mpp_schedule(n: int) -> dict[int, list[tuple]]:
+    """Store outage + leader transfer + columnar lag + the mpp/* points,
+    all mid-exchange, with a clean convergence tail."""
+    def at(frac: float) -> int:
+        return max(int(n * frac), 1)
+
+    sched: dict[int, list[tuple]] = {}
+
+    def add(i, *action):
+        sched.setdefault(i, []).append(tuple(action))
+
+    add(at(0.05), "arm", "mpp/dispatch-lost", 3)  # lost dispatches: counted
+    add(at(0.12), "disarm", "mpp/dispatch-lost")  # fallbacks, same rows
+    add(at(0.16), "down", 1)  # store outage mid-exchange: probe scan fails
+    add(at(0.26), "up", 1)  # over; mpp falls out typed or re-splits
+    add(at(0.30), "arm", "mpp/exchange-stall", 3)
+    add(at(0.38), "disarm", "mpp/exchange-stall")
+    add(at(0.42), "transfer")  # leader churn under the probe scan
+    add(at(0.48), "arm", "columnar/apply-stall", True)  # replica lags: the
+    add(at(0.56), "disarm", "columnar/apply-stall")  # probe source falls
+    add(at(0.56), "resume_columnar")  # back to the row store, counted
+    add(at(0.60), "split")
+    add(at(0.66), "arm", "columnar/compact-stall", True)
+    add(at(0.74), "disarm", "columnar/compact-stall")
+    add(at(0.78), "transfer")
+    # past at(0.78): clean tail — mpp must serve again before the end
+    return sched
+
+
+def run_mpp_storm(seed: int = 17, statements: int = 160,
+                  tick_every: int = 6) -> dict:
+    """The MPP chaos acceptance (ISSUE 18): exchange-eligible chain joins
+    and grouped aggs run under store outages, leader transfers, columnar
+    lag and the mpp/* failpoints. Every read runs TWICE back to back —
+    routed (mesh+mpp on) then row-store-forced (mesh off) — and the
+    single-threaded workload guarantees the same snapshot, so the sorted
+    row sets must be byte-identical. Failures must be typed; declines
+    must be counted fallbacks."""
+    from tidb_tpu.sql.session import SQLError
+    from tidb_tpu.util import failpoint as fp
+    from tidb_tpu.util import metrics
+
+    sess, tid = _fill_mpp_session()
+    workload = build_mpp_workload(seed, statements)
+    schedule = mpp_schedule(statements)
+    ok = typed = 0
+    wrong: list = []
+    untyped: list = []
+    mpp0 = metrics.MPP_SELECTS.value
+    falls0 = metrics.MPP_FALLBACKS.value
+    mesh0 = metrics.MESH_SELECTS.value
+
+    def run_one(sql: str):
+        nonlocal typed
+        try:
+            return sorted(map(repr, sess.execute(sql).values())), None
+        except SQLError as exc:
+            if getattr(exc, "code", 0) in (9005, 1105, 3024, 1317):
+                typed += 1
+                return None, "typed"
+            return None, f"SQLError: {exc}"
+        except Exception as exc:  # noqa: BLE001 — the bug class we hunt
+            return None, f"{type(exc).__name__}: {exc}"
+
+    from tidb_tpu.codec import tablecodec
+
+    def apply_mpp(actions):
+        for action in actions:
+            if action[0] == "split":  # _apply_cdc's split names chaos_t
+                handles = sorted(r[0] for r in sess.execute(
+                    "SELECT i_id FROM mpp_i").values())
+                if handles:
+                    mid = handles[len(handles) // 2]
+                    sess.store.cluster.split(tablecodec.encode_row_key(tid, mid))
+            elif action[0] == "resume_columnar":
+                sess.store.columnar.resume_all()
+            else:
+                _apply_cdc([action], sess, fp, tid)
+
+    try:
+        for i, sql in enumerate(workload):
+            apply_mpp(schedule.get(i, ()))
+            if sql.lstrip().upper().startswith("SELECT"):
+                # mirror oracle: routed (mesh+mpp, replica probes allowed)
+                # vs row-store-forced, same snapshot (single-threaded — no
+                # write lands between the pair)
+                sess.execute("SET tidb_isolation_read_engines = 'tpu,columnar'")
+                got, err1 = run_one(sql)
+                sess.execute("SET tidb_enable_tpu_mesh = OFF")
+                sess.execute("SET tidb_isolation_read_engines = 'tpu'")
+                want, err2 = run_one(sql)
+                sess.execute("SET tidb_enable_tpu_mesh = ON")
+                for err in (err1, err2):
+                    if err not in (None, "typed"):
+                        untyped.append({"stmt": i, "sql": sql, "error": err[:200]})
+                if got is not None and want is not None:
+                    if got != want:
+                        wrong.append({"stmt": i, "sql": sql,
+                                      "got": repr(got)[:200],
+                                      "want": repr(want)[:200]})
+                    else:
+                        ok += 1
+            else:
+                _, err = run_one(sql)
+                if err is None:
+                    ok += 1
+                elif err != "typed":
+                    untyped.append({"stmt": i, "sql": sql, "error": err[:200]})
+            if (i + 1) % tick_every == 0:
+                sess.store.pd.tick()
+    finally:
+        for name in FAULT_POINTS:
+            fp.disable(name)
+        for sid in range(N_STORES):
+            sess.store.set_up(sid)
+    sess.store.columnar.resume_all()
+    for _ in range(12):
+        sess.store.pd.tick()
+    return {
+        "seed": seed,
+        "statements": statements,
+        "ok": ok,
+        "typed_errors": typed,
+        "wrong_results": wrong,
+        "untyped_errors": untyped,
+        "mpp_selects": int(metrics.MPP_SELECTS.value - mpp0),
+        "mpp_fallbacks": int(metrics.MPP_FALLBACKS.value - falls0),
+        "mesh_selects": int(metrics.MESH_SELECTS.value - mesh0),
+    }
+
+
 def main():
     seed = int(sys.argv[1]) if len(sys.argv) > 1 else 7
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    if os.environ.get("CHAOS_MPP"):
+        report = run_mpp_storm(seed if len(sys.argv) > 1 else 17, n)
+        print(json.dumps(report, indent=2, default=str))
+        bad = (report["wrong_results"] or report["untyped_errors"]
+               or report["mpp_selects"] == 0 or report["mpp_fallbacks"] == 0)
+        sys.exit(1 if bad else 0)
     if os.environ.get("CHAOS_HTAP"):
         report = run_htap_storm(seed if len(sys.argv) > 1 else 13, n)
         print(json.dumps(report, indent=2, default=str))
