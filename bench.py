@@ -1307,8 +1307,12 @@ def _concurrent_main():
     burst against a small admission gate: every shed must be the typed
     ServerIsBusy (MySQL 9003) and every statement must eventually
     succeed on the Backoffer server_busy budget — zero untyped errors.
-    Finally the seeded chaos storm runs with the admission failpoint
-    flickering, proving shedding never corrupts results (oracle
+    The ISSUE 19 sweep then runs 64/256/1024 sessions with cross-session
+    fused execution OFF vs ON (point-get p99 vs the 64-session baseline,
+    launches saved by the read window, quorum proposals saved by group
+    commit). Finally the seeded chaos storm runs with the admission
+    failpoint flickering AND the coalescer enabled, proving neither
+    shedding nor lane fall-out ever corrupts a result (oracle
     byte-clean). Hermetic CPU."""
     try:
         from jax._src import xla_bridge as _xb
@@ -1421,6 +1425,95 @@ def _concurrent_main():
     log("concurrent: cache on...")
     on = one_phase(True)
 
+    # ---- ISSUE 19: cross-session fused execution sweep — 64/256/1024
+    # sessions of plan-cache-hit point gets + autocommit point writes,
+    # coalescing OFF (the control) vs ON. The bar: at 1024 sessions with
+    # coalescing ON, point-get p99 holds within 2x the 64-session
+    # baseline, the read window saves real device launches, and group
+    # commit makes fewer quorum proposals than it commits statements.
+    s.execute("SELECT v FROM conc_t WHERE id = 1")       # pointget tier
+    s.execute("UPDATE conc_t SET v = 31 WHERE id = 1")   # pointwrite tier
+
+    def log_appends():
+        # quorum proposals made = raft-lite log appends (propose_group
+        # counts ONE per call — the grouped fold is the thing measured)
+        return sum(g.log_len for g in s.store.replication._groups.values())
+
+    def coalesce_phase(n_sess, enable):
+        lat_point: list = []
+        lat_write: list = []
+        errs: list = []
+        conflicts: list = []
+
+        def worker(sid):
+            rng = random.Random(9000 + sid)
+            sess = Session(store=s.store, catalog=s.catalog)
+            sess.execute(
+                f"SET tidb_tpu_enable_coalesce = {'ON' if enable else 'OFF'}")
+            my_p, my_w = [], []
+            for j in range(n_stmts):
+                write = j % 4 == 3
+                if write:
+                    sql = (f"UPDATE conc_t SET v = {rng.randrange(997)} "
+                           f"WHERE id = {rng.randrange(seed_rows)}")
+                else:
+                    sql = (f"SELECT v FROM conc_t "
+                           f"WHERE id = {rng.randrange(seed_rows)}")
+                t0 = time.perf_counter()
+                try:
+                    sess.execute(sql)
+                except SQLError:
+                    conflicts.append(sid)  # write-write race: the same
+                    continue  # typed surface both modes have
+                except Exception as exc:  # noqa: BLE001 — the bug class
+                    errs.append(f"{type(exc).__name__}: {str(exc)[:120]}")
+                    continue
+                (my_w if write else my_p).append(
+                    (time.perf_counter() - t0) * 1000.0)
+            lat_point.extend(my_p)
+            lat_write.extend(my_w)
+
+        sv0 = metrics.COALESCE_LAUNCHES_SAVED.value
+        gc0 = metrics.COALESCE_GROUP_COMMITS.value
+        ps0 = metrics.COALESCE_GROUP_PROPOSALS_SAVED.value
+        ap0 = log_appends()
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(n_sess)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        lat_point.sort()
+        lat_write.sort()
+        return {
+            "sessions": n_sess,
+            "point_p50_ms": round(pct(lat_point, 0.50), 3),
+            "point_p99_ms": round(pct(lat_point, 0.99), 3),
+            "write_p99_ms": round(pct(lat_write, 0.99), 3),
+            "stmts_per_sec": round(
+                (len(lat_point) + len(lat_write)) / max(wall, 1e-9), 1),
+            "write_stmts": n_sess * (n_stmts // 4),
+            "write_conflicts": len(conflicts),
+            "proposals": int(log_appends() - ap0),
+            "launches_saved": int(metrics.COALESCE_LAUNCHES_SAVED.value - sv0),
+            "group_commits": int(metrics.COALESCE_GROUP_COMMITS.value - gc0),
+            "proposals_saved": int(
+                metrics.COALESCE_GROUP_PROPOSALS_SAVED.value - ps0),
+            "errors": errs[:5],
+        }
+
+    sweep = {"off": [], "on": []}
+    for n_sess in (64, 256, 1024):
+        for mode, enable in (("off", False), ("on", True)):
+            log(f"concurrent: coalesce sweep — {n_sess} sessions, {mode}...")
+            sweep[mode].append(coalesce_phase(n_sess, enable))
+    for rows in sweep.values():
+        base = rows[0]["point_p99_ms"]
+        for row in rows:
+            row["p99_vs_64"] = round(row["point_p99_ms"] / max(base, 1e-9), 2)
+
     # ---- saturation burst: a tiny gate with NO queue — arrivals past
     # max_inflight shed immediately, everyone retries on the budget
     gate = s.store.admission
@@ -1481,7 +1574,7 @@ def _concurrent_main():
 
     rep = chaos_mod.run_chaos(
         seed=7, statements=int(os.environ.get("BENCH_CONCURRENT_CHAOS", "80")),
-        admission_flicker=0.1)
+        admission_flicker=0.1, coalesce=True)
 
     print(json.dumps({
         "metric": "concurrent_front_door",
@@ -1494,6 +1587,8 @@ def _concurrent_main():
         "cache_off": off,
         "cache_on": on,
         "p50_ratio_off_vs_on": round(off["p50_ms"] / max(on["p50_ms"], 1e-9), 2),
+        "coalesce_sweep": sweep,
+        "chaos_coalesce": True,
         "burst": {
             "sessions": burst_n,
             "sheds": int(sheds),

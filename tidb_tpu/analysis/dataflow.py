@@ -134,6 +134,17 @@ TOPSQL_ROOTS = (
 MPP_ROOTS = (
     ("mpp/dispatch.py", None, "try_mpp_select"),
 )
+# cross-session fused execution (ISSUE 19): the coalescer's two park
+# entry points are ESCAPE and BACKOFF roots — a lane must leave with a
+# result, a typed error, or a counted fall-out (never a bare escape from
+# the batched flush), and the leader/follower waits must be deadline'd
+# condition/event waits, never a raw sleep or an unbudgeted spin. NOT
+# snapshot roots: the read flush draws ONE window ts and hands it to
+# batch_coprocessor, which the snapshot pass already polices.
+COALESCE_ROOTS = (
+    ("server/coalesce.py", "SessionCoalescer", "point_get"),
+    ("server/coalesce.py", "SessionCoalescer", "group_commit"),
+)
 SESSION_BOUNDARIES = (("sql/session.py", "Session", "execute"),)
 
 # directories whose exception classes form the "typed request-path error"
@@ -980,7 +991,7 @@ class EscapeAnalysis:
         self._sub_memo: dict = {}
         # escape only matters in the cone of the roots and the boundary
         reach = graph.reachable(
-            graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS + TOPSQL_ROOTS + MPP_ROOTS)
+            graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS + TOPSQL_ROOTS + MPP_ROOTS + COALESCE_ROOTS)
             + graph.boundaries())
         work = [graph.funcs[q] for q in sorted(reach)]
         rounds = 0
@@ -1250,7 +1261,7 @@ def _mapped_types(graph: CallGraph, boundary: FuncInfo) -> set:
 
 def run_escape(files: list[SourceFile]) -> list:
     graph = graph_for(files)
-    roots = graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS + TOPSQL_ROOTS + MPP_ROOTS)
+    roots = graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS + TOPSQL_ROOTS + MPP_ROOTS + COALESCE_ROOTS)
     boundaries = graph.boundaries()
     if not roots and not boundaries:
         return []

@@ -64,6 +64,8 @@ DESCRIPTIONS = {
     "store/transfer-leader-timeout": "times out leader-transfer attempts (breaker failover and the PD transfer-leader operator) — the operator retires as timeout and the caller backs off",
     "store/server-busy": "injects ServerIsBusy with an optional `backoff_ms` suggestion for armed stores",
     "store/unreachable": "injects StoreUnavailable for armed stores and fails their liveness probe (ping_store)",
+    "coalesce/window-stall": "wedges the coalescer window's leader past its deadline (arm with a float to choose the hold seconds) — followers outwait their patience, withdraw their unclaimed lanes, and fall back to the single path as counted `window_stall` fallbacks",
+    "coalesce/flush-lost": "loses a coalescer window's flush before any lane is answered — every lane falls out as a counted `flush_lost` fallback and re-runs its single path; no statement is lost, none launches twice",
 }
 
 _SITE = re.compile(r"""(?:failpoint|_fp|fp)\s*\.\s*(?:eval|is_armed|peek)\(\s*["']([^"']+)["']""")

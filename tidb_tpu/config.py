@@ -32,6 +32,12 @@ class Config:
     # their Top SQL cost class — heavy digests saturate (and shed) at a
     # fraction of the budget while point-gets keep their full count
     admission_cost_classed: bool = False
+    # cross-session fused execution (ISSUE 19) — bridged onto session
+    # sysvars at boot: coalesce concurrent point gets into one batched
+    # launch and autocommit writes into group commits
+    coalesce_enabled: bool = False
+    coalesce_wait_us: int = 300
+    coalesce_max_lanes: int = 64
     # observability
     enable_metrics: bool = True
     slow_query_threshold_ms: int = 300

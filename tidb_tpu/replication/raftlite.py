@@ -123,8 +123,25 @@ class ReplicaManager:
         rides the raft log). Returns False when quorum was NOT reached
         (the write is still durable on the shared KV; the flag is what
         failover consults)."""
+        return self.propose_group(region_id, [(ts, entries)],
+                                  placement=placement)
+
+    def propose_group(self, region_id: int, groups: list,
+                      placement: tuple | None = None) -> bool:
+        """Group commit (ISSUE 19): ONE log append / ack round / quorum
+        decision covering several commits against `region_id`, each at its
+        OWN timestamp — N coalesced sessions cost one raft-lite round
+        instead of N. `groups` is [(commit_ts, entries|None)]; entries are
+        delivered to the CDC hub per commit in ascending ts order, so the
+        changefeed sees exactly the per-key event sequence N separate
+        proposals would have produced."""
         from ..util import metrics
 
+        if not groups:
+            return True
+        groups = sorted(groups, key=lambda g: g[0])
+        first_ts = groups[0][0]
+        last_ts = groups[-1][0]
         if placement is not None:
             leader, peers = placement
         else:
@@ -135,7 +152,7 @@ class ReplicaManager:
         with self._mu:
             g = self._group(region_id, followers)
             prev_committed = g.committed_ts
-            g.committed_ts = max(g.committed_ts, ts)
+            g.committed_ts = max(g.committed_ts, last_ts)
             g.log_len += 1
             acks = 1  # the leader's own append
             for f in followers:
@@ -149,23 +166,28 @@ class ReplicaManager:
                 # entry, everything strictly below the new entry's ts
                 # stays servable — but it must NEVER be credited with the
                 # entry itself, so its watermark pins at ts - 1 (raft:
-                # safe_ts = first-unapplied-entry's ts - 1). The pin also
+                # safe_ts = first-unapplied-entry's ts - 1). For a grouped
+                # append the pin sits below the EARLIEST commit in the
+                # batch — crediting any later lane would let a wedged
+                # follower serve reads it never applied. The pin also
                 # clamps the lazy-bootstrap over-credit when this very
                 # proposal materialized the group (kv.max_committed()
                 # already included the write).
                 have = g.applied_ts.get(f, 0)
-                if have >= prev_committed or have >= ts:
-                    g.applied_ts[f] = ts - 1
+                if have >= prev_committed or have >= first_ts:
+                    g.applied_ts[f] = first_ts - 1
             g.quorum_ok = acks >= quorum
             if not g.quorum_ok:
                 metrics.REPLICA_QUORUM_FAILS.inc()
             ok = g.quorum_ok
         # CDC delivery OUTSIDE _mu (lock order: the hub's feed locks are
-        # leaves; a subscriber must never nest inside replication state)
-        if entries:
-            hub = getattr(self.store, "cdc", None)
-            if hub is not None:
-                hub.on_proposal(region_id, ts, entries)
+        # leaves; a subscriber must never nest inside replication state),
+        # one on_proposal per lane so every event wears its own commit ts
+        hub = getattr(self.store, "cdc", None)
+        if hub is not None:
+            for ts, entries in groups:
+                if entries:
+                    hub.on_proposal(region_id, ts, entries)
         return ok
 
     def check_write_quorum(self, region_id: int,

@@ -5,7 +5,7 @@ Lazily re-exported (PEP 562): the store tier imports
 server here would cycle back through sql -> store."""
 
 __all__ = ["MySQLServer", "MiniClient", "split_statements",
-           "AdmissionGate", "AdmissionShed"]
+           "AdmissionGate", "AdmissionShed", "SessionCoalescer"]
 
 
 def __getattr__(name):
@@ -18,4 +18,7 @@ def __getattr__(name):
     if name in ("AdmissionGate", "AdmissionShed"):
         from . import admission as _admission
         return getattr(_admission, name)
+    if name == "SessionCoalescer":
+        from .coalesce import SessionCoalescer
+        return SessionCoalescer
     raise AttributeError(name)

@@ -44,6 +44,7 @@ surfaced per statement in EXPLAIN [ANALYZE] and the
 
 from __future__ import annotations
 
+import copy
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -317,7 +318,7 @@ DECLINE_REASONS = (
     "not_select", "ddl", "set_opr", "multi_statement", "user_var",
     "in_txn", "stale_read", "for_update", "cte", "subquery",
     "derived_table", "view", "memtable", "no_table", "literal_shape",
-    "positional_ref", "uncacheable", "disabled",
+    "positional_ref", "uncacheable", "disabled", "dml_shape",
 )
 
 _DDL_KINDS = (
@@ -329,9 +330,11 @@ _DDL_KINDS = (
 
 
 def stmt_kind_reason(stmt) -> str | None:
-    """Typed decline for non-SELECT statement kinds (None = SELECT, keep
-    checking shape)."""
-    if isinstance(stmt, A.SelectStmt):
+    """Typed decline for statement kinds the cache never serves (None =
+    SELECT — keep checking shape — or UPDATE/DELETE, whose point-write
+    shapes get a `pointwrite` tier entry, ISSUE 19: the DML execute path
+    owns that shape decision and counts `dml_shape` for the rest)."""
+    if isinstance(stmt, (A.SelectStmt, A.UpdateStmt, A.DeleteStmt)):
         return None
     if isinstance(stmt, A.SetOprStmt):
         return "set_opr"
@@ -471,8 +474,12 @@ class PlanCache:
     keys — every session of a catalog consults one cache (the reference's
     instance-level plan cache)."""
 
-    def __init__(self, capacity: int = 512):
+    def __init__(self, capacity: int = 512, shared: bool = False):
         self.capacity = capacity
+        #: the shared cross-catalog instance must not drive the
+        #: tidb_tpu_plan_cache_entries gauge — that gauge tracks the
+        #: per-catalog cache, and two writers would fight over it
+        self._shared = shared
         self._mu = threading.Lock()
         self._entries: OrderedDict = OrderedDict()  # guarded_by: _mu
 
@@ -490,7 +497,8 @@ class PlanCache:
             self._entries.move_to_end(key)
             if e.bindings_rev != bindings_rev:
                 del self._entries[key]
-                metrics.PLAN_CACHE_ENTRIES.set(len(self._entries))
+                if not self._shared:
+                    metrics.PLAN_CACHE_ENTRIES.set(len(self._entries))
                 return None
             if e.catalog_version != catalog.version:
                 for name, fp in e.table_fps.items():
@@ -500,11 +508,39 @@ class PlanCache:
                         meta = None
                     if meta is None or table_fingerprint(meta) != fp:
                         del self._entries[key]
-                        metrics.PLAN_CACHE_ENTRIES.set(len(self._entries))
+                        if not self._shared:
+                            metrics.PLAN_CACHE_ENTRIES.set(len(self._entries))
                         return None
                 e.catalog_version = catalog.version  # re-validated: cheap again
             e.hits += 1
             return e
+
+    def lookup_shared(self, key, catalog):
+        """Cross-catalog lookup (ISSUE 19 satellite). A catalog.version
+        ticket is meaningless in another catalog — two catalogs' version
+        counters advance independently, so version 5 here and version 5
+        there can name different schemas. Every shared hit therefore
+        re-checks the per-table content fingerprints against the adopting
+        catalog; the returned copy carries the adopter's version ticket so
+        its promoted local entry validates cheaply from then on. Mismatch
+        returns None without evicting — the entry stays valid for its
+        home catalog."""
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None or e.bindings_rev != 0:
+                return None
+            self._entries.move_to_end(key)
+            for name, fp in e.table_fps.items():
+                try:
+                    meta = catalog.table(name)
+                except Exception:  # noqa: BLE001 — no such table here
+                    meta = None
+                if meta is None or table_fingerprint(meta) != fp:
+                    return None
+            e.hits += 1
+            out = copy.copy(e)
+            out.catalog_version = catalog.version
+            return out
 
     def put(self, key, entry: PlanCacheEntry):
         from ..util import metrics
@@ -514,27 +550,53 @@ class PlanCache:
             self._entries.move_to_end(key)
             while len(self._entries) > max(self.capacity, 1):
                 self._entries.popitem(last=False)
-                metrics.PLAN_CACHE_EVICTIONS.inc()
-            metrics.PLAN_CACHE_ENTRIES.set(len(self._entries))
+                if not self._shared:
+                    metrics.PLAN_CACHE_EVICTIONS.inc()
+            if not self._shared:
+                metrics.PLAN_CACHE_ENTRIES.set(len(self._entries))
 
     def clear(self):
         from ..util import metrics
 
         with self._mu:
             self._entries.clear()
-            metrics.PLAN_CACHE_ENTRIES.set(0)
+            if not self._shared:
+                metrics.PLAN_CACHE_ENTRIES.set(0)
 
     def stats(self) -> dict:
         with self._mu:
             return {
                 "entries": len(self._entries),
                 "tiers": {t: sum(1 for e in self._entries.values() if e.tier == t)
-                          for t in ("pointget", "dag", "ast")},
+                          for t in ("pointget", "dag", "ast", "pointwrite")},
             }
 
     def __len__(self):
         with self._mu:
             return len(self._entries)
+
+
+# ----------------------------------------------- shared cross-catalog tier
+
+#: process-wide tier behind every catalog's own cache (ISSUE 19
+#: satellite): sessions over DIFFERENT catalogs (one TPUStore per tenant)
+#: that compile the same digest against byte-identical schemas reuse one
+#: slotted template instead of paying one compile per catalog. Entries
+#: are copies — the home catalog's cache never aliases the shared one.
+SHARED_CACHE = PlanCache(256, shared=True)
+
+
+def publish_shared(key, entry: PlanCacheEntry,
+                   catalog_bindings_rev: int, session_bindings_rev: int):
+    """Offer a fresh install to the shared tier. Binding-active catalogs
+    and sessions never publish (nor adopt): a binding-shaped plan must not
+    leak into a catalog that doesn't carry that binding, and binding
+    revisions don't transfer across catalogs."""
+    if catalog_bindings_rev != 0 or session_bindings_rev != 0:
+        return
+    e = copy.copy(entry)
+    e.hits = 0
+    SHARED_CACHE.put(key, e)
 
 
 # --------------------------------------------------------- dag-tier rebind

@@ -299,6 +299,9 @@ class Session:
         self._last_plan_cache = None  # (status, reason, tier) of last consult
         self._record_digest = None  # (norm, digest) the stmt log records under
         self._bindings_rev = 0  # session-binding revision (plan-cache key part)
+        # --- cross-session fused execution (ISSUE 19) -----------------
+        self._coalesce_hint = False  # set around plan-cache-hit point gets
+        self._text_serve_type = "select"  # stmt kind of the last text-serve hit
         if config is not None:
             # instance config seeds session sysvars (ref: setGlobalVars
             # bridging config -> sysvar defaults, cmd/tidb-server/main.go:654)
@@ -319,6 +322,11 @@ class Session:
             if config.paging_size:
                 self.sysvars.set("tidb_enable_paging", "ON")
                 self.sysvars.set("tidb_max_chunk_size", str(config.paging_size))
+            # cross-session fused execution (ISSUE 19)
+            if config.coalesce_enabled:
+                self.sysvars.set("tidb_tpu_enable_coalesce", "ON")
+            self.sysvars.set("tidb_tpu_coalesce_wait_us", str(config.coalesce_wait_us))
+            self.sysvars.set("tidb_tpu_coalesce_max_lanes", str(config.coalesce_max_lanes))
             # PD scheduling knobs onto the store's placement driver
             pd = getattr(self.store, "pd", None)
             if pd is not None:
@@ -543,7 +551,8 @@ class Session:
             # commit_ts is allocated INSIDE the engine's critical section:
             # TSO monotonicity then guarantees no reader can hold a
             # read_ts >= commit_ts before the apply completes
-            self.store.txn.commit_txn(txn.mutations, txn.start_ts, self.store.next_ts)
+            if self._coalesce_commit(txn) is None:
+                self.store.txn.commit_txn(txn.mutations, txn.start_ts, self.store.next_ts)
         except TxnError as exc:
             self.store.txn.release_all(txn.start_ts)
             raise SQLError(str(exc)) from exc
@@ -559,6 +568,32 @@ class Session:
             meta = self.catalog.table_by_id(tid)
             if meta is not None:
                 meta.row_count = max(meta.row_count + delta, 0)
+
+    def _coalesce_commit(self, txn):
+        """Group-commit window for autocommit single-statement writes
+        (ISSUE 19): park the mutations in the store's coalescer so
+        concurrent sessions' commits ship as ONE quorum proposal per
+        (region, window), each lane at its own commit ts. Returns the
+        commit_ts, or None when this commit must take (or fell back to)
+        the canonical single path — a conflict inside the window releases
+        the lane's locks, so retrying via commit_txn re-stages them and
+        reproduces the exact single-session error surface."""
+        coalescer = getattr(self.store, "coalescer", None)
+        if (
+            coalescer is None
+            or txn.explicit
+            or txn.locked
+            or not self.sysvars.get_bool("tidb_tpu_enable_coalesce")
+            or len(txn.mutations)
+            > self.sysvars.get_int("tidb_tpu_coalesce_max_write_keys")
+        ):
+            return None
+        return coalescer.group_commit(
+            txn.mutations, txn.start_ts,
+            tag=topsql.current_tag(),
+            wait_us=self.sysvars.get_int("tidb_tpu_coalesce_wait_us"),
+            max_lanes=self.sysvars.get_int("tidb_tpu_coalesce_max_lanes"),
+        )
 
     def _rollback(self):
         txn, self.txn = self.txn, None
@@ -693,7 +728,9 @@ class Session:
                         # parse-free hit: the digest-keyed entry served the
                         # statement with literal values bound straight from
                         # the lexer's masked tokens — no parse, no plan
-                        stmt_type = "select"
+                        # ("select", or "update"/"delete" for the pointwrite
+                        # tier, ISSUE 19)
+                        stmt_type = self._text_serve_type
                     else:
                         with tracing.span("session.parse", sql=sql[:256]):
                             stmt = parse_one(sql)
@@ -949,9 +986,9 @@ class Session:
         if isinstance(stmt, A.InsertStmt):
             return self._autocommit_dml(lambda: self._insert(stmt))
         if isinstance(stmt, A.UpdateStmt):
-            return self._autocommit_dml(lambda: self._update(stmt))
+            return self._run_dml_cached(stmt, self._update)
         if isinstance(stmt, A.DeleteStmt):
-            return self._autocommit_dml(lambda: self._delete(stmt))
+            return self._run_dml_cached(stmt, self._delete)
         if isinstance(stmt, A.BeginStmt):
             # BEGIN implicitly commits any open txn (MySQL semantics)
             self._implicit_commit()
@@ -1515,11 +1552,18 @@ class Session:
             # distinguish them from DDL/EXPLAIN/SET text anyway, and the
             # entry lookup would land on keys the install path never fills
             return None
+        self._text_serve_type = "select"
         key = self._plan_cache_key(probe, probe.slot_kinds)
         entry = self.catalog.plan_cache.lookup(
             key, self.catalog, self.catalog.bindings_rev)
         if entry is None:
+            entry = self._plan_cache_shared_adopt(key)
+        if entry is None:
             return None
+        if entry.tier == "pointwrite":
+            # DML point-write tier (ISSUE 19): UPDATE/DELETE ... WHERE
+            # pk = ? serves parse-free through the same digest machinery
+            return self._plan_cache_serve_dml(entry, probe)
         with tracing.span("session.plan_cache") as sp:
             try:
                 self._check_privileges(entry.template)
@@ -1570,6 +1614,8 @@ class Session:
             key = self._plan_cache_key(probe, kinds)
             entry = self.catalog.plan_cache.lookup(
                 key, self.catalog, self.catalog.bindings_rev)
+            if entry is None:
+                entry = self._plan_cache_shared_adopt(key)
             if entry is not None:
                 try:
                     out = self._plan_cache_execute(entry, values)
@@ -1602,7 +1648,14 @@ class Session:
         if entry.tier == "pointget":
             det = self._point_get_detect(bound, {})
             if det is not None:
-                return self._exec_point_get(bound, *det)
+                # plan-cache-hit point gets are the coalescable tier
+                # (ISSUE 19): the hint lets _exec_point_get park in the
+                # store's micro-batch window instead of launching alone
+                self._coalesce_hint = True
+                try:
+                    return self._exec_point_get(bound, *det)
+                finally:
+                    self._coalesce_hint = False
         return self._run_select_inner(bound, None)
 
     def _plan_cache_install(self, probe, pending) -> None:
@@ -1661,6 +1714,155 @@ class Session:
             pc = self.catalog.plan_cache
             pc.capacity = self.sysvars.get_int("tidb_plan_cache_size")
             pc.put(key, entry)
+            if self.sysvars.get_bool("tidb_tpu_plan_cache_shared"):
+                _pc.publish_shared(key, entry, self.catalog.bindings_rev,
+                                   self._bindings_rev)
+        except Exception:  # noqa: BLE001 — install is best-effort; the
+            metrics.PLAN_CACHE_DECLINES.labels("uncacheable").inc()
+            self._last_plan_cache = ("decline", "uncacheable", "")
+
+    def _plan_cache_shared_adopt(self, key):
+        """Shared cross-catalog tier consult (ISSUE 19 satellite): on a
+        local miss, adopt an entry another catalog's sessions installed
+        for this digest — fingerprint-revalidated against OUR catalog,
+        then promoted into the local cache so the next hit is local.
+        Binding-active catalogs/sessions stay local: binding revisions
+        don't transfer across catalogs."""
+        from ..util import metrics
+        from . import plancache as _pc
+
+        if (not self.sysvars.get_bool("tidb_tpu_plan_cache_shared")
+                or self.catalog.bindings_rev != 0 or self._bindings_rev != 0):
+            return None
+        entry = _pc.SHARED_CACHE.lookup_shared(key, self.catalog)
+        if entry is None:
+            return None
+        metrics.PLAN_CACHE_SHARED_HITS.inc()
+        self.catalog.plan_cache.put(key, entry)
+        return entry
+
+    def _plan_cache_serve_dml(self, entry, probe) -> Result | None:
+        """Parse-free serve of a cached DML point-write (ISSUE 19): bind
+        the lexer's masked-token values into the template and run the
+        UPDATE/DELETE through the autocommit wrapper — the write reaches
+        the group-commit window without a parse or plan."""
+        from ..util import metrics
+        from . import plancache as _pc
+
+        try:
+            self._check_privileges(entry.template)
+            bound = _pc.bind_template(entry.template, list(probe.slot_values))
+        except _pc.RebindError:
+            return None  # recipe could not re-bind: replan cold
+        self._stmt_probe = None  # consumed: nested paths never re-consult
+        is_update = isinstance(bound, A.UpdateStmt)
+        self._text_serve_type = "update" if is_update else "delete"
+        # the hit counts only after the write succeeds: a conflict/abort
+        # surfaces exactly as the parse path's would, uncounted
+        res = self._autocommit_dml(
+            lambda: self._update(bound) if is_update else self._delete(bound))
+        metrics.PLAN_CACHE_HITS.inc()
+        self._last_plan_cache = ("hit", "", entry.tier)
+        return res
+
+    def _run_dml_cached(self, stmt, fn) -> Result:
+        """Top-level UPDATE/DELETE entry (ISSUE 19): point-write shapes
+        (WHERE pk = ? / pk IN (...) on an unpartitioned int-handle table)
+        install a `pointwrite` tier entry on success, so digest-equal
+        statements serve parse-free through _plan_cache_serve_dml. Other
+        shapes count a typed `dml_shape` decline. The statement itself
+        always runs the normal autocommit pipeline."""
+        import copy as _copy
+
+        from ..util import metrics
+        from . import plancache as _pc
+
+        probe = self._take_probe()
+        pending = None
+        if probe is not None and not (
+                probe.has_param or probe.has_var or probe.multi_stmt
+                or probe.n_masked == 0):
+            if not self.sysvars.get_bool("tidb_enable_plan_cache"):
+                self._last_plan_cache = ("off", "", "")
+            else:
+                reason = self._dml_shape_decline(stmt)
+                values = kinds = None
+                if reason is None:
+                    try:
+                        values, kinds = _pc.live_slot_values(stmt, probe.n_masked)
+                    except _pc.RebindError:
+                        reason = "literal_shape"
+                if reason is not None:
+                    metrics.PLAN_CACHE_DECLINES.labels(reason).inc()
+                    self._last_plan_cache = ("decline", reason, "")
+                else:
+                    metrics.PLAN_CACHE_MISSES.inc()
+                    self._last_plan_cache = ("miss", "", "")
+                    pending = (self._plan_cache_key(probe, kinds),
+                               _copy.deepcopy(stmt))
+        res = self._autocommit_dml(lambda: fn(stmt))
+        if pending is not None:
+            self._plan_cache_install_dml(probe, pending)
+        return res
+
+    def _dml_shape_decline(self, stmt) -> str | None:
+        """Typed decline for non-point DML shapes (None = cacheable
+        point write). Mirrors shape_decline's session checks, then
+        requires the WHERE clause to be a pure pk-equality the handle
+        extractor accepts."""
+        if self.txn is not None:
+            return "in_txn"
+        if self.sysvars.get("tidb_snapshot"):
+            return "stale_read"
+        if getattr(stmt, "multi_table", False):
+            return "dml_shape"
+        tbl = getattr(stmt, "table", None)
+        if not isinstance(tbl, A.TableName):
+            return "dml_shape"
+        if stmt.where is None:
+            return "dml_shape"
+        try:
+            meta = self.catalog.table(tbl.name)
+        except CatalogError:
+            return "no_table"
+        if meta.table_id < 0 or meta.partition is not None:
+            return "dml_shape"
+        if meta.handle_col is None:
+            return "dml_shape"  # no int pk: handles aren't value-addressed
+        alias = (tbl.alias or meta.name.rsplit(".", 1)[-1]).lower()
+        if self._extract_pk_handles(meta, alias, stmt.where) is None:
+            return "dml_shape"
+        return None
+
+    def _plan_cache_install_dml(self, probe, pending) -> None:
+        """Install the slotted pointwrite template after the cold DML
+        succeeded. Best-effort, like _plan_cache_install."""
+        from ..util import metrics
+        from . import plancache as _pc
+
+        key, tpl = pending
+        try:
+            kinds = _pc.wrap_slots(tpl, probe.n_masked)
+            fps = {}
+            for nm in _referenced_tables(tpl):
+                try:
+                    meta = self.catalog.table(nm)
+                except CatalogError:
+                    continue
+                fps[meta.name] = _pc.table_fingerprint(meta)
+            entry = _pc.PlanCacheEntry(
+                tier="pointwrite", template=tpl, n_slots=probe.n_masked,
+                kinds=kinds, table_fps=fps,
+                catalog_version=self.catalog.version,
+                bindings_rev=self.catalog.bindings_rev,
+                has_limit=True,  # a write returns no rows to trim
+            )
+            pc = self.catalog.plan_cache
+            pc.capacity = self.sysvars.get_int("tidb_plan_cache_size")
+            pc.put(key, entry)
+            if self.sysvars.get_bool("tidb_tpu_plan_cache_shared"):
+                _pc.publish_shared(key, entry, self.catalog.bindings_rev,
+                                   self._bindings_rev)
         except Exception:  # noqa: BLE001 — install is best-effort; the
             metrics.PLAN_CACHE_DECLINES.labels("uncacheable").inc()
             self._last_plan_cache = ("decline", "uncacheable", "")
@@ -2793,19 +2995,37 @@ class Session:
         scope = _Scope([_TableRef(meta, meta.name.rsplit(".", 1)[-1], 0)])
         lw = _Lowerer(scope)
         cond = lw.lower_base(where) if where is not None else None
-        cols = [ColumnInfo(-1, HANDLE_FT)] + list(meta.scan_columns())
-        scan = TableScan(meta.table_id, tuple(cols))
-        dag = DAGRequest((scan,), output_offsets=tuple(range(len(cols))))
-        ranges = [r for pid in meta.physical_ids() for r in full_table_ranges(pid)]
-        chunk = execute_root(self.store, dag, ranges, start_ts=ts)
-        by_handle = {int(r[0].val): r[1:] for r in chunk.rows()}
-        if self.txn is not None:
-            # read-your-writes overlay (the UnionScan analog)
-            for h, row in self.txn.row_ops.get(meta.table_id, {}).items():
-                if row is None:
-                    by_handle.pop(h, None)
-                else:
+        pinned = None
+        if where is not None and meta.handle_col is not None:
+            got = self._extract_pk_handles(
+                meta, meta.name.rsplit(".", 1)[-1].lower(), where)
+            if got is not None:
+                pinned = got[0]
+        if pinned is not None:
+            # point-write fast path (ISSUE 19): WHERE pins the primary
+            # key, so read exactly those rows instead of scanning the
+            # table. _read_row already applies the txn overlay and
+            # partition routing; the FULL where still evaluates below, so
+            # filtering is byte-equivalent to the scan path.
+            by_handle = {}
+            for h in pinned:
+                row = self._read_row(meta, h, ts)
+                if row is not None:
                     by_handle[h] = list(row)
+        else:
+            cols = [ColumnInfo(-1, HANDLE_FT)] + list(meta.scan_columns())
+            scan = TableScan(meta.table_id, tuple(cols))
+            dag = DAGRequest((scan,), output_offsets=tuple(range(len(cols))))
+            ranges = [r for pid in meta.physical_ids() for r in full_table_ranges(pid)]
+            chunk = execute_root(self.store, dag, ranges, start_ts=ts)
+            by_handle = {int(r[0].val): r[1:] for r in chunk.rows()}
+            if self.txn is not None:
+                # read-your-writes overlay (the UnionScan analog)
+                for h, row in self.txn.row_ops.get(meta.table_id, {}).items():
+                    if row is None:
+                        by_handle.pop(h, None)
+                    else:
+                        by_handle[h] = list(row)
         ev = RefEvaluator()
         out = []
         for handle in sorted(by_handle):
@@ -2991,9 +3211,27 @@ class Session:
         if meta.handle_col is None:
             return None
         alias = (stmt.from_clause.alias or meta.name).lower()
+        pinned = self._extract_pk_handles(meta, alias, stmt.where)
+        if pinned is None:
+            return None
+        handles, rest = pinned
+        # any aggregate/window in the select list leaves the fast path
+        from .planner import _has_agg, _has_window
+
+        for f in stmt.fields:
+            e = f.expr if isinstance(f, A.SelectField) else f
+            if not isinstance(e, A.Star) and (_has_agg(e) or _has_window(e)):
+                return None
+        return meta, alias, handles, rest
+
+    def _extract_pk_handles(self, meta: TableMeta, alias: str, where) -> tuple | None:
+        """WHERE-clause handle extraction shared by the point-get fast
+        path and the DML point-write tier (ISSUE 19): (pinned handles,
+        residual conjuncts) when the conjuncts pin the integer primary
+        key through eq/IN literals, else None. Pure — executes nothing."""
         from .planner import _lower_literal, _split_conjuncts
 
-        conjs = _split_conjuncts(stmt.where)
+        conjs = _split_conjuncts(where)
         if any(isinstance(c, A.SemiJoinCond) for c in conjs):
             return None  # decorrelated subquery markers need the full planner
         handles: list | None = None
@@ -3028,27 +3266,27 @@ class Session:
                 rest.append(c)
         if handles is None:
             return None
-        # any aggregate/window in the select list leaves the fast path
-        from .planner import _has_agg, _has_window
-
-        for f in stmt.fields:
-            e = f.expr if isinstance(f, A.SelectField) else f
-            if not isinstance(e, A.Star) and (_has_agg(e) or _has_window(e)):
-                return None
-        return meta, alias, handles, rest
+        return handles, rest
 
     def _exec_point_get(self, stmt: A.SelectStmt, meta, alias, handles, rest) -> tuple:
         """Execute a detected point get: read the pinned handles, filter
-        the residual conjuncts, evaluate the select list on the host."""
-        ts = self._pin_read_ts()
-        try:
-            rows = []
-            for h in handles:
-                row = self._read_row(meta, h, ts)
-                if row is not None:
-                    rows.append(row)
-        finally:
-            self._unpin_read_ts(ts)
+        the residual conjuncts, evaluate the select list on the host.
+        Plan-cache-hit statements (the _coalesce_hint window) first try
+        the store's cross-session coalescer: concurrent point gets park
+        briefly and ship as ONE batched device launch (ISSUE 19)."""
+        by_handle = self._coalesce_point_get(meta, handles)
+        if by_handle is not None:
+            rows = [by_handle[h] for h in handles if h in by_handle]
+        else:
+            ts = self._pin_read_ts()
+            try:
+                rows = []
+                for h in handles:
+                    row = self._read_row(meta, h, ts)
+                    if row is not None:
+                        rows.append(row)
+            finally:
+                self._unpin_read_ts(ts)
         scope = _Scope([_TableRef(meta, alias, 0)])
         lw = _Lowerer(scope)
         ev = RefEvaluator()
@@ -3110,6 +3348,32 @@ class Session:
 
         names = [_field_label(f) for f in fields]
         return names, [e.ft for e in exprs], out
+
+    def _coalesce_point_get(self, meta: TableMeta, handles) -> dict | None:
+        """Park this point get in the store's micro-batch window
+        (ISSUE 19): {handle: row} on a coalesced read, None when the
+        statement must take the single path — coalescing off, a session
+        state that owns its own snapshot (txn, tidb_snapshot), or a
+        value-routed (partitioned) table whose keys aren't
+        handle-addressed. Window faults also return None: the coalescer
+        reports the lane's fall-out and the single path re-reads."""
+        coalescer = getattr(self.store, "coalescer", None)
+        if (
+            coalescer is None
+            or not self._coalesce_hint
+            or self.txn is not None
+            or self.sysvars.get("tidb_snapshot")
+            or meta.partition is not None
+            or meta.table_id < 0
+            or not self.sysvars.get_bool("tidb_tpu_enable_coalesce")
+        ):
+            return None
+        return coalescer.point_get(
+            meta, handles,
+            tag=topsql.current_tag(),
+            wait_us=self.sysvars.get_int("tidb_tpu_coalesce_wait_us"),
+            max_lanes=self.sysvars.get_int("tidb_tpu_coalesce_max_lanes"),
+        )
 
     def _load_stats_json(self, path: str) -> None:
         """Minimal LoadStatsFromJSON: count/NDV/null_count/TopN land in the

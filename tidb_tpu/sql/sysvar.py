@@ -205,6 +205,20 @@ DEFINITIONS = {
         # per-SESSION memory quota parenting every query tracker (0 =
         # unlimited; ref: the server/session tracker tree in util/memory)
         SysVar("tidb_mem_quota_session", "0", "both", _int_validator(0, 1 << 60)),
+        # ---- cross-session fused execution (ISSUE 19) ------------------
+        # coalesce concurrent plan-cache-hit point gets into one batched
+        # device launch and autocommit single-row writes into group
+        # commits (OFF: every statement launches/proposes alone)
+        SysVar("tidb_tpu_enable_coalesce", "OFF", "both", _bool_validator),
+        # micro-batch window: how long the first lane waits for company
+        SysVar("tidb_tpu_coalesce_wait_us", "300", "both", _int_validator(0, 1_000_000)),
+        # lane count that closes the window early
+        SysVar("tidb_tpu_coalesce_max_lanes", "64", "both", _int_validator(1, 4096)),
+        # autocommit writes above this mutation count skip group commit
+        SysVar("tidb_tpu_coalesce_max_write_keys", "16", "both", _int_validator(1, 1024)),
+        # publish/adopt plan-cache entries through the process-wide
+        # cross-catalog tier (every shared hit fingerprint-revalidates)
+        SysVar("tidb_tpu_plan_cache_shared", "OFF", "both", _bool_validator),
         # ---- MySQL-compatibility variables -----------------------------
         SysVar("transaction_isolation", "REPEATABLE-READ", "both",
                _enum_validator("read-uncommitted", "read-committed", "repeatable-read", "serializable")),

@@ -197,7 +197,8 @@ class TPUStore:
         self.txn = TxnEngine(self.kv, on_commit=self._bump_write_ver,
                              on_apply=self.record_applied_writes,
                              pre_apply=self._check_write_quorum,
-                             write_guard=self.cdc.guard.writing)
+                             write_guard=self.cdc.guard.writing,
+                             on_apply_group=self.record_applied_writes_grouped)
         self._tso = itertools.count(100)  # guarded_by: _tso_lock
         self._tso_lock = threading.Lock()
         self._active_snapshots: dict[int, int] = {}  # guarded_by: _tso_lock
@@ -230,6 +231,14 @@ class TPUStore:
         from ..server.admission import AdmissionGate
 
         self.admission = AdmissionGate()
+        # cross-session fused execution (ISSUE 19): one coalescer per
+        # store — concurrent plan-cache-hit point-gets park in a
+        # micro-batch window and ship as ONE batch-cop launch; concurrent
+        # autocommit single-row writes fold into group commit (runtime
+        # import for the same no-cycle reason as the gate)
+        from ..server.coalesce import SessionCoalescer
+
+        self.coalescer = SessionCoalescer(self)
 
     # -- store fault switches (chaos/testing; ref: failpoint-driven store
     # outages in the reference's integration suites) ------------------------
@@ -382,6 +391,35 @@ class TPUStore:
         for rid, keys in self.cluster.group_keys_by_region(list(values)).items():
             self.replication.propose(rid, ts,
                                      entries=[(k, values[k]) for k in keys])
+
+    def record_applied_writes_grouped(self, lanes):
+        """Group-commit write flow (ISSUE 19): lanes of (applied items,
+        commit_ts) from ONE coalesced window, ascending commit ts. One
+        flow-stats batch for the whole window, then ONE replication
+        proposal per touched region carrying every lane's entries at its
+        own commit ts (ReplicaManager.propose_group) — N sessions cost
+        one quorum round per region instead of N."""
+        from ..util import metrics
+
+        flow_items = []
+        per_region: dict[int, list] = {}
+        pairs = 0
+        for applied, ts in lanes:
+            flow_items.extend(
+                (k, 0 if v is None else len(v), prev, v is None)
+                for k, v, prev in applied
+            )
+            values = {k: v for k, v, _prev in applied}
+            for rid, keys in self.cluster.group_keys_by_region(list(values)).items():
+                per_region.setdefault(rid, []).append(
+                    (ts, [(k, values[k]) for k in keys])
+                )
+                pairs += 1
+        self.pd.flow.record_writes(flow_items)
+        for rid, groups in per_region.items():
+            self.replication.propose_group(rid, groups)
+        if pairs > len(per_region):
+            metrics.COALESCE_GROUP_PROPOSALS_SAVED.inc(pairs - len(per_region))
 
     def _check_write_quorum(self, keys) -> None:
         """The pre-apply write gate (ROADMAP PR-8 follow-on): every
@@ -1180,7 +1218,17 @@ class TPUStore:
         try:
             with tracing.span("cop.batch_execute", regions=len(entries),
                               capacity=cap) as xsp:
-                stacked = to_stacked_device_batch(chunks, cap)
+                # pow2 lane axis: vmap_batch rides the ProgramCache key,
+                # so an unpadded lane count would compile a fresh program
+                # per batch size — coalesced windows (ISSUE 19) arrive at
+                # every size. Empty pad lanes cost rows=0 decode, same as
+                # the mesh tier's region-axis padding.
+                B_pad = _pow2(len(chunks))
+                lanes = list(chunks)
+                if B_pad > len(lanes):
+                    fts = chunks[0].field_types()
+                    lanes += [Chunk.empty(fts) for _ in range(B_pad - len(lanes))]
+                stacked = to_stacked_device_batch(lanes, cap)
                 per_region, info = drive_batched_program_info(
                     self.programs, dag, stacked, aux_batches, group_capacity,
                     small_groups=req0.small_groups,

@@ -64,7 +64,7 @@ class Lock:
 
 class TxnEngine:
     def __init__(self, kv: MemKV, on_commit=None, on_apply=None,
-                 pre_apply=None, write_guard=None):
+                 pre_apply=None, write_guard=None, on_apply_group=None):
         self.kv = kv
         self.locks: dict[bytes, Lock] = {}  # guarded_by: _mu
         self._mu = threading.RLock()
@@ -72,6 +72,10 @@ class TxnEngine:
         self._on_apply = on_apply  # batch hook: ([(key, value|None,
         # prev_live)], commit_ts) called AFTER the kv critical section
         # (PD write flow + replication proposal + CDC delivery)
+        self._on_apply_group = on_apply_group  # group-commit hook:
+        # ([(applied, commit_ts)]) for a whole coalesced window at once,
+        # so the store can fold every lane's changes into ONE replication
+        # proposal per region (falls back to per-lane _on_apply when unset)
         self._pre_apply = pre_apply  # keys hook BEFORE any apply: may raise
         # (the store's write-quorum gate — a refused commit applies nothing)
         self._write_guard = write_guard  # zero-arg ctx factory wrapping
@@ -183,6 +187,58 @@ class TxnEngine:
             self.release_all(start_ts)
             raise
         return self.commit(keys, start_ts, commit_ts)
+
+    def commit_group(self, reqs: list, tso) -> list:
+        """Group commit (ISSUE 19): 2PC several independent autocommit
+        transactions in ONE write-guard window and ONE kv critical
+        section, each lane committing at its OWN timestamp drawn from
+        `tso` in lane order. reqs: [(mutations dict, start_ts)]. Returns
+        one result per lane: the commit_ts on success, or the exception
+        instance for a lane that fell out (conflict / refused quorum —
+        its locks are released; the window stands for the other lanes).
+        The per-lane sequence is exactly commit_txn's — prewrite, quorum
+        gate, apply, release — so a group of one is byte-equivalent to
+        the single path."""
+        results: list = [None] * len(reqs)
+        staged_lanes: list = []  # (idx, keys, start_ts)
+        applied_lanes: list = []  # (applied, commit_ts)
+        with self._guard():  # entered BEFORE any commit ts is drawn
+            with self._mu:
+                for i, (mutations, start_ts) in enumerate(reqs):
+                    if not mutations:
+                        continue
+                    keys = list(mutations)
+                    try:
+                        self.prewrite(mutations, keys[0], start_ts)
+                        if self._pre_apply is not None:
+                            self._pre_apply(keys)
+                    except Exception as exc:  # TxnError | QuorumLostError
+                        self.release_all(start_ts)
+                        results[i] = exc
+                        continue
+                    staged_lanes.append((i, keys, start_ts))
+                with self.kv.lock:  # readers see all of a lane or none
+                    for i, keys, start_ts in staged_lanes:
+                        cts = tso()
+                        applied = []
+                        for k in keys:
+                            l = self.locks[k]
+                            v = None if l.is_delete else l.value
+                            prev = self.kv.put(k, v, cts)
+                            del self.locks[k]
+                            applied.append((k, v, prev))
+                        results[i] = cts
+                        applied_lanes.append((applied, cts))
+            if applied_lanes:  # outside the locks, inside the guard —
+                # same bracket as the single path's _on_apply
+                if self._on_apply_group is not None:
+                    self._on_apply_group(applied_lanes)
+                elif self._on_apply is not None:
+                    for applied, cts in applied_lanes:
+                        self._on_apply(applied, cts)
+        if applied_lanes and self._on_commit is not None:
+            self._on_commit()
+        return results
 
     def check_unlocked(self, keys, start_ts: int = 0):
         """Raise KeyIsLocked if any key is held by another transaction —

@@ -354,6 +354,9 @@ PLAN_CACHE_DECLINES = REGISTRY.counter_vec(
 )
 PLAN_CACHE_ENTRIES = REGISTRY.gauge(
     "tidb_tpu_plan_cache_entries", "plan templates resident in the cache")
+PLAN_CACHE_SHARED_HITS = REGISTRY.counter(
+    "tidb_tpu_plan_cache_shared_hits_total",
+    "local-miss lookups served by the shared cross-catalog tier (fingerprint-revalidated)")
 ADMISSION_ADMITTED = REGISTRY.counter(
     "tidb_tpu_admission_admitted_total", "statements admitted through the bounded statement gate")
 ADMISSION_SHED = REGISTRY.counter_vec(
@@ -364,6 +367,28 @@ ADMISSION_QUEUE_WAITS = REGISTRY.counter(
     "tidb_tpu_admission_queue_waits_total", "statements that waited in a per-session admission queue")
 ADMISSION_INFLIGHT = REGISTRY.gauge(
     "tidb_tpu_admission_inflight", "statements currently executing inside the admission gate")
+# cross-session fused execution (ISSUE 19) — the per-store session
+# coalescer: point-get micro-batch windows + group-commit write batching
+COALESCE_BATCHES = REGISTRY.counter(
+    "tidb_tpu_coalesce_batches_total", "coalescer micro-batch windows flushed (read launches + write group commits)")
+COALESCE_LANES = REGISTRY.counter_vec(
+    "tidb_tpu_coalesce_lanes_total", "session lanes served through a coalesced window, by kind",
+    labelnames=("kind",),
+)
+COALESCE_LAUNCHES_SAVED = REGISTRY.counter(
+    "tidb_tpu_coalesce_launches_saved_total", "device launches avoided by cross-session point-get coalescing (lanes - launches)")
+COALESCE_FALLBACKS = REGISTRY.counter_vec(
+    "tidb_tpu_coalesce_fallbacks_total", "lanes that fell out of a window to the single path, by typed reason",
+    labelnames=("reason",),
+)
+COALESCE_GROUP_COMMITS = REGISTRY.counter(
+    "tidb_tpu_coalesce_group_commits_total", "write lanes committed through a group-commit window")
+COALESCE_GROUP_PROPOSALS_SAVED = REGISTRY.counter(
+    "tidb_tpu_coalesce_group_proposals_saved_total", "quorum proposals avoided by folding lanes into per-region group proposals")
+COALESCE_WINDOW_WAIT = REGISTRY.histogram(
+    "tidb_tpu_coalesce_window_wait_seconds", "time a lane parked in the coalescer window before flush",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05),
+)
 OPEN_TXNS = REGISTRY.gauge("tidb_tpu_open_txns", "transactions currently open")
 NATIVE_DECODES = REGISTRY.counter("tidb_tpu_native_decode_batches_total", "region batches decoded by the C++ rowcodec")
 NATIVE_DECODE_FALLBACKS = REGISTRY.counter("tidb_tpu_native_decode_fallbacks_total", "native decode errors served by the python decoder")

@@ -176,7 +176,7 @@ def _apply(actions, sess, fp) -> None:
 
 def run_chaos(seed: int = 7, statements: int = 200, fault_rate: float | None = None,
               tick_every: int = 10, admission_flicker: float = 0.0,
-              cost_classed: bool = False) -> dict:
+              cost_classed: bool = False, coalesce: bool = False) -> dict:
     """Run the workload under the fault schedule; returns the invariant
     report. Raises nothing on query failures — failures are CLASSIFIED:
     typed retryable errors are expected under faults, wrong answers and
@@ -187,7 +187,11 @@ def run_chaos(seed: int = 7, statements: int = 200, fault_rate: float | None = N
     storm with Top SQL attribution ON and the admission gate in
     measured-cost mode (ISSUE 17): every statement classifies + admits
     through the per-class lanes while faults fly — any shed must still be
-    typed 9003 and the answer oracle must stay clean."""
+    typed 9003 and the answer oracle must stay clean. `coalesce`
+    runs the storm with cross-session fused execution ON (ISSUE 19):
+    plan-cache-hit point gets route through the coalescer window and
+    autocommit writes through group commit — faulted lanes must fall
+    out to the single path, never corrupt an answer."""
     from tidb_tpu.sql.session import SQLError
     from tidb_tpu.util import failpoint as fp
     from tidb_tpu.util import metrics
@@ -198,6 +202,8 @@ def run_chaos(seed: int = 7, statements: int = 200, fault_rate: float | None = N
 
     s = _fill_session(split_regions=True)
     store = s.store
+    if coalesce:
+        s.execute("SET tidb_tpu_enable_coalesce = ON")
     if cost_classed:
         # measured-cost admission under the storm: Top SQL tags every
         # statement, the EWMAs learn live, the gate weighs each admit by
