@@ -297,11 +297,17 @@ class TestStaleness:
         assert metrics.COLUMNAR_FALLBACKS.value > fb0
 
 
-# ------------------------------------------- mid-feed DDL guard (satellite)
+# ---------------------------------- mid-feed DDL through the feed (ISSUE 20)
 
-class TestSchemaDriftGuard:
-    def test_alter_mid_feed_parks_instead_of_mounting(self):
-        from tidb_tpu.cdc import MemorySink
+class TestSchemaChangeThroughFeed:
+    """The pre-ISSUE-20 guard PARKED any feed whose table shape moved.
+    DDL now replicates THROUGH the feed as an ordered SchemaEvent (the
+    mounter tracks a per-feed snapshot advanced only by the schema
+    stream), so a mid-feed ALTER is an event, never a park — and the
+    legacy SchemaDriftError survives only as a counted fallback."""
+
+    def test_alter_mid_feed_replicates_as_ordered_event(self):
+        from tidb_tpu.cdc import MemorySink, SchemaEvent
 
         s = Session()
         s.execute("CREATE TABLE g (id BIGINT PRIMARY KEY, v BIGINT)")
@@ -311,46 +317,26 @@ class TestSchemaDriftGuard:
         s.execute("INSERT INTO g VALUES (1, 10)")
         s.store.cdc.tick()
         assert len(feed.sink.rows()) == 1
+        ckpt_before = feed.view(s.store)["checkpoint_ts"]
         s.execute("ALTER TABLE g ADD COLUMN w BIGINT DEFAULT 7")
         s.execute("INSERT INTO g VALUES (2, 20, 21)")
-        s.store.cdc.tick()
-        v = feed.view(s.store)
-        assert v["state"] == "error"
-        assert "schema drift" in v["error"]
-        assert len(feed.sink.rows()) == 1  # nothing mounted on drift
-        checkpoint_held = v["checkpoint_ts"]
-        s.store.cdc.tick()
-        assert feed.view(s.store)["checkpoint_ts"] == checkpoint_held
-
-    def test_resume_restamps_and_replays(self):
-        from tidb_tpu.cdc import MemorySink
-
-        s = Session()
-        s.execute("CREATE TABLE g (id BIGINT PRIMARY KEY, v BIGINT)")
-        meta = s.catalog.table("g")
-        feed = s.store.cdc.create("gf", MemorySink(), s.catalog,
-                                  table_ids={meta.table_id}, start_ts=0)
-        s.execute("INSERT INTO g VALUES (1, 10)")
-        s.store.cdc.tick()
-        s.execute("ALTER TABLE g ADD COLUMN w BIGINT DEFAULT 7")
-        s.execute("INSERT INTO g VALUES (2, 20, 21)")
-        s.store.cdc.tick()
-        assert feed.view(s.store)["state"] == "error"
-        s.store.cdc.resume("gf")  # the operator accepts the new schema
         s.store.cdc.tick()
         v = feed.view(s.store)
         assert v["state"] == "normal" and v["error"] == ""
-        rows = feed.sink.rows()
-        assert [r.handle for r in rows] == [1, 2]
-        assert dict(rows[1].columns)["w"].val == 21  # mounted on NEW schema
+        assert v["checkpoint_ts"] > ckpt_before  # never held by the DDL
+        events = feed.sink.rows()
+        assert [type(e).__name__ for e in events[1:]] == ["SchemaEvent", "RowEvent"]
+        ddl = events[1]
+        assert isinstance(ddl, SchemaEvent) and ddl.op == "add column"
+        assert "alter table g" in ddl.query.lower() and ddl.schema_version == 1
+        assert ddl.commit_ts < events[2].commit_ts  # ordered, not out-of-band
+        assert dict(events[2].columns)["w"].val == 21  # mounted on NEW shape
 
-    def test_resume_after_unrelated_park_still_catches_drift(self):
-        """RESUME only acknowledges a drift the operator actually SAW
-        (the park reason was SchemaDriftError). A feed paused before the
-        ALTER keeps its birth stamps across resume, so the old-shape
-        backlog still parks instead of silently mounting against the
-        new catalog (review finding)."""
-        from tidb_tpu.cdc import MemorySink
+    def test_paused_feed_across_alter_resumes_without_parking(self):
+        """A feed paused BEFORE the ALTER drains its old-shape backlog
+        and the schema event in commit order on resume — the case that
+        used to need a double RESUME to acknowledge the drift."""
+        from tidb_tpu.cdc import MemorySink, SchemaEvent
 
         s = Session()
         s.execute("CREATE TABLE g (id BIGINT PRIMARY KEY, v BIGINT)")
@@ -358,31 +344,80 @@ class TestSchemaDriftGuard:
         feed = s.store.cdc.create("gf", MemorySink(), s.catalog,
                                   table_ids={meta.table_id}, start_ts=0)
         s.execute("INSERT INTO g VALUES (1, 10)")
-        s.store.cdc.pause("gf")  # parked for an UNRELATED reason
+        s.store.cdc.pause("gf")
         s.execute("ALTER TABLE g ADD COLUMN w BIGINT DEFAULT 7")
-        s.store.cdc.resume("gf")  # must NOT absorb the drift
-        s.store.cdc.tick()
-        v = feed.view(s.store)
-        assert v["state"] == "error"
-        assert "schema drift" in v["error"]
-        assert feed.sink.rows() == []  # nothing mounted on the new catalog
-        s.store.cdc.resume("gf")  # NOW the drift was seen: acknowledged
+        s.execute("INSERT INTO g VALUES (2, 20, 21)")
+        s.store.cdc.resume("gf")
         s.store.cdc.tick()
         assert feed.view(s.store)["state"] == "normal"
-        assert [r.handle for r in feed.sink.rows()] == [1]
+        events = feed.sink.rows()
+        rows = [e for e in events if not isinstance(e, SchemaEvent)]
+        assert [r.handle for r in rows] == [1, 2]
+        assert "w" not in dict(rows[0].columns)  # old row, old shape
+        assert dict(rows[1].columns)["w"].val == 21
+        assert sum(isinstance(e, SchemaEvent) for e in events) == 1
 
-    def test_parked_columnar_feed_degrades_scans_to_row_store(self):
+    def test_unexplained_drift_counts_legacy_fallback_not_park(self):
+        """Bytes the tracked snapshot cannot decode AND the schema
+        stream never explained: the mounter re-decodes against the live
+        catalog as a counted CDC_SCHEMA_DRIFT_LEGACY fallback — the
+        typed park is gone."""
+        from tidb_tpu.cdc import MemorySink
+        from tidb_tpu.cdc.schema import ColumnSnap, SchemaSnapshot
+
+        s = Session()
+        s.execute("CREATE TABLE g (id BIGINT PRIMARY KEY, v BIGINT)")
+        meta = s.catalog.table("g")
+        feed = s.store.cdc.create("gf", MemorySink(), s.catalog,
+                                  table_ids={meta.table_id}, start_ts=0)
+        s.execute("INSERT INTO g VALUES (1, 10)")
+        s.store.cdc.tick()
+        # wedge the tracked snapshot with a shape the row bytes cannot
+        # satisfy — a schema move the journal never carried (ft=None on a
+        # STORED column makes decode_row_value raise)
+        vid = next(c.col_id for c in meta.columns if c.name == "v")
+        with feed.mounter._mu:
+            feed.mounter._tracked[meta.table_id] = SchemaSnapshot(
+                0, (ColumnSnap("v", vid, None, None),))
+        d0 = metrics.CDC_SCHEMA_DRIFT_LEGACY.value
+        s.execute("INSERT INTO g VALUES (2, 20)")
+        s.store.cdc.tick()
+        assert metrics.CDC_SCHEMA_DRIFT_LEGACY.value > d0
+        assert feed.view(s.store)["state"] == "normal"  # counted, not parked
+        assert [r.handle for r in feed.sink.rows()] == [1, 2]
+        # the fallback re-tracked the live shape: the next row is clean
+        d1 = metrics.CDC_SCHEMA_DRIFT_LEGACY.value
+        s.execute("INSERT INTO g VALUES (3, 30)")
+        s.store.cdc.tick()
+        assert metrics.CDC_SCHEMA_DRIFT_LEGACY.value == d1
+        assert [r.handle for r in feed.sink.rows()] == [1, 2, 3]
+
+    def test_columnar_replica_reshapes_and_keeps_serving(self):
+        """The ColumnarSink applies the replicated ALTER as a reshape of
+        the attached replica (old rows backfill the origin default) and
+        keeps consuming — scans stay on the replica, no park, no rebuild
+        toggle."""
         s = make_replicated(rows=8)
         s.execute("ALTER TABLE t ADD COLUMN extra BIGINT DEFAULT 0")
         s.execute("INSERT INTO t VALUES (90, 1, 1, 5)")
-        s.store.pd.tick()  # the columnar feed parks on drift
-        assert s.store.columnar.views()[0]["state"] == "error"
-        fb0 = metrics.COLUMNAR_FALLBACKS.value
+        r0 = metrics.COLUMNAR_RESHAPES.value
+        s.store.pd.tick()
+        assert metrics.COLUMNAR_RESHAPES.value > r0
+        assert s.store.columnar.views()[0]["state"] == "normal"
+        sc0 = metrics.COLUMNAR_SCANS.value
         got, want = both_engines(s, "SELECT count(*), sum(extra) FROM t")
         assert got == want
         assert got[0][0] == 9 and str(got[0][1]) == "5"
-        # the replica held the OLD schema: routed-then-declined fallback
-        assert metrics.COLUMNAR_FALLBACKS.value > fb0
+        assert metrics.COLUMNAR_SCANS.value > sc0  # served, not fallen back
+
+    def test_change_column_rename_reshapes_in_place(self):
+        s = make_replicated(rows=6)
+        s.execute("ALTER TABLE t CHANGE COLUMN v vol BIGINT")
+        s.execute("INSERT INTO t VALUES (90, 4, 1)")
+        s.store.pd.tick()
+        assert s.store.columnar.views()[0]["state"] == "normal"
+        got, want = both_engines(s, "SELECT count(*), sum(vol) FROM t")
+        assert got == want and got[0][0] == 7
 
     def test_partition_moving_update_keeps_the_row(self):
         """An UPDATE that moves a row across partitions emits delete(old
@@ -405,29 +440,28 @@ class TestSchemaDriftGuard:
         assert got == want
         assert got[0][0] == 3  # the moved row survived the tombstone fan
 
-    def test_post_resume_new_shape_rows_park_with_rebuild_reason(self):
-        """After a column DDL parks the columnar feed, RESUME re-stamps —
-        but the replica's layers are frozen at the OLD row shape, so the
-        sink parks again with the rebuild instruction instead of
-        applying misaligned rows (review finding); a 0-then-1 replica
-        toggle rebuilds and serves again."""
+    def test_reshape_remaps_uncompacted_delta_rows(self):
+        """An ALTER landing while old-shape rows still sit in the delta
+        layer (compaction stalled) must remap delta AND stable under the
+        new shape — the misaligned-rows bug the old rebuild park
+        guarded against."""
         s = make_replicated(rows=4)
-        s.execute("ALTER TABLE t ADD COLUMN extra BIGINT DEFAULT 0")
-        s.execute("INSERT INTO t VALUES (90, 1, 1, 5)")
-        s.store.pd.tick()  # parks on schema drift
-        assert s.store.columnar.views()[0]["state"] == "error"
-        s.store.columnar.resume_all()  # operator accepts the new schema
-        s.store.pd.tick()  # replays — the sink refuses the new shape
-        v = s.store.columnar.views()[0]
-        assert v["state"] == "error"
-        assert "rebuild" in s.execute("SHOW CHANGEFEEDS").values()[0][9]
-        s.execute("ALTER TABLE t SET COLUMNAR REPLICA 0")
-        s.execute("ALTER TABLE t SET COLUMNAR REPLICA 1")  # the rebuild
-        s.store.pd.tick()
-        sc0 = metrics.COLUMNAR_SCANS.value
+        failpoint.enable("columnar/compact-stall", True)
+        try:
+            s.execute("INSERT INTO t VALUES (50, 2, 1)")  # old shape, delta
+            s.store.pd.tick()  # applied but NOT compacted
+            s.execute("ALTER TABLE t ADD COLUMN extra BIGINT DEFAULT 3")
+            s.execute("INSERT INTO t VALUES (90, 1, 1, 5)")
+            s.store.pd.tick()  # reshape + new-shape apply, still stalled
+            assert s.store.columnar.views()[0]["state"] == "normal"
+            got, want = both_engines(s, "SELECT count(*), sum(extra) FROM t")
+            assert got == want
+            assert got[0][0] == 6 and str(got[0][1]) == str(3 * 5 + 5)
+        finally:
+            failpoint.disable("columnar/compact-stall")
+        s.store.pd.tick()  # drain: compaction folds the remapped delta
         got, want = both_engines(s, "SELECT count(*), sum(extra) FROM t")
-        assert got == want
-        assert metrics.COLUMNAR_SCANS.value > sc0
+        assert got == want and got[0][0] == 6
 
     def test_index_ddl_does_not_park(self):
         s = make_replicated(rows=8)

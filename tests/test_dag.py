@@ -256,3 +256,49 @@ class TestFullSort:
             s.store.cluster.split(tablecodec.encode_row_key(meta.table_id, h))
         got = [int(x[0].val) for x in s.execute("select a from srt2 order by a").rows]
         assert got == sorted(got) and len(got) == 300
+
+
+class TestProgramCacheSingleFlight:
+    """A cold key hit by N pool threads at once must compile exactly once —
+    the launch-count regression guard (compiles/hits, the TRACE cache_hit
+    attr) is meaningless if it's timing-dependent."""
+
+    def test_concurrent_cold_miss_compiles_once(self, monkeypatch):
+        import threading
+        import time
+        from concurrent.futures import ThreadPoolExecutor
+
+        from tidb_tpu.exec import DAGRequest, Selection, TableScan, ColumnInfo
+        from tidb_tpu.exec import builder as builder_mod
+        from tidb_tpu.exec.builder import ProgramCache
+        from tidb_tpu.expr import col, func, lit
+        from tidb_tpu.types import new_longlong
+
+        real_build = builder_mod.build_program
+        started = threading.Barrier(4, timeout=10)
+
+        def slow_build(*a, **kw):
+            time.sleep(0.05)  # hold the miss window open for every racer
+            return real_build(*a, **kw)
+
+        monkeypatch.setattr(builder_mod, "build_program", slow_build)
+        ft = new_longlong(notnull=True)
+        pred = func("gt", BOOL, col(0, ft), lit(0, ft))
+        dag = DAGRequest(
+            (TableScan(1, (ColumnInfo(1, ft),)), Selection((pred,))),
+            output_offsets=(0,),
+        )
+        cache = ProgramCache()
+
+        def fetch():
+            started.wait()
+            return cache.get_info(dag, (64,))
+
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            results = [f.result() for f in [pool.submit(fetch) for _ in range(4)]]
+        assert cache.stats()["compiles"] == 1
+        assert cache.stats()["hits"] == 3
+        assert sorted(hit for _, hit, _ in results) == [False, True, True, True]
+        progs = {id(p) for p, _, _ in results}
+        assert len(progs) == 1  # every thread got the one compiled program
+        assert not cache._inflight  # claim released

@@ -1,0 +1,375 @@
+"""Point-in-time recovery (ISSUE 20): log backup riding the CDC stream
+as a raw changefeed with atomic segment+manifest writes, replay-to-ts
+RESTORE over the latest full backup with typed gap detection and a
+resumable per-segment checkpoint, DDL replication through the feed, the
+sliding GC safepoint, the pd.pitr tick phase, and the CHAOS_PITR storm
+acceptance (ref: br/pkg/stream + br/pkg/restore's PiTR path)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from tidb_tpu.br import (
+    LogGapError,
+    ReplayInterrupted,
+    log_backup_views,
+    restore_until,
+    start_log_backup,
+)
+from tidb_tpu.codec import tablecodec
+from tidb_tpu.sql.session import Session, SQLError
+from tidb_tpu.util import failpoint, metrics
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def make_session():
+    s = Session()
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v BIGINT, name VARCHAR(16))")
+    return s
+
+
+def rows_of(s, table="t"):
+    return s.execute(f"SELECT * FROM {table} ORDER BY 1").values()
+
+
+def pitr_cluster(tmp_path, n=6):
+    """Session + full backup + attached log backup under tmp_path; n
+    seed rows land BEFORE the full backup."""
+    s = make_session()
+    if n:
+        s.execute("INSERT INTO t VALUES " + ",".join(
+            f"({i},{i * 10},'r{i}')" for i in range(n)))
+    root = str(tmp_path / "bk")
+    s.execute(f"BACKUP DATABASE * TO '{os.path.join(root, 'full', 'b0')}'")
+    s.execute(f"BACKUP LOG TO 'file://{root}'")
+    return s, root
+
+
+# ------------------------------------------------------------- log backup
+
+class TestLogBackup:
+    def test_sql_lifecycle_and_show(self, tmp_path):
+        s, root = pitr_cluster(tmp_path)
+        row = s.execute("SHOW BACKUP LOGS").values()[0]
+        assert row[0] == f"file://{root}" and row[2] == "normal"
+        s.execute("INSERT INTO t VALUES (50, 1, 'x')")
+        s.store.pd.tick()  # the pd.cdc phase drives the raw feed
+        row = s.execute("SHOW BACKUP LOGS").values()[0]
+        assert row[6] >= 1 and row[7] >= 1  # segments, events
+        assert row[4] >= s.store.kv.max_committed()  # checkpoint caught up
+        with pytest.raises(SQLError):  # second attach to the same dest
+            s.execute(f"BACKUP LOG TO 'file://{root}'")
+        s.execute(f"STOP BACKUP LOG TO 'file://{root}'")
+        assert s.execute("SHOW BACKUP LOGS").values() == []
+        with pytest.raises(SQLError):
+            s.execute(f"STOP BACKUP LOG TO 'file://{root}'")
+
+    def test_segments_chain_and_end_in_resolved_marks(self, tmp_path):
+        s, root = pitr_cluster(tmp_path)
+        for i in range(3):
+            s.execute(f"INSERT INTO t VALUES ({60 + i}, {i}, 'w')")
+            s.store.pd.tick()
+        man = json.loads(open(os.path.join(root, "log", "manifest.json")).read())
+        segs = man["segments"]
+        assert len(segs) >= 2
+        prev_resolved = 0
+        for seg in segs:
+            # the chain: each link starts where the previous segment ended
+            assert seg["base_ts"] == prev_resolved
+            assert seg["min_ts"] > seg["base_ts"]
+            assert seg["max_ts"] <= seg["resolved_ts"]
+            prev_resolved = seg["resolved_ts"]
+            lines = open(os.path.join(root, "log", seg["file"])).read().splitlines()
+            last = json.loads(lines[-1])
+            assert last == {"t": "resolved", "ts": seg["resolved_ts"]}
+            assert sum(1 for ln in lines if json.loads(ln).get("t") == "kv") == seg["events"]
+        assert man["checkpoint_ts"] >= prev_resolved
+
+    def test_reattach_resumes_chain_without_duplicates(self, tmp_path):
+        s, root = pitr_cluster(tmp_path)
+        s.execute("INSERT INTO t VALUES (50, 1, 'x')")
+        s.store.pd.tick()
+        s.execute(f"STOP BACKUP LOG TO 'file://{root}'")
+        s.execute("INSERT INTO t VALUES (51, 2, 'y')")  # while detached
+        s.execute(f"BACKUP LOG TO 'file://{root}'")  # re-attach resumes
+        s.store.pd.tick()
+        lb = next(iter(s.store.log_backups.values()))
+        seen = set()
+        for rec in lb.sink.writer.read_records():
+            if rec.get("t") != "kv":
+                continue
+            assert (rec["k"], rec["ts"]) not in seen
+            seen.add((rec["k"], rec["ts"]))
+        # the detach-window write was recovered by the incremental scan
+        assert lb.sink.checkpoint_ts >= s.store.kv.max_committed()
+        until = s.store.next_ts()
+        s.store.pd.tick()  # the checkpoint must pass the cut to prove it
+        r = Session()
+        r.execute(f"RESTORE DATABASE * FROM '{root}' UNTIL TS = {until}")
+        assert rows_of(r) == rows_of(s)
+
+    def test_checkpoint_slides_the_gc_safepoint(self, tmp_path):
+        s, root = pitr_cluster(tmp_path, n=0)
+        s.execute("INSERT INTO t VALUES (1, 10, 'a')")
+        s.execute("UPDATE t SET v = 11 WHERE id = 1")  # two versions
+        key = tablecodec.encode_row_key(s.catalog.table("t").table_id, 1)
+        s.store.run_gc(safepoint=s.store.kv.max_committed() + 1)
+        with s.store.kv.lock:
+            n_held = len(s.store.kv._data.get(key, ()))
+        assert n_held == 2  # the feed's safepoint pinned the old version
+        s.store.pd.tick()  # flush: the checkpoint (and safepoint) slide
+        s.store.run_gc(safepoint=s.store.kv.max_committed() + 1)
+        with s.store.kv.lock:
+            n_after = len(s.store.kv._data.get(key, ()))
+        assert n_after == 1  # released: GC may fold history the log holds
+
+
+# -------------------------------------------------------- replay-to-ts
+
+class TestRestoreUntil:
+    def test_restore_to_mid_ts_is_byte_exact(self, tmp_path):
+        s, root = pitr_cluster(tmp_path)
+        s.execute("INSERT INTO t VALUES (50, 1, 'x')")
+        s.execute("UPDATE t SET v = 2 WHERE id = 50")
+        s.store.pd.tick()
+        mid_ts = s.store.next_ts()
+        oracle_mid = rows_of(s)
+        s.execute("DELETE FROM t WHERE id = 0")
+        s.execute("INSERT INTO t VALUES (51, 3, 'y')")
+        s.store.pd.tick()
+        end_ts = s.store.next_ts()
+        oracle_end = rows_of(s)
+        s.store.pd.tick()  # the checkpoint must pass end_ts to prove it
+
+        r1 = Session()
+        res = r1.execute(f"RESTORE DATABASE * FROM '{root}' UNTIL TS = {mid_ts}")
+        assert rows_of(r1) == oracle_mid  # no id=51, no delete, v=2
+        assert int(res.values()[0][1]) == mid_ts
+        r2 = Session()
+        r2.execute(f"RESTORE DATABASE * FROM '{root}' UNTIL TS = {end_ts}")
+        assert rows_of(r2) == oracle_end
+        # the restored cluster is live: TSO moved past the cut
+        r2.execute("INSERT INTO t VALUES (99, 9, 'z')")
+        assert len(rows_of(r2)) == len(oracle_end) + 1
+
+    def test_ddl_replays_through_the_feed_to_the_right_cut(self, tmp_path):
+        s, root = pitr_cluster(tmp_path, n=2)
+        s.store.pd.tick()
+        pre_ddl_ts = s.store.next_ts()
+        pre_rows = rows_of(s)
+        s.execute("ALTER TABLE t ADD COLUMN w BIGINT DEFAULT 7")
+        s.execute("INSERT INTO t VALUES (50, 1, 'x', 8)")
+        s.store.pd.tick()
+        post_ddl_ts = s.store.next_ts()
+        post_rows = rows_of(s)
+        s.store.pd.tick()  # the checkpoint must pass post_ddl_ts
+
+        r_old = Session()
+        r_old.execute(f"RESTORE DATABASE * FROM '{root}' UNTIL TS = {pre_ddl_ts}")
+        assert rows_of(r_old) == pre_rows  # 3-column shape: DDL not yet
+        assert len(r_old.catalog.table("t").columns) == 3
+        r_new = Session()
+        r_new.execute(f"RESTORE DATABASE * FROM '{root}' UNTIL TS = {post_ddl_ts}")
+        assert rows_of(r_new) == post_rows  # old rows backfill w=7
+        assert [c.name for c in r_new.catalog.table("t").columns][-1] == "w"
+
+    def test_log_gap_is_typed_never_silently_short(self, tmp_path):
+        s, root = pitr_cluster(tmp_path)
+        for i in range(3):
+            s.execute(f"INSERT INTO t VALUES ({60 + i}, {i}, 'w')")
+            s.store.pd.tick()
+        until = s.store.next_ts()
+        g0 = metrics.PITR_LOG_GAPS.value
+        r = Session()
+        failpoint.enable("br/log-gap", 1)
+        try:
+            with pytest.raises(LogGapError) as ei:
+                restore_until(r.store, r.catalog, root, until)
+        finally:
+            failpoint.disable("br/log-gap")
+        assert ei.value.covered_ts < ei.value.target_ts == until
+        assert metrics.PITR_LOG_GAPS.value > g0
+        # the SQL surface maps it to a typed SQLError, same failpoint
+        failpoint.enable("br/log-gap", 1)
+        try:
+            with pytest.raises(SQLError):
+                Session().execute(
+                    f"RESTORE DATABASE * FROM '{root}' UNTIL TS = {until}")
+        finally:
+            failpoint.disable("br/log-gap")
+
+    def test_restore_past_log_end_is_typed(self, tmp_path):
+        s, root = pitr_cluster(tmp_path)
+        s.execute("INSERT INTO t VALUES (50, 1, 'x')")
+        s.store.pd.tick()
+        beyond = s.store.next_ts() + 100_000  # no log covers this
+        with pytest.raises(LogGapError):
+            r = Session()
+            restore_until(r.store, r.catalog, root, beyond)
+
+    def test_no_full_backup_under_ts_is_typed(self, tmp_path):
+        s = make_session()
+        root = str(tmp_path / "bk")
+        s.execute(f"BACKUP LOG TO 'file://{root}'")  # log only, no full
+        s.execute("INSERT INTO t VALUES (1, 10, 'a')")
+        s.store.pd.tick()
+        r = Session()
+        with pytest.raises(LogGapError):
+            restore_until(r.store, r.catalog, root, s.store.next_ts())
+
+    def test_replay_crash_resumes_idempotently(self, tmp_path):
+        s, root = pitr_cluster(tmp_path)
+        for i in range(3):  # several segments so the crash lands mid-chain
+            s.execute(f"INSERT INTO t VALUES ({60 + i}, {i}, 'w')")
+            s.store.pd.tick()
+        until = s.store.next_ts()
+        oracle = rows_of(s)
+        s.store.pd.tick()  # the checkpoint must pass the cut to prove it
+        r = Session()
+        r0 = metrics.PITR_REPLAY_RESUMES.value
+        failpoint.enable("restore/replay-crash", 1)
+        try:
+            with pytest.raises(ReplayInterrupted):
+                restore_until(r.store, r.catalog, root, until)
+        finally:
+            failpoint.disable("restore/replay-crash")
+        ckpt = os.path.join(root, f"restore-ckpt-{until}.json")
+        assert os.path.exists(ckpt)  # the per-segment checkpoint survived
+        rep = restore_until(r.store, r.catalog, root, until)
+        assert rep["resumed"] is True
+        assert metrics.PITR_REPLAY_RESUMES.value > r0
+        assert rows_of(r) == oracle  # re-run is idempotent, not doubled
+        assert not os.path.exists(ckpt)  # done: a fresh run starts clean
+
+
+# ----------------------------------------- atomic segments (satellite 1)
+
+class TestKillMidFlush:
+    def test_kill_mid_flush_leaves_no_torn_tail(self, tmp_path):
+        """The torn-tail crash this PR fixes: a kill between tmp write
+        and rename must leave NOTHING a consumer reads — and the
+        re-queued window must land exactly once after RESUME."""
+        from tidb_tpu.cdc import FileSink
+
+        s = make_session()
+        s.execute(f"CREATE CHANGEFEED cf INTO 'file://{tmp_path}/out' FOR TABLE t WITH start_ts = 0")
+        s.execute("INSERT INTO t VALUES (1, 10, 'a')")
+        failpoint.enable("cdc/segment-crash", 1)
+        s.store.cdc.tick()
+        feed = s.store.cdc.get("cf")
+        assert feed.view(s.store)["state"] == "error"
+        sink_dir = f"{tmp_path}/out/cf"
+        assert any(f.endswith(".tmp") for f in os.listdir(sink_dir))
+        recs = FileSink(f"{tmp_path}/out", "cf").read_records()
+        assert recs == []  # the torn tmp is invisible, not a broken read
+        s.store.cdc.resume("cf")
+        s.store.cdc.tick()
+        assert feed.view(s.store)["state"] == "normal"
+        recs = FileSink(f"{tmp_path}/out", "cf").read_records()
+        assert sum(1 for r in recs if r.get("type") == "row") == 1  # once
+
+
+# --------------------------------- snapshot backup safepoint (satellite 2)
+
+class TestSnapshotBackupSafepoint:
+    def test_backup_and_restore_pin_then_release(self, tmp_path, monkeypatch):
+        from tidb_tpu.tools import backup, restore
+
+        s = make_session()
+        s.execute("INSERT INTO t VALUES (1, 10, 'a'), (2, 20, 'b')")
+        calls = []
+        orig_reg, orig_unreg = s.store.register_snapshot, s.store.unregister_snapshot
+        monkeypatch.setattr(s.store, "register_snapshot",
+                            lambda ts: (calls.append(("reg", ts)), orig_reg(ts))[1])
+        monkeypatch.setattr(s.store, "unregister_snapshot",
+                            lambda ts: (calls.append(("unreg", ts)), orig_unreg(ts))[1])
+        bdir = str(tmp_path / "full")
+        backup(s.store, s.catalog, bdir)
+        assert ("reg", calls[0][1]) in calls and ("unreg", calls[0][1]) in calls
+        with s.store._tso_lock:
+            assert calls[0][1] not in s.store._active_snapshots  # released
+        calls.clear()
+        r = Session()
+        rcalls = []
+        r_reg, r_unreg = r.store.register_snapshot, r.store.unregister_snapshot
+        monkeypatch.setattr(r.store, "register_snapshot",
+                            lambda ts: (rcalls.append(("reg", ts)), r_reg(ts))[1])
+        monkeypatch.setattr(r.store, "unregister_snapshot",
+                            lambda ts: (rcalls.append(("unreg", ts)), r_unreg(ts))[1])
+        restore(r.store, r.catalog, bdir)
+        assert [c[0] for c in rcalls] == ["reg", "unreg"]
+        assert rows_of(r) == rows_of(s)
+
+
+# ------------------------------------------------------ surfaces + metrics
+
+class TestSurfaces:
+    def test_pd_tick_has_pitr_phase(self, tmp_path):
+        s, _root = pitr_cluster(tmp_path, n=1)
+        s.store.pd.tick()
+        root = s.store.pd.last_tick_root
+        assert any(c.name == "pd.pitr" for c in root.children)
+
+    def test_pitr_tick_trims_the_schema_journal(self, tmp_path):
+        s, _root = pitr_cluster(tmp_path, n=1)
+        s.execute("ALTER TABLE t ADD COLUMN w BIGINT DEFAULT 7")
+        assert len(s.store.schema_journal) == 1
+        s.store.pd.tick()  # checkpoint passes the DDL; pd.pitr trims below
+        assert len(s.store.schema_journal) == 0
+
+    def test_metric_families_pass_scrape_check(self, tmp_path):
+        from scrape_check import validate
+
+        s, root = pitr_cluster(tmp_path)
+        s.execute("INSERT INTO t VALUES (50, 1, 'x')")
+        s.store.pd.tick()
+        until = s.store.next_ts()
+        s.store.pd.tick()
+        r = Session()
+        restore_until(r.store, r.catalog, root, until)
+        text = metrics.REGISTRY.dump()
+        for family in (
+            "tidb_tpu_log_backup_segments_total",
+            "tidb_tpu_log_backup_events_total",
+            "tidb_tpu_log_backup_checkpoint_ts",
+            "tidb_tpu_log_backup_resolved_lag",
+            "tidb_tpu_pitr_restores_total",
+            "tidb_tpu_pitr_segments_replayed_total",
+            "tidb_tpu_pitr_replayed_events_total",
+            "tidb_tpu_cdc_schema_events_total",
+        ):
+            assert f"# TYPE {family}" in text, family
+        assert 'tidb_tpu_log_backup_checkpoint_ts{changefeed="log-backup:' in text
+        assert validate(text) == []
+
+    def test_views_surface(self, tmp_path):
+        s, root = pitr_cluster(tmp_path)
+        s.execute("INSERT INTO t VALUES (50, 1, 'x')")
+        s.store.pd.tick()
+        v = log_backup_views(s.store)[0]
+        assert v["destination"] == f"file://{root}"
+        assert v["state"] == "normal" and v["resolved_lag"] == 0
+        assert v["segments"] >= 1 and v["events"] >= 1
+
+
+# ------------------------------------------------------- storm acceptance
+
+def test_pitr_chaos_storm_acceptance():
+    """ISSUE 20 acceptance: a log backup + a mirror replay feed ride a
+    seeded DML+DDL storm under splits/transfers/outage and the cdc/*
+    failpoints; three mid-storm restore points come back byte-identical
+    to the live oracle, the mid-feed ALTERs park nothing, a kill
+    mid-flush costs nothing, a mid-replay crash resumes idempotently,
+    and a manifest gap fails typed."""
+    from chaos import pitr_storm_bad, run_pitr_storm
+
+    report = run_pitr_storm(seed=19, statements=100)
+    assert report["untyped_errors"] == [], report["untyped_errors"]
+    assert report["ordering_violations"] == [], report["ordering_violations"]
+    assert all(r["chaos_t_equal"] and r["chaos_d_equal"]
+               for r in report["restores"]), report["restores"]
+    assert report["replay_crash_resumed"] and report["log_gap_typed"], report
+    assert not pitr_storm_bad(report), report

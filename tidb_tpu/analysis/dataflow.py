@@ -145,6 +145,19 @@ COALESCE_ROOTS = (
     ("server/coalesce.py", "SessionCoalescer", "point_get"),
     ("server/coalesce.py", "SessionCoalescer", "group_commit"),
 )
+# point-in-time recovery (ISSUE 20): the restore replay loop and the
+# log-backup flush are ESCAPE and BACKOFF roots — every coverage break
+# must leave as the typed LogGapError (mapped to a SQLError at the
+# session boundary), a flush failure must park the feed typed (never a
+# bare escape from the segment writer), and neither loop may spin or
+# raw-sleep. NOT snapshot roots: replay re-ingests at SOURCE commit
+# timestamps and the sink buffers raw bytes — neither draws a statement
+# snapshot.
+PITR_ROOTS = (
+    ("br/pitr.py", None, "restore_until"),
+    ("br/pitr.py", "LogBackupSink", "flush"),
+    ("br/pitr.py", None, "pitr_tick"),
+)
 SESSION_BOUNDARIES = (("sql/session.py", "Session", "execute"),)
 
 # directories whose exception classes form the "typed request-path error"
@@ -941,7 +954,7 @@ def _is_time_sleep(call: ast.Call, graph: CallGraph, fi: FuncInfo) -> bool:
 
 def run_backoff(files: list[SourceFile]) -> list:
     graph = graph_for(files)
-    roots = graph.request_roots(extra=CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + TOPSQL_ROOTS + MPP_ROOTS)
+    roots = graph.request_roots(extra=CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + TOPSQL_ROOTS + MPP_ROOTS + PITR_ROOTS)
     if not roots:
         return []
     _compute_backoff_consulters(graph)
@@ -991,7 +1004,7 @@ class EscapeAnalysis:
         self._sub_memo: dict = {}
         # escape only matters in the cone of the roots and the boundary
         reach = graph.reachable(
-            graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS + TOPSQL_ROOTS + MPP_ROOTS + COALESCE_ROOTS)
+            graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS + TOPSQL_ROOTS + MPP_ROOTS + COALESCE_ROOTS + PITR_ROOTS)
             + graph.boundaries())
         work = [graph.funcs[q] for q in sorted(reach)]
         rounds = 0
@@ -1261,7 +1274,7 @@ def _mapped_types(graph: CallGraph, boundary: FuncInfo) -> set:
 
 def run_escape(files: list[SourceFile]) -> list:
     graph = graph_for(files)
-    roots = graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS + TOPSQL_ROOTS + MPP_ROOTS + COALESCE_ROOTS)
+    roots = graph.request_roots(extra=ESCAPE_EXTRA_ROOTS + CDC_ROOTS + COLUMNAR_ROOTS + FRONT_DOOR_ROOTS + FRONT_DOOR_ESCAPE_ROOTS + TOPSQL_ROOTS + MPP_ROOTS + COALESCE_ROOTS + PITR_ROOTS)
     boundaries = graph.boundaries()
     if not roots and not boundaries:
         return []
@@ -1307,7 +1320,7 @@ def run_escape(files: list[SourceFile]) -> list:
     # reachability must narrow nothing the lexical rule guaranteed)
     for sf in graph.files:
         rel = sf.rel.replace(os.sep, "/")
-        if not any(rel.startswith(f"tidb_tpu/{d}/") for d in ("distsql", "store", "pd", "cdc", "columnar", "mpp")):
+        if not any(rel.startswith(f"tidb_tpu/{d}/") for d in ("distsql", "store", "pd", "cdc", "columnar", "mpp", "br")):
             continue
         for node in ast.walk(sf.tree):
             if not (isinstance(node, ast.Raise) and node.exc is not None):

@@ -66,6 +66,9 @@ DESCRIPTIONS = {
     "store/unreachable": "injects StoreUnavailable for armed stores and fails their liveness probe (ping_store)",
     "coalesce/window-stall": "wedges the coalescer window's leader past its deadline (arm with a float to choose the hold seconds) — followers outwait their patience, withdraw their unclaimed lanes, and fall back to the single path as counted `window_stall` fallbacks",
     "coalesce/flush-lost": "loses a coalescer window's flush before any lane is answered — every lane falls out as a counted `flush_lost` fallback and re-runs its single path; no statement is lost, none launches twice",
+    "cdc/segment-crash": "kills a segment flush between the tmp write and the rename (typed SinkError, tmp left behind) — the kill-mid-flush drill: consumers must see only whole renamed-in segments, and the feed re-queues the window for exactly-once redelivery",
+    "restore/replay-crash": "raises typed ReplayInterrupted right after a replayed segment's checkpoint write — a re-run of the same RESTORE ... UNTIL TS resumes past every already-applied segment (counted PITR_REPLAY_RESUMES)",
+    "br/log-gap": "drops the middle entry from the log-backup manifest as restore reads it — the coverage chain breaks and the restore MUST fail as typed LogGapError, never a silently-short cluster",
 }
 
 _SITE = re.compile(r"""(?:failpoint|_fp|fp)\s*\.\s*(?:eval|is_armed|peek)\(\s*["']([^"']+)["']""")

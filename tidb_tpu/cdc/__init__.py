@@ -1,15 +1,21 @@
 """Change data capture (ISSUE 10): the TiCDC-analog changefeed
 subsystem — puller over the replication log, commit-ts sorter,
-resolved-ts frontier, rowcodec mounter, pluggable sinks."""
+resolved-ts frontier, rowcodec mounter, pluggable sinks. ISSUE 20 adds
+schema-change entries riding the same log (DDL through the feed), raw
+feeds for log backup, and atomic file-sink segments."""
 
-from .events import RowEvent
+from .events import RawKVEvent, RowEvent, SchemaEvent
 from .hub import Changefeed, ChangefeedError, ChangefeedHub, WriteGuard
 from .mounter import Mounter, SchemaDriftError
-from .sink import FileSink, MemorySink, SessionReplaySink, Sink, SinkError, open_sink
+from .schema import SchemaJournal
+from .sink import (
+    FileSink, MemorySink, SegmentWriter, SessionReplaySink, Sink, SinkError,
+    open_sink,
+)
 
 __all__ = [
-    "RowEvent", "Changefeed", "ChangefeedError", "ChangefeedHub", "WriteGuard",
-    "Mounter", "SchemaDriftError", "FileSink", "MemorySink",
-    "SessionReplaySink", "Sink",
-    "SinkError", "open_sink",
+    "RowEvent", "SchemaEvent", "RawKVEvent", "Changefeed", "ChangefeedError",
+    "ChangefeedHub", "WriteGuard", "Mounter", "SchemaDriftError",
+    "SchemaJournal", "FileSink", "MemorySink", "SegmentWriter",
+    "SessionReplaySink", "Sink", "SinkError", "open_sink",
 ]

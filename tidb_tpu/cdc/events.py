@@ -25,6 +25,10 @@ class RowEvent:
     op: str  # "put" | "delete"
     commit_ts: int
     columns: tuple = field(default=())
+    col_ids: tuple = field(default=())  # column ids aligned with `columns`
+    # — the shape the mounter's schema tracker decoded against, so sinks
+    # that hold their OWN schema snapshot (the columnar replica) can remap
+    # by id instead of trusting the live catalog's column order
 
     def to_json(self) -> dict:
         """JSON-lines shape for the file sink (ref: TiCDC's canal-json /
@@ -38,4 +42,56 @@ class RowEvent:
             "columns": {
                 name: (None if d.is_null() else d.val) for name, d in self.columns
             },
+        }
+
+
+@dataclass(frozen=True)
+class SchemaEvent:
+    """A schema change replicated THROUGH the feed as an ordered event
+    (ref: TiCDC's DDLEvent riding the same sorted stream as row changes;
+    ISSUE 20). `payload` is the full post-change column snapshot
+    (cdc/schema.py's wire dict) — enough for a downstream to rebuild the
+    table shape without consulting the source catalog. Rows before this
+    event's commit_ts mounted against the PREVIOUS snapshot; rows after
+    it mount against this one."""
+
+    table: str
+    table_id: int
+    commit_ts: int
+    schema_version: int
+    op: str  # "add column" | "drop column" | ... (the DDL job type)
+    query: str
+    payload: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "type": "schema",
+            "table": self.table,
+            "table_id": self.table_id,
+            "commit_ts": self.commit_ts,
+            "schema_version": self.schema_version,
+            "op": self.op,
+            "query": self.query,
+            "payload": self.payload,
+        }
+
+
+@dataclass(frozen=True)
+class RawKVEvent:
+    """One raw (undecoded) KV change for the log-backup feed (ref: BR's
+    log backup streaming raw KV write batches, br/pkg/stream): PITR
+    replay re-ingests these bytes at the source commit ts, so index
+    entries and row bytes survive byte-exactly — no mount/re-encode
+    round trip to drift through."""
+
+    key: bytes
+    value: bytes | None
+    commit_ts: int
+
+    def to_json(self) -> dict:
+        return {
+            "type": "kv",
+            "k": self.key.hex(),
+            "v": None if self.value is None else self.value.decode("latin1"),
+            "commit_ts": self.commit_ts,
         }

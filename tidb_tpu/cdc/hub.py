@@ -25,10 +25,21 @@ pipeline for one subscription:
              candidate drawn with no write in flight (and none completing
              between two samples) dominates every delivered and every
              future commit ts. `cdc/resolved-stuck` pins the advance.
-  mounter    cdc/mounter.py decodes rows against the feed's catalog.
+  mounter    cdc/mounter.py decodes rows against the feed's TRACKED
+             schema snapshots; schema-change entries in the log
+             (cdc/schema.py, ISSUE 20) advance the tracker in commit-ts
+             order and emit SchemaEvents downstream — a mid-feed ALTER
+             replicates through the feed instead of parking it. A RAW
+             feed (the BR log backup) skips mounting entirely and hands
+             the sink undecoded RawKVEvents, index entries included.
   sink       cdc/sink.py; `cdc/sink-stall` skips a tick's emission
              (the frontier may advance internally, the emitted
              checkpoint — and the sink — stay put).
+
+Schema entries are not in KV, so the incremental-scan recovery path
+cannot backfill them: every tick additionally injects the store's
+SchemaJournal window (checkpoint, candidate] into the sorter — the
+(key, ts) dedupe absorbs the overlap with live captures.
 
 The emitted checkpoint doubles as the feed's GC service safepoint
 (ref: TiCDC's service GC safepoint in PD): the hub keeps a registered
@@ -50,7 +61,9 @@ import time
 from contextlib import contextmanager
 
 from ..store.region import KEY_MAX
+from .events import RawKVEvent
 from .mounter import Mounter, SchemaDriftError
+from .schema import is_schema_key, schema_key_table_id
 from .sink import Sink, SinkError, open_sink
 
 
@@ -95,16 +108,20 @@ class Changefeed:
     feed with the message; RESUME retries), or removed (DROP)."""
 
     def __init__(self, hub, name: str, sink: Sink, catalog,
-                 table_ids=None, start_ts: int = 0):
+                 table_ids=None, start_ts: int = 0, raw: bool = False):
         self.hub = hub
         self.name = name
         self.sink = sink
         self.catalog = catalog
         self.mounter = Mounter(catalog)
         self.table_ids = frozenset(table_ids) if table_ids is not None else None
-        # birth schema snapshot (ISSUE 12 satellite): every subscribed
-        # table's row-shape version is stamped NOW; a mid-feed ALTER
-        # parks the feed instead of mounting old rows on the new catalog
+        # raw feeds (the BR log backup) skip the mounter: the sink gets
+        # undecoded RawKVEvents (index entries included) so PITR replay
+        # re-ingests the exact bytes at the source commit ts
+        self.raw = raw
+        # birth schema snapshot (ISSUE 12/20): every subscribed table's
+        # row SHAPE is snapshotted NOW; a mid-feed ALTER advances it via
+        # a replicated schema entry instead of parking the feed
         self.mounter.stamp_tables(self.table_ids)
         self.start_ts = start_ts
         self._mu = threading.Lock()
@@ -134,10 +151,18 @@ class Changefeed:
 
     # ------------------------------------------------------------- puller
     def _wants(self, key: bytes) -> bool:
-        """Table filter: record/index keys of subscribed tables only
-        (None = every table; the m-prefix meta keyspace never streams)."""
+        """Table filter: record/index keys of subscribed tables, plus
+        schema-change entries of subscribed tables (None = every table;
+        the rest of the m-prefix meta keyspace never streams)."""
         from ..codec import tablecodec
 
+        if is_schema_key(key):
+            if self.table_ids is None:
+                return True
+            try:
+                return schema_key_table_id(key) in self.table_ids
+            except ValueError:
+                return False
         if key[:1] != b"t" or len(key) < 9:
             return False
         if self.table_ids is None:
@@ -205,6 +230,7 @@ class Changefeed:
         if state != "normal":
             return 0
         self._recover_lost(store, checkpoint, cand)
+        self._inject_schema(store, checkpoint, cand)
         stuck = bool(failpoint.eval("cdc/resolved-stuck"))
         with self._mu:
             live = set(region_ids)
@@ -229,17 +255,32 @@ class Changefeed:
         rows, skipped = [], 0
         try:
             for ts, k, v in batch:
+                if self.raw:
+                    # the log-backup feed: no mounting, exact bytes out
+                    rows.append(RawKVEvent(k, v, ts))
+                    continue
+                if is_schema_key(k):
+                    # a replicated DDL draining in commit-ts order:
+                    # advance the tracked snapshot so later rows in THIS
+                    # batch already decode against the new shape
+                    ev = self.mounter.apply_schema(v, ts)
+                    if ev is None:
+                        skipped += 1  # stale/duplicate schema entry
+                    else:
+                        rows.append(ev)
+                        metrics.CDC_SCHEMA_EVENTS.inc()
+                    continue
                 ev = self.mounter.mount(k, v, ts)
                 if ev is None:
                     skipped += 1
                 else:
                     rows.append(ev)
         except SchemaDriftError as exc:
-            # a mid-feed ALTER: park with the typed reason and re-queue
-            # the WHOLE batch below the held checkpoint — nothing mounts
-            # against the drifted catalog, nothing is lost. RESUME
-            # re-stamps (the operator accepting the new schema) and the
-            # sorter redelivers (sinks dedupe by (key, commit_ts))
+            # the legacy park path (pre-ISSUE-20): the mounter now
+            # resolves drift as a counted fallback and should never
+            # raise, but a feed that still does parks safely with the
+            # typed reason and re-queues the batch below the held
+            # checkpoint — nothing is lost, sinks dedupe on redelivery
             with self._mu:
                 self.state = "error"
                 self.last_error = f"{type(exc).__name__}: {exc}"
@@ -292,6 +333,20 @@ class Changefeed:
                         fresh += 1
         if fresh:
             metrics.CDC_EVENTS.inc(fresh)
+
+    def _inject_schema(self, store, checkpoint: int, cand: int) -> None:
+        """Schema entries in (checkpoint, cand] from the store journal:
+        the live capture path delivers them too, but a feed whose
+        subscription lapsed (pause, puller-drop, birth) cannot recover
+        them by KV scan — the journal is the durable source. Dedupe by
+        (key, commit_ts) absorbs the overlap."""
+        journal = getattr(store, "schema_journal", None)
+        if journal is None or not len(journal):
+            return
+        with self._mu:
+            for k, ts, v in journal.entries_in(checkpoint, cand):
+                if self._wants(k) and (k, ts) not in self._pending:
+                    self._pending[(k, ts)] = v
 
     def _advance_checkpoint(self, store, frontier: int, emitted: int,
                             skipped: int) -> None:
@@ -391,13 +446,15 @@ class ChangefeedHub:
 
     # ---------------------------------------------------------- lifecycle
     def create(self, name: str, sink, catalog, table_ids=None,
-               start_ts: int = 0):
+               start_ts: int = 0, raw: bool = False):
         """`sink` is a Sink instance or a sink-uri string. The new feed's
-        first tick runs the initial incremental scan at `start_ts`."""
+        first tick runs the initial incremental scan at `start_ts`.
+        `raw=True` makes a log-backup-style feed that skips the mounter
+        (the sink receives RawKVEvents, index entries included)."""
         opened_here = isinstance(sink, str)
         if opened_here:
             sink = open_sink(sink, name)
-        feed = Changefeed(self, name, sink, catalog, table_ids, start_ts)
+        feed = Changefeed(self, name, sink, catalog, table_ids, start_ts, raw=raw)
         # GC service safepoint at the checkpoint BEFORE the feed becomes
         # tickable (TiCDC's PD service safepoint): _advance_checkpoint's
         # register-new/unregister-old slide assumes the old pin exists —

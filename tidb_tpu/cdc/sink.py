@@ -3,12 +3,16 @@ behind one interface). Three concrete sinks:
 
   MemorySink         buffered events + resolved marks (tests, SHOW-style
                      introspection; the blackhole sink with a memory)
-  FileSink           JSON-lines under a directory, one file per
-                     changefeed (the storage sink analog; resolved marks
-                     interleave so a consumer can cut complete prefixes)
+  FileSink           JSON-lines segments under a directory, one
+                     subdirectory per changefeed (the storage sink
+                     analog; each flush writes ONE atomic segment ending
+                     in a resolved mark, so a consumer can cut complete
+                     prefixes and a crash can never leave a torn tail)
   SessionReplaySink  applies the stream into a SECOND cluster through
                      its store write path (the MySQL-sink analog; the
-                     mirror-equality oracle rides this one)
+                     mirror-equality oracle rides this one); schema
+                     events apply the replicated DDL to the mirror
+                     catalog (ISSUE 20)
 
 The contract every sink honors: `write(events)` receives rows in
 (commit_ts, key) order, all at or below the NEXT `flush(resolved_ts)` —
@@ -84,33 +88,104 @@ class MemorySink(Sink):
         return "memory://"
 
 
-class FileSink(Sink):
-    """JSON lines: one `{"type":"row",...}` per event, one
-    `{"type":"resolved","ts":N}` per flush. Append-only — a restarted
-    consumer replays from the last resolved mark it trusts."""
+class SegmentWriter:
+    """Atomic JSONL segment writer (ISSUE 20; ref: br/pkg/storage's
+    write-then-rename local backend). Each segment is written whole to a
+    `.tmp` sibling, fsync'd, then renamed into place — a segment is
+    either fully present or absent, never a torn tail. Consumers read
+    `seg-*.jsonl` in name order and ignore `*.tmp` leftovers."""
 
-    def __init__(self, directory: str, name: str):
-        self.path = os.path.join(directory, f"{name}.jsonl")
+    def __init__(self, directory: str):
+        self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self._mu = threading.Lock()
-        self._f = open(self.path, "a", encoding="utf-8")  # guarded_by: _mu
+        # resume past segments already durable (a re-attached sink must
+        # never overwrite a committed segment); guarded_by: _mu
+        self._next = 1 + max(
+            (int(f[4:10]) for f in os.listdir(directory)
+             if f.startswith("seg-") and f.endswith(".jsonl")), default=-1)
+
+    def write_segment(self, lines: list) -> str:
+        """One atomic segment of complete JSON lines; returns the file
+        name. The tmp file is removed on failure so a crashed flush
+        leaves nothing a consumer could mistake for data."""
+        from ..util import failpoint
+
+        with self._mu:
+            fname = f"seg-{self._next:06d}.jsonl"
+            tmp = os.path.join(self.directory, fname + ".tmp")
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write("".join(line + "\n" for line in lines))
+                f.flush()
+                os.fsync(f.fileno())
+            if failpoint.eval("cdc/segment-crash"):
+                # the kill-mid-flush drill: the process "dies" with the
+                # tmp written but never renamed in — the leftover MUST be
+                # invisible to consumers (the torn-tail crash this
+                # writer exists to fix), so it deliberately stays behind
+                raise SinkError(
+                    "cdc/segment-crash: killed between write and rename")
+            try:
+                os.replace(tmp, os.path.join(self.directory, fname))
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            self._next += 1
+            return fname
+
+    def segments(self) -> list:
+        """Durable segment file names, in write order."""
+        return sorted(f for f in os.listdir(self.directory)
+                      if f.startswith("seg-") and f.endswith(".jsonl"))
+
+    def read_records(self) -> list:
+        """Every record of every durable segment, in order — the
+        consumer's view (tmp leftovers and torn tails cannot appear:
+        only renamed-in segments are read)."""
+        out = []
+        for fname in self.segments():
+            with open(os.path.join(self.directory, fname), encoding="utf-8") as f:
+                out.extend(json.loads(line) for line in f if line.strip())
+        return out
+
+
+class FileSink(Sink):
+    """JSON-lines segments: `write` buffers the batch, `flush` commits
+    it as ONE atomic segment (SegmentWriter: write-temp + fsync +
+    rename) ending in a `{"type":"resolved","ts":N}` mark — any prefix
+    of segments is a consistent cut, and a kill mid-flush leaves only
+    whole segments behind (the torn-tail crash bug this replaced: a
+    partial JSON line in an append-mode file poisoned every later read).
+    A failed flush drops the buffer — the feed re-queues the batch below
+    its held checkpoint and redelivers it to a fresh flush, so exactly
+    one durable copy ever lands."""
+
+    def __init__(self, directory: str, name: str):
+        self.directory = os.path.join(directory, name)
+        self.writer = SegmentWriter(self.directory)
+        self._mu = threading.Lock()
+        self._buf: list = []  # pending event lines; guarded_by: _mu
 
     def write(self, events: list) -> None:
         with self._mu:
-            for ev in events:
-                self._f.write(json.dumps(ev.to_json(), default=str) + "\n")
+            self._buf.extend(json.dumps(ev.to_json(), default=str) for ev in events)
 
     def flush(self, resolved_ts: int) -> None:
         with self._mu:
-            self._f.write(json.dumps({"type": "resolved", "ts": resolved_ts}) + "\n")
-            self._f.flush()
+            lines, self._buf = self._buf, []
+            if not lines:
+                return  # quiet window: no empty segment spam per tick
+            lines.append(json.dumps({"type": "resolved", "ts": resolved_ts}))
+            self.writer.write_segment(lines)
 
-    def close(self) -> None:
-        with self._mu:
-            self._f.close()
+    def read_records(self) -> list:
+        return self.writer.read_records()
 
     def describe(self) -> str:
-        return f"file://{self.path}"
+        return f"file://{self.directory}"
 
 
 class SessionReplaySink(Sink):
@@ -129,14 +204,47 @@ class SessionReplaySink(Sink):
     def __init__(self, session):
         self.session = session
 
+    def _apply_schema(self, ev) -> None:
+        """One replicated DDL onto the mirror catalog: rebuild the
+        table's column list from the event payload (idempotent — a
+        redelivered event at or below the mirror's version is a no-op).
+        The mirror keeps consuming instead of parking (ISSUE 20)."""
+        from ..sql.catalog import CatalogError, ColumnMeta
+        from .schema import snapshot_from_payload
+
+        catalog = self.session.catalog
+        try:
+            meta = catalog.table(ev.table)
+        except CatalogError as exc:
+            raise SinkError(f"replay: no downstream table for {ev.table!r}") from exc
+        if meta.schema_version >= ev.schema_version:
+            return  # redelivery / already applied
+        snap = snapshot_from_payload(ev.payload)
+        meta.columns = [
+            ColumnMeta(c.name, c.col_id, c.ft, origin_default=c.origin_default)
+            for c in snap.columns
+        ]
+        handle_col = ev.payload.get("handle_col")
+        if handle_col:
+            meta.handle_col = handle_col
+        meta.next_col_id = max(meta.next_col_id,
+                               ev.payload.get("next_col_id", 0),
+                               max((c.col_id for c in snap.columns), default=0) + 1)
+        meta.schema_version = ev.schema_version
+        catalog.version += 1
+
     def write(self, events: list) -> None:
         from ..codec import tablecodec
         from ..sql.catalog import CatalogError
         from ..types import Datum
+        from .events import SchemaEvent
 
         catalog = self.session.catalog
         store = self.session.store
         for ev in events:
+            if isinstance(ev, SchemaEvent):
+                self._apply_schema(ev)
+                continue
             try:
                 meta = catalog.table(ev.table)
             except CatalogError as exc:

@@ -92,12 +92,15 @@ class ColumnarTable:
     def __init__(self, pid: int, meta):
         self.pid = pid
         self.meta = meta  # identity/current-name only — the row SHAPE
-        # below is frozen at enable time (a live meta.columns read would
-        # silently drift under DDL; the sink's schema_sig guard parks
-        # the feed instead)
+        # below snapshots at enable time (a live meta.columns read would
+        # silently drift under DDL) and advances ONLY through
+        # `reshape()`, driven by the feed's ordered SchemaEvents
         self.table_id = meta.table_id
         self.fts = [c.ft for c in meta.columns]
         self.schema_sig = _schema_sig(meta.columns)
+        self.schema_version = meta.schema_version
+        self.col_ids = [c.col_id for c in meta.columns]
+        self.defaults = [c.origin_default for c in meta.columns]
         self._mu = threading.Lock()
         self.delta: list = []  # [(commit_ts, handle, row|None)]; guarded_by: _mu
         self.applied_ts = 0  # flushed resolved frontier; guarded_by: _mu
@@ -131,6 +134,46 @@ class ColumnarTable:
         with self._mu:
             if resolved_ts > self.applied_ts:
                 self.applied_ts = resolved_ts
+
+    # ---------------------------------------------------------- reshape
+    def reshape(self, schema_version: int, columns) -> bool:
+        """Remap every held row to a NEW column shape by col_id (ISSUE
+        20: a mid-feed ALTER arrives as an ordered SchemaEvent and the
+        replica follows it instead of parking). Columns the old shape
+        lacked fill from the column's origin default (NULL when none) —
+        the same backfill the mounter applies to old row bytes.
+        Idempotent by schema version (redelivered events no-op); returns
+        True when the shape moved. `columns` is a sequence of
+        ColumnSnap-shaped objects (.name/.col_id/.ft/.origin_default)."""
+        from ..types import Datum
+
+        with self._mu:
+            if schema_version <= self.schema_version:
+                return False
+            old_idx = {cid: i for i, cid in enumerate(self.col_ids)}
+
+            def remap(row):
+                return [
+                    row[old_idx[c.col_id]] if c.col_id in old_idx
+                    else (c.origin_default if c.origin_default is not None
+                          else Datum.NULL)
+                    for c in columns
+                ]
+
+            self._stable_rows = {h: remap(r) for h, r in self._stable_rows.items()}
+            self.delta = [(ts, h, None if r is None else remap(r))
+                          for ts, h, r in self.delta]
+            self.fts = [c.ft for c in columns]
+            self.schema_sig = _schema_sig(columns)
+            self.col_ids = [c.col_id for c in columns]
+            self.defaults = [c.origin_default for c in columns]
+            self.schema_version = schema_version
+            self._stable_chunk = Chunk.from_rows(
+                self.fts, [self._stable_rows[h] for h in self._stable_handles])
+            # the host chunk serves until the next compact re-uploads;
+            # a stale-shape device batch must never outlive the remap
+            self._stable_batch = None
+            return True
 
     # ------------------------------------------------------- compaction
     def compact(self) -> int:

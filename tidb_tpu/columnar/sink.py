@@ -18,7 +18,6 @@ error); the delta fold is idempotent by (commit_ts, handle)."""
 from __future__ import annotations
 
 from ..cdc.sink import Sink, SinkError
-from .replica import _schema_sig
 
 
 class ColumnarSink(Sink):
@@ -33,6 +32,8 @@ class ColumnarSink(Sink):
         return self.meta.name  # follows RENAME TABLE (meta mutates in place)
 
     def write(self, events: list) -> None:
+        from ..cdc.events import SchemaEvent
+        from ..cdc.schema import snapshot_from_payload
         from ..sql.catalog import CatalogError
         from ..types import Datum
         from ..util import failpoint, metrics
@@ -45,6 +46,20 @@ class ColumnarSink(Sink):
             raise SinkError("columnar/apply-stall: replica apply loop stalled")
         applied = 0
         for ev in events:
+            if isinstance(ev, SchemaEvent):
+                # a mid-feed ALTER, ordered between the rows committed
+                # before and after it: remap the replica's layers to the
+                # new shape and KEEP consuming (ISSUE 20 — the pre-20
+                # behavior parked the feed here with a rebuild message)
+                snap = snapshot_from_payload(ev.payload)
+                reshaped = False
+                for pid in self.pids:
+                    t = self.replica.table_for(pid)
+                    if t is not None and t.reshape(snap.version, snap.columns):
+                        reshaped = True
+                if reshaped:
+                    metrics.COLUMNAR_RESHAPES.inc()
+                continue
             try:
                 meta = self.catalog.table(ev.table)
             except CatalogError:
@@ -66,24 +81,26 @@ class ColumnarSink(Sink):
                     applied += 1
                 continue
             by_name = dict(ev.columns)
-            datums = [by_name.get(c.name, Datum.NULL) for c in meta.columns]
-            pid = meta.pid_for_row(datums)
+            # live-meta name alignment is used ONLY to route the row to
+            # its partition; the applied row maps by col_id below
+            route = [by_name.get(c.name, Datum.NULL) for c in meta.columns]
+            pid = meta.pid_for_row(route)
             t = self.replica.table_for(pid)
             if t is None:
                 continue  # a partition added after enable: not replicated
-            if _schema_sig(meta.columns) != t.schema_sig:
-                # the replica's layers are frozen at the enable-time row
-                # shape; a post-ALTER RESUME would otherwise apply rows
-                # of the NEW shape into OLD-schema columns (misaligned
-                # datums, or an fts/row length mismatch crashing the
-                # fold). Park with the rebuild instruction instead —
-                # scans already decline on the same signature and fall
-                # back to the row store (review finding)
-                raise SinkError(
-                    f"columnar replica for {ev.table!r} holds the pre-ALTER "
-                    f"row shape: rebuild it (ALTER TABLE {ev.table} SET "
-                    f"COLUMNAR REPLICA 0, then 1)")
-            t.apply(ev.commit_ts, ev.handle, datums)
+            # remap by col_id against the TABLE's tracked shape (which a
+            # schema event earlier in this same ordered stream may have
+            # reshaped): a row mounted under the pre-ALTER snapshot still
+            # lands in the right columns, missing ones fill from the
+            # column's origin default. Only this feed thread reshapes, so
+            # the unlocked col_ids/defaults reads cannot race.
+            if ev.col_ids:
+                by_id = dict(zip(ev.col_ids, (d for _n, d in ev.columns)))
+                row = [by_id.get(cid, dflt if dflt is not None else Datum.NULL)
+                       for cid, dflt in zip(t.col_ids, t.defaults)]
+            else:  # a legacy event with no ids: trust live-name order
+                row = route
+            t.apply(ev.commit_ts, ev.handle, row)
             applied += 1
         if applied:
             metrics.COLUMNAR_APPLIED.inc(applied)

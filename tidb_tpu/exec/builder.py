@@ -827,17 +827,24 @@ class ProgramCache:
     program is specialized to its leading axis, so a new batch shape is an
     honest recompile, not a hit — `stats()` exposes per-instance
     compiles/hits so tests can assert "one compile + N hits per batch
-    shape" (the launch-count regression guard)."""
+    shape" (the launch-count regression guard).
+
+    Compiles are single-flight per key: pool-tier region tasks all need
+    the same push program on a cold cache, and without coordination each
+    thread that misses compiles its own copy (correct but N× the compile
+    cost, and the compiles/hits counters — the regression guard — become
+    timing-dependent). The first thread to miss claims the key; racers
+    wait on its event and land as hits."""
 
     def __init__(self):
         import threading
 
         # _cache is deliberately unguarded: dict get/set are GIL-atomic
-        # and a racing double-compile is benign (last insert wins)
         self._cache: dict = {}
         self._stats_mu = threading.Lock()  # pool threads share one cache
         self.compiles = 0  # guarded_by: _stats_mu
         self.hits = 0  # guarded_by: _stats_mu
+        self._inflight: dict = {}  # key -> Event, guarded_by: _stats_mu
 
     def get(
         self,
@@ -891,31 +898,46 @@ class ProgramCache:
         # count (shard_map shapes both into the trace); mesh_kind is
         # derivable from the fingerprint but cheap to carry explicitly
         key = (dag.fingerprint(), capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, vmap_batch, pallas_mode(), mesh_lanes, mesh_devices, mesh_kind, radix_joins)
-        prog = self._cache.get(key)
-        if prog is not None:
+        import threading
+
+        while True:
+            prog = self._cache.get(key)
+            if prog is not None:
+                with self._stats_mu:
+                    self.hits += 1
+                metrics.PROGRAM_CACHE_HITS.inc()
+                with tracing.span("exec.program", cache_hit=True):
+                    pass
+                return prog, True, 0
             with self._stats_mu:
-                self.hits += 1
-            metrics.PROGRAM_CACHE_HITS.inc()
-            with tracing.span("exec.program", cache_hit=True):
-                pass
-            return prog, True, 0
-        with tracing.span("exec.program", cache_hit=False) as sp:
+                ev = self._inflight.get(key)
+                if ev is None:
+                    self._inflight[key] = threading.Event()
+                    break  # this thread owns the compile
+            # another thread is compiling this key: wait, then re-read the
+            # cache (if its compile raised, the next waiter claims the key)
+            ev.wait()
+        try:
+            with tracing.span("exec.program", cache_hit=False) as sp:
+                with self._stats_mu:
+                    self.compiles += 1
+                metrics.PROGRAM_COMPILES.inc()
+                t0 = _t.perf_counter_ns()
+                prog = build_program(dag, capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, vmap_batch=vmap_batch,
+                                     mesh_lanes=mesh_lanes, mesh_devices=mesh_devices, mesh_kind=mesh_kind, radix_joins=radix_joins)
+                compile_ns = _t.perf_counter_ns() - t0
+                metrics.PROGRAM_COMPILE_DURATION.observe(compile_ns / 1e9)
+                if sp is not None:
+                    sp.set("compile_ns", compile_ns)
+                    if vmap_batch is not None:
+                        sp.set("batch_size", vmap_batch)
+                    if mesh_lanes is not None:
+                        sp.set("mesh_lanes", mesh_lanes)
+            self._cache[key] = prog
+            metrics.PROGRAM_CACHE_ENTRIES.set(len(self._cache))
+        finally:
             with self._stats_mu:
-                self.compiles += 1
-            metrics.PROGRAM_COMPILES.inc()
-            t0 = _t.perf_counter_ns()
-            prog = build_program(dag, capacities, group_capacity, join_capacity, topn_full, small_groups, unique_joins, vmap_batch=vmap_batch,
-                                 mesh_lanes=mesh_lanes, mesh_devices=mesh_devices, mesh_kind=mesh_kind, radix_joins=radix_joins)
-            compile_ns = _t.perf_counter_ns() - t0
-            metrics.PROGRAM_COMPILE_DURATION.observe(compile_ns / 1e9)
-            if sp is not None:
-                sp.set("compile_ns", compile_ns)
-                if vmap_batch is not None:
-                    sp.set("batch_size", vmap_batch)
-                if mesh_lanes is not None:
-                    sp.set("mesh_lanes", mesh_lanes)
-        self._cache[key] = prog
-        metrics.PROGRAM_CACHE_ENTRIES.set(len(self._cache))
+                self._inflight.pop(key).set()
         return prog, False, compile_ns
 
     def stats(self):

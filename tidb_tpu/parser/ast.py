@@ -601,11 +601,16 @@ class KillStmt:
 
 @dataclass
 class BRIEStmt:
-    """BACKUP/RESTORE SQL (ref: br glue pkg/executor/brie.go)."""
+    """BACKUP/RESTORE SQL (ref: br glue pkg/executor/brie.go). ISSUE 20
+    adds the PITR forms: `BACKUP LOG TO ...` / `STOP BACKUP LOG TO ...`
+    attach/detach a durable log backup (kind "backup_log" /
+    "stop_backup_log"), and `RESTORE FROM ... UNTIL TS = n` replays the
+    log to an exact ts (`until_ts` set)."""
 
-    kind: str  # "backup" | "restore"
+    kind: str  # "backup" | "restore" | "backup_log" | "stop_backup_log"
     storage: str
     tables: list = field(default_factory=list)  # empty = full
+    until_ts: int | None = None  # RESTORE ... UNTIL TS = n
 
 
 @dataclass

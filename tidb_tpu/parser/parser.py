@@ -323,6 +323,15 @@ class Parser:
             return self.split_stmt()
         if kw in ("BACKUP", "RESTORE"):
             return self.brie_stmt(kw.lower())
+        if kw == "STOP":
+            # STOP BACKUP LOG TO 'file://dir' (ISSUE 20; ref: `br log
+            # stop`): detach the log backup attached at that destination
+            self.next()
+            self.expect_kw("BACKUP")
+            if not self.eat_kw("LOG", "LOGS"):
+                raise ParseError(f"expected LOG at {self._where()}")
+            self.expect_kw("TO")
+            return A.BRIEStmt("stop_backup_log", self.next().text)
         if kw == "TRACE":
             self.next()
             fmt = "row"
@@ -2576,6 +2585,12 @@ class Parser:
             s.kind = "stats_meta"
         elif self.eat_kw("STATS_HISTOGRAMS"):
             s.kind = "stats_histograms"
+        elif self.eat_kw("BACKUP"):
+            # SHOW BACKUP LOGS (ISSUE 20; ref: `br log status`): one row
+            # per attached log backup with its durable checkpoint
+            if not self.eat_kw("LOGS", "LOG"):
+                raise ParseError(f"expected LOGS at {self._where()}")
+            s.kind = "backup_logs"
         elif self.eat_kw("CHANGEFEEDS", "CHANGEFEED"):
             # SHOW CHANGEFEEDS (ref: TiCDC `changefeed list`); the
             # singular form with a name filters to exactly that feed —
@@ -2826,6 +2841,11 @@ class Parser:
 
     def brie_stmt(self, kind: str) -> A.BRIEStmt:
         self.next()
+        if kind == "backup" and self.eat_kw("LOG", "LOGS"):
+            # BACKUP LOG TO 'file://dir' (ISSUE 20; ref: `br log start`):
+            # attach the durable log backup changefeed
+            self.expect_kw("TO")
+            return A.BRIEStmt("backup_log", self.next().text)
         tables = []
         if self.eat_kw("TABLE"):
             tables.append(self.table_name())
@@ -2842,4 +2862,11 @@ class Parser:
         else:
             self.expect_kw("FROM")
         storage = self.next().text
-        return A.BRIEStmt(kind, storage, tables)
+        until_ts = None
+        if kind == "restore" and self.eat_kw("UNTIL"):
+            # RESTORE FROM 'file://dir' UNTIL TS = n (ISSUE 20: PITR —
+            # full backup + log replay to exactly ts n)
+            self.expect_kw("TS")
+            self.eat_op("=")
+            until_ts = self.expect_number()
+        return A.BRIEStmt(kind, storage, tables, until_ts=until_ts)

@@ -420,6 +420,17 @@ class PlacementDriver:
                 folded = rep.compact_tick() if rep is not None else 0
                 if osp is not None:
                     osp.set("rows_folded", folded)
+            with tracing.span("pd.pitr") as pitr_sp:
+                # point-in-time recovery upkeep (ISSUE 20): refresh each
+                # log backup's durable-checkpoint gauges and trim the
+                # schema journal below the floor every feed has passed —
+                # AFTER pd.cdc so this tick's checkpoint slide is visible
+                from ..br import pitr_tick
+
+                pitr_tick(self.store)
+                if pitr_sp is not None:
+                    pitr_sp.set("log_backups",
+                                len(getattr(self.store, "log_backups", ())))
             with tracing.span("topsql.report") as tsp:
                 # Top SQL window rotation (ISSUE 17): the reporter seals
                 # its live window on a clock even when no statement lands

@@ -110,16 +110,17 @@ def alter_table(session, stmt: A.AlterTableStmt):
         query = f"ALTER TABLE {meta.name} {action}"
         if action == "add_column":
             run_job(session.catalog, "add column", meta.name, query,
-                    lambda s=spec: _add_column(session, meta, s))
+                    lambda s=spec, q=query: _add_column(session, meta, s, q))
         elif action == "drop_column":
             run_job(session.catalog, "drop column", meta.name, query,
-                    lambda s=spec: _drop_column(session, meta, s.name))
+                    lambda s=spec, q=query: _drop_column(session, meta, s.name, q))
         elif action in ("modify_column", "change_column"):
             run_job(session.catalog, action.replace("_", " "), meta.name, query,
-                    lambda s=spec: _modify_column(session, meta, s))
+                    lambda s=spec, q=query: _modify_column(session, meta, s, q))
         elif action == "rename_column":
             run_job(session.catalog, "rename column", meta.name, query,
-                    lambda s=spec: _rename_column(session, meta, s.name, s.new_name))
+                    lambda s=spec, q=query: _rename_column(
+                        session, meta, s.name, s.new_name, q))
         elif action == "add_index":
             idx = spec.index
             if getattr(idx, "primary", False):
@@ -157,7 +158,19 @@ def _set_columnar_replica(session, meta, count: int):
         raise DDLError(str(exc)) from exc
 
 
-def _add_column(session, meta, spec: A.AlterTableSpec):
+def _propose_schema(session, meta, op: str, query: str) -> None:
+    """A row-shape DDL just committed: ride a schema-change entry
+    through the replication log so every live changefeed sees the ALTER
+    as an ORDERED event between the rows committed before and after it
+    (ISSUE 20 — the pre-20 behavior let feeds discover the drift and
+    park). Mirror/bare stores without the propose hook have no feeds to
+    inform."""
+    propose = getattr(session.store, "propose_schema_change", None)
+    if propose is not None:
+        propose(meta, op, query)
+
+
+def _add_column(session, meta, spec: A.AlterTableSpec, query: str = ""):
     cd = spec.column
     name = cd.name.lower()
     if any(c.name == name for c in meta.columns):
@@ -189,11 +202,12 @@ def _add_column(session, meta, spec: A.AlterTableSpec):
                     generated_stored=getattr(cd, "generated_stored", False),
                     decl=decl_text(cd.type))
     meta.columns.insert(pos, cm)
-    meta.schema_version += 1  # row-shape change: changefeeds park on drift
+    meta.schema_version += 1  # row-shape change: replicated through the feed
     session.catalog.version += 1
+    _propose_schema(session, meta, "add column", query)
 
 
-def _drop_column(session, meta, name: str):
+def _drop_column(session, meta, name: str, query: str = ""):
     name = name.lower()
     if meta.handle_col == name:
         raise DDLError("cannot drop the PRIMARY KEY handle column")
@@ -208,11 +222,12 @@ def _drop_column(session, meta, name: str):
     meta.columns = [c for c in meta.columns if c.name != name]
     if len(meta.columns) == before:
         raise DDLError(f"unknown column {name!r}")
-    meta.schema_version += 1  # row-shape change: changefeeds park on drift
+    meta.schema_version += 1  # row-shape change: replicated through the feed
     session.catalog.version += 1
+    _propose_schema(session, meta, "drop column", query)
 
 
-def _modify_column(session, meta, spec: A.AlterTableSpec):
+def _modify_column(session, meta, spec: A.AlterTableSpec, query: str = ""):
     cd = spec.column
     old_name = (spec.name or cd.name).lower()
     cm = meta.col(old_name)
@@ -232,13 +247,14 @@ def _modify_column(session, meta, spec: A.AlterTableSpec):
         raise DDLError(f"column {cd.name.lower()!r} already exists")
     cm.ft = new_ft
     if renaming:
-        _rename_column(session, meta, old_name, cd.name)
+        _rename_column(session, meta, old_name, cd.name, query)
         return
-    meta.schema_version += 1  # row-shape change: changefeeds park on drift
+    meta.schema_version += 1  # row-shape change: replicated through the feed
     session.catalog.version += 1
+    _propose_schema(session, meta, "modify column", query)
 
 
-def _rename_column(session, meta, old: str, new: str):
+def _rename_column(session, meta, old: str, new: str, query: str = ""):
     old, new = old.lower(), new.lower()
     if any(c.name == new for c in meta.columns):
         raise DDLError(f"column {new!r} already exists")
@@ -250,8 +266,9 @@ def _rename_column(session, meta, old: str, new: str):
         meta.handle_col = new
     if meta.partition is not None and meta.partition.col == old:
         meta.partition.col = new
-    meta.schema_version += 1  # row-shape change: changefeeds park on drift
+    meta.schema_version += 1  # row-shape change: replicated through the feed
     session.catalog.version += 1
+    _propose_schema(session, meta, "rename column", query)
 
 
 def _rename_table(catalog: Catalog, meta, new_name: str):

@@ -1133,21 +1133,42 @@ class Session:
             except TxnError as exc:
                 raise SQLError(str(exc)) from exc
         if isinstance(stmt, A.BRIEStmt):
+            from ..br import LogGapError, restore_until, start_log_backup, stop_log_backup
+            from ..cdc import ChangefeedError
             from ..store.txn import TxnError
             from ..tools import backup, restore
 
             self._implicit_commit()
             try:
+                if stmt.kind == "backup_log":
+                    lb = start_log_backup(self.store, self.catalog, stmt.storage)
+                    row = [Datum.string(stmt.storage), Datum.string(lb.feed_name),
+                           Datum.i64(lb.start_ts)]
+                    return Result(columns=["Destination", "Changefeed", "StartTS"],
+                                  rows=[row])
+                if stmt.kind == "stop_backup_log":
+                    stop_log_backup(self.store, stmt.storage)
+                    return Result()
                 if stmt.kind == "backup":
                     m = backup(self.store, self.catalog, stmt.storage)
                     row = [Datum.string(stmt.storage), Datum.i64(m["total_keys"]), Datum.i64(m["snapshot_ts"])]
                     return Result(columns=["Destination", "Keys", "SnapshotTS"], rows=[row])
+                if stmt.until_ts is not None:
+                    info = restore_until(self.store, self.catalog, stmt.storage,
+                                         stmt.until_ts)
+                    row = [Datum.string(stmt.storage), Datum.i64(info["until_ts"]),
+                           Datum.i64(info["segments_replayed"]),
+                           Datum.i64(info["events_applied"])]
+                    return Result(columns=["Source", "UntilTS", "Segments", "Events"],
+                                  rows=[row])
                 info = restore(self.store, self.catalog, stmt.storage)
                 row = [Datum.string(stmt.storage), Datum.i64(info["keys"]), Datum.i64(info["tables"])]
                 return Result(columns=["Source", "Keys", "Tables"], rows=[row])
-            except TxnError as exc:
-                # RESTORE's bulk_ingest hits a held lock: a typed SQL
-                # error, not an engine stack (vet dataflow-error-escape)
+            except (TxnError, LogGapError, ChangefeedError, ValueError) as exc:
+                # RESTORE's bulk_ingest hits a held lock, a PITR coverage
+                # gap, a duplicate/unknown log backup, a table collision:
+                # every failure is a typed SQL error, never a raw Python
+                # stack (vet dataflow-error-escape)
                 raise SQLError(str(exc)) from exc
         if isinstance(stmt, A.AlterTableStmt):
             from .ddl import DDLError, alter_table
@@ -3561,6 +3582,25 @@ class Session:
             return Result(
                 columns=["Changefeed", "State", "Sink", "Start_ts", "Checkpoint_ts",
                          "Resolved_lag", "Pending", "Emitted", "Skipped", "Error"],
+                rows=rows,
+            )
+        if kind == "backup_logs":
+            # SHOW BACKUP LOGS (ISSUE 20; ref: `br log status`): one row
+            # per attached log backup with its durable checkpoint chain
+            from ..br import log_backup_views
+
+            rows = [
+                [
+                    Datum.string(v["destination"]), Datum.string(v["changefeed"]),
+                    Datum.string(v["state"]), Datum.i64(v["start_ts"]),
+                    Datum.i64(v["checkpoint_ts"]), Datum.i64(v["resolved_lag"]),
+                    Datum.i64(v["segments"]), Datum.i64(v["events"]),
+                ]
+                for v in log_backup_views(self.store)
+            ]
+            return Result(
+                columns=["Destination", "Changefeed", "State", "Start_ts",
+                         "Checkpoint_ts", "Resolved_lag", "Segments", "Events"],
                 rows=rows,
             )
         if kind == "status":

@@ -239,6 +239,15 @@ class TPUStore:
         from ..server.coalesce import SessionCoalescer
 
         self.coalescer = SessionCoalescer(self)
+        # point-in-time recovery (ISSUE 20): the ordered store-level log
+        # of schema-change entries (the changefeed recovery source — they
+        # are synthetic, never in KV) and the attached log backups
+        # (dest uri -> br.pitr.LogBackup; GIL-atomic dict ops, written by
+        # BACKUP LOG / stop, read by the pd.pitr tick)
+        from ..cdc.schema import SchemaJournal
+
+        self.schema_journal = SchemaJournal()
+        self.log_backups: dict = {}
 
     # -- store fault switches (chaos/testing; ref: failpoint-driven store
     # outages in the reference's integration suites) ------------------------
@@ -457,6 +466,28 @@ class TPUStore:
             prev = self.kv.put(key, value, ts)
             self._record_write_flow(key, value, prev, ts, placement=placement)
         self._bump_write_ver()
+
+    def propose_schema_change(self, meta, op: str, query: str) -> int:
+        """One committed row-shape DDL -> one schema-change entry riding
+        `ReplicaManager.propose` (ISSUE 20: DDL through the feed). The
+        key is synthetic (`m_schema_<tid>_<ver>`, never in KV); the ts
+        draws INSIDE the CDC WriteGuard so no resolved-ts candidate can
+        prove quiescence past an undelivered schema change — exactly the
+        row write paths' ordering guarantee. The journal records it
+        first: a feed that misses the live delivery (paused, born later,
+        puller-drop) re-injects from the journal on its next tick."""
+        from ..cdc.schema import encode_schema_key, schema_payload
+        import json as _json
+
+        key = encode_schema_key(meta.table_id, meta.schema_version)
+        value = _json.dumps(schema_payload(meta, op, query)).encode()
+        with self.cdc.guard.writing():
+            ts = self.next_ts()
+            self.schema_journal.append(ts, meta.table_id, key, value)
+            rid = self.cluster.locate_placement(
+                tablecodec.table_prefix(meta.table_id))[0]
+            self.replication.propose(rid, ts, entries=[(key, value)])
+        return ts
 
     # -- scan/decode with caching -------------------------------------------
     def region_chunk(self, region: Region, ranges: list, dag: DAGRequest, start_ts: int) -> Chunk:

@@ -154,16 +154,23 @@ def backup(store, catalog, dest_dir: str) -> dict:
         buf = bytearray()
         count = 0
 
-    for key, val in store.kv.scan(b"", b"\xff" * 40, ts):
-        # live values only: kv.scan filters tombstones, so the format has
-        # no delete representation (a full backup needs none)
-        buf += struct.pack("<I", len(key)) + key
-        buf += struct.pack("<I", len(val)) + val
-        count += 1
-        n_keys += 1
-        if count >= SEGMENT_KEYS:
-            flush()
-    flush()
+    # pin the snapshot while copying: a concurrent GC pass must not
+    # collect versions the backup's read view still needs (ISSUE 20
+    # satellite — the unpinned ts let run_gc race the scan)
+    store.register_snapshot(ts)
+    try:
+        for key, val in store.kv.scan(b"", b"\xff" * 40, ts):
+            # live values only: kv.scan filters tombstones, so the format
+            # has no delete representation (a full backup needs none)
+            buf += struct.pack("<I", len(key)) + key
+            buf += struct.pack("<I", len(val)) + val
+            count += 1
+            n_keys += 1
+            if count >= SEGMENT_KEYS:
+                flush()
+        flush()
+    finally:
+        store.unregister_snapshot(ts)
     manifest = {
         "snapshot_ts": ts,
         "total_keys": n_keys,
@@ -236,25 +243,34 @@ def restore(store, catalog, src_dir: str) -> dict:
     # bulk_ingest's own writing() bracket — it is a plain counter)
     with store.cdc.guard.writing():
         ts = store.next_ts()
-        for seg in manifest["segments"]:
-            data = open(os.path.join(src_dir, seg["file"]), "rb").read()
-            if hashlib.sha256(data).hexdigest() != seg["sha256"]:
-                raise ValueError(f"restore: checksum mismatch in {seg['file']}")
-            pos = 0
-            batch = []
-            for _ in range(seg["keys"]):
-                (klen,) = struct.unpack_from("<I", data, pos)
-                pos += 4
-                key = data[pos : pos + klen]
-                pos += klen
-                (vlen,) = struct.unpack_from("<I", data, pos)
-                pos += 4
-                val = data[pos : pos + vlen]
-                pos += vlen
-                batch.append((bytes(key), bytes(val)))
-            # restore must not overwrite keys locked by an in-flight 2PC:
-            # lock-check + apply in one engine critical section (ADVICE r2)
-            store.txn.bulk_ingest(batch, ts)
-            n += len(batch)
+        # pin the ingest ts while copying (released on completion OR
+        # failure): a GC pass racing a half-done restore must not collect
+        # at or above the versions still being written (ISSUE 20
+        # satellite)
+        store.register_snapshot(ts)
+        try:
+            for seg in manifest["segments"]:
+                data = open(os.path.join(src_dir, seg["file"]), "rb").read()
+                if hashlib.sha256(data).hexdigest() != seg["sha256"]:
+                    raise ValueError(f"restore: checksum mismatch in {seg['file']}")
+                pos = 0
+                batch = []
+                for _ in range(seg["keys"]):
+                    (klen,) = struct.unpack_from("<I", data, pos)
+                    pos += 4
+                    key = data[pos : pos + klen]
+                    pos += klen
+                    (vlen,) = struct.unpack_from("<I", data, pos)
+                    pos += 4
+                    val = data[pos : pos + vlen]
+                    pos += vlen
+                    batch.append((bytes(key), bytes(val)))
+                # restore must not overwrite keys locked by an in-flight
+                # 2PC: lock-check + apply in one engine critical section
+                # (ADVICE r2)
+                store.txn.bulk_ingest(batch, ts)
+                n += len(batch)
+        finally:
+            store.unregister_snapshot(ts)
     store._bump_write_ver()
     return {"tables": len(manifest["schema"]), "keys": n, "snapshot_ts": manifest["snapshot_ts"]}

@@ -410,6 +410,10 @@ CDC_SINK_FLUSH = REGISTRY.histogram(
     "tidb_tpu_cdc_sink_flush_seconds", "sink write+flush latency per changefeed tick")
 CDC_RECOVERY_SCANS = REGISTRY.counter(
     "tidb_tpu_cdc_recovery_scans_total", "incremental re-scans after a lost subscription, pause resume, or changefeed birth")
+CDC_SCHEMA_EVENTS = REGISTRY.counter(
+    "tidb_tpu_cdc_schema_events_total", "schema-change entries replicated through changefeeds as ordered DDL events (ISSUE 20)")
+CDC_SCHEMA_DRIFT_LEGACY = REGISTRY.counter(
+    "tidb_tpu_cdc_schema_drift_legacy_total", "rows the tracked snapshot could not decode, re-decoded against the live catalog (the counted legacy drift fallback)")
 
 # HTAP columnar replica (tidb_tpu/columnar) — the TiFlash-analog tier
 # (ref: tiflash_* metrics: apply throughput, delta compaction counts, the
@@ -426,6 +430,34 @@ COLUMNAR_RESOLVED_LAG = REGISTRY.gauge_vec(
     "tidb_tpu_columnar_resolved_ts_lag", "latest commit watermark minus the replica's applied resolved frontier, per table (ts units)",
     labelnames=("table",),
 )
+COLUMNAR_RESHAPES = REGISTRY.counter(
+    "tidb_tpu_columnar_reshapes_total", "mid-feed ALTERs applied to columnar replicas by col_id remap (zero parks; ISSUE 20)")
+
+# point-in-time recovery (tidb_tpu/br; ISSUE 20) — the log-backup stream
+# and replay-to-ts restore families (ref: BR's br_log_backup_* /
+# tikv_log_backup_* checkpoint and flush metrics)
+LOG_BACKUP_SEGMENTS = REGISTRY.counter(
+    "tidb_tpu_log_backup_segments_total", "atomic log-backup segments committed (write-temp + fsync + rename)")
+LOG_BACKUP_EVENTS = REGISTRY.counter(
+    "tidb_tpu_log_backup_events_total", "raw KV change records persisted into log-backup segments")
+LOG_BACKUP_CHECKPOINT_TS = REGISTRY.gauge_vec(
+    "tidb_tpu_log_backup_checkpoint_ts", "the log backup's durable manifest checkpoint (every commit at or below it is restorable)",
+    labelnames=("changefeed",),
+)
+LOG_BACKUP_LAG = REGISTRY.gauge_vec(
+    "tidb_tpu_log_backup_resolved_lag", "latest commit watermark minus the log backup's durable checkpoint (ts units)",
+    labelnames=("changefeed",),
+)
+PITR_RESTORES = REGISTRY.counter(
+    "tidb_tpu_pitr_restores_total", "RESTORE ... UNTIL TS runs that completed (full backup + log replay)")
+PITR_SEGMENTS_REPLAYED = REGISTRY.counter(
+    "tidb_tpu_pitr_segments_replayed_total", "log segments replayed into a restore target")
+PITR_REPLAYED_EVENTS = REGISTRY.counter(
+    "tidb_tpu_pitr_replayed_events_total", "KV and schema records applied during log replay")
+PITR_LOG_GAPS = REGISTRY.counter(
+    "tidb_tpu_pitr_log_gaps_total", "restores refused with a typed LogGapError (missing/corrupt segment, broken chain, short log)")
+PITR_REPLAY_RESUMES = REGISTRY.counter(
+    "tidb_tpu_pitr_replay_resumes_total", "restores that resumed from a per-segment checkpoint after a mid-replay crash")
 
 # mpp exchange data plane (ISSUE 18; ref: tiflash_coprocessor_* mpp task
 # metrics and the mpp_gather dispatch counters)

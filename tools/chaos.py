@@ -55,6 +55,9 @@ FAULT_POINTS = (
     "columnar/compact-stall",
     "mpp/dispatch-lost",
     "mpp/exchange-stall",
+    "cdc/segment-crash",
+    "restore/replay-crash",
+    "br/log-gap",
 )
 
 
@@ -345,7 +348,9 @@ class CheckingSink:
 
     def write(self, events):
         for ev in events:
-            k = (ev.table, ev.handle)
+            # schema events ride the stream handle-less (ISSUE 20): they
+            # share the per-table ordering lane and the resolved gate
+            k = (ev.table, getattr(ev, "handle", "<schema>"))
             if ev.commit_ts <= self.resolved:
                 self.violations.append(
                     f"event {k} at {ev.commit_ts} at/below flushed resolved {self.resolved}")
@@ -878,9 +883,266 @@ def run_mpp_storm(seed: int = 17, statements: int = 160,
     }
 
 
+# --------------------------------------------------- the PITR storm phase
+# (ISSUE 20 acceptance: a log backup and a mirror replay feed ride the
+# same storm of DML + mid-feed DDL + splits/transfers/outage + cdc/*
+# failpoints; three mid-storm restore points must come back byte-identical
+# to live oracle snapshots, a kill-mid-flush must cost nothing, a
+# mid-replay crash must resume idempotently, and a manifest gap must fail
+# as the typed LogGapError — never a silently-short cluster)
+
+
+def build_pitr_workload(seed: int, n: int) -> list[str]:
+    """The CDC write mix with EXPLICIT column lists, so the mid-storm
+    `ADD COLUMN` DDLs never invalidate a later INSERT's shape."""
+    rng = random.Random(seed * 7 + 3)
+    reads = build_workload(seed, n)
+    out = []
+    next_id = TID_ROWS
+    for i in range(n):
+        t = rng.randrange(8)
+        if t in (0, 1):
+            out.append("INSERT INTO chaos_t (id, v, g) VALUES "
+                       f"({next_id},{rng.randrange(100)},{next_id % 6})")
+            next_id += 1
+        elif t == 2:
+            out.append(f"UPDATE chaos_t SET v = {rng.randrange(100)} WHERE id = {rng.randrange(next_id)}")
+        elif t == 3:
+            out.append(f"DELETE FROM chaos_t WHERE id = {rng.randrange(next_id)}")
+        elif t == 4:
+            out.append(f"UPDATE chaos_d SET name = 'g{rng.randrange(100)}' WHERE g = {rng.randrange(6)}")
+        else:
+            out.append(reads[i])
+    return out
+
+
+def pitr_schedule(n: int) -> dict[int, list[tuple]]:
+    """Topology churn + the cdc/* points + three mid-feed DDLs (the
+    zero-parks acceptance) + one kill-mid-flush, with a clean tail."""
+    def at(frac: float) -> int:
+        return max(int(n * frac), 1)
+
+    sched: dict[int, list[tuple]] = {}
+
+    def add(i, *action):
+        sched.setdefault(i, []).append(tuple(action))
+
+    add(at(0.06), "split")
+    add(at(0.10), "ddl", "ALTER TABLE chaos_t ADD COLUMN note BIGINT DEFAULT 7")
+    add(at(0.14), "arm", "cdc/sink-stall", True)
+    add(at(0.20), "disarm", "cdc/sink-stall")
+    add(at(0.22), "transfer")
+    add(at(0.28), "arm", "cdc/segment-crash", 1)  # one flush dies between
+    add(at(0.32), "resume_log")  # write and rename; RESUME redelivers the
+    add(at(0.36), "ddl",  # window — exactly one durable copy may land
+        "ALTER TABLE chaos_d ADD COLUMN tag BIGINT DEFAULT 1")
+    add(at(0.40), "down", 1)
+    add(at(0.48), "up", 1)
+    add(at(0.56), "arm", "cdc/resolved-stuck", True)
+    add(at(0.62), "disarm", "cdc/resolved-stuck")
+    add(at(0.66), "ddl", "ALTER TABLE chaos_d CHANGE COLUMN tag tag2 BIGINT")
+    add(at(0.70), "merge")
+    add(at(0.74), "transfer")
+    # past at(0.74): clean tail — checkpoint must pass the last commit
+    return sched
+
+
+def run_pitr_storm(seed: int = 19, statements: int = 160,
+                   tick_every: int = 6) -> dict:
+    """The PITR chaos acceptance (ISSUE 20). One full backup + a log
+    backup attach before the storm; a mirror replay feed (CheckingSink
+    ordering oracle) rides the same stream so the mid-feed DDLs prove
+    zero parks. Three restore points are snapshotted mid-storm; after the
+    drain each is restored into a fresh cluster and compared row-for-row
+    (the middle one through a mid-replay crash + resume)."""
+    from tidb_tpu.br import (LogGapError, ReplayInterrupted, log_backup_views,
+                             restore_until)
+    from tidb_tpu.cdc import SessionReplaySink
+    from tidb_tpu.sql.session import Session, SQLError
+    from tidb_tpu.util import failpoint as fp
+    from tidb_tpu.util import metrics
+    import shutil
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="pitr-storm-")
+    sess = _fill_session(split_regions=True)
+    mirror = Session()
+    mirror.execute("CREATE TABLE chaos_t (id BIGINT PRIMARY KEY, v BIGINT, g BIGINT)")
+    mirror.execute("CREATE TABLE chaos_d (g BIGINT PRIMARY KEY, name VARCHAR(16))")
+    tid = sess.catalog.table("chaos_t").table_id
+    did = sess.catalog.table("chaos_d").table_id
+    sink = CheckingSink(SessionReplaySink(mirror))
+    feed = sess.store.cdc.create("pitr-mirror", sink, sess.catalog,
+                                 table_ids={tid, did}, start_ts=0)
+    sess.execute(f"BACKUP DATABASE * TO '{os.path.join(root, 'full', 'b0')}'")
+    sess.execute(f"BACKUP LOG TO 'file://{root}'")
+    lb = next(iter(sess.store.log_backups.values()))
+
+    workload = build_pitr_workload(seed, statements)
+    schedule = pitr_schedule(statements)
+    capture_at = {max(int(statements * f), 1) for f in (0.25, 0.52, 0.80)}
+    restore_points: list = []  # [(ts, rows_t, rows_d)]
+    ok = typed = ddls = 0
+    untyped: list = []
+    drift0 = metrics.CDC_SCHEMA_DRIFT_LEGACY.value
+    schema0 = metrics.CDC_SCHEMA_EVENTS.value
+
+    def snap(s):
+        return (s.execute("SELECT * FROM chaos_t ORDER BY 1").values(),
+                s.execute("SELECT * FROM chaos_d ORDER BY 1").values())
+
+    def apply_pitr(actions):
+        nonlocal ddls
+        for action in actions:
+            if action[0] == "ddl":
+                sess.execute(action[1])
+                ddls += 1
+            elif action[0] == "resume_log":
+                fp.disable("cdc/segment-crash")
+                sess.store.cdc.resume(lb.feed_name)
+            else:
+                _apply_cdc([action], sess, fp, tid)
+
+    try:
+        for i, sql in enumerate(workload):
+            apply_pitr(schedule.get(i, ()))
+            try:
+                sess.execute(sql)
+                ok += 1
+            except SQLError as exc:
+                if getattr(exc, "code", 0) in (9005, 1105, 3024, 1317):
+                    typed += 1
+                else:
+                    untyped.append({"stmt": i, "sql": sql, "error": str(exc)[:200]})
+            except Exception as exc:  # noqa: BLE001 — the bug class we hunt
+                untyped.append({"stmt": i, "sql": sql,
+                                "error": f"{type(exc).__name__}: {str(exc)[:200]}"})
+            if (i + 1) % tick_every == 0:
+                sess.store.pd.tick()
+            if i in capture_at:
+                # a restore point: the next fresh ts covers exactly the
+                # commits so far (single-threaded, so this read IS the
+                # snapshot the restored cluster must reproduce)
+                ts = sess.store.next_ts()
+                rows_t, rows_d = snap(sess)
+                restore_points.append((ts, rows_t, rows_d))
+    finally:
+        for name in FAULT_POINTS:
+            fp.disable(name)
+        for sid in range(N_STORES):
+            sess.store.set_up(sid)
+    # drain: the log checkpoint must pass the last commit so every
+    # restore point is provably covered; the mirror must converge too
+    sess.store.cdc.resume(lb.feed_name)
+    last_commit = sess.store.kv.max_committed()
+    for _ in range(16):
+        sess.store.pd.tick()
+        if (lb.sink.checkpoint_ts >= last_commit
+                and feed.view(sess.store)["pending"] == 0
+                and feed.view(sess.store)["checkpoint_ts"] >= last_commit):
+            break
+    lb_view = log_backup_views(sess.store)[0]
+
+    # no duplicate events may have survived the kill-mid-flush redelivery
+    kv_seen: set = set()
+    duplicate_log_events = 0
+    for rec in lb.sink.writer.read_records():
+        if rec.get("t") != "kv":
+            continue
+        rk = (rec["k"], rec["ts"])
+        if rk in kv_seen:
+            duplicate_log_events += 1
+        kv_seen.add(rk)
+
+    # the three restores: fresh cluster each, byte-identical to its
+    # oracle snapshot; the middle one crashes mid-replay and resumes
+    restores: list = []
+    resumed_ok = False
+    for idx, (ts, rows_t, rows_d) in enumerate(restore_points):
+        r = Session()
+        if idx == 1:
+            fp.enable("restore/replay-crash", 1)
+            crashed = False
+            try:
+                restore_until(r.store, r.catalog, root, ts)
+            except ReplayInterrupted:
+                crashed = True
+            finally:
+                fp.disable("restore/replay-crash")
+            rep = restore_until(r.store, r.catalog, root, ts)
+            resumed_ok = crashed and bool(rep["resumed"])
+        else:
+            r.execute(f"RESTORE DATABASE * FROM '{root}' UNTIL TS = {ts}")
+        got_t, got_d = snap(r)
+        restores.append({
+            "until_ts": ts,
+            "chaos_t_equal": got_t == rows_t,
+            "chaos_d_equal": got_d == rows_d,
+            "rows": len(got_t),
+        })
+
+    # the gap drill: drop a manifest link — the restore MUST fail typed
+    gap_typed = False
+    gap_sess = Session()
+    fp.enable("br/log-gap", 1)
+    try:
+        restore_until(gap_sess.store, gap_sess.catalog, root, restore_points[-1][0])
+    except LogGapError as exc:
+        gap_typed = exc.covered_ts < exc.target_ts
+    except Exception:  # noqa: BLE001 — anything else fails the gate
+        gap_typed = False
+    finally:
+        fp.disable("br/log-gap")
+
+    report = {
+        "seed": seed,
+        "statements": statements,
+        "ok": ok,
+        "typed_errors": typed,
+        "untyped_errors": untyped,
+        "ddls": ddls,
+        "schema_events": int(metrics.CDC_SCHEMA_EVENTS.value - schema0),
+        "drift_legacy_fallbacks": int(metrics.CDC_SCHEMA_DRIFT_LEGACY.value - drift0),
+        "ordering_violations": sink.violations,
+        "mirror_feed_state": feed.view(sess.store)["state"],
+        "log_backup": lb_view,
+        "duplicate_log_events": duplicate_log_events,
+        "restores": restores,
+        "replay_crash_resumed": resumed_ok,
+        "log_gap_typed": gap_typed,
+        "mirror_equal": {
+            "chaos_t": snap(sess)[0] == snap(mirror)[0],
+            "chaos_d": snap(sess)[1] == snap(mirror)[1],
+        },
+    }
+    shutil.rmtree(root, ignore_errors=True)
+    return report
+
+
+def pitr_storm_bad(report: dict):
+    """The CHAOS_PITR gate, shared with tests/test_pitr.py: truthy iff
+    any acceptance invariant broke."""
+    return (report["untyped_errors"] or report["ordering_violations"]
+            or report["drift_legacy_fallbacks"]
+            or report["mirror_feed_state"] != "normal"
+            or report["log_backup"]["state"] != "normal"
+            or report["duplicate_log_events"]
+            or not all(r["chaos_t_equal"] and r["chaos_d_equal"]
+                       for r in report["restores"])
+            or len(report["restores"]) != 3
+            or not report["replay_crash_resumed"]
+            or not report["log_gap_typed"]
+            or report["ddls"] < 3 or report["schema_events"] < 3
+            or not all(report["mirror_equal"].values()))
+
+
 def main():
     seed = int(sys.argv[1]) if len(sys.argv) > 1 else 7
     n = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    if os.environ.get("CHAOS_PITR"):
+        report = run_pitr_storm(seed if len(sys.argv) > 1 else 19, n)
+        print(json.dumps(report, indent=2, default=str))
+        sys.exit(1 if pitr_storm_bad(report) else 0)
     if os.environ.get("CHAOS_MPP"):
         report = run_mpp_storm(seed if len(sys.argv) > 1 else 17, n)
         print(json.dumps(report, indent=2, default=str))
